@@ -1,0 +1,35 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of the Eclipse Deeplearning4j capability surface
+(reference: /root/reference) for TPU hardware:
+
+- ``ndarray``   : eager NDArray API (reference: nd4j INDArray/Nd4j,
+  nd4j-api org.nd4j.linalg) backed by jax.Array — every op is an XLA
+  computation rather than a hand-written CUDA/C++ kernel.
+- ``ops``       : named-op registry (reference: libnd4j declarable ops +
+  legacy op families, libnd4j/include/ops & loops/legacy_ops.h) emitted
+  as jax/lax compositions that XLA fuses and tiles onto the MXU.
+- ``autodiff``  : SameDiff-equivalent define-then-run graph (reference:
+  org.nd4j.autodiff.samediff.SameDiff) that lowers whole training steps
+  (forward + backward + fused updater) into ONE compiled XLA computation.
+- ``nn``        : layer-based network API (reference: deeplearning4j-nn
+  MultiLayerNetwork / NeuralNetConfiguration) compiled through the graph
+  layer — there is a single execution path.
+- ``learning``  : gradient updaters + LR schedules (reference:
+  org.nd4j.linalg.learning).
+- ``dataset``/``datavec`` : data pipeline (reference: datavec +
+  org.nd4j.linalg.dataset).
+- ``evaluation``: metrics (reference: org.nd4j.evaluation).
+- ``parallel``  : device-mesh parallelism — DP/TP/PP/sequence parallel via
+  jax.sharding + XLA collectives over ICI/DCN (new first-class capability;
+  the reference's distributed modules were removed upstream).
+- ``models``    : model zoo (reference: deeplearning4j-zoo).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray import factory as nd
+
+__all__ = ["DataType", "NDArray", "nd", "__version__"]

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.ndarray.dtype import DataType, default_float, set_default_float
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+__all__ = ["DataType", "NDArray", "default_float", "set_default_float"]

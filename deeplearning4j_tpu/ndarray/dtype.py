@@ -1,0 +1,106 @@
+"""Data type system.
+
+Reference parity: libnd4j/include/array/DataType.h (dtype enum bool..utf8) and
+org.nd4j.linalg.api.buffer.DataType. UTF8/compressed types are represented at
+the framework level only (numpy object arrays are host-side); device dtypes map
+onto XLA element types. BFLOAT16 is first-class on TPU (MXU-native).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    # name -> (jnp dtype or None for host-only types)
+    BOOL = "bool"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+    UTF8 = "utf8"  # host-only
+
+    # ------------------------------------------------------------------
+    @property
+    def jnp(self):
+        if self is DataType.UTF8:
+            raise TypeError("UTF8 is a host-only data type")
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self):
+        if self is DataType.UTF8:
+            return np.dtype(object)
+        return np.dtype(self.value)
+
+    # reference: DataType.isFPType / isIntType / width()
+    def is_fp(self) -> bool:
+        return self in (DataType.HALF, DataType.BFLOAT16, DataType.FLOAT, DataType.DOUBLE)
+
+    def is_int(self) -> bool:
+        return self in (
+            DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
+            DataType.UINT8, DataType.UINT16, DataType.UINT32, DataType.UINT64,
+        )
+
+    def is_signed(self) -> bool:
+        return self.is_fp() or self in (
+            DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64)
+
+    def width(self) -> int:
+        """Bytes per element."""
+        if self is DataType.UTF8:
+            return 0
+        return self.np.itemsize
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_any(x) -> "DataType":
+        if isinstance(x, DataType):
+            return x
+        if isinstance(x, str):
+            s = x.lower()
+            alias = {
+                "float": "float32", "double": "float64", "half": "float16",
+                "long": "int64", "int": "int32", "short": "int16", "byte": "int8",
+                "ubyte": "uint8",
+            }
+            s = alias.get(s, s)
+            for dt in DataType:
+                if dt.value == s or dt.name.lower() == x.lower():
+                    return dt
+            raise ValueError(f"Unknown data type: {x}")
+        # numpy / jax dtype objects
+        name = np.dtype(x).name
+        for dt in DataType:
+            if dt.value == name:
+                return dt
+        raise ValueError(f"Unknown data type: {x}")
+
+
+# Global default dtype — reference: Nd4j.defaultFloatingPointType() /
+# ND4JSystemProperties "dtype". On TPU we keep float32 as the default user
+# dtype; matmul-heavy paths downcast to bfloat16 where configured.
+_DEFAULT_FLOAT = DataType.FLOAT
+
+
+def default_float() -> DataType:
+    return _DEFAULT_FLOAT
+
+
+def set_default_float(dt) -> None:
+    global _DEFAULT_FLOAT
+    dt = DataType.from_any(dt)
+    if not dt.is_fp():
+        raise ValueError("default float type must be a floating point type")
+    _DEFAULT_FLOAT = dt

@@ -1,0 +1,468 @@
+"""Eager NDArray — the INDArray equivalent.
+
+Reference parity: org.nd4j.linalg.api.ndarray.INDArray (interface,
+nd4j-api .../api/ndarray/INDArray.java) and BaseNDArray.java. The reference
+implements views as (offset, stride) aliases over a shared DataBuffer and
+mutates in place; XLA has value semantics, so this class maps the same user
+API onto functional updates:
+
+- A *view* stores its parent plus a (gather, scatter) lens pair. Reads walk
+  up to the owning array's current buffer; in-place writes scatter back
+  through the chain (``x[1:3].addi(1)`` updates ``x``, like the reference).
+- In-place ops on an owner simply rebind the underlying ``jax.Array``.
+  Live views see the update because reads are routed through the owner.
+
+This gives reference-compatible aliasing behaviour while every actual
+computation stays a pure XLA op (fusable, donation-friendly). Hot paths
+(training loops) do not use this class at all — they run through the graph
+layer (autodiff/) which compiles whole steps; NDArray is the imperative
+convenience layer, like INDArray was for nd4j users.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.dtype import DataType, default_float
+
+Number = Union[int, float, bool]
+
+
+def _as_jax(values, dtype=None):
+    if isinstance(values, NDArray):
+        arr = values.data
+        return arr.astype(dtype) if dtype is not None and arr.dtype != dtype else arr
+    if isinstance(values, (jnp.ndarray, jax.Array)):
+        return values if dtype is None else values.astype(dtype)
+    return jnp.asarray(values, dtype=dtype)
+
+
+class NDArray:
+    """Dense n-dimensional tensor handle over a ``jax.Array``."""
+
+    __slots__ = ("_data", "_base", "_gather", "_scatter")
+
+    def __init__(self, data, dtype=None, _base: Optional["NDArray"] = None,
+                 _gather: Optional[Callable] = None,
+                 _scatter: Optional[Callable] = None):
+        if _base is not None:
+            self._data = None
+            self._base = _base
+            self._gather = _gather
+            self._scatter = _scatter
+        else:
+            if dtype is not None:
+                dtype = DataType.from_any(dtype).jnp
+            self._data = _as_jax(data, dtype)
+            self._base = None
+            self._gather = None
+            self._scatter = None
+
+    # ------------------------------------------------------------------
+    # buffer plumbing
+    # ------------------------------------------------------------------
+    @property
+    def data(self) -> jax.Array:
+        """Current value as a jax.Array (pure; views re-gather from owner)."""
+        if self._base is None:
+            return self._data
+        return self._gather(self._base.data)
+
+    def _set_data(self, new: jax.Array) -> None:
+        """Functional write-through: scatter into the owning buffer."""
+        if self._base is None:
+            self._data = new
+        else:
+            self._base._set_data(self._scatter(self._base.data, new))
+
+    def is_view(self) -> bool:
+        return self._base is not None
+
+    def _view(self, gather: Callable, scatter: Callable) -> "NDArray":
+        return NDArray(None, _base=self, _gather=gather, _scatter=scatter)
+
+    # ------------------------------------------------------------------
+    # basic properties  (reference: INDArray.shape()/rank()/length()/...)
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def rank(self) -> int:
+        return self.data.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    @property
+    def length(self) -> int:
+        return int(self.data.size)
+
+    @property
+    def size_total(self) -> int:
+        return int(self.data.size)
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    @property
+    def dtype(self) -> DataType:
+        return DataType.from_any(self.data.dtype.name)
+
+    @property
+    def rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def columns(self) -> int:
+        return self.shape[1]
+
+    def is_scalar(self) -> bool:
+        return self.rank == 0 or self.length == 1
+
+    def is_vector(self) -> bool:
+        return self.rank == 1 or (self.rank == 2 and 1 in self.shape)
+
+    def is_matrix(self) -> bool:
+        return self.rank == 2
+
+    def is_empty(self) -> bool:
+        return self.length == 0
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def item(self) -> Number:
+        return self.data.reshape(()).item() if self.length == 1 else self._scalar_err()
+
+    def _scalar_err(self):
+        raise ValueError(f"Array with shape {self.shape} is not a scalar")
+
+    def get_double(self, *indices) -> float:
+        return float(self.data[tuple(indices)]) if indices else float(self.item())
+
+    def get_int(self, *indices) -> int:
+        return int(self.data[tuple(indices)]) if indices else int(self.item())
+
+    def cast_to(self, dtype) -> "NDArray":
+        return NDArray(self.data.astype(DataType.from_any(dtype).jnp))
+
+    astype = cast_to
+
+    def dup(self) -> "NDArray":
+        """Detached copy (reference: INDArray.dup())."""
+        return NDArray(jnp.asarray(self.data))
+
+    # ------------------------------------------------------------------
+    # indexing: basic indexing returns a write-through view
+    # ------------------------------------------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        basic = all(isinstance(i, (int, slice, type(Ellipsis), type(None))) for i in idx)
+        if basic:
+            gather = lambda d: d[idx]
+            scatter = lambda d, v: d.at[idx].set(v)
+            return self._view(gather, scatter)
+        # advanced indexing → copy (matches numpy; reference get(INDArrayIndex...)
+        # with NDArrayIndex.indices also copies)
+        jidx = tuple(_as_jax(i) if isinstance(i, (list, np.ndarray, NDArray)) else i
+                     for i in idx)
+        return NDArray(self.data[jidx])
+
+    def __setitem__(self, idx, value) -> None:
+        v = _as_jax(value)
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        jidx = tuple(_as_jax(i) if isinstance(i, (list, np.ndarray, NDArray)) else i
+                     for i in idx)
+        self._set_data(self.data.at[jidx].set(v.astype(self.data.dtype)))
+
+    def get_row(self, i: int) -> "NDArray":
+        return self[i]
+
+    def get_column(self, i: int) -> "NDArray":
+        return self[:, i]
+
+    def get_rows(self, rows: Sequence[int]) -> "NDArray":
+        return NDArray(self.data[jnp.asarray(list(rows))])
+
+    def get_columns(self, cols: Sequence[int]) -> "NDArray":
+        return NDArray(self.data[:, jnp.asarray(list(cols))])
+
+    def put_row(self, i: int, row) -> "NDArray":
+        self[i] = _as_jax(row)
+        return self
+
+    def put_column(self, i: int, col) -> "NDArray":
+        self[:, i] = _as_jax(col)
+        return self
+
+    def put_scalar(self, indices, value) -> "NDArray":
+        if isinstance(indices, int):
+            indices = (indices,)
+        self[tuple(indices)] = value
+        return self
+
+    def assign(self, other) -> "NDArray":
+        """In-place overwrite, broadcasting (reference: INDArray.assign)."""
+        v = _as_jax(other)
+        self._set_data(jnp.broadcast_to(v.astype(self.data.dtype), self.shape))
+        return self
+
+    # ------------------------------------------------------------------
+    # shape manipulation — views with write-through where the reference
+    # returns views (reshape/transpose/permute), copies elsewhere
+    # ------------------------------------------------------------------
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        old_shape = self.shape
+        gather = lambda d: d.reshape(shape)
+        scatter = lambda d, v: v.reshape(old_shape)
+        return self._view(gather, scatter)
+
+    def transpose(self) -> "NDArray":
+        axes = tuple(reversed(range(self.rank)))
+        return self.permute(*axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def permute(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        inv = tuple(np.argsort(axes))
+        gather = lambda d: jnp.transpose(d, axes)
+        scatter = lambda d, v: jnp.transpose(v, inv)
+        return self._view(gather, scatter)
+
+    def swap_axes(self, a: int, b: int) -> "NDArray":
+        axes = list(range(self.rank))
+        axes[a], axes[b] = axes[b], axes[a]
+        return self.permute(*axes)
+
+    def ravel(self) -> "NDArray":
+        return self.reshape(-1)
+
+    def flatten(self) -> "NDArray":
+        return NDArray(self.data.reshape(-1))
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self.data, axis))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self.data, axis))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return NDArray(jnp.broadcast_to(self.data, tuple(shape)))
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return NDArray(jnp.repeat(self.data, repeats, axis))
+
+    def tile(self, reps) -> "NDArray":
+        return NDArray(jnp.tile(self.data, reps))
+
+    # ------------------------------------------------------------------
+    # arithmetic — out-of-place + "i"-suffixed in-place (reference naming)
+    # ------------------------------------------------------------------
+    def _binary(self, other, fn) -> "NDArray":
+        return NDArray(fn(self.data, _as_jax(other)))
+
+    def _binary_i(self, other, fn) -> "NDArray":
+        self._set_data(fn(self.data, _as_jax(other)).astype(self.data.dtype))
+        return self
+
+    def add(self, o): return self._binary(o, jnp.add)
+    def sub(self, o): return self._binary(o, jnp.subtract)
+    def mul(self, o): return self._binary(o, jnp.multiply)
+    def div(self, o): return self._binary(o, jnp.divide)
+    def rsub(self, o): return self._binary(o, lambda a, b: b - a)
+    def rdiv(self, o): return self._binary(o, lambda a, b: b / a)
+    def pow(self, o): return self._binary(o, jnp.power)
+    def fmod(self, o): return self._binary(o, jnp.fmod)
+
+    def addi(self, o): return self._binary_i(o, jnp.add)
+    def subi(self, o): return self._binary_i(o, jnp.subtract)
+    def muli(self, o): return self._binary_i(o, jnp.multiply)
+    def divi(self, o): return self._binary_i(o, jnp.divide)
+    def rsubi(self, o): return self._binary_i(o, lambda a, b: b - a)
+    def rdivi(self, o): return self._binary_i(o, lambda a, b: b / a)
+    def powi(self, o): return self._binary_i(o, jnp.power)
+
+    def neg(self): return NDArray(-self.data)
+    def negi(self): self._set_data(-self.data); return self
+
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __pow__ = pow
+    __neg__ = neg
+    __mod__ = fmod
+
+    def __iadd__(self, o): return self.addi(o)
+    def __isub__(self, o): return self.subi(o)
+    def __imul__(self, o): return self.muli(o)
+    def __itruediv__(self, o): return self.divi(o)
+
+    # comparisons (reference: gt/lt/gte/lte/eq/neq return BOOL arrays)
+    def gt(self, o): return self._binary(o, jnp.greater)
+    def lt(self, o): return self._binary(o, jnp.less)
+    def gte(self, o): return self._binary(o, jnp.greater_equal)
+    def lte(self, o): return self._binary(o, jnp.less_equal)
+    def eq(self, o): return self._binary(o, jnp.equal)
+    def neq(self, o): return self._binary(o, jnp.not_equal)
+
+    __gt__ = gt
+    __lt__ = lt
+    __ge__ = gte
+    __le__ = lte
+
+    def equals(self, other, eps: float = 1e-5) -> bool:
+        """Value equality with epsilon (reference: BaseNDArray.equals)."""
+        if not isinstance(other, NDArray):
+            try:
+                other = NDArray(_as_jax(other))
+            except (TypeError, ValueError):
+                return False
+        if self.shape != other.shape:
+            return False
+        a, b = self.data, other.data
+        if self.dtype.is_fp() or other.dtype.is_fp():
+            return bool(jnp.all(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)) < eps))
+        return bool(jnp.all(a == b))
+
+    # ------------------------------------------------------------------
+    # matmul — rides the MXU
+    # ------------------------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        return NDArray(jnp.matmul(self.data, _as_jax(other)))
+
+    def mmuli(self, other, out: Optional["NDArray"] = None) -> "NDArray":
+        r = jnp.matmul(self.data, _as_jax(other))
+        if out is not None:
+            out._set_data(r.astype(out.data.dtype))
+            return out
+        self._set_data(r.astype(self.data.dtype))
+        return self
+
+    __matmul__ = mmul
+
+    def dot(self, other) -> "NDArray":
+        return NDArray(jnp.dot(self.data, _as_jax(other)))
+
+    def tensor_mmul(self, other, axes) -> "NDArray":
+        return NDArray(jnp.tensordot(self.data, _as_jax(other), axes=axes))
+
+    # ------------------------------------------------------------------
+    # reductions (reference: INDArray.sum/mean/... with dimension varargs)
+    # ------------------------------------------------------------------
+    def _reduce(self, fn, dims, keep_dims=False) -> "NDArray":
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        return NDArray(fn(self.data, axis=axis, keepdims=keep_dims))
+
+    def sum(self, *dims, keep_dims=False): return self._reduce(jnp.sum, dims, keep_dims)
+    def mean(self, *dims, keep_dims=False): return self._reduce(jnp.mean, dims, keep_dims)
+    def prod(self, *dims, keep_dims=False): return self._reduce(jnp.prod, dims, keep_dims)
+    def max(self, *dims, keep_dims=False): return self._reduce(jnp.max, dims, keep_dims)
+    def min(self, *dims, keep_dims=False): return self._reduce(jnp.min, dims, keep_dims)
+
+    def std(self, *dims, bias_corrected=True, keep_dims=False):
+        ddof = 1 if bias_corrected else 0
+        return self._reduce(
+            lambda d, axis, keepdims: jnp.std(d, axis=axis, ddof=ddof, keepdims=keepdims),
+            dims, keep_dims)
+
+    def var(self, *dims, bias_corrected=True, keep_dims=False):
+        ddof = 1 if bias_corrected else 0
+        return self._reduce(
+            lambda d, axis, keepdims: jnp.var(d, axis=axis, ddof=ddof, keepdims=keepdims),
+            dims, keep_dims)
+
+    def argmax(self, *dims):
+        ax = dims[0] if dims else None
+        return NDArray(jnp.argmax(self.data, axis=ax))
+
+    def argmin(self, *dims):
+        ax = dims[0] if dims else None
+        return NDArray(jnp.argmin(self.data, axis=ax))
+
+    def norm1(self, *dims): return self._reduce(lambda d, axis, keepdims: jnp.sum(jnp.abs(d), axis=axis, keepdims=keepdims), dims)
+    def norm2(self, *dims): return self._reduce(lambda d, axis, keepdims: jnp.sqrt(jnp.sum(d * d, axis=axis, keepdims=keepdims)), dims)
+    def normmax(self, *dims): return self._reduce(lambda d, axis, keepdims: jnp.max(jnp.abs(d), axis=axis, keepdims=keepdims), dims)
+
+    def cumsum(self, axis=None): return NDArray(jnp.cumsum(self.data, axis=axis))
+    def cumprod(self, axis=None): return NDArray(jnp.cumprod(self.data, axis=axis))
+
+    def entropy(self, *dims):
+        p = self.data
+        return self._reduce(lambda d, axis, keepdims: -jnp.sum(d * jnp.log(d), axis=axis, keepdims=keepdims), dims)
+
+    def scan_all(self) -> dict:
+        """Summary stats (reference: SummaryStats ops family)."""
+        d = self.data.astype(jnp.float32)
+        return {
+            "mean": float(jnp.mean(d)), "std": float(jnp.std(d, ddof=1) if d.size > 1 else 0.0),
+            "min": float(jnp.min(d)), "max": float(jnp.max(d)),
+            "nan": int(jnp.sum(jnp.isnan(d))), "inf": int(jnp.sum(jnp.isinf(d))),
+        }
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    def __len__(self) -> int:
+        if self.rank == 0:
+            raise TypeError("len() of a rank-0 NDArray")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self) -> str:
+        return f"NDArray(shape={self.shape}, dtype={self.dtype.name})\n{np.asarray(self.data)}"
+
+    def __format__(self, spec):
+        return format(np.asarray(self.data), spec)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self.data
+
+    def __bool__(self):
+        if self.length != 1:
+            raise ValueError("truth value of a non-scalar NDArray is ambiguous")
+        return bool(self.data.reshape(()))
+
+
+# ----------------------------------------------------------------------
+# camelCase aliases so reference (nd4j) users find familiar method names
+# ----------------------------------------------------------------------
+_ALIASES = {
+    "toNumpy": "to_numpy", "castTo": "cast_to", "getDouble": "get_double",
+    "getInt": "get_int", "getRow": "get_row", "getColumn": "get_column",
+    "getRows": "get_rows", "getColumns": "get_columns", "putRow": "put_row",
+    "putColumn": "put_column", "putScalar": "put_scalar",
+    "swapAxes": "swap_axes", "tensorMmul": "tensor_mmul",
+    "isScalar": "is_scalar", "isVector": "is_vector", "isMatrix": "is_matrix",
+    "isEmpty": "is_empty", "isView": "is_view",
+}
+for _camel, _snake in _ALIASES.items():
+    setattr(NDArray, _camel, getattr(NDArray, _snake))

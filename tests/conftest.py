@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Reference parity: the reference runs its consolidated platform-tests module
+against a backend selected by property (SURVEY.md §4). Here tests run on the
+CPU backend with a virtual 8-device mesh so multi-chip sharding logic is
+exercised without TPU hardware (XLA --xla_force_host_platform_device_count),
+exactly how multi-device code must be CI-tested for TPU.
+"""
+import os
+
+# Force CPU: the session environment pre-sets JAX_PLATFORMS to the TPU
+# tunnel; unit tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# The reference treats DOUBLE/INT64 as first-class dtypes; enable 64-bit on
+# the CPU test backend. TPU runs keep jax's 32-bit defaults (MXU-friendly).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# The env var alone does not displace the preinstalled TPU-tunnel plugin;
+# the config update does.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,243 @@
+"""NDArray core tests.
+
+Reference parity model: platform-tests org.eclipse.deeplearning4j.nd4j.linalg
+basic ndarray tests (views, in-place ops, dup, reductions, gemm).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataType, NDArray, nd
+
+
+class TestCreation:
+    def test_create_from_list(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.dtype == DataType.FLOAT
+        np.testing.assert_allclose(a.to_numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones(self):
+        assert nd.zeros(2, 3).to_numpy().sum() == 0
+        assert nd.ones((4, 5)).to_numpy().sum() == 20
+
+    def test_dtypes(self):
+        a = nd.create([1, 2, 3], dtype="int64")
+        assert a.dtype == DataType.INT64
+        b = a.cast_to(DataType.BFLOAT16)
+        assert b.dtype == DataType.BFLOAT16
+
+    def test_linspace_arange_eye(self):
+        np.testing.assert_allclose(nd.linspace(0, 1, 5).to_numpy(), [0, 0.25, 0.5, 0.75, 1])
+        np.testing.assert_array_equal(nd.arange(5, dtype="int32").to_numpy(), np.arange(5))
+        assert nd.eye(3).to_numpy().trace() == 3
+
+    def test_rand_seeded_reproducible(self):
+        a = nd.rand(3, 3, seed=42)
+        b = nd.rand(3, 3, seed=42)
+        assert a.equals(b)
+
+    def test_global_rng_seed(self):
+        nd.get_random().set_seed(7)
+        a = nd.randn(4)
+        nd.get_random().set_seed(7)
+        b = nd.randn(4)
+        assert a.equals(b)
+
+    def test_value_array_scalar(self):
+        v = nd.value_array_of((2, 2), 3.5)
+        assert float(v.to_numpy()[0, 0]) == 3.5
+        s = nd.scalar(2.0)
+        assert s.item() == 2.0
+
+
+class TestViews:
+    def test_slice_view_writes_through(self):
+        a = nd.zeros(4, 4)
+        row = a[1]
+        row.addi(5.0)
+        assert a.to_numpy()[1].sum() == 20
+        assert a.to_numpy()[0].sum() == 0
+
+    def test_nested_view_write_through(self):
+        a = nd.zeros(4, 4)
+        sub = a[1:3]
+        subsub = sub[0, 2:4]
+        subsub.assign(9.0)
+        expected = np.zeros((4, 4), np.float32)
+        expected[1, 2:4] = 9
+        np.testing.assert_allclose(a.to_numpy(), expected)
+
+    def test_reshape_view_write_through(self):
+        a = nd.zeros(2, 6)
+        v = a.reshape(3, 4)
+        v[0] = 1.0
+        assert a.to_numpy().sum() == 4
+
+    def test_transpose_view_write_through(self):
+        a = nd.zeros(2, 3)
+        t = a.T
+        t[0] = 1.0  # first row of transpose = first column of a
+        np.testing.assert_allclose(a.to_numpy()[:, 0], [1, 1])
+        assert a.to_numpy().sum() == 2
+
+    def test_dup_detaches(self):
+        a = nd.ones(3)
+        b = a.dup()
+        b.addi(1.0)
+        assert a.to_numpy().sum() == 3
+        assert b.to_numpy().sum() == 6
+
+    def test_owner_update_visible_to_view(self):
+        a = nd.zeros(3, 3)
+        v = a[2]
+        a.addi(1.0)
+        np.testing.assert_allclose(v.to_numpy(), [1, 1, 1])
+
+    def test_put_scalar_and_get(self):
+        a = nd.zeros(2, 2)
+        a.put_scalar((0, 1), 7.0)
+        assert a.get_double(0, 1) == 7.0
+
+    def test_setitem_broadcast(self):
+        a = nd.zeros(3, 3)
+        a[1:] = 2.0
+        assert a.to_numpy().sum() == 12
+
+
+class TestArithmetic:
+    def test_binary_ops(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        b = nd.create([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((a + b).to_numpy(), [5, 7, 9])
+        np.testing.assert_allclose((a - b).to_numpy(), [-3, -3, -3])
+        np.testing.assert_allclose((a * b).to_numpy(), [4, 10, 18])
+        np.testing.assert_allclose((b / a).to_numpy(), [4, 2.5, 2])
+        np.testing.assert_allclose(a.rsub(1.0).to_numpy(), [0, -1, -2])
+        np.testing.assert_allclose(a.rdiv(6.0).to_numpy(), [6, 3, 2])
+
+    def test_inplace_ops(self):
+        a = nd.create([1.0, 2.0])
+        a.addi(1.0).muli(3.0)
+        np.testing.assert_allclose(a.to_numpy(), [6, 9])
+
+    def test_broadcasting(self):
+        a = nd.ones(3, 4)
+        col = nd.create([[1.0], [2.0], [3.0]])
+        np.testing.assert_allclose((a * col).to_numpy().sum(), 24)
+
+    def test_comparisons(self):
+        a = nd.create([1.0, 5.0, 3.0])
+        assert (a > 2.0).to_numpy().tolist() == [False, True, True]
+        assert (a.eq(5.0)).to_numpy().tolist() == [False, True, False]
+
+
+class TestMatmul:
+    def test_mmul(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.eye(2)
+        assert a.mmul(b).equals(a)
+
+    def test_gemm_transpose(self):
+        a = nd.rand(3, 4, seed=1)
+        b = nd.rand(3, 5, seed=2)
+        r = nd.gemm(a, b, transpose_a=True)
+        np.testing.assert_allclose(
+            r.to_numpy(), a.to_numpy().T @ b.to_numpy(), rtol=1e-5)
+
+    def test_mmuli_out(self):
+        a = nd.rand(2, 3, seed=3)
+        w = nd.rand(3, 4, seed=4)
+        out = nd.zeros(2, 4)
+        a.mmuli(w, out)
+        np.testing.assert_allclose(out.to_numpy(), a.to_numpy() @ w.to_numpy(), rtol=1e-5)
+
+    def test_batched_matmul(self):
+        a = nd.rand(5, 2, 3, seed=5)
+        b = nd.rand(5, 3, 2, seed=6)
+        assert a.mmul(b).shape == (5, 2, 2)
+
+
+class TestReductions:
+    def test_sum_axes(self):
+        a = nd.ones(2, 3, 4)
+        assert a.sum().item() == 24
+        assert a.sum(0).shape == (3, 4)
+        assert a.sum(1, 2).shape == (2,)
+        assert a.sum(0, keep_dims=True).shape == (1, 3, 4)
+
+    def test_mean_std_var(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        assert a.mean().item() == 2.5
+        np.testing.assert_allclose(a.var().item(), np.var([1, 2, 3, 4], ddof=1))
+        np.testing.assert_allclose(a.std(bias_corrected=False).item(), np.std([1, 2, 3, 4]))
+
+    def test_norms(self):
+        a = nd.create([-3.0, 4.0])
+        assert a.norm1().item() == 7
+        assert a.norm2().item() == 5
+        assert a.normmax().item() == 4
+
+    def test_argmax(self):
+        a = nd.create([[1.0, 9.0], [8.0, 2.0]])
+        assert a.argmax(1).to_numpy().tolist() == [1, 0]
+
+    def test_cumsum(self):
+        np.testing.assert_allclose(nd.create([1.0, 2.0, 3.0]).cumsum().to_numpy(), [1, 3, 6])
+
+
+class TestShapeOps:
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 3), nd.zeros(2, 3)
+        assert nd.concat(0, a, b).shape == (4, 3)
+        assert nd.concat(1, a, b).shape == (2, 6)
+        assert nd.stack(0, a, b).shape == (2, 2, 3)
+        assert nd.vstack(a, b).shape == (4, 3)
+        assert nd.hstack(a, b).shape == (2, 6)
+
+    def test_permute_reshape(self):
+        a = nd.rand(2, 3, 4, seed=9)
+        assert a.permute(2, 0, 1).shape == (4, 2, 3)
+        assert a.reshape(6, 4).shape == (6, 4)
+        assert a.ravel().shape == (24,)
+
+    def test_squeeze_expand(self):
+        a = nd.ones(1, 3, 1)
+        assert a.squeeze().shape == (3,)
+        assert a.expand_dims(0).shape == (1, 1, 3, 1)
+
+    def test_split(self):
+        parts = nd.split(nd.arange(12, dtype="float32").reshape(4, 3), 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+    def test_where_sort(self):
+        a = nd.create([3.0, 1.0, 2.0])
+        np.testing.assert_allclose(nd.sort(a).to_numpy(), [1, 2, 3])
+        np.testing.assert_allclose(nd.sort(a, descending=True).to_numpy(), [3, 2, 1])
+        np.testing.assert_allclose(nd.where(a > 1.5, a, 0.0).to_numpy(), [3, 0, 2])
+
+    def test_rows_columns(self):
+        a = nd.arange(6, dtype="float32").reshape(2, 3).dup()
+        np.testing.assert_allclose(a.get_row(1).to_numpy(), [3, 4, 5])
+        np.testing.assert_allclose(a.get_column(0).to_numpy(), [0, 3])
+        a.put_row(0, nd.create([9.0, 9.0, 9.0]))
+        assert a.to_numpy()[0].sum() == 27
+
+
+class TestInterop:
+    def test_numpy_roundtrip(self):
+        x = np.random.default_rng(0).normal(size=(3, 3)).astype(np.float32)
+        assert np.array_equal(nd.create(x).to_numpy(), x)
+
+    def test_iteration(self):
+        rows = list(nd.eye(3))
+        assert len(rows) == 3
+        np.testing.assert_allclose(rows[1].to_numpy(), [0, 1, 0])
+
+    def test_scan_all(self):
+        stats = nd.create([1.0, 2.0, 3.0]).scan_all()
+        assert stats["mean"] == 2.0 and stats["nan"] == 0
+
+    def test_camelcase_aliases(self):
+        a = nd.create([[1.0, 2.0]])
+        assert a.getDouble(0, 1) == 2.0
+        assert a.isMatrix()
