@@ -331,6 +331,10 @@ class NDArray:
     __lt__ = lt
     __ge__ = gte
     __le__ = lte
+    __eq__ = eq
+    __ne__ = neq
+    # elementwise __eq__ makes NDArray unhashable, same as numpy arrays
+    __hash__ = None
 
     def equals(self, other, eps: float = 1e-5) -> bool:
         """Value equality with epsilon (reference: BaseNDArray.equals)."""

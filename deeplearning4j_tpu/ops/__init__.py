@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.ops.registry import (
+    Op, exec_op, get_op, has_op, op, op_names, ops_by_category,
+)
+
+__all__ = ["Op", "exec_op", "get_op", "has_op", "op", "op_names", "ops_by_category"]

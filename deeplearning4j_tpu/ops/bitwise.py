@@ -1,0 +1,72 @@
+"""Bitwise ops.
+
+Reference parity: ops/declarable/generic/bitwise/ (and, or, xor, shifts,
+cyclic shifts, bits_hamming_distance) and SDBitwise namespace.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+_B = "bitwise"
+
+
+@op("bitwise_and", _B, n_inputs=2, differentiable=False)
+def bitwise_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+@op("bitwise_or", _B, n_inputs=2, differentiable=False)
+def bitwise_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+@op("bitwise_xor", _B, n_inputs=2, differentiable=False)
+def bitwise_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+@op("bitwise_not", _B, n_inputs=1, differentiable=False)
+def bitwise_not(a):
+    return jnp.bitwise_not(a)
+
+
+@op("shift_left", _B, n_inputs=2, differentiable=False, aliases=("shift_bits",))
+def shift_left(a, n):
+    return jnp.left_shift(a, n)
+
+
+@op("shift_right", _B, n_inputs=2, differentiable=False, aliases=("rshift_bits",))
+def shift_right(a, n):
+    return jnp.right_shift(a, n)
+
+
+@op("cyclic_shift_left", _B, n_inputs=2, differentiable=False, aliases=("cyclic_shift_bits",))
+def cyclic_shift_left(a, n):
+    bits = a.dtype.itemsize * 8
+    return jnp.bitwise_or(jnp.left_shift(a, n), jnp.right_shift(a, bits - n))
+
+
+@op("cyclic_shift_right", _B, n_inputs=2, differentiable=False, aliases=("cyclic_rshift_bits",))
+def cyclic_shift_right(a, n):
+    bits = a.dtype.itemsize * 8
+    return jnp.bitwise_or(jnp.right_shift(a, n), jnp.left_shift(a, bits - n))
+
+
+@op("bits_hamming_distance", _B, n_inputs=2, differentiable=False)
+def bits_hamming_distance(a, b):
+    return _popcount_sum(jnp.bitwise_xor(a, b))
+
+
+def _popcount_sum(x):
+    bits = x.dtype.itemsize * 8
+    count = jnp.zeros_like(x)
+    for shift in range(bits):
+        count = count + jnp.bitwise_and(jnp.right_shift(x, shift), 1)
+    return jnp.sum(count.astype(jnp.int32))
+
+
+@op("toggle_bits", _B, n_inputs=1, differentiable=False)
+def toggle_bits(a):
+    return jnp.bitwise_not(a)

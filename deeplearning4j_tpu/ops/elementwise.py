@@ -1,0 +1,269 @@
+"""Elementwise transform ops.
+
+Reference parity: libnd4j transform op families
+(loops/legacy_ops.h TRANSFORM_STRICT/TRANSFORM_FLOAT/TRANSFORM_SAME/
+TRANSFORM_BOOL lists) plus declarable activations
+(ops/declarable/generic/transforms/ and .../nn/activations/). Each is one HLO
+elementwise op; XLA fuses chains of these into the surrounding matmul/conv.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+_E = "elementwise"
+
+
+def _reg(name, fn, aliases=()):
+    op(name, _E, n_inputs=1, aliases=aliases)(fn)
+
+
+# -- strict float transforms (legacy TRANSFORM_STRICT) -------------------
+_reg("abs", jnp.abs)
+_reg("exp", jnp.exp)
+_reg("log", jnp.log)
+_reg("log2", jnp.log2)
+_reg("log10", jnp.log10)
+_reg("log1p", jnp.log1p)
+_reg("expm1", jnp.expm1)
+_reg("sqrt", jnp.sqrt)
+_reg("rsqrt", lax.rsqrt)
+_reg("square", jnp.square)
+_reg("cube", lambda x: x * x * x)
+_reg("reciprocal", jnp.reciprocal)
+_reg("neg", jnp.negative, aliases=("negative",))
+_reg("sign", jnp.sign)
+_reg("floor", jnp.floor)
+_reg("ceil", jnp.ceil)
+_reg("round", jnp.round)
+_reg("rint", jnp.rint)
+_reg("trunc", jnp.trunc)
+
+_reg("sin", jnp.sin)
+_reg("cos", jnp.cos)
+_reg("tan", jnp.tan)
+_reg("asin", jnp.arcsin)
+_reg("acos", jnp.arccos)
+_reg("atan", jnp.arctan)
+_reg("sinh", jnp.sinh)
+_reg("cosh", jnp.cosh)
+_reg("tanh", jnp.tanh)
+_reg("asinh", jnp.arcsinh)
+_reg("acosh", jnp.arccosh)
+_reg("atanh", jnp.arctanh)
+
+_reg("erf", jax.scipy.special.erf)
+_reg("erfc", jax.scipy.special.erfc)
+_reg("lgamma", jax.scipy.special.gammaln)
+_reg("digamma", jax.scipy.special.digamma)
+
+_reg("isnan", jnp.isnan)
+_reg("isinf", jnp.isinf)
+_reg("isfinite", jnp.isfinite)
+_reg("not", jnp.logical_not, aliases=("boolean_not",))
+
+_reg("oneminus", lambda x: 1.0 - x, aliases=("one_minus",))
+_reg("onesas", jnp.ones_like)
+_reg("zerosas", jnp.zeros_like)
+_reg("identity", lambda x: x, aliases=("linear",))
+
+
+# -- activations (reference: generic/nn/activations/*.cpp) ---------------
+@op("sigmoid", _E, n_inputs=1)
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op("log_sigmoid", _E, n_inputs=1)
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@op("hard_sigmoid", _E, n_inputs=1, aliases=("hardsigmoid",))
+def hard_sigmoid(x):
+    # reference: hard_sigmoid = clamp(0.2*x + 0.5, 0, 1)
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@op("hard_tanh", _E, n_inputs=1, aliases=("hardtanh",))
+def hard_tanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@op("relu", _E, n_inputs=1)
+def relu(x, cutoff: float = 0.0):
+    return jnp.where(x > cutoff, x, 0.0).astype(x.dtype)
+
+
+@op("relu6", _E, n_inputs=1)
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@op("leaky_relu", _E, n_inputs=1, aliases=("leakyrelu",))
+def leaky_relu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x).astype(x.dtype)
+
+
+@op("elu", _E, n_inputs=1)
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@op("selu", _E, n_inputs=1)
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@op("celu", _E, n_inputs=1)
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@op("gelu", _E, n_inputs=1)
+def gelu(x, precise: bool = False):
+    # reference gelu (generic/nn/activations/gelu.cpp) uses the tanh approx
+    return jax.nn.gelu(x, approximate=not precise)
+
+
+@op("softplus", _E, n_inputs=1)
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@op("softsign", _E, n_inputs=1)
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@op("swish", _E, n_inputs=1, aliases=("silu",))
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@op("mish", _E, n_inputs=1)
+def mish(x):
+    return jax.nn.mish(x)
+
+
+@op("rationaltanh", _E, n_inputs=1)
+def rationaltanh(x):
+    # reference: transform same family — 1.7159 * tanh(2x/3) rational approx
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+@op("rectifiedtanh", _E, n_inputs=1)
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x)).astype(x.dtype)
+
+
+@op("thresholdedrelu", _E, n_inputs=1)
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0).astype(x.dtype)
+
+
+@op("prelu", _E, n_inputs=2)
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x).astype(x.dtype)
+
+
+@op("step", _E, n_inputs=1)
+def step(x, cutoff: float = 0.0):
+    return (x > cutoff).astype(x.dtype)
+
+
+@op("clip_by_value", _E, n_inputs=1, aliases=("clipbyvalue", "clip"))
+def clip_by_value(x, clip_min: float, clip_max: float):
+    return jnp.clip(x, clip_min, clip_max)
+
+
+@op("clip_by_norm", _E, n_inputs=1, aliases=("clipbynorm",))
+def clip_by_norm(x, clip_norm: float, axis=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=axis is not None))
+    scale = jnp.where(n > clip_norm, clip_norm / jnp.maximum(n, 1e-12), 1.0)
+    return x * scale
+
+
+@op("clip_by_global_norm", _E, differentiable=True)
+def clip_by_global_norm(*arrays, clip_norm: float):
+    gn = jnp.sqrt(sum(jnp.sum(a * a) for a in arrays))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return tuple(a * scale for a in arrays)
+
+
+@op("scalar_add", _E, n_inputs=1)
+def scalar_add(x, scalar: float):
+    return x + scalar
+
+
+@op("scalar_mul", _E, n_inputs=1)
+def scalar_mul(x, scalar: float):
+    return x * scalar
+
+
+@op("scalar_max", _E, n_inputs=1)
+def scalar_max(x, scalar: float):
+    return jnp.maximum(x, scalar)
+
+
+@op("scalar_min", _E, n_inputs=1)
+def scalar_min(x, scalar: float):
+    return jnp.minimum(x, scalar)
+
+
+@op("pow", _E, n_inputs=1, aliases=("pow_scalar",))
+def pow_(x, exponent: float = 2.0):
+    return jnp.power(x, exponent)
+
+
+@op("cast", _E, n_inputs=1, differentiable=False)
+def cast(x, dtype: str):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return x.astype(DataType.from_any(dtype).jnp)
+
+
+@op("nan_to_num", _E, n_inputs=1, aliases=("replace_nans",))
+def nan_to_num(x, value: float = 0.0):
+    return jnp.nan_to_num(x, nan=value)
+
+
+@op("softmax", _E, n_inputs=1)
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax", _E, n_inputs=1)
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("cumsum", _E, n_inputs=1)
+def cumsum(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    r = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        r = r - x
+    if reverse:
+        r = jnp.flip(r, axis)
+    return r
+
+
+@op("cumprod", _E, n_inputs=1)
+def cumprod(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        shifted = jnp.pad(x, pad, constant_values=1)
+        shifted = lax.slice_in_dim(shifted, 0, x.shape[axis], axis=axis)
+        r = jnp.cumprod(shifted, axis=axis)
+    else:
+        r = jnp.cumprod(x, axis=axis)
+    if reverse:
+        r = jnp.flip(r, axis)
+    return r

@@ -1,0 +1,224 @@
+"""Image ops.
+
+Reference parity: ops/declarable/generic/images/ (resize family via
+helpers/image_resize.h, adjust_contrast/hue/saturation, rgb<->hsv/yuv,
+crop_and_resize, extract_image_patches, non_max_suppression).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+_I = "image"
+
+
+@op("resize_bilinear", _I, n_inputs=1)
+def resize_bilinear(images, height: int, width: int, align_corners: bool = False,
+                    half_pixel_centers: bool = True, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        images = jnp.transpose(images, (0, 2, 3, 1))
+    out = jax.image.resize(images, (images.shape[0], height, width, images.shape[3]),
+                           method="bilinear")
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+@op("resize_nearest_neighbor", _I, n_inputs=1, aliases=("resize_nearest",))
+def resize_nearest_neighbor(images, height: int, width: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        images = jnp.transpose(images, (0, 2, 3, 1))
+    out = jax.image.resize(images, (images.shape[0], height, width, images.shape[3]),
+                           method="nearest")
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+@op("resize_bicubic", _I, n_inputs=1)
+def resize_bicubic(images, height: int, width: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        images = jnp.transpose(images, (0, 2, 3, 1))
+    out = jax.image.resize(images, (images.shape[0], height, width, images.shape[3]),
+                           method="cubic")
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+@op("adjust_contrast", _I, n_inputs=1)
+def adjust_contrast(images, factor: float):
+    mean = jnp.mean(images, axis=(-3, -2), keepdims=True)
+    return (images - mean) * factor + mean
+
+
+@op("adjust_saturation", _I, n_inputs=1)
+def adjust_saturation(images, factor: float):
+    hsv = rgb_to_hsv(images)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@op("adjust_hue", _I, n_inputs=1)
+def adjust_hue(images, delta: float):
+    hsv = rgb_to_hsv(images)
+    h = jnp.mod(hsv[..., 0] + delta, 1.0)
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@op("rgb_to_hsv", _I, n_inputs=1)
+def rgb_to_hsv(images):
+    r, g, b = images[..., 0], images[..., 1], images[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    diff = mx - mn
+    safe = jnp.where(diff == 0, 1.0, diff)
+    h = jnp.where(mx == r, jnp.mod((g - b) / safe, 6.0),
+                  jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(diff == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, diff / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@op("hsv_to_rgb", _I, n_inputs=1)
+def hsv_to_rgb(images):
+    h, s, v = images[..., 0] * 6.0, images[..., 1], images[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@op("rgb_to_yuv", _I, n_inputs=1)
+def rgb_to_yuv(images):
+    m = jnp.asarray([[0.299, -0.14714119, 0.61497538],
+                     [0.587, -0.28886916, -0.51496512],
+                     [0.114, 0.43601035, -0.10001026]], dtype=images.dtype)
+    return jnp.matmul(images, m)
+
+
+@op("yuv_to_rgb", _I, n_inputs=1)
+def yuv_to_rgb(images):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.0, -0.394642334, 2.03206185],
+                     [1.13988303, -0.58062185, 0.0]], dtype=images.dtype)
+    return jnp.matmul(images, m)
+
+
+@op("rgb_to_grs", _I, n_inputs=1, aliases=("rgb_to_grayscale",))
+def rgb_to_grs(images):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], dtype=images.dtype)
+    return jnp.sum(images * w, axis=-1, keepdims=True)
+
+
+@op("image_flip_lr", _I, n_inputs=1)
+def image_flip_lr(images):
+    return jnp.flip(images, axis=-2)
+
+
+@op("image_flip_ud", _I, n_inputs=1)
+def image_flip_ud(images):
+    return jnp.flip(images, axis=-3)
+
+
+@op("crop_and_resize", _I)
+def crop_and_resize(images, boxes, box_indices, crop_height: int, crop_width: int,
+                    method: str = "bilinear"):
+    """(reference: generic/images/crop_and_resize.cpp) boxes: (n,4) [y1,x1,y2,x2]
+    normalized."""
+    def crop_one(box, idx):
+        img = images[idx]
+        h, w = images.shape[1], images.shape[2]
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = y1 * (h - 1) + jnp.linspace(0.0, 1.0, crop_height) * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.linspace(0.0, 1.0, crop_width) * (x2 - x1) * (w - 1)
+        if method == "nearest":
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+            return img[yi][:, xi]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        a = img[y0][:, x0]
+        b = img[y0][:, x1i]
+        c = img[y1i][:, x0]
+        d = img[y1i][:, x1i]
+        return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx +
+                c * wy * (1 - wx) + d * wy * wx)
+
+    return jax.vmap(crop_one)(boxes, box_indices)
+
+
+@op("extract_image_patches", _I, n_inputs=1)
+def extract_image_patches(images, ksizes, strides, rates, padding: str = "VALID"):
+    """(reference: generic/images/extract_image_patches.cpp) NHWC in/out."""
+    kh, kw = ksizes
+    sh, sw = strides
+    rh, rw = rates
+    from deeplearning4j_tpu.ops.nn_ops import _conv_padding
+    pads = _conv_padding(padding, [images.shape[1], images.shape[2]], (sh, sw),
+                         [(kh - 1) * rh + 1, (kw - 1) * rw + 1])
+    x = jnp.pad(images, [(0, 0), pads[0], pads[1], (0, 0)])
+    oh = (x.shape[1] - (kh - 1) * rh - 1) // sh + 1
+    ow = (x.shape[2] - (kw - 1) * rw - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(x[:, i * rh:i * rh + oh * sh:sh, j * rw:j * rw + ow * sw:sw, :])
+    return jnp.concatenate(patches, axis=-1)
+
+
+@op("non_max_suppression", _I, differentiable=False)
+def non_max_suppression(boxes, scores, max_output_size: int,
+                        iou_threshold: float = 0.5, score_threshold: float = -jnp.inf):
+    """(reference: generic/images/nonMaxSuppression.cpp) static-size output:
+    returns (indices, valid_count); indices padded with -1."""
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.abs(y2 - y1) * jnp.abs(x2 - x1)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area[j] - inter, 1e-12)
+
+    order = jnp.argsort(-scores)
+
+    def body(state, k):
+        selected, count, suppressed = state
+        idx = order[k]
+        ok = jnp.logical_and(
+            jnp.logical_and(~suppressed[idx], scores[idx] >= score_threshold),
+            count < max_output_size)
+
+        def select():
+            s2 = selected.at[count].set(idx)
+            all_idx = jnp.arange(n)
+            over = iou(idx, all_idx) > iou_threshold
+            return s2, count + 1, jnp.logical_or(suppressed, over)
+
+        def skip():
+            return selected, count, suppressed
+
+        state2 = jax.lax.cond(ok, select, skip)
+        return state2, None
+
+    init = (jnp.full((max_output_size,), -1, dtype=jnp.int32),
+            jnp.asarray(0, dtype=jnp.int32),
+            jnp.zeros((n,), dtype=bool))
+    (selected, count, _), _ = jax.lax.scan(body, init, jnp.arange(n))
+    return selected, count

@@ -1,0 +1,173 @@
+"""Linear algebra ops.
+
+Reference parity: MmulHelper/BlasHelper (libnd4j/include/helpers/MmulHelper.h)
+and declarable generic/linalg/ (svd, lup, cholesky, triangular_solve, matrix
+inverse, ...). GEMM maps to lax.dot_general (MXU); decompositions use XLA's
+linalg lowerings. ``bf16_matmul`` flags the TPU-native mixed-precision path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+_L = "linalg"
+
+
+@op("matmul", _L, n_inputs=2, aliases=("mmul",))
+def matmul(a, b, transpose_a: bool = False, transpose_b: bool = False,
+           transpose_result: bool = False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    r = jnp.matmul(a, b)
+    return jnp.swapaxes(r, -1, -2) if transpose_result else r
+
+
+@op("gemm", _L, n_inputs=2)
+def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None,
+         transpose_a: bool = False, transpose_b: bool = False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    r = alpha * jnp.matmul(a, b)
+    if c is not None and beta != 0.0:
+        r = r + beta * c
+    return r
+
+
+@op("bf16_matmul", _L, n_inputs=2)
+def bf16_matmul(a, b):
+    """Cast operands to bfloat16 for the MXU, accumulate in float32."""
+    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+@op("tensordot", _L, n_inputs=2, aliases=("tensormmul",))
+def tensordot(a, b, axes_a, axes_b):
+    return jnp.tensordot(a, b, axes=(tuple(axes_a), tuple(axes_b)))
+
+
+@op("einsum", _L)
+def einsum(*operands, equation: str):
+    return jnp.einsum(equation, *operands)
+
+
+@op("batched_matmul", _L, n_inputs=2, aliases=("batch_mmul",))
+def batched_matmul(a, b, transpose_a: bool = False, transpose_b: bool = False):
+    return matmul(a, b, transpose_a, transpose_b)
+
+
+@op("svd", _L, n_inputs=1, differentiable=False)
+def svd(x, full_matrices: bool = False, compute_uv: bool = True):
+    if compute_uv:
+        u, s, vt = jnp.linalg.svd(x, full_matrices=full_matrices)
+        return s, u, jnp.swapaxes(vt, -1, -2)  # reference returns s, u, v
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@op("qr", _L, n_inputs=1, differentiable=False)
+def qr(x, full_matrices: bool = False):
+    return jnp.linalg.qr(x, mode="complete" if full_matrices else "reduced")
+
+
+@op("cholesky", _L, n_inputs=1)
+def cholesky(x):
+    return jnp.linalg.cholesky(x)
+
+
+@op("lu", _L, n_inputs=1, differentiable=False)
+def lu(x):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv
+
+
+@op("solve", _L, n_inputs=2, aliases=("linear_solve",))
+def solve(a, b, adjoint: bool = False):
+    if adjoint:
+        a = jnp.swapaxes(a, -1, -2)
+    return jnp.linalg.solve(a, b)
+
+
+@op("triangular_solve", _L, n_inputs=2)
+def triangular_solve(a, b, lower: bool = True, adjoint: bool = False):
+    return jax.scipy.linalg.solve_triangular(a, b, lower=lower, trans=1 if adjoint else 0)
+
+
+@op("lstsq", _L, n_inputs=2, differentiable=False)
+def lstsq(a, b, fast: bool = True):
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("matrix_inverse", _L, n_inputs=1)
+def matrix_inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op("matrix_determinant", _L, n_inputs=1, aliases=("det",))
+def matrix_determinant(x):
+    return jnp.linalg.det(x)
+
+
+@op("log_matrix_determinant", _L, n_inputs=1, aliases=("logdet",))
+def log_matrix_determinant(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return logabs
+
+
+@op("trace", _L, n_inputs=1)
+def trace(x):
+    return jnp.trace(x, axis1=-2, axis2=-1)
+
+
+@op("matrix_band_part", _L, n_inputs=1)
+def matrix_band_part(x, num_lower: int, num_upper: int):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    in_band = jnp.logical_and(
+        (i - j) <= (num_lower if num_lower >= 0 else m),
+        (j - i) <= (num_upper if num_upper >= 0 else n))
+    return jnp.where(in_band, x, jnp.zeros_like(x))
+
+
+@op("cross", _L, n_inputs=2)
+def cross(a, b, axis: int = -1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@op("outer", _L, n_inputs=2)
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@op("norm", _L, n_inputs=1)
+def norm(x, ord=None, axis=None, keep_dims: bool = False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keep_dims)
+
+
+@op("l2_normalize", _L, n_inputs=1)
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    return x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True), epsilon))
+
+
+@op("eig", _L, n_inputs=1, differentiable=False)
+def eig(x):
+    # XLA supports symmetric eigendecomposition natively on TPU
+    return jnp.linalg.eigh(x)
+
+
+@op("sufficient_statistics", _L, n_inputs=1)
+def sufficient_statistics(x, axis, shift: float = None):
+    ax = tuple(axis)
+    count = jnp.asarray(1.0)
+    for a in ax:
+        count = count * x.shape[a]
+    s = x - shift if shift is not None else x
+    mean_ss = jnp.sum(s, axis=ax)
+    var_ss = jnp.sum(s * s, axis=ax)
+    return count, mean_ss, var_ss

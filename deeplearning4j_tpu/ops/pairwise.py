@@ -1,0 +1,69 @@
+"""Pairwise / broadcastable binary ops.
+
+Reference parity: legacy PAIRWISE/BROADCAST families (loops/legacy_ops.h)
+and declarable broadcastables (ops/declarable/generic/broadcastable/*.cpp).
+Broadcasting is numpy-style (the reference implements the same semantics via
+its TAD/broadcast machinery).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+_P = "pairwise"
+
+
+def _reg(name, fn, aliases=()):
+    op(name, _P, n_inputs=2, aliases=aliases)(fn)
+
+
+_reg("add", jnp.add)
+_reg("subtract", jnp.subtract, aliases=("sub",))
+_reg("multiply", jnp.multiply, aliases=("mul",))
+_reg("divide", jnp.divide, aliases=("div",))
+_reg("reversesubtract", lambda a, b: b - a, aliases=("rsub",))
+_reg("reversedivide", lambda a, b: b / a, aliases=("rdiv",))
+_reg("floordiv", jnp.floor_divide)
+_reg("floormod", lambda a, b: a - jnp.floor(a / b) * b)
+_reg("fmod", jnp.fmod)  # C-style sign semantics, matching NDArray.fmod
+_reg("mod", jnp.mod)
+_reg("pow_pairwise", jnp.power)
+_reg("maximum", jnp.maximum, aliases=("max_pairwise",))
+_reg("minimum", jnp.minimum, aliases=("min_pairwise",))
+_reg("atan2", jnp.arctan2)
+_reg("squaredsubtract", lambda a, b: jnp.square(a - b), aliases=("squareddifference",))
+_reg("hypot", jnp.hypot)
+_reg("copysign", jnp.copysign)
+_reg("truncatediv", lambda a, b: jnp.trunc(a / b))
+_reg("divide_no_nan", lambda a, b: jnp.where(b == 0, jnp.zeros_like(a), a / jnp.where(b == 0, 1, b)))
+
+# comparisons → BOOL output (reference: broadcastable/greater.cpp etc.)
+_reg("greater", jnp.greater, aliases=("gt",))
+_reg("greater_equal", jnp.greater_equal, aliases=("gte",))
+_reg("less", jnp.less, aliases=("lt",))
+_reg("less_equal", jnp.less_equal, aliases=("lte",))
+_reg("equals", jnp.equal, aliases=("eq",))
+_reg("not_equals", jnp.not_equal, aliases=("neq",))
+
+# boolean
+_reg("boolean_and", jnp.logical_and, aliases=("and",))
+_reg("boolean_or", jnp.logical_or, aliases=("or",))
+_reg("boolean_xor", jnp.logical_xor, aliases=("xor",))
+
+
+@op("igamma", _P, n_inputs=2)
+def igamma(a, x):
+    import jax.scipy.special as sp
+    return sp.gammainc(a, x)
+
+
+@op("igammac", _P, n_inputs=2)
+def igammac(a, x):
+    import jax.scipy.special as sp
+    return sp.gammaincc(a, x)
+
+
+@op("axpy", _P, n_inputs=2)
+def axpy(x, y, alpha: float = 1.0):
+    return alpha * x + y

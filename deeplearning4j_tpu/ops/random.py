@@ -1,0 +1,138 @@
+"""Random distribution ops.
+
+Reference parity: legacy RANDOM_OPS (loops/legacy_ops.h:105-111 — uniform,
+gaussian, bernoulli, binomial, exponential, truncated/log normal, dropout,
+alpha-dropout) and declarable generic/random/. The reference RNG is
+counter-based (graph/RandomGenerator.h); the TPU-native equivalent is jax's
+threefry with explicit keys. Every op takes ``key`` (a jax PRNG key) or
+``seed`` (int attr) — in graphs the key is threaded as a real input so the
+whole step stays reproducible and jit-stable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+_R = "random"
+
+
+def _key(key=None, seed=None):
+    if key is not None:
+        return key
+    if seed is not None:
+        return jax.random.key(seed)
+    from deeplearning4j_tpu.ndarray import factory
+    return factory.get_random().next_key()
+
+
+@op("random_uniform", _R, differentiable=False, aliases=("randomuniform",))
+def random_uniform(shape, minval: float = 0.0, maxval: float = 1.0,
+                   dtype: str = "float32", key=None, seed=None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jax.random.uniform(_key(key, seed), tuple(shape),
+                              dtype=DataType.from_any(dtype).jnp,
+                              minval=minval, maxval=maxval)
+
+
+@op("random_normal", _R, differentiable=False, aliases=("randomnormal", "random_gaussian"))
+def random_normal(shape, mean: float = 0.0, stddev: float = 1.0,
+                  dtype: str = "float32", key=None, seed=None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return mean + stddev * jax.random.normal(
+        _key(key, seed), tuple(shape), dtype=DataType.from_any(dtype).jnp)
+
+
+@op("random_truncated_normal", _R, differentiable=False, aliases=("truncated_normal",))
+def random_truncated_normal(shape, mean: float = 0.0, stddev: float = 1.0,
+                            dtype: str = "float32", key=None, seed=None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return mean + stddev * jax.random.truncated_normal(
+        _key(key, seed), -2.0, 2.0, tuple(shape), dtype=DataType.from_any(dtype).jnp)
+
+
+@op("random_lognormal", _R, differentiable=False)
+def random_lognormal(shape, mean: float = 0.0, stddev: float = 1.0, key=None, seed=None):
+    return jnp.exp(mean + stddev * jax.random.normal(_key(key, seed), tuple(shape)))
+
+
+@op("random_bernoulli", _R, differentiable=False, aliases=("bernoulli_dist",))
+def random_bernoulli(shape, prob: float = 0.5, dtype: str = "float32", key=None, seed=None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jax.random.bernoulli(_key(key, seed), prob, tuple(shape)).astype(
+        DataType.from_any(dtype).jnp)
+
+
+@op("random_binomial", _R, differentiable=False)
+def random_binomial(shape, trials: int = 1, prob: float = 0.5, key=None, seed=None):
+    draws = jax.random.bernoulli(_key(key, seed), prob, (trials,) + tuple(shape))
+    return jnp.sum(draws, axis=0).astype(jnp.float32)
+
+
+@op("random_exponential", _R, differentiable=False)
+def random_exponential(shape, lam: float = 1.0, key=None, seed=None):
+    return jax.random.exponential(_key(key, seed), tuple(shape)) / lam
+
+
+@op("random_gamma", _R, differentiable=False)
+def random_gamma(shape, alpha: float = 1.0, beta: float = 1.0, key=None, seed=None):
+    return jax.random.gamma(_key(key, seed), alpha, tuple(shape)) / beta
+
+
+@op("random_poisson", _R, differentiable=False)
+def random_poisson(shape, lam: float = 1.0, key=None, seed=None):
+    return jax.random.poisson(_key(key, seed), lam, tuple(shape)).astype(jnp.float32)
+
+
+@op("random_multinomial", _R, n_inputs=1, differentiable=False)
+def random_multinomial(logits, num_samples: int, key=None, seed=None):
+    return jax.random.categorical(_key(key, seed), logits, axis=-1,
+                                  shape=logits.shape[:-1] + (num_samples,))
+
+
+@op("random_shuffle", _R, n_inputs=1, differentiable=False)
+def random_shuffle(x, key=None, seed=None):
+    return jax.random.permutation(_key(key, seed), x, axis=0)
+
+
+@op("dropout", _R, n_inputs=1)
+def dropout(x, p: float, key=None, seed=None, training: bool = True):
+    """Inverted dropout (reference: legacy DropOutInverted / generic dropout).
+
+    ``p`` is the RETAIN probability, matching the reference's convention
+    (deeplearning4j nn/conf/dropout/Dropout.java: p = probability to keep).
+    """
+    if not training or p >= 1.0:
+        return x
+    mask = jax.random.bernoulli(_key(key, seed), p, x.shape)
+    return jnp.where(mask, x / p, 0.0).astype(x.dtype)
+
+
+@op("alpha_dropout", _R, n_inputs=1)
+def alpha_dropout(x, p: float, key=None, seed=None, training: bool = True):
+    """SELU-compatible dropout (reference: legacy AlphaDropOut)."""
+    if not training or p >= 1.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    mask = jax.random.bernoulli(_key(key, seed), p, x.shape)
+    a = (p + alpha_p ** 2 * p * (1 - p)) ** -0.5
+    b = -a * alpha_p * (1 - p)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@op("gaussian_dropout", _R, n_inputs=1)
+def gaussian_dropout(x, rate: float, key=None, seed=None, training: bool = True):
+    if not training or rate <= 0.0:
+        return x
+    stddev = (rate / (1.0 - rate)) ** 0.5
+    return x * (1.0 + stddev * jax.random.normal(_key(key, seed), x.shape, dtype=x.dtype))
+
+
+@op("gaussian_noise", _R, n_inputs=1)
+def gaussian_noise(x, stddev: float, key=None, seed=None, training: bool = True):
+    if not training:
+        return x
+    return x + stddev * jax.random.normal(_key(key, seed), x.shape, dtype=x.dtype)

@@ -1,0 +1,202 @@
+"""Reduction ops.
+
+Reference parity: legacy REDUCE_FLOAT/REDUCE_SAME/REDUCE_BOOL/REDUCE_LONG,
+INDEX_REDUCE, REDUCE3 and SUMMARY_STATS families (loops/legacy_ops.h) plus
+declarable reduce ops (ops/declarable/generic/reduce/). Axis handling follows
+the reference: ``axis=None`` reduces all dims; keep_dims mirrors the
+reference's boolean attr.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+_R = "reduce"
+
+
+def _norm_axis(axis):
+    if axis is None or isinstance(axis, int):
+        return axis
+    t = tuple(int(a) for a in axis)
+    return t if t else None
+
+
+def _reg(name, fn, aliases=()):
+    @op(name, _R, n_inputs=1, aliases=aliases)
+    def _f(x, axis=None, keep_dims: bool = False, _fn=fn):
+        return _fn(x, axis=_norm_axis(axis), keepdims=keep_dims)
+    _f.__name__ = name
+    return _f
+
+
+_reg("reduce_sum", jnp.sum, aliases=("sum",))
+_reg("reduce_mean", jnp.mean, aliases=("mean",))
+_reg("reduce_prod", jnp.prod, aliases=("prod",))
+_reg("reduce_max", jnp.max, aliases=("amax_reduce",))
+_reg("reduce_min", jnp.min, aliases=("amin_reduce",))
+_reg("reduce_logsumexp", lambda x, axis=None, keepdims=False: (
+    __import__("jax").scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)),
+    aliases=("logsumexp",))
+_reg("reduce_norm1", lambda x, axis=None, keepdims=False: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims),
+     aliases=("norm1",))
+_reg("reduce_norm2", lambda x, axis=None, keepdims=False: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)),
+     aliases=("norm2",))
+_reg("reduce_norm_max", lambda x, axis=None, keepdims=False: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims),
+     aliases=("normmax",))
+_reg("reduce_sqnorm", lambda x, axis=None, keepdims=False: jnp.sum(x * x, axis=axis, keepdims=keepdims),
+     aliases=("sqnorm",))
+_reg("reduce_any", lambda x, axis=None, keepdims=False: jnp.any(x, axis=axis, keepdims=keepdims),
+     aliases=("any",))
+_reg("reduce_all", lambda x, axis=None, keepdims=False: jnp.all(x, axis=axis, keepdims=keepdims),
+     aliases=("all",))
+
+
+@op("reduce_variance", _R, n_inputs=1, aliases=("variance",))
+def reduce_variance(x, axis=None, keep_dims: bool = False, bias_corrected: bool = True):
+    return jnp.var(x, axis=_norm_axis(axis), keepdims=keep_dims,
+                   ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_stdev", _R, n_inputs=1, aliases=("standarddeviation", "std"))
+def reduce_stdev(x, axis=None, keep_dims: bool = False, bias_corrected: bool = True):
+    return jnp.std(x, axis=_norm_axis(axis), keepdims=keep_dims,
+                   ddof=1 if bias_corrected else 0)
+
+
+@op("count_nonzero", _R, n_inputs=1, differentiable=False)
+def count_nonzero(x, axis=None, keep_dims: bool = False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keep_dims)
+
+
+@op("count_zero", _R, n_inputs=1, differentiable=False)
+def count_zero(x, axis=None, keep_dims: bool = False):
+    return jnp.sum((x == 0), axis=_norm_axis(axis), keepdims=keep_dims)
+
+
+# -- index reductions (legacy INDEX_REDUCE) ------------------------------
+@op("argmax", _R, n_inputs=1, differentiable=False, aliases=("imax",))
+def argmax(x, axis=None, keep_dims: bool = False):
+    r = jnp.argmax(x, axis=axis if isinstance(axis, int) else None)
+    if keep_dims and isinstance(axis, int):
+        r = jnp.expand_dims(r, axis)
+    return r
+
+
+@op("argmin", _R, n_inputs=1, differentiable=False, aliases=("imin",))
+def argmin(x, axis=None, keep_dims: bool = False):
+    r = jnp.argmin(x, axis=axis if isinstance(axis, int) else None)
+    if keep_dims and isinstance(axis, int):
+        r = jnp.expand_dims(r, axis)
+    return r
+
+
+@op("argamax", _R, n_inputs=1, differentiable=False)
+def argamax(x, axis=None):
+    return jnp.argmax(jnp.abs(x), axis=axis if isinstance(axis, int) else None)
+
+
+@op("argamin", _R, n_inputs=1, differentiable=False)
+def argamin(x, axis=None):
+    return jnp.argmin(jnp.abs(x), axis=axis if isinstance(axis, int) else None)
+
+
+# -- reduce3 (pairwise distance reductions, legacy REDUCE_3) -------------
+@op("cosine_similarity", _R, n_inputs=2, aliases=("cosinesimilarity",))
+def cosine_similarity(a, b, axis=None, keep_dims: bool = False):
+    ax = _norm_axis(axis)
+    num = jnp.sum(a * b, axis=ax, keepdims=keep_dims)
+    na = jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keep_dims))
+    nb = jnp.sqrt(jnp.sum(b * b, axis=ax, keepdims=keep_dims))
+    return num / (na * nb)
+
+
+@op("cosine_distance", _R, n_inputs=2, aliases=("cosinedistance",))
+def cosine_distance(a, b, axis=None, keep_dims: bool = False):
+    return 1.0 - cosine_similarity(a, b, axis=axis, keep_dims=keep_dims)
+
+
+@op("euclidean_distance", _R, n_inputs=2, aliases=("euclidean",))
+def euclidean_distance(a, b, axis=None, keep_dims: bool = False):
+    d = a - b
+    return jnp.sqrt(jnp.sum(d * d, axis=_norm_axis(axis), keepdims=keep_dims))
+
+
+@op("manhattan_distance", _R, n_inputs=2, aliases=("manhattan",))
+def manhattan_distance(a, b, axis=None, keep_dims: bool = False):
+    return jnp.sum(jnp.abs(a - b), axis=_norm_axis(axis), keepdims=keep_dims)
+
+
+@op("hamming_distance", _R, n_inputs=2, differentiable=False)
+def hamming_distance(a, b, axis=None, keep_dims: bool = False):
+    return jnp.sum((a != b), axis=_norm_axis(axis), keepdims=keep_dims)
+
+
+@op("jaccard_distance", _R, n_inputs=2)
+def jaccard_distance(a, b, axis=None, keep_dims: bool = False):
+    ax = _norm_axis(axis)
+    num = jnp.sum(jnp.minimum(a, b), axis=ax, keepdims=keep_dims)
+    den = jnp.sum(jnp.maximum(a, b), axis=ax, keepdims=keep_dims)
+    return 1.0 - num / den
+
+
+@op("dot", _R, n_inputs=2)
+def dot(a, b, axis=None, keep_dims: bool = False):
+    return jnp.sum(a * b, axis=_norm_axis(axis), keepdims=keep_dims)
+
+
+# -- summary stats (legacy SUMMARY_STATS) --------------------------------
+@op("moments", _R, n_inputs=1)
+def moments(x, axis=None, keep_dims: bool = False):
+    ax = _norm_axis(axis)
+    mean = jnp.mean(x, axis=ax, keepdims=keep_dims)
+    var = jnp.var(x, axis=ax, keepdims=keep_dims)
+    return mean, var
+
+
+@op("normalize_moments", _R, n_inputs=3)
+def normalize_moments(counts, means_ss, variances_ss, shift: float = 0.0):
+    div = jnp.maximum(counts, 1.0)
+    mean = means_ss / div + shift
+    var = variances_ss / div - jnp.square(means_ss / div)
+    return mean, var
+
+
+# -- segment / unsorted-segment reductions (generic/parity_ops/segment_*) -
+@op("segment_sum", _R, n_inputs=2)
+def segment_sum(data, segment_ids, num_segments: int):
+    import jax.ops
+    import jax
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@op("segment_max", _R, n_inputs=2)
+def segment_max(data, segment_ids, num_segments: int):
+    import jax
+    return jax.ops.segment_max(data, segment_ids, num_segments)
+
+
+@op("segment_min", _R, n_inputs=2)
+def segment_min(data, segment_ids, num_segments: int):
+    import jax
+    return jax.ops.segment_min(data, segment_ids, num_segments)
+
+
+@op("segment_mean", _R, n_inputs=2)
+def segment_mean(data, segment_ids, num_segments: int):
+    import jax
+    s = jax.ops.segment_sum(data, segment_ids, num_segments)
+    n = jax.ops.segment_sum(jnp.ones_like(data[..., :1] if data.ndim > 1 else data),
+                            segment_ids, num_segments)
+    return s / jnp.maximum(n, 1)
+
+
+@op("segment_prod", _R, n_inputs=2)
+def segment_prod(data, segment_ids, num_segments: int):
+    import jax
+    return jax.ops.segment_prod(data, segment_ids, num_segments)
+
+
+@op("zero_fraction", _R, n_inputs=1)
+def zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))

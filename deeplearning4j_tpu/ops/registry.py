@@ -1,0 +1,118 @@
+"""Named-op registry.
+
+Reference parity: libnd4j's OpRegistrator + DeclarableOp table
+(libnd4j/include/ops/declarable/OpRegistrator.h:67, DeclarableOp.h:67) and the
+legacy opNum families (libnd4j/include/loops/legacy_ops.h). The reference
+dispatches ops by name/hash into hand-written kernels; here every op is a pure
+function over jax arrays that emits HLO — XLA fuses and schedules, so there is
+no per-op kernel to write and the registry's job is discovery, namespacing and
+introspection:
+
+- the eager layer calls ops directly (``nd.exec_op("exp", x)``),
+- the autodiff graph records op *names* and re-emits them at trace time,
+- autodiff comes from jax's AD instead of per-op ``doDiff`` methods.
+
+Ops take positional jax arrays plus keyword attrs (the reference's
+iArgs/tArgs/bArgs) and return one jax array or a tuple of them.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _as_jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    name: str
+    fn: Callable
+    category: str
+    n_inputs: Optional[int]  # None = variadic
+    differentiable: bool = True
+    aliases: Tuple[str, ...] = ()
+
+    def __call__(self, *args, **attrs):
+        return self.fn(*args, **attrs)
+
+
+_REGISTRY: Dict[str, Op] = {}
+
+
+def op(name: str, category: str, n_inputs: Optional[int] = None,
+       differentiable: bool = True, aliases: Sequence[str] = ()):
+    """Decorator: register a pure jax function as a named op."""
+    def deco(fn: Callable) -> Callable:
+        o = Op(name=name, fn=fn, category=category, n_inputs=n_inputs,
+               differentiable=differentiable, aliases=tuple(aliases))
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate op registration: {name}")
+        _REGISTRY[name] = o
+        for a in aliases:
+            if a in _REGISTRY:
+                raise ValueError(f"duplicate op alias: {a}")
+            _REGISTRY[a] = o
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Op:
+    _ensure_loaded()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown op: {name!r}; {len(op_names())} ops registered") from None
+
+
+def has_op(name: str) -> bool:
+    _ensure_loaded()
+    return name in _REGISTRY
+
+
+def op_names() -> List[str]:
+    _ensure_loaded()
+    return sorted({o.name for o in _REGISTRY.values()})
+
+
+def ops_by_category() -> Dict[str, List[str]]:
+    _ensure_loaded()
+    out: Dict[str, List[str]] = {}
+    for o in set(_REGISTRY.values()):
+        out.setdefault(o.category, []).append(o.name)
+    return {k: sorted(v) for k, v in sorted(out.items())}
+
+
+def exec_op(name: str, *args, **attrs):
+    """Execute by name on NDArray/array inputs, wrap results as NDArray.
+
+    Reference: Nd4j.exec(DynamicCustomOp) →
+    NativeOpExecutioner.execCustomOp2 (SURVEY.md §3.5) — here "dispatch" is
+    just calling the jax function; XLA compiles/caches per shape signature.
+    """
+    import numpy as _np
+    o = get_op(name)
+    jargs = [_as_jax(a) if isinstance(a, (NDArray, jax.Array, _np.ndarray)) else a
+             for a in args]
+    result = o.fn(*jargs, **attrs)
+    if isinstance(result, (tuple, list)):
+        return [NDArray(r) for r in result]
+    return NDArray(result)
+
+
+_LOADED = False
+
+
+def _ensure_loaded() -> None:
+    """Import all op modules (registration side effects)."""
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from deeplearning4j_tpu.ops import (  # noqa: F401
+        elementwise, pairwise, reduce as _reduce, shape_ops, random as _random,
+        linalg, nn_ops, loss, bitwise, image,
+    )

@@ -1,0 +1,434 @@
+"""Shape / gather-scatter / restructuring ops.
+
+Reference parity: ops/declarable/generic/shape/ (reshape, permute, squeeze,
+expand_dims, ...), generic/transforms/ (concat, stack, unstack, split, tile,
+reverse, pad, gather, scatter_*), generic/parity_ops/. All shapes are static
+(XLA requirement); dynamic-shape reference ops (e.g. boolean mask with
+data-dependent output size) surface size-bounded variants.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+_S = "shape"
+
+
+@op("reshape", _S, n_inputs=1)
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+@op("permute", _S, n_inputs=1, aliases=("transpose_nd",))
+def permute(x, axes=None):
+    return jnp.transpose(x, tuple(axes) if axes is not None else None)
+
+
+@op("transpose", _S, n_inputs=1)
+def transpose(x):
+    return jnp.transpose(x)
+
+
+@op("squeeze", _S, n_inputs=1)
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@op("expand_dims", _S, n_inputs=1)
+def expand_dims(x, axis: int = 0):
+    return jnp.expand_dims(x, axis)
+
+
+@op("flatten_2d", _S, n_inputs=1)
+def flatten_2d(x, axis: int = 1):
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return jnp.reshape(x, (lead, -1))
+
+
+@op("concat", _S)
+def concat(*xs, axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@op("stack", _S, aliases=("parallel_stack",))
+def stack(*xs, axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+@op("unstack", _S, n_inputs=1)
+def unstack(x, axis: int = 0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@op("split", _S, n_inputs=1)
+def split(x, num_split: int, axis: int = 0):
+    return tuple(jnp.split(x, num_split, axis=axis))
+
+
+@op("split_v", _S, n_inputs=1)
+def split_v(x, sizes, axis: int = 0):
+    idx = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@op("tile", _S, n_inputs=1)
+def tile(x, reps):
+    return jnp.tile(x, tuple(reps))
+
+
+@op("repeat", _S, n_inputs=1)
+def repeat(x, repeats, axis: int = 0):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op("reverse", _S, n_inputs=1, aliases=("flip",))
+def reverse(x, axis):
+    return jnp.flip(x, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+@op("reverse_sequence", _S, n_inputs=2)
+def reverse_sequence(x, seq_lengths, seq_axis: int = 1, batch_axis: int = 0):
+    def rev_one(row, n):
+        idx = jnp.arange(row.shape[seq_axis - 1 if seq_axis > batch_axis else seq_axis])
+        src = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(row, src, axis=seq_axis - 1 if seq_axis > batch_axis else seq_axis)
+    xm = jnp.moveaxis(x, batch_axis, 0)
+    out = jax.vmap(rev_one)(xm, seq_lengths)
+    return jnp.moveaxis(out, 0, batch_axis)
+
+
+@op("pad", _S, n_inputs=1)
+def pad(x, paddings, mode: str = "constant", constant: float = 0.0):
+    mode = mode.lower()
+    pw = tuple(tuple(p) for p in paddings)
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant)
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    if mode == "symmetric":
+        return jnp.pad(x, pw, mode="symmetric")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+@op("slice", _S, n_inputs=1)
+def slice_(x, begin, size):
+    size = [x.shape[i] - b if s == -1 else s for i, (b, s) in enumerate(zip(begin, size))]
+    return lax.dynamic_slice(x, tuple(begin), tuple(size)) if any(
+        not isinstance(b, int) for b in begin) else lax.slice(
+        x, tuple(begin), tuple(b + s for b, s in zip(begin, size)))
+
+
+@op("strided_slice", _S, n_inputs=1)
+def strided_slice(x, begin, end, strides=None):
+    idx = tuple(slice(b, e, s) for b, e, s in zip(
+        begin, end, strides or [1] * len(begin)))
+    return x[idx]
+
+
+@op("gather", _S, n_inputs=2)
+def gather(x, indices, axis: int = 0):
+    return jnp.take(x, indices, axis=axis)
+
+
+@op("gather_nd", _S, n_inputs=2)
+def gather_nd(x, indices):
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return x[idx]
+
+
+@op("scatter_update", _S, n_inputs=3, differentiable=False)
+def scatter_update(ref, indices, updates):
+    return ref.at[indices].set(updates)
+
+
+@op("scatter_add", _S, n_inputs=3)
+def scatter_add(ref, indices, updates):
+    return ref.at[indices].add(updates)
+
+
+@op("scatter_sub", _S, n_inputs=3)
+def scatter_sub(ref, indices, updates):
+    return ref.at[indices].add(-updates)
+
+
+@op("scatter_mul", _S, n_inputs=3)
+def scatter_mul(ref, indices, updates):
+    return ref.at[indices].multiply(updates)
+
+
+@op("scatter_div", _S, n_inputs=3)
+def scatter_div(ref, indices, updates):
+    return ref.at[indices].divide(updates)
+
+
+@op("scatter_max", _S, n_inputs=3)
+def scatter_max(ref, indices, updates):
+    return ref.at[indices].max(updates)
+
+
+@op("scatter_min", _S, n_inputs=3)
+def scatter_min(ref, indices, updates):
+    return ref.at[indices].min(updates)
+
+
+@op("scatter_nd", _S, n_inputs=2)
+def scatter_nd(indices, updates, shape):
+    out = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return out.at[idx].add(updates)
+
+
+@op("size", _S, n_inputs=1, differentiable=False)
+def size(x):
+    return jnp.asarray(x.size, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+@op("shape_of", _S, n_inputs=1, differentiable=False, aliases=("shape",))
+def shape_of(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+@op("rank", _S, n_inputs=1, differentiable=False)
+def rank(x):
+    return jnp.asarray(x.ndim, dtype=jnp.int32)
+
+
+@op("fill", _S, differentiable=False)
+def fill(shape, value: float, dtype: str = "float32"):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jnp.full(tuple(shape), value, dtype=DataType.from_any(dtype).jnp)
+
+
+@op("zeros_like", _S, n_inputs=1)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@op("ones_like", _S, n_inputs=1)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@op("eye_op", _S, differentiable=False)
+def eye_op(rows: int, cols: int = None, dtype: str = "float32"):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jnp.eye(rows, cols, dtype=DataType.from_any(dtype).jnp)
+
+
+@op("range_op", _S, differentiable=False, aliases=("arange",))
+def range_op(start, limit=None, delta=1, dtype: str = None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    dt = DataType.from_any(dtype).jnp if dtype else None
+    if limit is None:
+        return jnp.arange(start, dtype=dt)
+    return jnp.arange(start, limit, delta, dtype=dt)
+
+
+@op("linspace_op", _S, differentiable=False)
+def linspace_op(start, stop, num: int):
+    return jnp.linspace(start, stop, num)
+
+
+@op("meshgrid", _S)
+def meshgrid(*xs, indexing: str = "xy"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+@op("broadcast_to", _S, n_inputs=1)
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@op("where_op", _S, aliases=("select",))
+def where_op(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@op("one_hot", _S, n_inputs=1, differentiable=False, aliases=("onehot",))
+def one_hot(indices, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+            axis: int = -1, dtype: str = "float32"):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    oh = jax.nn.one_hot(indices, depth, axis=axis,
+                        dtype=DataType.from_any(dtype).jnp)
+    return oh * (on_value - off_value) + off_value
+
+
+@op("diag", _S, n_inputs=1)
+def diag(x):
+    return jnp.diagflat(x) if x.ndim == 1 else jnp.diagonal(x)
+
+
+@op("diag_part", _S, n_inputs=1)
+def diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+@op("matrix_diag", _S, n_inputs=1)
+def matrix_diag(x):
+    return x[..., None] * jnp.eye(x.shape[-1], dtype=x.dtype)
+
+
+@op("matrix_set_diag", _S, n_inputs=2)
+def matrix_set_diag(x, diagonal):
+    n = min(x.shape[-2], x.shape[-1])
+    r = jnp.arange(n)
+    return x.at[..., r, r].set(diagonal)
+
+
+@op("space_to_depth", _S, n_inputs=1)
+def space_to_depth(x, block_size: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    b, h, w, c = x.shape
+    bs = block_size
+    x = x.reshape(b, h // bs, bs, w // bs, bs, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, h // bs, w // bs, bs * bs * c)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("depth_to_space", _S, n_inputs=1)
+def depth_to_space(x, block_size: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    b, h, w, c = x.shape
+    bs = block_size
+    x = x.reshape(b, h, w, bs, bs, c // (bs * bs))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, h * bs, w * bs, c // (bs * bs))
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("batch_to_space", _S, n_inputs=1)
+def batch_to_space(x, block_shape, crops):
+    import numpy as np
+    bs = list(block_shape)
+    b = x.shape[0]
+    prod = int(np.prod(bs))
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    x = x.reshape(bs + [b // prod] + list(spatial) + list(rest))
+    perm = [len(bs)]
+    for i in range(len(bs)):
+        perm += [len(bs) + 1 + i, i]
+    perm += list(range(2 * len(bs) + 1, x.ndim))
+    x = jnp.transpose(x, perm)
+    new_spatial = [spatial[i] * bs[i] for i in range(len(bs))]
+    x = x.reshape([b // prod] + new_spatial + list(rest))
+    idx = [slice(None)]
+    for i, (c0, c1) in enumerate(crops):
+        idx.append(slice(c0, new_spatial[i] - c1))
+    return x[tuple(idx)]
+
+
+@op("space_to_batch", _S, n_inputs=1)
+def space_to_batch(x, block_shape, paddings):
+    import numpy as np
+    bs = list(block_shape)
+    pw = [(0, 0)] + [tuple(p) for p in paddings] + [(0, 0)] * (x.ndim - 1 - len(bs))
+    x = jnp.pad(x, pw)
+    b = x.shape[0]
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    shape = [b]
+    for i in range(len(bs)):
+        shape += [spatial[i] // bs[i], bs[i]]
+    shape += list(rest)
+    x = x.reshape(shape)
+    perm = []
+    for i in range(len(bs)):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(len(bs)):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * len(bs), x.ndim))
+    x = jnp.transpose(x, perm)
+    prod = int(np.prod(bs))
+    return x.reshape([b * prod] + [spatial[i] // bs[i] for i in range(len(bs))] + list(rest))
+
+
+@op("top_k", _S, n_inputs=1, differentiable=False)
+def top_k(x, k: int, sorted: bool = True):
+    values, indices = lax.top_k(x, k)
+    return values, indices
+
+
+@op("in_top_k", _S, n_inputs=2, differentiable=False)
+def in_top_k(predictions, targets, k: int):
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@op("unique", _S, n_inputs=1, differentiable=False)
+def unique(x, size: int = None):
+    # XLA needs static sizes; `size` bounds the output (pads with first value)
+    vals, idx = jnp.unique(x, return_inverse=True, size=size)
+    return vals, idx
+
+
+@op("dynamic_partition", _S, n_inputs=2, differentiable=False)
+def dynamic_partition(x, partitions, num_partitions: int):
+    # static-size variant: returns masks-selected, padded partitions
+    return tuple(jnp.where(partitions == i, x, jnp.zeros_like(x))
+                 for i in range(num_partitions))
+
+
+@op("dynamic_stitch", _S, differentiable=False)
+def dynamic_stitch(indices_list_then_data_list, *rest):
+    args = (indices_list_then_data_list,) + rest
+    n = len(args) // 2
+    idxs, datas = args[:n], args[n:]
+    total = sum(int(i.size) for i in idxs)
+    elem_shape = datas[0].shape[idxs[0].ndim:]
+    out = jnp.zeros((total,) + elem_shape, dtype=datas[0].dtype)
+    for i, d in zip(idxs, datas):
+        out = out.at[i.reshape(-1)].set(d.reshape((-1,) + d.shape[i.ndim:]))
+    return out
+
+
+@op("confusion_matrix", _S, n_inputs=2, differentiable=False)
+def confusion_matrix(labels, predictions, num_classes: int, weights=None):
+    cm = jnp.zeros((num_classes, num_classes), dtype=jnp.float32 if weights is not None else jnp.int32)
+    w = weights if weights is not None else jnp.ones_like(labels, dtype=cm.dtype)
+    return cm.at[labels, predictions].add(w)
+
+
+@op("assign_op", _S, n_inputs=2, aliases=("copy",))
+def assign_op(x, y):
+    return jnp.broadcast_to(y.astype(x.dtype), x.shape)
+
+
+@op("stop_gradient", _S, n_inputs=1)
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@op("checknumerics", _S, n_inputs=1, differentiable=False)
+def checknumerics(x, message: str = "CheckNumerics failed"):
+    # reference: parity_ops/check_numerics.cpp — NaN/Inf panic (SURVEY §5)
+    from jax.experimental import checkify  # noqa: F401
+    return jax.lax.cond(
+        jnp.all(jnp.isfinite(x)), lambda: x,
+        lambda: x * jnp.nan)  # propagates NaN; host-side checks live in executioner
+
+
+@op("bincount", _S, n_inputs=1, differentiable=False)
+def bincount(x, weights=None, minlength: int = 0, maxlength: int = None, length: int = None):
+    n = length if length is not None else maxlength
+    if n is None and minlength > 0:
+        n = minlength
+    return jnp.bincount(x.reshape(-1), weights=None if weights is None else weights.reshape(-1),
+                        length=n)
