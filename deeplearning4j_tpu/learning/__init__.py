@@ -1,0 +1,21 @@
+from deeplearning4j_tpu.learning.schedules import (
+    CycleSchedule, ExponentialSchedule, FixedSchedule, ISchedule,
+    InverseSchedule, MapSchedule, PolySchedule, RampSchedule, SigmoidSchedule,
+    StepSchedule, resolve_lr,
+)
+from deeplearning4j_tpu.learning.updaters import (
+    UPDATERS, AMSGrad, AdaBelief, AdaDelta, AdaGrad, AdaMax, Adam, IUpdater,
+    Nadam, Nesterovs, NoOp, RmsProp, Sgd,
+)
+from deeplearning4j_tpu.learning.regularization import (
+    L1Regularization, L2Regularization, Regularization, WeightDecay,
+)
+
+__all__ = [
+    "ISchedule", "FixedSchedule", "ExponentialSchedule", "InverseSchedule",
+    "PolySchedule", "SigmoidSchedule", "StepSchedule", "MapSchedule",
+    "RampSchedule", "CycleSchedule", "resolve_lr",
+    "IUpdater", "Sgd", "NoOp", "Nesterovs", "Adam", "AdaMax", "Nadam",
+    "AMSGrad", "AdaBelief", "AdaDelta", "AdaGrad", "RmsProp", "UPDATERS",
+    "Regularization", "L1Regularization", "L2Regularization", "WeightDecay",
+]

@@ -1,0 +1,66 @@
+"""Regularization applied to gradients before the updater.
+
+Reference parity: org.nd4j.linalg.learning.regularization (L1Regularization,
+L2Regularization, WeightDecay) as consumed by
+deeplearning4j nn/updater/BaseMultiLayerUpdater.update() — L1/L2 modify the
+GRADIENT pre-updater; WeightDecay applies to the update post-LR (coeff * w * lr
+added to the update when applyLR=true).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+class Regularization:
+    apply_step: str = "BEFORE_UPDATER"  # or "POST_UPDATER"
+
+    def apply(self, param, grad_or_update, lr):
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "Regularization":
+        d = dict(d)
+        return _REGS[d.pop("@class")](**d)
+
+
+@dataclasses.dataclass
+class L2Regularization(Regularization):
+    """grad += l2 * param (reference: L2Regularization.java)."""
+    l2: float = 0.0
+
+    def apply(self, param, grad, lr):
+        return grad + self.l2 * param
+
+
+@dataclasses.dataclass
+class L1Regularization(Regularization):
+    """grad += l1 * sign(param) (reference: L1Regularization.java)."""
+    l1: float = 0.0
+
+    def apply(self, param, grad, lr):
+        return grad + self.l1 * jnp.sign(param)
+
+
+@dataclasses.dataclass
+class WeightDecay(Regularization):
+    """update += coeff * param [* lr] (reference: WeightDecay.java,
+    applied POST_UPDATER so it is not rescaled by adaptive updaters)."""
+    coeff: float = 0.0
+    apply_lr: bool = True
+    apply_step: str = "POST_UPDATER"
+
+    def apply(self, param, update, lr):
+        scale = lr if self.apply_lr else 1.0
+        return update + self.coeff * scale * param
+
+
+_REGS: Dict[str, type] = {c.__name__: c for c in
+                          [L1Regularization, L2Regularization, WeightDecay]}

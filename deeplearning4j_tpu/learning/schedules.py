@@ -1,0 +1,200 @@
+"""Learning-rate (and momentum) schedules.
+
+Reference parity: org.nd4j.linalg.schedule.* (ISchedule + Fixed/Exponential/
+Inverse/Map/Poly/Sigmoid/Step/Cycle/Ramp schedules, ScheduleType
+ITERATION|EPOCH). Schedules are pure functions of (iteration, epoch) so they
+trace into the compiled step — the LR is an XLA scalar input, not a Python
+recompile trigger.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+
+class ISchedule:
+    """value_at(iteration, epoch) → scalar (traceable)."""
+
+    schedule_type: str = "ITERATION"  # or "EPOCH"
+
+    def value_at(self, iteration, epoch):
+        raise NotImplementedError
+
+    def _t(self, iteration, epoch):
+        return epoch if self.schedule_type == "EPOCH" else iteration
+
+    # serde ------------------------------------------------------------
+    def to_json(self) -> dict:
+        d = {"@class": type(self).__name__}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    @staticmethod
+    def from_json(d: Optional[dict]) -> Optional["ISchedule"]:
+        if d is None:
+            return None
+        d = dict(d)
+        cls_name = d.pop("@class")
+        cls = _SCHEDULES[cls_name]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class FixedSchedule(ISchedule):
+    value: float = 1e-3
+
+    def value_at(self, iteration, epoch):
+        return jnp.asarray(self.value, dtype=jnp.float32)
+
+
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    """lr = initial * gamma^t (reference: ExponentialSchedule.java)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        return self.initial_value * jnp.power(self.gamma, t)
+
+
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    """lr = initial / (1 + gamma*t)^power (reference: InverseSchedule.java)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.1
+    power: float = 1.0
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        return self.initial_value / jnp.power(1.0 + self.gamma * t, self.power)
+
+
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    """lr = initial * (1 - t/maxIter)^power (reference: PolySchedule.java)."""
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        frac = jnp.clip(t / float(self.max_iter), 0.0, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    """lr = initial / (1 + exp(-gamma*(t - stepSize))) (reference: SigmoidSchedule.java)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.1
+    step_size: int = 100
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (t - self.step_size)))
+
+
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    """lr = initial * decayRate^floor(t/step) (reference: StepSchedule.java)."""
+    initial_value: float = 1e-3
+    decay_rate: float = 0.5
+    step: float = 1000.0
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        return self.initial_value * jnp.power(self.decay_rate, jnp.floor(t / self.step))
+
+
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant by explicit {t: lr} map (reference: MapSchedule.java —
+    requires a value for position 0, rejected at construction otherwise)."""
+    values: Dict[int, float] = None
+    schedule_type: str = "ITERATION"
+
+    def __post_init__(self):
+        if not self.values:
+            raise ValueError("MapSchedule requires a values map")
+        self.values = {int(k): v for k, v in self.values.items()}
+        if 0 not in self.values:
+            raise ValueError(
+                "MapSchedule values must contain a value for position 0")
+
+    def value_at(self, iteration, epoch):
+        t = _f(self._t(iteration, epoch))
+        keys = sorted(self.values)
+        out = jnp.asarray(self.values[keys[0]], dtype=jnp.float32)
+        for k in keys[1:]:
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+
+@dataclasses.dataclass
+class RampSchedule(ISchedule):
+    """Linear warmup wrapper (reference: RampSchedule.java — ramps the
+    underlying schedule over numIter iterations)."""
+    base: dict = None  # serialized base schedule
+    num_iter: int = 1000
+
+    def __post_init__(self):
+        if self.base is None:
+            raise ValueError("RampSchedule requires a base schedule")
+        self._base = ISchedule.from_json(self.base) if isinstance(self.base, dict) else self.base
+        if not isinstance(self.base, dict):
+            self.base = self._base.to_json()
+
+    def value_at(self, iteration, epoch):
+        frac = jnp.clip((_f(iteration) + 1.0) / float(self.num_iter), 0.0, 1.0)
+        return frac * self._base.value_at(iteration, epoch)
+
+
+@dataclasses.dataclass
+class CycleSchedule(ISchedule):
+    """1-cycle schedule (reference: CycleSchedule.java): linear ramp up over
+    stepSize = (cycleLength-annealingLength)/2, linear ramp down, then
+    exponential annihilation lr = initial * decay^(annealingLength -
+    (cycleLength - pos))."""
+    initial_lr: float = 1e-3
+    max_lr: float = 1e-2
+    cycle_length: int = 1000
+    annealing_length: int = 100
+    annealing_decay: float = 0.1
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch):
+        pos = _f(self._t(iteration, epoch)) % self.cycle_length
+        step_size = (self.cycle_length - self.annealing_length) // 2
+        increment = (self.max_lr - self.initial_lr) / max(step_size, 1)
+        up = self.initial_lr + increment * pos
+        down = self.max_lr - increment * (pos - step_size)
+        anneal = self.initial_lr * jnp.power(
+            self.annealing_decay,
+            self.annealing_length - (self.cycle_length - pos))
+        return jnp.where(pos < step_size, up,
+                         jnp.where(pos < 2 * step_size, down, anneal))
+
+
+def _f(t):
+    return t.astype(jnp.float32) if hasattr(t, "astype") else jnp.asarray(float(t))
+
+
+_SCHEDULES = {c.__name__: c for c in [
+    FixedSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+    SigmoidSchedule, StepSchedule, MapSchedule, RampSchedule, CycleSchedule,
+]}
+
+
+def resolve_lr(lr, iteration, epoch):
+    """lr may be a float or an ISchedule."""
+    if isinstance(lr, ISchedule):
+        return lr.value_at(iteration, epoch)
+    return jnp.asarray(lr, dtype=jnp.float32)

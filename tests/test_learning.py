@@ -1,0 +1,210 @@
+"""Updater + schedule tests.
+
+Reference parity model: nd4j UpdaterTest / UpdaterValidation (platform-tests)
+— closed-form single-step checks per updater, convergence sanity, serde
+round-trips.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.learning import (
+    Adam, AdaMax, AdaGrad, AdaDelta, AdaBelief, AMSGrad, Nadam, Nesterovs,
+    NoOp, RmsProp, Sgd, IUpdater, UPDATERS,
+    ExponentialSchedule, FixedSchedule, InverseSchedule, MapSchedule,
+    PolySchedule, SigmoidSchedule, StepSchedule, CycleSchedule, RampSchedule,
+    ISchedule, L1Regularization, L2Regularization, WeightDecay,
+)
+
+
+def params():
+    return {"w": jnp.asarray(np.full((3,), 2.0, np.float32)),
+            "b": jnp.asarray(np.full((2,), -1.0, np.float32))}
+
+
+def grads():
+    return {"w": jnp.asarray(np.full((3,), 0.5, np.float32)),
+            "b": jnp.asarray(np.full((2,), -0.25, np.float32))}
+
+
+class TestUpdaterMath:
+    def test_sgd(self):
+        u = Sgd(learning_rate=0.1)
+        st = u.init(params())
+        upd, _ = u.apply(grads(), st, 0)
+        np.testing.assert_allclose(upd["w"], 0.05, rtol=1e-6)
+
+    def test_noop(self):
+        u = NoOp()
+        upd, _ = u.apply(grads(), u.init(params()), 0)
+        assert float(jnp.abs(upd["w"]).sum()) == 0
+
+    def test_adam_first_step(self):
+        # step 1: m=(1-b1)g, v=(1-b2)g^2, alphat=lr*sqrt(1-b2)/(1-b1)
+        u = Adam(learning_rate=0.001)
+        upd, st = u.apply(grads(), u.init(params()), 0)
+        g = 0.5
+        m = 0.1 * g
+        v = 0.001 * g * g
+        alphat = 0.001 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        expect = alphat * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(upd["w"], expect, rtol=1e-5)
+
+    def test_nesterovs_first_step(self):
+        u = Nesterovs(learning_rate=0.1, momentum=0.9)
+        upd, st = u.apply(grads(), u.init(params()), 0)
+        # v' = -lr*g ; update = -(1+mu)*v'
+        expect = (1 + 0.9) * 0.1 * 0.5
+        np.testing.assert_allclose(upd["w"], expect, rtol=1e-6)
+
+    def test_adagrad_first_step(self):
+        u = AdaGrad(learning_rate=0.1)
+        upd, _ = u.apply(grads(), u.init(params()), 0)
+        expect = 0.1 * 0.5 / (np.sqrt(0.25) + 1e-6)
+        np.testing.assert_allclose(upd["w"], expect, rtol=1e-5)
+
+    def test_rmsprop_first_step(self):
+        u = RmsProp(learning_rate=0.1)
+        upd, _ = u.apply(grads(), u.init(params()), 0)
+        r = 0.05 * 0.25
+        expect = 0.1 * 0.5 / np.sqrt(r + 1e-8)
+        np.testing.assert_allclose(upd["w"], expect, rtol=1e-5)
+
+    def test_amsgrad_monotone_vhat(self):
+        u = AMSGrad(learning_rate=0.01)
+        st = u.init(params())
+        _, st = u.apply(grads(), st, 0)
+        big = {k: v * 10 for k, v in grads().items()}
+        _, st2 = u.apply(big, st, 1)
+        small = {k: v * 0 for k, v in grads().items()}
+        _, st3 = u.apply(small, st2, 2)
+        # v_hat never decreases
+        assert float(st3["w"][2].min()) >= float(st2["w"][2].min()) * 0.999
+
+    @pytest.mark.parametrize("cls", [Adam, AdaMax, Nadam, AMSGrad, AdaBelief,
+                                     AdaGrad, RmsProp, Nesterovs, Sgd, AdaDelta])
+    def test_convergence_quadratic(self, cls):
+        # minimize f(x) = x^2 from x=5 — every updater must reduce |x|
+        u = cls(learning_rate=0.1) if cls is not AdaDelta else AdaDelta(rho=0.9)
+        x = jnp.asarray([5.0])
+        st = u.init(x)
+        for i in range(300):
+            g = 2 * x
+            upd, st = u.apply(g, st, i)
+            x = x - upd
+        # AdaGrad/AdaDelta are inherently slow from zero state; the gate is
+        # monotone progress, not speed
+        assert abs(float(x[0])) < 4.0, f"{cls.__name__} did not make progress: {x}"
+
+    def test_state_shapes(self):
+        for name, cls in UPDATERS.items():
+            u = cls()
+            st = u.init(params())
+            upd, st2 = u.apply(grads(), st, 0)
+            assert jnp.asarray(upd["w"]).shape == (3,), name
+
+
+class TestSerde:
+    def test_updater_roundtrip(self):
+        for name, cls in UPDATERS.items():
+            u = cls()
+            j = u.to_json()
+            u2 = IUpdater.from_json(j)
+            assert u2 == u, name
+
+    def test_updater_with_schedule_roundtrip(self):
+        u = Adam(learning_rate=ExponentialSchedule(initial_value=0.01, gamma=0.9))
+        u2 = IUpdater.from_json(u.to_json())
+        assert isinstance(u2.learning_rate, ExponentialSchedule)
+        assert u2 == u
+
+    def test_schedule_roundtrip(self):
+        for s in [FixedSchedule(0.1), ExponentialSchedule(0.1, 0.5),
+                  InverseSchedule(0.1, 0.2, 2.0), PolySchedule(0.1, 2.0, 100),
+                  SigmoidSchedule(0.1, 0.5, 10), StepSchedule(0.1, 0.5, 10),
+                  MapSchedule({0: 0.1, 10: 0.01}),
+                  CycleSchedule(1e-4, 1e-2, 100, 10)]:
+            s2 = ISchedule.from_json(s.to_json())
+            np.testing.assert_allclose(float(s2.value_at(5, 0)), float(s.value_at(5, 0)),
+                                       rtol=1e-6)
+
+
+class TestSchedules:
+    def test_fixed(self):
+        assert float(FixedSchedule(0.1).value_at(100, 5)) == pytest.approx(0.1)
+
+    def test_exponential(self):
+        s = ExponentialSchedule(initial_value=1.0, gamma=0.5)
+        assert float(s.value_at(3, 0)) == pytest.approx(0.125)
+
+    def test_step(self):
+        s = StepSchedule(initial_value=1.0, decay_rate=0.1, step=10)
+        assert float(s.value_at(5, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(15, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(25, 0)) == pytest.approx(0.01)
+
+    def test_poly(self):
+        s = PolySchedule(initial_value=1.0, power=1.0, max_iter=100)
+        assert float(s.value_at(50, 0)) == pytest.approx(0.5)
+        assert float(s.value_at(100, 0)) == pytest.approx(0.0)
+
+    def test_map(self):
+        s = MapSchedule(values={0: 1.0, 10: 0.1, 20: 0.01})
+        assert float(s.value_at(0, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(12, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(30, 0)) == pytest.approx(0.01)
+
+    def test_epoch_type(self):
+        s = StepSchedule(initial_value=1.0, decay_rate=0.1, step=2,
+                         schedule_type="EPOCH")
+        assert float(s.value_at(1000, 1)) == pytest.approx(1.0)
+        assert float(s.value_at(0, 3)) == pytest.approx(0.1)
+
+    def test_ramp(self):
+        s = RampSchedule(base=FixedSchedule(1.0), num_iter=10)
+        assert float(s.value_at(0, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(9, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(99, 0)) == pytest.approx(1.0)
+
+    def test_cycle_reference_form(self):
+        # reference CycleSchedule: stepSize=(100-10)/2=45; annihilation is
+        # exponential: initial * decay^(annealingLength-(cycleLength-pos))
+        s = CycleSchedule(initial_lr=1e-3, max_lr=1e-2, cycle_length=100,
+                          annealing_length=10, annealing_decay=0.1)
+        assert float(s.value_at(0, 0)) == pytest.approx(1e-3)
+        assert float(s.value_at(45, 0)) == pytest.approx(1e-2)
+        assert float(s.value_at(90, 0)) == pytest.approx(1e-3)
+        assert float(s.value_at(99, 0)) == pytest.approx(1e-3 * 0.1 ** 9, rel=1e-4)
+
+    def test_map_requires_zero_key(self):
+        with pytest.raises(ValueError):
+            MapSchedule(values={10: 0.1})
+        with pytest.raises(ValueError):
+            RampSchedule(base=None)
+
+    def test_updater_hashable(self):
+        assert hash(Adam()) == hash(Adam())
+        assert len({Adam(), Adam(), Sgd()}) == 2
+
+    def test_schedule_in_updater(self):
+        u = Sgd(learning_rate=StepSchedule(initial_value=1.0, decay_rate=0.5, step=10))
+        upd0, _ = u.apply(grads(), u.init(params()), 0)
+        upd1, _ = u.apply(grads(), u.init(params()), 15)
+        np.testing.assert_allclose(upd1["w"], upd0["w"] * 0.5, rtol=1e-6)
+
+
+class TestRegularization:
+    def test_l2(self):
+        r = L2Regularization(l2=0.1)
+        g = r.apply(jnp.asarray([2.0]), jnp.asarray([0.5]), 0.1)
+        np.testing.assert_allclose(g, [0.7], rtol=1e-6)
+
+    def test_l1(self):
+        r = L1Regularization(l1=0.1)
+        g = r.apply(jnp.asarray([-2.0]), jnp.asarray([0.5]), 0.1)
+        np.testing.assert_allclose(g, [0.4], rtol=1e-6)
+
+    def test_weight_decay(self):
+        r = WeightDecay(coeff=0.01, apply_lr=True)
+        upd = r.apply(jnp.asarray([2.0]), jnp.asarray([0.5]), 0.1)
+        np.testing.assert_allclose(upd, [0.502], rtol=1e-6)
