@@ -1,0 +1,18 @@
+"""SameDiff-equivalent autodiff (reference: org.nd4j.autodiff).
+
+Define-then-run graphs compiled whole (forward + backward + updater) into
+single XLA computations — see samediff.py for the design contrast with the
+reference's op-by-op Java interpreter.
+"""
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, OpNode
+from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
+from deeplearning4j_tpu.autodiff.training import (
+    TrainingConfig, History, Listener, ScoreIterationListener,
+    PerformanceListener, CheckpointListener, EarlyStoppingListener,
+)
+
+__all__ = [
+    "SameDiff", "SDVariable", "VariableType", "OpNode", "TrainingConfig",
+    "History", "Listener", "ScoreIterationListener", "PerformanceListener",
+    "CheckpointListener", "EarlyStoppingListener",
+]

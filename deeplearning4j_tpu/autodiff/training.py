@@ -1,0 +1,223 @@
+"""Training configuration, history and listener API for SameDiff.
+
+Reference parity:
+- TrainingConfig (org.nd4j.autodiff.samediff.TrainingConfig.java:42):
+  updater + L1/L2 + dataSetFeatureMapping/dataSetLabelMapping.
+- Listener (org.nd4j.autodiff.listeners.Listener) and the History/LossCurve
+  records (org.nd4j.autodiff.listeners.records).
+- ScoreIterationListener / PerformanceListener
+  (deeplearning4j optimize/listeners/) — throughput metrics use the same
+  samples/sec & batches/sec definitions (PerformanceListener.java:46-118).
+
+The listener surface is host-side: it observes per-iteration scalars after
+the compiled step returns. It can NOT inject code into the XLA computation
+(the reference's listeners run between per-op JNI dispatches; here there is
+nothing between ops — that is the point).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.learning.updaters import IUpdater
+from deeplearning4j_tpu.learning.regularization import Regularization
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    updater: IUpdater
+    data_set_feature_mapping: Sequence[str] = ()
+    data_set_label_mapping: Sequence[str] = ()
+    regularization: Sequence[Regularization] = ()
+    grad_clip_value: Optional[float] = None
+    minibatch: bool = True
+    iteration_count: int = 0
+    epoch_count: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "updater": self.updater.to_json(),
+            "data_set_feature_mapping": list(self.data_set_feature_mapping),
+            "data_set_label_mapping": list(self.data_set_label_mapping),
+            "regularization": [r.to_json() for r in self.regularization],
+            "grad_clip_value": self.grad_clip_value,
+            "minibatch": self.minibatch,
+            "iteration_count": self.iteration_count,
+            "epoch_count": self.epoch_count,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "TrainingConfig":
+        return TrainingConfig(
+            updater=IUpdater.from_json(d["updater"]),
+            data_set_feature_mapping=d.get("data_set_feature_mapping", []),
+            data_set_label_mapping=d.get("data_set_label_mapping", []),
+            regularization=[Regularization.from_json(r)
+                            for r in d.get("regularization", [])],
+            grad_clip_value=d.get("grad_clip_value"),
+            minibatch=d.get("minibatch", True),
+            iteration_count=d.get("iteration_count", 0),
+            epoch_count=d.get("epoch_count", 0),
+        )
+
+    class Builder:
+        """Fluent builder matching the reference's TrainingConfig.Builder."""
+
+        def __init__(self):
+            self._kw: Dict[str, Any] = {}
+
+        def updater(self, u):             self._kw["updater"] = u; return self
+        def data_set_feature_mapping(self, *names):
+            self._kw["data_set_feature_mapping"] = list(names); return self
+        def data_set_label_mapping(self, *names):
+            self._kw["data_set_label_mapping"] = list(names); return self
+        def regularization(self, *regs):  self._kw["regularization"] = list(regs); return self
+        def grad_clip_value(self, v):     self._kw["grad_clip_value"] = v; return self
+        def minibatch(self, b):           self._kw["minibatch"] = b; return self
+        def build(self) -> "TrainingConfig":
+            return TrainingConfig(**self._kw)
+
+    @staticmethod
+    def builder() -> "TrainingConfig.Builder":
+        return TrainingConfig.Builder()
+
+
+class LossCurve:
+    """Per-epoch mean loss (reference: listeners.records.LossCurve)."""
+
+    def __init__(self):
+        self.epochs: List[int] = []
+        self.losses: List[float] = []
+
+    def add(self, epoch: int, loss: float):
+        self.epochs.append(epoch)
+        self.losses.append(loss)
+
+    def mean_loss(self, epoch: int) -> float:
+        return self.losses[self.epochs.index(epoch)]
+
+    def last(self) -> float:
+        return self.losses[-1] if self.losses else float("nan")
+
+
+class History:
+    """Training run record (reference: listeners.records.History)."""
+
+    def __init__(self):
+        self.loss_curve = LossCurve()
+
+    def add_epoch(self, epoch: int, mean_loss: float):
+        self.loss_curve.add(epoch, mean_loss)
+
+    def final_loss(self) -> float:
+        return self.loss_curve.last()
+
+
+class Listener:
+    """Training listener (reference: autodiff.listeners.Listener /
+    dl4j TrainingListener). Return False from on_epoch_end to stop."""
+
+    def on_training_start(self, sd): ...
+    def on_training_end(self, sd): ...
+    def on_epoch_start(self, sd, epoch: int): ...
+    def on_epoch_end(self, sd, epoch: int, mean_loss: float): ...
+    def iteration_done(self, sd, epoch: int, iteration: int, loss: float): ...
+
+
+class ScoreIterationListener(Listener):
+    """Print score every N iterations (reference:
+    optimize/listeners/ScoreIterationListener)."""
+
+    def __init__(self, print_every: int = 10, print_fn=print):
+        self.print_every = print_every
+        self.print_fn = print_fn
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if iteration % self.print_every == 0:
+            self.print_fn(f"Score at iteration {iteration} is {loss}")
+
+
+class PerformanceListener(Listener):
+    """Throughput metrics: samples/sec, batches/sec (reference:
+    optimize/listeners/PerformanceListener.java:46-118)."""
+
+    def __init__(self, frequency: int = 10, print_fn=print):
+        self.frequency = frequency
+        self.print_fn = print_fn
+        self.batch_size = None  # auto-filled by fit() from the first batch
+        self._last_time = None
+        self._last_iter = None
+        self.samples_per_sec = float("nan")
+        self.batches_per_sec = float("nan")
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration > self._last_iter:
+            dt = now - self._last_time
+            n_batches = iteration - self._last_iter
+            self.batches_per_sec = n_batches / dt
+            if self.batch_size:
+                self.samples_per_sec = self.batch_size * self.batches_per_sec
+            if iteration % self.frequency == 0:
+                self.print_fn(
+                    f"iteration {iteration}: {self.batches_per_sec:.1f} batches/sec"
+                    + (f", {self.samples_per_sec:.1f} samples/sec"
+                       if self.batch_size else ""))
+        self._last_time = now
+        self._last_iter = iteration
+
+
+class CheckpointListener(Listener):
+    """Periodic model save (reference: optimize/listeners/CheckpointListener
+    + autodiff/listeners/checkpoint/CheckpointListener): keep-last-N,
+    every-N-epochs."""
+
+    def __init__(self, save_dir, every_n_epochs: int = 1, keep_last: int = 3):
+        import os
+        self.save_dir = str(save_dir)
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        self._saved: List[str] = []
+        os.makedirs(self.save_dir, exist_ok=True)
+
+    def on_epoch_end(self, sd, epoch, mean_loss):
+        import os
+        if (epoch + 1) % self.every_n_epochs != 0:
+            return
+        path = os.path.join(self.save_dir, f"checkpoint_epoch_{epoch}.zip")
+        sd.save(path, include_updater_state=True)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def last_checkpoint(self) -> Optional[str]:
+        return self._saved[-1] if self._saved else None
+
+
+class EarlyStoppingListener(Listener):
+    """Stop when the score stops improving (reference: earlystopping/
+    EarlyStoppingTrainer + termination conditions, compressed into a
+    listener since fit() owns the loop here)."""
+
+    def __init__(self, patience: int = 5, min_delta: float = 0.0,
+                 max_epochs: Optional[int] = None):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.max_epochs = max_epochs
+        self.best_loss = float("inf")
+        self.best_epoch = -1
+        self.stopped_epoch = None
+
+    def on_epoch_end(self, sd, epoch, mean_loss):
+        if mean_loss < self.best_loss - self.min_delta:
+            self.best_loss = mean_loss
+            self.best_epoch = epoch
+            return None
+        if epoch - self.best_epoch >= self.patience or \
+                (self.max_epochs is not None and epoch + 1 >= self.max_epochs):
+            self.stopped_epoch = epoch
+            return False
+        return None

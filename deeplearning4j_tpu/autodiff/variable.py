@@ -1,0 +1,218 @@
+"""SDVariable — symbolic variable in a SameDiff-equivalent graph.
+
+Reference parity: org.nd4j.autodiff.samediff.SDVariable (SDVariable.java:46)
+and VariableType (VariableType.java). A variable is a named node:
+
+- VARIABLE    : trainable parameter (has a value; receives gradients)
+- CONSTANT    : fixed value (no gradient)
+- PLACEHOLDER : fed at execution time
+- ARRAY       : output of an op (computed, never stored)
+
+Unlike the reference — where SDVariable wraps an INDArray that the Java
+interpreter materializes per-op — here a variable is purely a graph name;
+values only exist inside the single compiled XLA computation (or in the
+parameter store for VARIABLE/CONSTANT).
+"""
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Optional, Sequence, Tuple, Union
+
+if TYPE_CHECKING:
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class VariableType(enum.Enum):
+    VARIABLE = "VARIABLE"
+    CONSTANT = "CONSTANT"
+    PLACEHOLDER = "PLACEHOLDER"
+    ARRAY = "ARRAY"
+
+
+class SDVariable:
+    __slots__ = ("sd", "name", "var_type", "_shape", "_dtype")
+
+    def __init__(self, sd: "SameDiff", name: str, var_type: VariableType,
+                 shape: Optional[Tuple[int, ...]] = None, dtype: str = "float32"):
+        self.sd = sd
+        self.name = name
+        self.var_type = var_type
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        return (f"SDVariable(name={self.name!r}, type={self.var_type.value}, "
+                f"shape={self._shape}, dtype={self._dtype})")
+
+    @property
+    def shape(self) -> Optional[Tuple[int, ...]]:
+        if self._shape is None:
+            self._shape = self.sd.infer_shape(self.name)
+        return self._shape
+
+    @property
+    def dtype(self) -> str:
+        return self._dtype
+
+    def rank(self) -> int:
+        s = self.shape
+        return len(s) if s is not None else -1
+
+    # value access ------------------------------------------------------
+    def eval(self, placeholders=None):
+        """Evaluate this variable (reference: SDVariable.eval())."""
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def get_arr(self):
+        """Stored value for VARIABLE/CONSTANT (reference: SDVariable.getArr())."""
+        return self.sd.get_arr_for_var(self.name)
+
+    def set_arr(self, value):
+        self.sd.set_arr_for_var(self.name, value)
+
+    def rename(self, new_name: str) -> "SDVariable":
+        return self.sd.rename_variable(self.name, new_name)
+
+    def mark_as_loss(self) -> "SDVariable":
+        if self.name not in self.sd.loss_variables:
+            self.sd.set_loss_variables(
+                list(self.sd.loss_variables) + [self.name])
+        return self
+
+    def convert_to_constant(self) -> "SDVariable":
+        return self.sd.convert_to_constant(self)
+
+    def convert_to_variable(self) -> "SDVariable":
+        return self.sd.convert_to_variable(self)
+
+    # op sugar ----------------------------------------------------------
+    def _op(self, op_name: str, *others, name: Optional[str] = None, **attrs):
+        inputs = [self] + [self.sd._lift(o) for o in others]
+        return self.sd.invoke(op_name, inputs, attrs, name=name)
+
+    # arithmetic
+    def add(self, other, name=None):  return self._op("add", other, name=name)
+    def sub(self, other, name=None):  return self._op("subtract", other, name=name)
+    def mul(self, other, name=None):  return self._op("multiply", other, name=name)
+    def div(self, other, name=None):  return self._op("divide", other, name=name)
+    def rsub(self, other, name=None): return self.sd._lift(other)._op("subtract", self, name=name)
+    def rdiv(self, other, name=None): return self.sd._lift(other)._op("divide", self, name=name)
+    def pow(self, other, name=None):  return self._op("pow", other, name=name)
+    def neg(self, name=None):         return self._op("neg", name=name)
+    def fmod(self, other, name=None): return self._op("fmod", other, name=name)
+
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __pow__ = pow
+    __neg__ = neg
+    def __radd__(self, other): return self.sd._lift(other).add(self)
+    def __rsub__(self, other): return self.sd._lift(other).sub(self)
+    def __rmul__(self, other): return self.sd._lift(other).mul(self)
+    def __rtruediv__(self, other): return self.sd._lift(other).div(self)
+
+    # comparisons (return numeric mask like the reference)
+    def gt(self, other, name=None):  return self._op("greater", other, name=name)
+    def gte(self, other, name=None): return self._op("greater_equal", other, name=name)
+    def lt(self, other, name=None):  return self._op("less", other, name=name)
+    def lte(self, other, name=None): return self._op("less_equal", other, name=name)
+    def eq(self, other, name=None):  return self._op("equals", other, name=name)
+    def neq(self, other, name=None): return self._op("not_equals", other, name=name)
+
+    # linalg
+    def mmul(self, other, name=None):
+        return self._op("matmul", other, name=name)
+
+    def dot(self, other, name=None):
+        return self._op("matmul", other, name=name)
+
+    def tensordot(self, other, axes_a, axes_b, name=None):
+        return self._op("tensordot", other, name=name, axes_a=axes_a, axes_b=axes_b)
+
+    # reductions
+    def _red(self, op_name, dims, keep_dims, name):
+        attrs = {"keep_dims": keep_dims}
+        if dims is not None:
+            attrs["axis"] = tuple(dims) if isinstance(dims, (list, tuple)) else (dims,)
+        return self._op(op_name, name=name, **attrs)
+
+    def sum(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_sum", dims, keep_dims, name)
+
+    def mean(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_mean", dims, keep_dims, name)
+
+    def max(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_max", dims, keep_dims, name)
+
+    def min(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_min", dims, keep_dims, name)
+
+    def prod(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_prod", dims, keep_dims, name)
+
+    def std(self, dims=None, keep_dims=False, bias_corrected=True, name=None):
+        attrs = {"keep_dims": keep_dims, "bias_corrected": bias_corrected}
+        if dims is not None:
+            attrs["axis"] = tuple(dims) if isinstance(dims, (list, tuple)) else (dims,)
+        return self._op("reduce_stdev", name=name, **attrs)
+
+    def var(self, dims=None, keep_dims=False, bias_corrected=True, name=None):
+        attrs = {"keep_dims": keep_dims, "bias_corrected": bias_corrected}
+        if dims is not None:
+            attrs["axis"] = tuple(dims) if isinstance(dims, (list, tuple)) else (dims,)
+        return self._op("reduce_variance", name=name, **attrs)
+
+    def norm1(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_norm1", dims, keep_dims, name)
+
+    def norm2(self, dims=None, keep_dims=False, name=None):
+        return self._red("reduce_norm2", dims, keep_dims, name)
+
+    def argmax(self, dim=-1, name=None):
+        return self._op("argmax", name=name, axis=dim)
+
+    def argmin(self, dim=-1, name=None):
+        return self._op("argmin", name=name, axis=dim)
+
+    # shape ops
+    def reshape(self, *shape, name=None):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self._op("reshape", name=name, shape=shape)
+
+    def permute(self, *dims, name=None):
+        if len(dims) == 1 and isinstance(dims[0], (list, tuple)):
+            dims = tuple(dims[0])
+        return self._op("permute", name=name, axes=dims)
+
+    def transpose(self, name=None):
+        return self._op("transpose", name=name)
+
+    def squeeze(self, axis=None, name=None):
+        return self._op("squeeze", name=name, axis=axis)
+
+    def expand_dims(self, axis, name=None):
+        return self._op("expand_dims", name=name, axis=axis)
+
+    def cast(self, dtype, name=None):
+        return self._op("cast", name=name, dtype=str(dtype))
+
+    def get(self, begin, end, strides=None, name=None):
+        """Static slice (reference: SDVariable.get(SDIndex...))."""
+        return self._op("strided_slice", name=name, begin=tuple(begin),
+                        end=tuple(end), strides=tuple(strides) if strides else None)
+
+    # common math sugar
+    def abs(self, name=None):     return self._op("abs", name=name)
+    def exp(self, name=None):     return self._op("exp", name=name)
+    def log(self, name=None):     return self._op("log", name=name)
+    def sqrt(self, name=None):    return self._op("sqrt", name=name)
+    def square(self, name=None):  return self._op("square", name=name)
+    def sigmoid(self, name=None): return self._op("sigmoid", name=name)
+    def tanh(self, name=None):    return self._op("tanh", name=name)
+    def relu(self, name=None):    return self._op("relu", name=name)
+    def softmax(self, axis=-1, name=None):
+        return self._op("softmax", name=name, axis=axis)
