@@ -67,6 +67,12 @@ class SameDiff:
         self._producer: Dict[str, str] = {}       # var name -> op node name
         self._name_counter: Dict[str, int] = {}
         self.loss_variables: List[str] = []
+        # non-trainable state vars (e.g. BN running stats): carried through
+        # the compiled step, updated from graph outputs, never given to the
+        # updater (reference: BatchNormalization's self-updated mean/var
+        # params, excluded from the gradient view)
+        self._state_var_names: set = set()
+        self._state_updates: Dict[str, str] = {}  # state var -> source output
         self._version = 0                         # bump on any mutation
         self._fn_cache: Dict[Any, Any] = {}
         self.training_config = None
@@ -172,7 +178,29 @@ class SameDiff:
 
     def trainable_params(self) -> Dict[str, jax.Array]:
         return {n: self._arrays[n] for n, v in self._vars.items()
-                if v.var_type == VariableType.VARIABLE}
+                if v.var_type == VariableType.VARIABLE
+                and n not in self._state_var_names}
+
+    def state_var(self, name: str, value, dtype: str = "float32") -> SDVariable:
+        """Non-trainable state variable (e.g. BN running mean): updated via
+        update_state(), not by the updater."""
+        v = self.var(name, value=value, dtype=dtype)
+        self._state_var_names.add(v.name)
+        return v
+
+    def update_state(self, state_var: Union[str, SDVariable],
+                     new_value: Union[str, SDVariable]) -> None:
+        """Declare that ``state_var`` takes the value of graph output
+        ``new_value`` after each training step."""
+        sn = state_var.name if isinstance(state_var, SDVariable) else state_var
+        nn_ = new_value.name if isinstance(new_value, SDVariable) else new_value
+        if sn not in self._state_var_names:
+            raise ValueError(f"{sn!r} is not a state var")
+        self._state_updates[sn] = nn_
+        self._mutated()
+
+    def state_vars_map(self) -> Dict[str, jax.Array]:
+        return {n: self._arrays[n] for n in self._state_var_names}
 
     def constants_map(self) -> Dict[str, jax.Array]:
         return {n: self._arrays[n] for n, v in self._vars.items()
@@ -335,7 +363,8 @@ class SameDiff:
         if key is None:
             key = jax.random.key(self._seed)
             self._seed += 1
-        res = compiled(self.trainable_params(), self.constants_map(), ph, key)
+        res = compiled({**self.trainable_params(), **self.state_vars_map()},
+                       self.constants_map(), ph, key)
         return {k: NDArray(v) for k, v in res.items()}
 
     # reference names
@@ -371,7 +400,10 @@ class SameDiff:
                 shape = pv._shape
             ph_specs[pn] = jax.ShapeDtypeStruct(shape, DataType.from_any(pv.dtype).jnp)
         try:
-            out = jax.eval_shape(fn, self.trainable_params(), self.constants_map(),
+            out = jax.eval_shape(fn,
+                                 {**self.trainable_params(),
+                                  **self.state_vars_map()},
+                                 self.constants_map(),
                                  ph_specs, jax.random.key(0))
             return tuple(out[name].shape)
         except Exception:
@@ -400,7 +432,7 @@ class SameDiff:
 
             compiled = jax.jit(jax.grad(loss_fn))
             self._fn_cache[cache_key] = compiled
-        params = self.trainable_params()
+        params = {**self.trainable_params(), **self.state_vars_map()}
         wrt_params = {n: params[n] for n in wrt_names}
         other = {n: p for n, p in params.items() if n not in wrt_names}
         if key is None:
@@ -430,7 +462,9 @@ class SameDiff:
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
         loss_names = self._resolve_loss()
-        fn = self._trace_fn(loss_names)
+        state_updates = dict(self._state_updates)
+        trace_outputs = loss_names + tuple(state_updates.values())
+        fn = self._trace_fn(trace_outputs)
         updater = tc.updater
         regs = tc.regularization or []
 
@@ -438,12 +472,17 @@ class SameDiff:
         pre_regs = [r for r in regs if r.apply_step == "BEFORE_UPDATER"]
         post_regs = [r for r in regs if r.apply_step == "POST_UPDATER"]
 
-        def step(params, state, constants, phv, iteration, key):
+        def step(params, svars, state, constants, phv, iteration, key):
             def loss_fn(p):
-                outs = fn(p, constants, phv, key)
-                return sum(jnp.sum(outs[ln]) for ln in loss_names)
+                outs = fn({**p, **jax.lax.stop_gradient(svars)},
+                          constants, phv, key)
+                return sum(jnp.sum(outs[ln]) for ln in loss_names), outs
 
-            data_loss, grads = jax.value_and_grad(loss_fn)(params)
+            (data_loss, outs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_svars = {sn: outs[src] for sn, src in state_updates.items()}
+            # state vars with no declared update carry over unchanged
+            new_svars = {**svars, **new_svars}
             lr = resolve_lr(getattr(updater, "learning_rate", 0.0), iteration, 0)
             # L1/L2 modify the gradient pre-updater; WeightDecay modifies the
             # update post-updater (reference: BaseMultiLayerUpdater.update)
@@ -460,12 +499,12 @@ class SameDiff:
                     lambda p, u: r.apply(p, u, lr), params, updates)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p - u, params, updates)
-            return new_params, new_state, data_loss
+            return new_params, new_svars, new_state, data_loss
 
         cache_key = ("train_step", self._version, loss_names, donate)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
-            compiled = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+            compiled = jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
 
@@ -481,6 +520,7 @@ class SameDiff:
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
+        svars = jax.tree_util.tree_map(jnp.copy, self.state_vars_map())
         # restored state only reusable if the trainable set hasn't changed
         # (e.g. convert_to_constant between fits); otherwise re-init
         if self._updater_state is not None and \
@@ -512,8 +552,8 @@ class SameDiff:
                         l.batch_size = next(iter(ph.values())).shape[0]
                 key = jax.random.key(self._seed)
                 self._seed += 1
-                params, state, loss_val = step(params, state, constants, ph,
-                                               iteration, key)
+                params, svars, state, loss_val = step(
+                    params, svars, state, constants, ph, iteration, key)
                 loss_f = float(loss_val)
                 epoch_losses.append(loss_f)
                 for l in listeners:
@@ -524,7 +564,7 @@ class SameDiff:
             if listeners:
                 # sync current params/state into the graph (copies — the next
                 # step donates the working buffers) so listeners can save/eval
-                for n, p in params.items():
+                for n, p in {**params, **svars}.items():
                     self._arrays[n] = jnp.copy(p)
                 self._updater_state = jax.tree_util.tree_map(jnp.copy, state)
                 tc.iteration_count = iteration
@@ -535,7 +575,7 @@ class SameDiff:
             if stop:
                 break
         # write trained params back into the graph
-        for n, p in params.items():
+        for n, p in {**params, **svars}.items():
             self._arrays[n] = p
         self._updater_state = state
         tc.iteration_count = iteration

@@ -77,6 +77,8 @@ def save(sd, path, include_updater_state: bool = True) -> None:
             for n in sd.ops()
         ],
         "loss_variables": sd.loss_variables,
+        "state_vars": sorted(sd._state_var_names),
+        "state_updates": dict(sd._state_updates),
         "training_config": sd.training_config.to_json()
         if sd.training_config else None,
     }
@@ -131,6 +133,8 @@ def load(path):
         for on in node.outputs:
             sd._producer[on] = node.name
     sd.loss_variables = list(graph.get("loss_variables", []))
+    sd._state_var_names = set(graph.get("state_vars", []))
+    sd._state_updates = dict(graph.get("state_updates", {}))
     if graph.get("training_config"):
         sd.training_config = TrainingConfig.from_json(graph["training_config"])
         if updater_leaves is not None:

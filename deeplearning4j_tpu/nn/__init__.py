@@ -1,0 +1,22 @@
+"""Layer-based NN framework (reference: deeplearning4j-nn).
+
+Config DSL + MultiLayerNetwork compiled through the SameDiff graph layer —
+one execution path, whole-step XLA compilation.
+"""
+from deeplearning4j_tpu.nn.conf import (
+    MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, InputType, LSTMLayer,
+    LossLayer, OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.activations import resolve_activation
+
+__all__ = [
+    "NeuralNetConfiguration", "MultiLayerConfiguration", "MultiLayerNetwork",
+    "InputType", "DenseLayer", "ConvolutionLayer", "SubsamplingLayer",
+    "BatchNormalization", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
+    "LSTMLayer", "GlobalPoolingLayer", "OutputLayer", "LossLayer",
+    "init_weights", "resolve_activation",
+]

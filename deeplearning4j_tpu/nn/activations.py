@@ -1,0 +1,47 @@
+"""Activation resolution for layer configs.
+
+Reference parity: org.nd4j.linalg.activations.Activation enum (IActivation
+impls under nd4j linalg/activations/impl) — names map onto registry ops.
+"""
+from __future__ import annotations
+
+_ALIASES = {
+    "identity": "identity",
+    "linear": "identity",
+    "relu": "relu",
+    "relu6": "relu6",
+    "leakyrelu": "leaky_relu",
+    "leaky_relu": "leaky_relu",
+    "elu": "elu",
+    "selu": "selu",
+    "gelu": "gelu",
+    "sigmoid": "sigmoid",
+    "hardsigmoid": "hard_sigmoid",
+    "hard_sigmoid": "hard_sigmoid",
+    "tanh": "tanh",
+    "hardtanh": "hard_tanh",
+    "hard_tanh": "hard_tanh",
+    "softmax": "softmax",
+    "softplus": "softplus",
+    "softsign": "softsign",
+    "swish": "swish",
+    "mish": "mish",
+    "cube": "cube",
+}
+
+
+def resolve_activation(name: str) -> str:
+    """Activation name -> registry op name."""
+    key = name.lower()
+    if key not in _ALIASES:
+        raise ValueError(f"unknown activation {name!r}; "
+                         f"known: {sorted(set(_ALIASES))}")
+    return _ALIASES[key]
+
+
+def apply_activation(sd, x, name: str, layer_name: str = None):
+    op = resolve_activation(name)
+    if op == "identity":
+        return x
+    kwargs = {"name": f"{layer_name}_act" if layer_name else None}
+    return sd.invoke(op, [x], {}, **kwargs)

@@ -1,0 +1,68 @@
+"""Weight initialization schemes.
+
+Reference parity: org.deeplearning4j.nn.weights.WeightInit enum +
+WeightInitUtil (deeplearning4j-nn nn/weights/) — same variance formulas:
+XAVIER = N(0, 2/(fanIn+fanOut)), RELU = N(0, 2/fanIn), LECUN_NORMAL =
+N(0, 1/fanIn), *_UNIFORM variants with the matching bounds.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Tuple
+
+import numpy as np
+
+
+def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels HWIO: receptive field * channels
+    rf = int(np.prod(shape[:-2]))
+    return shape[-2] * rf, shape[-1] * rf
+
+
+def init_weights(scheme: str, shape: Tuple[int, ...],
+                 rng: np.random.Generator) -> np.ndarray:
+    scheme = scheme.upper()
+    fan_in, fan_out = _fans(tuple(shape))
+    if scheme == "ZERO":
+        return np.zeros(shape)
+    if scheme == "ONES":
+        return np.ones(shape)
+    if scheme == "IDENTITY":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY needs a square 2d shape")
+        return np.eye(shape[0])
+    if scheme == "NORMAL":
+        return rng.normal(0.0, 1.0 / math.sqrt(fan_in), shape)
+    if scheme == "XAVIER":
+        return rng.normal(0.0, math.sqrt(2.0 / (fan_in + fan_out)), shape)
+    if scheme == "XAVIER_UNIFORM":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return rng.uniform(-a, a, shape)
+    if scheme == "RELU":
+        return rng.normal(0.0, math.sqrt(2.0 / fan_in), shape)
+    if scheme == "RELU_UNIFORM":
+        a = math.sqrt(6.0 / fan_in)
+        return rng.uniform(-a, a, shape)
+    if scheme == "LECUN_NORMAL":
+        return rng.normal(0.0, math.sqrt(1.0 / fan_in), shape)
+    if scheme == "LECUN_UNIFORM":
+        a = math.sqrt(3.0 / fan_in)
+        return rng.uniform(-a, a, shape)
+    if scheme == "UNIFORM":
+        a = 1.0 / math.sqrt(fan_in)
+        return rng.uniform(-a, a, shape)
+    if scheme == "SIGMOID_UNIFORM":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return rng.uniform(-a, a, shape)
+    if scheme == "VAR_SCALING_NORMAL_FAN_AVG":
+        return rng.normal(0.0, math.sqrt(2.0 / (fan_in + fan_out)), shape)
+    raise ValueError(f"unknown weight init scheme: {scheme}")
+
+
+ALL_SCHEMES = ["ZERO", "ONES", "IDENTITY", "NORMAL", "XAVIER",
+               "XAVIER_UNIFORM", "RELU", "RELU_UNIFORM", "LECUN_NORMAL",
+               "LECUN_UNIFORM", "UNIFORM", "SIGMOID_UNIFORM"]

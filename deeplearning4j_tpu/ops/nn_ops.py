@@ -492,6 +492,15 @@ def lstm_layer(x, h0, c0, w_ih, w_hh, b, time_major: bool = False,
     return hT, hT, cT
 
 
+@op("rnn_init_state", _N, n_inputs=1, differentiable=False)
+def rnn_init_state(x, units: int, time_major: bool = False):
+    """Zero initial hidden state (batch, units) derived from the sequence
+    input inside the graph — keeps batch size dynamic (no host-side shape
+    dependency; reference layers allocate h0/c0 eagerly per minibatch)."""
+    batch = x.shape[0] if not time_major else x.shape[1]
+    return jnp.zeros((batch, units), x.dtype)
+
+
 @op("gru_cell", _N)
 def gru_cell(x, h_prev, w_ih, w_hh, b_ih, b_hh):
     """One GRU step (reference: generic/recurrent/gruCell.cpp gate order r,u,c)."""

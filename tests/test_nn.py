@@ -1,0 +1,317 @@
+"""Layer-based API tests (reference test model: deeplearning4j platform-tests
+dl4jcore/nn — config serde round-trips, forward shapes, fit convergence,
+ModelSerializer round-trip)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, InputType, LSTMLayer,
+    MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer, SubsamplingLayer)
+
+
+def _mlp_conf(updater=None, l2=0.0):
+    b = (NeuralNetConfiguration.builder()
+         .seed(7)
+         .updater(updater or Adam(learning_rate=0.05)))
+    if l2:
+        b = b.l2(l2)
+    return (b.list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+
+
+def _xor():
+    X = np.tile(np.array([[0, 0], [0, 1], [1, 0], [1, 1]], dtype=np.float32),
+                (16, 1))
+    Y = np.eye(2, dtype=np.float32)[
+        (X[:, 0].astype(int) ^ X[:, 1].astype(int))]
+    return X, Y
+
+
+def test_builder_produces_config():
+    conf = _mlp_conf(l2=1e-4)
+    assert len(conf.layers) == 2
+    assert conf.seed == 7
+    assert conf.regularization[0].l2 == 1e-4
+
+
+def test_config_json_round_trip():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(99)
+            .updater(Adam(learning_rate=0.001))
+            .l2(5e-4)
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="MAX", kernel_size=(2, 2)))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=32, activation="relu", dropout=0.8))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert conf2.layers[0].kernel_size == (3, 3)
+    assert conf2.updater == conf.updater
+    assert conf2.input_type == conf.input_type
+
+
+def test_mlp_fit_and_predict_xor():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    X, Y = _xor()
+    hist = net.fit(X, Y, epochs=60, batch_size=16)
+    assert hist.final_loss() < 0.05
+    assert net.score() < 0.05
+    preds = net.predict(X[:4])
+    np.testing.assert_array_equal(preds, [0, 1, 1, 0])
+
+
+def test_output_shape_and_probabilities():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    X, _ = _xor()
+    out = net.output(X[:8]).to_numpy()
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(out.sum(-1), np.ones(8), rtol=1e-5)
+
+
+def test_num_params():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    # dense 2*16+16, out 16*2+2
+    assert net.num_params() == (2 * 16 + 16) + (16 * 2 + 2)
+
+
+def test_cnn_shapes_lenet_style():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(learning_rate=0.01))
+            .list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5),
+                                    convolution_mode="SAME",
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5),
+                                    convolution_mode="VALID",
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 1, 28, 28)).astype(np.float32)
+    out = net.output(x).to_numpy()
+    assert out.shape == (4, 10)
+    # conv SAME 28->28, pool 14, conv VALID 10, pool 5 → flat 16*5*5=400
+    assert "400" in net.summary() or net.num_params() > 0
+
+
+def test_cnn_learns_synthetic():
+    rng = np.random.default_rng(5)
+    # class 0: bright top-left quadrant; class 1: bright bottom-right
+    n = 64
+    X = rng.normal(0, 0.1, size=(n, 1, 8, 8)).astype(np.float32)
+    y = rng.integers(0, 2, n)
+    X[y == 0, :, :4, :4] += 1.0
+    X[y == 1, :, 4:, 4:] += 1.0
+    Y = np.eye(2, dtype=np.float32)[y]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="AVG"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, Y, epochs=60, batch_size=32)
+    acc = (net.predict(X) == y).mean()
+    assert acc > 0.9
+
+
+def test_batchnorm_trains_and_infers():
+    X, Y = _xor()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11)
+            .updater(Adam(learning_rate=0.05))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="identity"))
+            .layer(BatchNormalization())
+            .layer(ActivationLayer(activation="tanh"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, Y, epochs=40, batch_size=16)
+    # running stats were updated away from init
+    p = net.params()
+    mean_key = [k for k in p if k.endswith("_mean")][0]
+    assert np.abs(p[mean_key]).sum() > 0
+    # inference uses running stats and still classifies
+    preds = net.predict(X[:4])
+    np.testing.assert_array_equal(preds, [0, 1, 1, 0])
+
+
+def test_dropout_only_in_training_graph():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2)
+            .updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(DenseLayer(n_out=64, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    a = net.output(x).to_numpy()
+    b = net.output(x).to_numpy()
+    np.testing.assert_array_equal(a, b)  # inference deterministic
+    t1 = net.output(x, training=True).to_numpy()
+    t2 = net.output(x, training=True).to_numpy()
+    assert not np.array_equal(t1, t2)    # dropout active in train graph
+
+
+def test_lstm_classifier():
+    rng = np.random.default_rng(8)
+    # class = whether the sequence mean of feature 0 is positive
+    X = rng.normal(size=(64, 10, 3)).astype(np.float32)
+    y = (X[:, :, 0].mean(1) > 0).astype(int)
+    Y = np.eye(2, dtype=np.float32)[y]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4)
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(LSTMLayer(n_out=16, return_sequences=False))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, 10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, Y, epochs=40, batch_size=32)
+    acc = (net.predict(X) == y).mean()
+    assert acc > 0.85
+
+
+def test_embedding_layer():
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 10, size=(64, 1)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(ids[:, 0] % 2).astype(int)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(6)
+            .updater(Adam(learning_rate=0.05))
+            .list()
+            .layer(EmbeddingLayer(n_in=10, n_out=8))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ids, Y, epochs=40, batch_size=32)
+    acc = (net.predict(ids) == (ids[:, 0] % 2)).mean()
+    assert acc > 0.95
+
+
+def test_model_serializer_round_trip(tmp_path):
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=10, batch_size=16)
+    before = net.output(X[:8]).to_numpy()
+    path = tmp_path / "net.zip"
+    net.save(path)
+    net2 = MultiLayerNetwork.load(path)
+    after = net2.output(X[:8]).to_numpy()
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+    # training resumes (updater state restored)
+    h = net2.fit(X, Y, epochs=2, batch_size=16)
+    assert np.isfinite(h.final_loss())
+    assert net2._sd_train.training_config.iteration_count > 0
+
+
+def test_regularization_shrinks_weights():
+    X, Y = _xor()
+    net_plain = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    net_l2 = MultiLayerNetwork(
+        _mlp_conf(Sgd(learning_rate=0.1), l2=0.3)).init()
+    net_plain.fit(X, Y, epochs=20, batch_size=16)
+    net_l2.fit(X, Y, epochs=20, batch_size=16)
+    w_plain = np.abs(net_plain.params()["layer0_dense_W"]).mean()
+    w_l2 = np.abs(net_l2.params()["layer0_dense_W"]).mean()
+    assert w_l2 < w_plain
+
+
+def test_summary_lists_layers():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    s = net.summary()
+    assert "DenseLayer" in s and "OutputLayer" in s
+
+
+def test_uninitialized_raises():
+    net = MultiLayerNetwork(_mlp_conf())
+    with pytest.raises(RuntimeError, match="init"):
+        net.output(np.zeros((1, 2), dtype=np.float32))
+
+
+# ---- regression tests for review findings ----
+
+def test_dilated_valid_conv_shape():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    dilation=(2, 2),
+                                    convolution_mode="VALID"))
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.output(np.zeros((2, 1, 8, 8), dtype=np.float32)).to_numpy()
+    assert out.shape == (2, 2)
+    # effective kernel 5 → 8-5+1 = 4
+    assert conf.layers[0].output_type(conf.input_type).dims == (4, 4, 4)
+
+
+def test_batchnorm_on_rnn_sequences():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(LSTMLayer(n_out=6))
+            .layer(BatchNormalization())
+            .layer(GlobalPoolingLayer(pooling_type="AVG"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, 10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    X = np.random.default_rng(0).normal(size=(4, 10, 3)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    net.fit(X, Y, epochs=2, batch_size=4)
+    assert net.output(X).to_numpy().shape == (4, 2)
+
+
+def test_embedding_rejects_multicolumn_input():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(EmbeddingLayer(n_in=10, n_out=4))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    with pytest.raises(ValueError, match="single index column"):
+        MultiLayerNetwork(conf).init()
+
+
+def test_infer_shape_through_state_vars():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert net._sd_train.get_variable("output").shape is not None
