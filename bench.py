@@ -1,0 +1,55 @@
+"""Benchmark: flagship LeNet-class CNN training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric = steady-state training samples/sec (PerformanceListener definition,
+reference optimize/listeners/PerformanceListener.java:46-118) for
+MultiLayerNetwork.fit() on MNIST-shaped synthetic data, batch 128 —
+BASELINE.md target config 1 (LeNet MNIST fit()). The reference publishes no
+numbers (BASELINE.json "published": {}), so vs_baseline is reported as 1.0
+(parity placeholder) until a measured reference baseline exists.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    from __graft_entry__ import _flagship
+
+    batch = 128
+    steps_per_epoch = 8
+    n = batch * steps_per_epoch
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 1, 28, 28)).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+
+    net = _flagship()
+
+    class _It:
+        def reset(self): ...
+        def __iter__(self):
+            for i in range(0, n, batch):
+                yield X[i:i + batch], Y[i:i + batch]
+
+    # warmup epoch (compile) then timed epochs
+    net.fit(_It(), epochs=1)
+    t0 = time.perf_counter()
+    timed_epochs = 5
+    net.fit(_It(), epochs=timed_epochs)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = timed_epochs * n / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -472,7 +472,11 @@ class SameDiff:
         pre_regs = [r for r in regs if r.apply_step == "BEFORE_UPDATER"]
         post_regs = [r for r in regs if r.apply_step == "POST_UPDATER"]
 
-        def step(params, svars, state, constants, phv, iteration, key):
+        def step(params, svars, state, iteration, constants, phv, base_key):
+            # per-step key derived ON DEVICE (a host-side jax.random.key per
+            # step costs a tunnel round-trip; fold_in is free inside the jit)
+            key = jax.random.fold_in(base_key, iteration)
+
             def loss_fn(p):
                 outs = fn({**p, **jax.lax.stop_gradient(svars)},
                           constants, phv, key)
@@ -499,12 +503,14 @@ class SameDiff:
                     lambda p, u: r.apply(p, u, lr), params, updates)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p - u, params, updates)
-            return new_params, new_svars, new_state, data_loss
+            # iteration advances on device — no per-step int transfer
+            return new_params, new_svars, new_state, iteration + 1, data_loss
 
         cache_key = ("train_step", self._version, loss_names, donate)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
-            compiled = jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+            compiled = jax.jit(step,
+                               donate_argnums=(0, 1, 2, 3) if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
 
@@ -530,36 +536,62 @@ class SameDiff:
             state = tc.updater.init(params)
         constants = self.constants_map()
         iteration = getattr(tc, "iteration_count", 0)
+        it_dev = jnp.asarray(iteration, jnp.int32)    # one transfer per fit
+        base_key = jax.random.key(self._seed)          # one key per fit
+        self._seed += 1
         history = History()
+        deferred_means = []   # device scalars, fetched once at fit end
         for l in listeners:
             l.on_training_start(self)
+
+        def _prep_batch(batch):
+            if isinstance(batch, dict):
+                ph = dict(batch)  # keys are placeholder names
+            else:
+                feats, labels = _split_batch(batch)
+                ph = dict(zip(tc.data_set_feature_mapping, feats))
+                ph.update(zip(tc.data_set_label_mapping, labels))
+            return self._prep_placeholders(ph)
+
         for epoch in range(epochs):
             epoch_losses = []
             for l in listeners:
                 l.on_epoch_start(self, epoch)
             if hasattr(dataset_iterator, "reset"):
                 dataset_iterator.reset()
-            for batch in dataset_iterator:
-                if isinstance(batch, dict):
-                    ph = dict(batch)  # keys are placeholder names
-                else:
-                    feats, labels = _split_batch(batch)
-                    ph = dict(zip(tc.data_set_feature_mapping, feats))
-                    ph.update(zip(tc.data_set_label_mapping, labels))
-                ph = self._prep_placeholders(ph)
+            # one-batch-ahead prefetch: enqueue the NEXT batch's host→HBM
+            # transfer before stepping on the current one, so transfers
+            # overlap compute (reference: AsyncDataSetIterator's prefetch
+            # thread, MultiLayerNetwork.java:1678)
+            batch_iter = iter(dataset_iterator)
+            ph = next((_prep_batch(b) for b in batch_iter), None)
+            while ph is not None:
+                nxt = next((_prep_batch(b) for b in batch_iter), None)
                 for l in listeners:
                     if getattr(l, "batch_size", -1) is None:
                         l.batch_size = next(iter(ph.values())).shape[0]
-                key = jax.random.key(self._seed)
-                self._seed += 1
-                params, svars, state, loss_val = step(
-                    params, svars, state, constants, ph, iteration, key)
-                loss_f = float(loss_val)
-                epoch_losses.append(loss_f)
-                for l in listeners:
-                    l.iteration_done(self, epoch, iteration, loss_f)
+                params, svars, state, it_dev, loss_val = step(
+                    params, svars, state, it_dev, constants, ph, base_key)
+                # without listeners, never force a device sync: losses stay
+                # async device scalars (a scalar fetch = tunnel round-trip)
+                if listeners:
+                    loss_f = float(loss_val)
+                    epoch_losses.append(loss_f)
+                    for l in listeners:
+                        l.iteration_done(self, epoch, iteration, loss_f)
+                else:
+                    epoch_losses.append(loss_val)
                 iteration += 1
-            mean_loss = float(np.mean(epoch_losses)) if epoch_losses else float("nan")
+                ph = nxt
+            if listeners:
+                mean_loss = float(np.mean(epoch_losses)) \
+                    if epoch_losses else float("nan")
+            else:
+                # mean on device, fetch deferred to fit end (one transfer)
+                mean_loss = None
+                deferred_means.append(
+                    jnp.mean(jnp.stack(epoch_losses)) if epoch_losses
+                    else jnp.asarray(float("nan")))
             history.add_epoch(epoch, mean_loss)
             if listeners:
                 # sync current params/state into the graph (copies — the next
@@ -574,6 +606,9 @@ class SameDiff:
                     stop = True
             if stop:
                 break
+        if deferred_means:
+            fetched = np.asarray(jnp.stack(deferred_means))
+            history.loss_curve.losses = [float(v) for v in fetched]
         # write trained params back into the graph
         for n, p in {**params, **svars}.items():
             self._arrays[n] = p

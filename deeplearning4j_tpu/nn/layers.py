@@ -393,13 +393,13 @@ class GlobalPoolingLayer(BaseLayer):
     pooling_type: str = "AVG"
 
     def output_type(self, itype):
-        if itype.kind == "cnn":
+        if itype.kind in ("cnn", "rnn"):
             return InputType.feed_forward(itype.dims[0])
-        if itype.kind == "rnn":
-            return InputType.feed_forward(itype.dims[0])
-        return itype
+        raise ValueError("GlobalPoolingLayer needs cnn or rnn input "
+                         "(reference GlobalPoolingLayer rejects FF input too)")
 
     def build(self, ctx, x, itype):
+        self.output_type(itype)  # validate input kind
         lname = f"layer{ctx.idx}_gpool"
         axis = (2, 3) if itype.kind == "cnn" else (1,)
         opname = {"AVG": "reduce_mean", "MAX": "reduce_max",

@@ -36,18 +36,17 @@ _WANTED_KIND = {
 }
 
 
-def _adapt_input(sd, x, itype: InputType, layer: BaseLayer, idx: int):
-    """Auto-insert input preprocessors (reference:
+def _adapt_itype(itype: InputType, layer: BaseLayer, idx: int) -> InputType:
+    """Preprocessor-kind rule — the ONE place deciding how an input type
+    adapts to a layer's wanted kind (reference:
     nn/conf/preprocessor/{CnnToFeedForward,...}PreProcessor, added
-    automatically by setInputType)."""
+    automatically by setInputType). Used by both graph build and type
+    walking so they cannot desynchronize."""
     wanted = _WANTED_KIND.get(type(layer).__name__)
     if wanted is None or wanted == itype.kind:
-        return x, itype
+        return itype
     if itype.kind == "cnn" and wanted == "ff":
-        flat = itype.flat_size
-        x = sd.invoke("reshape", [x], {"shape": (-1, flat)},
-                      name=f"layer{idx}_cnn2ff")
-        return x, InputType.feed_forward(flat)
+        return InputType.feed_forward(itype.flat_size)
     if itype.kind == "rnn" and wanted == "ff":
         # reference RnnToFeedForwardPreProcessor merges time into batch;
         # here the common intent after an LSTM is "last step" — use
@@ -60,15 +59,23 @@ def _adapt_input(sd, x, itype: InputType, layer: BaseLayer, idx: int):
                      f"(layer {idx}, {type(layer).__name__})")
 
 
+def _adapt_input(sd, x, itype: InputType, layer: BaseLayer, idx: int):
+    """Apply _adapt_itype's decision to the graph (emit the reshape)."""
+    new_itype = _adapt_itype(itype, layer, idx)
+    if new_itype is itype:
+        return x, itype
+    x = sd.invoke("reshape", [x], {"shape": (-1, new_itype.flat_size)},
+                  name=f"layer{idx}_cnn2ff")
+    return x, new_itype
+
+
 def _type_walk(conf: MultiLayerConfiguration):
     """Yield (idx, layer, adapted input type, output type) — the single
     source of truth for preprocessor-kind adaptation, shared by graph
     build sizing, summary() and _final_output_type()."""
     itype = conf.input_type
     for idx, layer in enumerate(conf.layers):
-        wanted = _WANTED_KIND.get(type(layer).__name__)
-        if wanted == "ff" and itype.kind == "cnn":
-            itype = InputType.feed_forward(itype.flat_size)
+        itype = _adapt_itype(itype, layer, idx)
         otype = layer.output_type(itype)
         yield idx, layer, itype, otype
         itype = otype

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism: DP/TP/SP over XLA collectives.
+
+The reference's distributed training was removed upstream (SURVEY.md §2.5);
+this package is the TPU-native replacement designed per the GSPMD recipe:
+named mesh → sharding annotations → XLA inserts ICI/DCN collectives.
+"""
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, DeviceMesh)
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingRule, ShardingStrategy, data_and_tensor_parallel, data_parallel,
+    tensor_parallel_rules)
+from deeplearning4j_tpu.parallel.trainer import (
+    ParallelInference, ParallelTrainer)
+from deeplearning4j_tpu.parallel.ring_attention import (
+    ring_attention, ulysses_attention)
+from deeplearning4j_tpu.parallel import collectives
+
+__all__ = [
+    "DeviceMesh", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
+    "ShardingRule", "ShardingStrategy", "data_parallel",
+    "data_and_tensor_parallel", "tensor_parallel_rules",
+    "ParallelTrainer", "ParallelInference", "ring_attention",
+    "ulysses_attention", "collectives",
+]

@@ -1,0 +1,53 @@
+"""Named collective wrappers for use inside shard_map-ped functions.
+
+Reference parity: the reference's removed Aeron parameter-server /
+GradientsAccumulator gradient sharing (SURVEY.md §2.5) — replaced wholesale
+by XLA collectives over ICI/DCN. These wrappers exist so framework code
+reads in terms of the collective vocabulary (all_reduce / all_gather /
+reduce_scatter / all_to_all / permute) rather than raw lax calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_sum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: str):
+    return lax.pmean(x, axis_name)
+
+
+def all_reduce_max(x, axis_name: str):
+    return lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1):
+    """Send to the next device on the ring (CollectivePermute over ICI)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)

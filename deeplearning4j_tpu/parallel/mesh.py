@@ -1,0 +1,81 @@
+"""Device mesh abstraction.
+
+Reference parity: the reference has NO multi-device training left in-tree
+(SURVEY.md §2.5 — ParallelWrapper/parameter-server removed); its only
+placement abstractions are AffinityManager thread→device binding and the
+JITA per-device allocator. This module is their TPU-native replacement and
+the root of all parallelism here: a named `jax.sharding.Mesh` over the
+chip topology; data/tensor/pipeline/sequence parallelism are just axis
+names, and XLA inserts the ICI/DCN collectives implied by shardings.
+
+Axis convention (scaling-book style):
+- "data"  : batch sharding (DP)
+- "model" : weight sharding (TP)
+- "pipe"  : pipeline stages (PP)
+- "seq"   : sequence/context parallelism (SP, ring attention)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+class DeviceMesh:
+    """A named mesh over available devices.
+
+    DeviceMesh.create(data=4, model=2) → 4x2 mesh; axis sizes of 1 are
+    kept (harmless) so sharding rules can always reference all axes.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @staticmethod
+    def create(devices: Optional[Sequence] = None, **axis_sizes: int) -> "DeviceMesh":
+        devices = list(devices if devices is not None else jax.devices())
+        if not axis_sizes:
+            axis_sizes = {DATA_AXIS: len(devices)}
+        names = tuple(axis_sizes.keys())
+        sizes = tuple(int(v) for v in axis_sizes.values())
+        n = int(np.prod(sizes))
+        if n != len(devices):
+            raise ValueError(f"mesh {dict(axis_sizes)} needs {n} devices, "
+                             f"have {len(devices)}")
+        arr = np.array(devices[:n]).reshape(sizes)
+        return DeviceMesh(Mesh(arr, names))
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name] if name in self.mesh.axis_names else 1
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding from a partition spec; None entries = replicated."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __enter__(self):
+        return self.mesh.__enter__()
+
+    def __exit__(self, *a):
+        return self.mesh.__exit__(*a)
+
+    def __repr__(self):
+        return f"DeviceMesh({dict(self.mesh.shape)})"

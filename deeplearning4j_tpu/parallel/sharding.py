@@ -1,0 +1,98 @@
+"""Sharding strategies: param/batch partition rules over a DeviceMesh.
+
+Reference parity: none to mirror — the reference's data-parallel training
+was removed upstream and it never had tensor parallelism (SURVEY.md §2.5).
+Design follows the GSPMD/scaling-book recipe: pick a mesh, annotate array
+shardings, let XLA insert collectives.
+
+A strategy maps parameter NAMES (regex rules, first match wins) to
+PartitionSpecs, plus batch specs for inputs. `tensor_parallel_rules`
+produces Megatron-style specs for the nn layer naming scheme:
+column-parallel for even dense layers (shard n_out), row-parallel for the
+following layer (shard n_in) — XLA places the psum where the row-parallel
+matmul contracts over the sharded dim.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, DeviceMesh)
+
+
+@dataclasses.dataclass
+class ShardingRule:
+    pattern: str                      # regex on parameter name
+    spec: Tuple[Optional[str], ...]   # PartitionSpec entries
+
+    def matches(self, name: str) -> bool:
+        return re.search(self.pattern, name) is not None
+
+
+class ShardingStrategy:
+    """Resolves shardings for params and batch over a mesh."""
+
+    def __init__(self, mesh: DeviceMesh, param_rules: Sequence[ShardingRule] = (),
+                 batch_axes: Tuple[Optional[str], ...] = (DATA_AXIS,)):
+        self.mesh = mesh
+        self.param_rules = list(param_rules)
+        self.batch_axes = batch_axes
+
+    def param_spec(self, name: str, ndim: int) -> PartitionSpec:
+        for rule in self.param_rules:
+            if rule.matches(name):
+                spec = [a for a in rule.spec]
+                # pad/trim to rank
+                spec = (spec + [None] * ndim)[:ndim]
+                return PartitionSpec(*spec)
+        return PartitionSpec()  # replicated
+
+    def param_sharding(self, name: str, ndim: int) -> NamedSharding:
+        return NamedSharding(self.mesh.mesh, self.param_spec(name, ndim))
+
+    def batch_sharding(self, ndim: int) -> NamedSharding:
+        spec = (list(self.batch_axes) + [None] * ndim)[:ndim]
+        return NamedSharding(self.mesh.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh.mesh, PartitionSpec())
+
+
+def data_parallel(mesh: DeviceMesh) -> ShardingStrategy:
+    """Pure DP: batch over 'data', params replicated; XLA AllReduces grads
+    (the TPU-native replacement for the reference's removed
+    ParallelWrapper/GradientsAccumulator)."""
+    return ShardingStrategy(mesh, param_rules=(), batch_axes=(DATA_AXIS,))
+
+
+def tensor_parallel_rules() -> List[ShardingRule]:
+    """Megatron-style rules for the nn layer naming scheme
+    (layer{i}_dense_W etc.): alternate column/row parallel so activations
+    stay sharded between the pair and one psum closes the block."""
+    return [
+        # dense/output kernels: shard the output dim (column parallel)
+        ShardingRule(r"_dense_W$", (None, MODEL_AXIS)),
+        ShardingRule(r"_out_W$", (None, MODEL_AXIS)),
+        # biases follow their kernel's output dim
+        ShardingRule(r"_dense_b$", (MODEL_AXIS,)),
+        ShardingRule(r"_out_b$", (MODEL_AXIS,)),
+        # conv kernels HWIO: shard output channels
+        ShardingRule(r"_conv_W$", (None, None, None, MODEL_AXIS)),
+        ShardingRule(r"_conv_b$", (MODEL_AXIS,)),
+        # LSTM: shard the 4*units gate dim
+        ShardingRule(r"_lstm_Wih$", (None, MODEL_AXIS)),
+        ShardingRule(r"_lstm_Whh$", (None, MODEL_AXIS)),
+        ShardingRule(r"_lstm_b$", (MODEL_AXIS,)),
+        # embeddings: shard the vocab dim (row parallel lookup)
+        ShardingRule(r"_embedding_W$", (MODEL_AXIS, None)),
+    ]
+
+
+def data_and_tensor_parallel(mesh: DeviceMesh) -> ShardingStrategy:
+    """2D DP×TP: batch over 'data', weights over 'model'."""
+    return ShardingStrategy(mesh, param_rules=tensor_parallel_rules(),
+                            batch_axes=(DATA_AXIS,))

@@ -1,0 +1,136 @@
+"""Parallel training and serving over a DeviceMesh.
+
+Reference parity:
+- Training: NEW capability (the reference's ParallelWrapper/
+  GradientsAccumulator data-parallel training was removed upstream,
+  SURVEY.md §2.5). TPU-native design: place params/batch with
+  NamedShardings and jit the SAME whole-graph train step SameDiff already
+  compiles — GSPMD propagates shardings and inserts AllReduce over ICI for
+  gradients; there is no separate "gradient sharing" code path to write.
+- Serving: ParallelInference (deeplearning4j-parallelwrapper
+  ParallelInference.java:54) ran N model replicas on N GPUs with
+  host-thread affinity + dynamic batching; here a batch sharded over the
+  'data' axis runs on all chips inside one compiled computation.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingStrategy, data_parallel)
+
+
+class _ShardedIterator:
+    """Wraps a dataset iterator, placing each batch with the strategy's
+    batch sharding (host→HBM transfer lands pre-sharded; the analogue of
+    the reference's AsyncDataSetIterator device feed)."""
+
+    def __init__(self, it, strategy: ShardingStrategy):
+        self._it = it
+        self._strategy = strategy
+
+    def reset(self):
+        if hasattr(self._it, "reset"):
+            self._it.reset()
+
+    def _place(self, a):
+        a = np.asarray(a)
+        return jax.device_put(a, self._strategy.batch_sharding(a.ndim))
+
+    def __iter__(self):
+        for batch in self._it:
+            if isinstance(batch, dict):
+                yield {k: self._place(v) for k, v in batch.items()}
+            elif isinstance(batch, (tuple, list)) and len(batch) == 2:
+                f, l = batch
+                fs = [self._place(x) for x in (f if isinstance(f, (list, tuple)) else [f])]
+                ls = [self._place(x) for x in (l if isinstance(l, (list, tuple)) else [l])]
+                yield (fs if len(fs) > 1 else fs[0],
+                       ls if len(ls) > 1 else ls[0])
+            else:
+                yield batch
+
+
+class ParallelTrainer:
+    """Trains a SameDiff (or MultiLayerNetwork) across a mesh.
+
+    Params are committed to their strategy shardings; the already-compiled
+    train step follows input shardings (GSPMD), so DP/TP need no new
+    step code — collectives appear in the compiled computation.
+    """
+
+    def __init__(self, model, strategy: Optional[ShardingStrategy] = None,
+                 mesh: Optional[DeviceMesh] = None):
+        # accept MultiLayerNetwork or SameDiff
+        self.sd = getattr(model, "samediff", model)
+        self.model = model
+        if strategy is None:
+            strategy = data_parallel(mesh or DeviceMesh.create())
+        self.strategy = strategy
+
+    def shard_params(self) -> None:
+        """Commit parameter/state arrays to their mesh shardings."""
+        sd, st = self.sd, self.strategy
+        for n, v in sd.trainable_params().items():
+            sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
+        for n, v in sd.state_vars_map().items():
+            sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
+        for n, v in sd.constants_map().items():
+            sd._arrays[n] = jax.device_put(v, st.replicated())
+        if sd._updater_state is not None:
+            # updater state leaves mirror their parameter's sharding
+            new_state = {}
+            for pname, leaves in sd._updater_state.items():
+                sh = st.param_sharding(pname, np.ndim(
+                    sd._arrays[pname]) if pname in sd._arrays else 0)
+                new_state[pname] = tuple(jax.device_put(l, sh) for l in leaves) \
+                    if isinstance(leaves, tuple) else jax.device_put(leaves, sh)
+            sd._updater_state = new_state
+
+    def fit(self, dataset_iterator, epochs: int = 1, listeners: Sequence = ()):
+        self.shard_params()
+        return self.sd.fit(_ShardedIterator(dataset_iterator, self.strategy),
+                           epochs=epochs, listeners=listeners)
+
+
+class ParallelInference:
+    """Mesh-wide batched inference (reference:
+    parallelism/ParallelInference.java:54 — replica-per-device workers,
+    BATCHED mode). One compiled computation with the batch sharded over
+    'data' replaces worker threads + affinity + observable queues."""
+
+    def __init__(self, model, strategy: Optional[ShardingStrategy] = None,
+                 mesh: Optional[DeviceMesh] = None):
+        self.model = model
+        self.sd = getattr(model, "_sd_infer", None) or getattr(
+            model, "samediff", model)
+        if strategy is None:
+            strategy = data_parallel(mesh or DeviceMesh.create())
+        self.strategy = strategy
+
+    def _ensure_on_mesh(self):
+        """Place arrays on the mesh ONLY if they are not already there —
+        existing mesh shardings (e.g. tensor-parallel params) are kept, so
+        a sharded-to-fit model is never forcibly replicated."""
+        sd, st = self.sd, self.strategy
+        mesh_devices = frozenset(self.strategy.mesh.mesh.devices.flat)
+        for n, v in {**sd.trainable_params(), **sd.state_vars_map(),
+                     **sd.constants_map()}.items():
+            if frozenset(v.sharding.device_set) != mesh_devices:
+                sd._arrays[n] = jax.device_put(v, st.replicated())
+
+    def output(self, x, output_names: Optional[Sequence[str]] = None):
+        if hasattr(self.model, "_sync_infer"):
+            self.model._sync_infer()
+        sd, st = self.sd, self.strategy
+        self._ensure_on_mesh()
+        x = np.asarray(x)
+        x = jax.device_put(x, st.batch_sharding(x.ndim))
+        names = list(output_names) if output_names else ["output"]
+        ph_name = "input" if sd.has_variable("input") else sd.placeholders()[0]
+        res = sd.output({ph_name: x}, names)
+        return res[names[0]] if len(names) == 1 else res
