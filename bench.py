@@ -19,30 +19,28 @@ import numpy as np
 
 def main():
     from __graft_entry__ import _flagship
+    from deeplearning4j_tpu.dataset import DeviceCachedIterator, load_mnist
 
     batch = 128
-    steps_per_epoch = 8
-    n = batch * steps_per_epoch
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(n, 1, 28, 28)).astype(np.float32)
-    Y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    X, y = load_mnist(train=True, n_synthetic=2048)
+    Y = np.eye(10, dtype=np.float32)[y]
+    n = (len(X) // batch) * batch
 
     net = _flagship()
+    # device-cached feed: the dataset is uploaded to HBM once; the training
+    # loop's only host traffic is the dispatch stream
+    it = DeviceCachedIterator(X, Y, batch_size=batch)
 
-    class _It:
-        def reset(self): ...
-        def __iter__(self):
-            for i in range(0, n, batch):
-                yield X[i:i + batch], Y[i:i + batch]
-
-    # warmup epoch (compile) then timed epochs
-    net.fit(_It(), epochs=1)
-    t0 = time.perf_counter()
-    timed_epochs = 5
-    net.fit(_It(), epochs=timed_epochs)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = timed_epochs * n / dt
+    # warmup epochs (compile incl. per-slice programs), then median of 3
+    # timed trials (the tunnel to the chip adds run-to-run jitter)
+    net.fit(it, epochs=2)
+    timed_epochs = 6
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        net.fit(it, epochs=timed_epochs)
+        rates.append(timed_epochs * n / (time.perf_counter() - t0))
+    samples_per_sec = sorted(rates)[1]
     print(json.dumps({
         "metric": "lenet_mnist_train_throughput",
         "value": round(samples_per_sec, 1),

@@ -1,0 +1,21 @@
+"""Data pipeline (reference: org.nd4j.linalg.dataset + deeplearning4j-data)."""
+from deeplearning4j_tpu.dataset.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.dataset.iterators import (
+    ArrayDataSetIterator, AsyncDataSetIterator, BenchmarkDataSetIterator,
+    DataSetIterator, DeviceCachedIterator, EarlyTerminationIterator,
+    ListDataSetIterator, MultipleEpochsIterator, SamplingDataSetIterator)
+from deeplearning4j_tpu.dataset.normalizers import (
+    ImagePreProcessingScaler, Normalizer, NormalizerMinMaxScaler,
+    NormalizerStandardize)
+from deeplearning4j_tpu.dataset.mnist import (
+    MnistDataSetIterator, load_mnist, synthetic_mnist)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
+    "ListDataSetIterator", "DeviceCachedIterator", "AsyncDataSetIterator",
+    "BenchmarkDataSetIterator", "MultipleEpochsIterator",
+    "EarlyTerminationIterator", "SamplingDataSetIterator", "Normalizer",
+    "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "MnistDataSetIterator", "load_mnist",
+    "synthetic_mnist",
+]

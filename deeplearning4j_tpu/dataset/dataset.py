@@ -1,0 +1,99 @@
+"""DataSet / MultiDataSet containers.
+
+Reference parity: org.nd4j.linalg.dataset.DataSet (features+labels+masks,
+shuffle/split/batchBy/save-load) and MultiDataSet (multi-input/output).
+Arrays are host numpy until they enter a training step — the device feed
+is the iterator's job (device-cached/prefetch iterators in iterators.py).
+"""
+from __future__ import annotations
+
+import io
+import zipfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    # ------------------------------------------------------------------
+    def num_examples(self) -> int:
+        return len(self.features)
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        idx = np.random.default_rng(seed).permutation(self.num_examples())
+        return self._take(idx)
+
+    def _take(self, idx) -> "DataSet":
+        return DataSet(
+            self.features[idx], self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx])
+
+    def split_test_and_train(self, frac_train: float,
+                             seed: Optional[int] = None
+                             ) -> Tuple["DataSet", "DataSet"]:
+        """(train, test) split (reference: DataSet.splitTestAndTrain)."""
+        n = self.num_examples()
+        idx = np.random.default_rng(seed).permutation(n) if seed is not None \
+            else np.arange(n)
+        k = int(round(n * frac_train))
+        return self._take(idx[:k]), self._take(idx[k:])
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        return [self._take(slice(i, i + batch_size))
+                for i in range(0, self.num_examples(), batch_size)]
+
+    def sample(self, n: int, seed: Optional[int] = None) -> "DataSet":
+        idx = np.random.default_rng(seed).choice(self.num_examples(), n,
+                                                 replace=False)
+        return self._take(idx)
+
+    # ------------------------------------------------------------------
+    def save(self, path) -> None:
+        arrays = {"features": self.features, "labels": self.labels}
+        if self.features_mask is not None:
+            arrays["features_mask"] = self.features_mask
+        if self.labels_mask is not None:
+            arrays["labels_mask"] = self.labels_mask
+        np.savez_compressed(path, **arrays)
+
+    @staticmethod
+    def load(path) -> "DataSet":
+        with np.load(path) as npz:
+            return DataSet(npz["features"], npz["labels"],
+                           npz.get("features_mask"), npz.get("labels_mask"))
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]))
+
+    def __repr__(self):
+        return (f"DataSet(features={self.features.shape}, "
+                f"labels={self.labels.shape})")
+
+
+class MultiDataSet:
+    """Multi-input/output container (reference:
+    org.nd4j.linalg.dataset.MultiDataSet)."""
+
+    def __init__(self, features: Sequence, labels: Sequence,
+                 features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in features]
+        self.labels = [np.asarray(l) for l in labels]
+        self.features_masks = features_masks
+        self.labels_masks = labels_masks
+
+    def num_examples(self) -> int:
+        return len(self.features[0])
+
+    def __repr__(self):
+        return (f"MultiDataSet(features={[f.shape for f in self.features]}, "
+                f"labels={[l.shape for l in self.labels]})")

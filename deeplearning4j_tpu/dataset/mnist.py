@@ -1,0 +1,90 @@
+"""MNIST / EMNIST-style idx dataset loading.
+
+Reference parity: deeplearning4j-datasets MnistDataSetIterator
+(datasets/iterator/impl/MnistDataSetIterator.java) + the idx-file fetchers.
+This environment has no network egress, so the loader reads idx files from
+a directory when present (``MNIST_DIR`` env var or explicit path, same
+ubyte file names the reference downloads) and otherwise falls back to a
+deterministic synthetic digit set (class-dependent strokes) so examples,
+tests and benchmarks run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.dataset.dataset import DataSet
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+
+_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        _, dtype_code, ndim = magic
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(dims)
+
+
+def _find(dir_: str, base: str) -> Optional[str]:
+    for cand in (base, base + ".gz"):
+        p = os.path.join(dir_, cand)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def synthetic_mnist(n: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable digit-like data: each class is a distinct
+    bright 7x7 patch pattern + noise."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    X = rng.normal(0.1, 0.05, size=(n, 1, 28, 28)).astype(np.float32)
+    for c in range(10):
+        r, col = divmod(c, 4)
+        mask = labels == c
+        X[mask, 0, 7 * r:7 * r + 7, 7 * col:7 * col + 7] += 0.8
+    return np.clip(X, 0, 1), labels.astype(np.int64)
+
+
+def load_mnist(train: bool = True, data_dir: Optional[str] = None,
+               n_synthetic: int = 8192):
+    """(features NCHW float32 in [0,1], int labels). Real data when idx
+    files exist, synthetic otherwise."""
+    data_dir = data_dir or os.environ.get("MNIST_DIR", "/root/data/mnist")
+    key = "train" if train else "test"
+    img = _find(data_dir, _FILES[f"{key}_images"]) if os.path.isdir(data_dir) else None
+    lab = _find(data_dir, _FILES[f"{key}_labels"]) if os.path.isdir(data_dir) else None
+    if img and lab:
+        X = _read_idx(img).astype(np.float32)[:, None, :, :] / 255.0
+        y = _read_idx(lab).astype(np.int64)
+        return X, y
+    return synthetic_mnist(n_synthetic if train else n_synthetic // 4,
+                           seed=0 if train else 1)
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """Reference: MnistDataSetIterator(batch, train) — yields
+    (features (B,1,28,28), one-hot labels (B,10))."""
+
+    def __init__(self, batch_size: int = 128, train: bool = True,
+                 shuffle: bool = True, seed: int = 6,
+                 data_dir: Optional[str] = None, n_synthetic: int = 8192):
+        X, y = load_mnist(train=train, data_dir=data_dir,
+                          n_synthetic=n_synthetic)
+        Y = np.eye(10, dtype=np.float32)[y]
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+        self.raw_labels = y

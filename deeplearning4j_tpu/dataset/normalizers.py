@@ -1,0 +1,189 @@
+"""Data normalizers.
+
+Reference parity: org.nd4j.linalg.dataset.api.preprocessor —
+NormalizerStandardize (z-score), NormalizerMinMaxScaler,
+ImagePreProcessingScaler (pixel /255 into [a,b]). Same fit/transform/
+revert contract incl. fit(iterator) streaming statistics; serde to npz.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class Normalizer:
+    def fit(self, data) -> "Normalizer":
+        """Accepts an array, DataSet, or iterator of batches."""
+        it = self._as_feature_batches(data)
+        self._fit_batches(it)
+        return self
+
+    @staticmethod
+    def _as_feature_batches(data):
+        from deeplearning4j_tpu.dataset.dataset import DataSet
+        if isinstance(data, DataSet):
+            return [data.features]
+        if isinstance(data, np.ndarray):
+            return [data]
+        def gen():
+            for batch in data:
+                if isinstance(batch, DataSet):
+                    yield batch.features
+                elif isinstance(batch, (tuple, list)):
+                    yield np.asarray(batch[0])
+                else:
+                    yield np.asarray(batch)
+        return gen()
+
+    def _fit_batches(self, batches):
+        raise NotImplementedError
+
+    def transform(self, features):
+        raise NotImplementedError
+
+    def revert(self, features):
+        raise NotImplementedError
+
+    def preprocess(self, dataset) -> None:
+        """In-place DataSet transform (reference: preProcess(DataSet))."""
+        dataset.features = self.transform(dataset.features)
+
+    def save(self, path) -> None:
+        np.savez(path, __class__=type(self).__name__, **self._state())
+
+    @staticmethod
+    def load(path) -> "Normalizer":
+        with np.load(path, allow_pickle=False) as npz:
+            cls = {c.__name__: c for c in
+                   [NormalizerStandardize, NormalizerMinMaxScaler,
+                    ImagePreProcessingScaler]}[str(npz["__class__"])]
+            obj = cls.__new__(cls)
+            obj._load_state(npz)
+            return obj
+
+
+class NormalizerStandardize(Normalizer):
+    """Per-feature z-score over the batch axis (reference:
+    NormalizerStandardize; streaming via Welford-style moment sums)."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def _fit_batches(self, batches):
+        n, s, s2 = 0, 0.0, 0.0
+        for f in batches:
+            f = np.asarray(f, np.float64)
+            flat = f.reshape(len(f), -1)
+            n += len(flat)
+            s = s + flat.sum(0)
+            s2 = s2 + (flat ** 2).sum(0)
+        mean = s / n
+        var = np.maximum(s2 / n - mean ** 2, 0.0)
+        self.mean = mean
+        self.std = np.sqrt(var)
+        self.std[self.std == 0] = 1.0
+
+    def transform(self, features):
+        f = np.asarray(features)
+        shape = f.shape
+        out = (f.reshape(len(f), -1) - self.mean) / self.std
+        return out.reshape(shape).astype(f.dtype if
+                                         np.issubdtype(f.dtype, np.floating)
+                                         else np.float32)
+
+    def revert(self, features):
+        f = np.asarray(features)
+        shape = f.shape
+        out = f.reshape(len(f), -1) * self.std + self.mean
+        return out.reshape(shape)
+
+    def _state(self):
+        return {"mean": self.mean, "std": self.std}
+
+    def _load_state(self, npz):
+        self.mean = npz["mean"]
+        self.std = npz["std"]
+
+
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale each feature to [min_range, max_range] (reference:
+    NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def _fit_batches(self, batches):
+        lo, hi = None, None
+        for f in batches:
+            flat = np.asarray(f, np.float64).reshape(len(f), -1)
+            bmin, bmax = flat.min(0), flat.max(0)
+            lo = bmin if lo is None else np.minimum(lo, bmin)
+            hi = bmax if hi is None else np.maximum(hi, bmax)
+        self.data_min, self.data_max = lo, hi
+
+    def _scale(self):
+        rng = self.data_max - self.data_min
+        rng[rng == 0] = 1.0
+        return rng
+
+    def transform(self, features):
+        f = np.asarray(features)
+        shape = f.shape
+        x = (f.reshape(len(f), -1) - self.data_min) / self._scale()
+        out = x * (self.max_range - self.min_range) + self.min_range
+        return out.reshape(shape).astype(np.float32)
+
+    def revert(self, features):
+        f = np.asarray(features)
+        shape = f.shape
+        x = (f.reshape(len(f), -1) - self.min_range) / \
+            (self.max_range - self.min_range)
+        out = x * self._scale() + self.data_min
+        return out.reshape(shape)
+
+    def _state(self):
+        return {"data_min": self.data_min, "data_max": self.data_max,
+                "range": np.array([self.min_range, self.max_range])}
+
+    def _load_state(self, npz):
+        self.data_min = npz["data_min"]
+        self.data_max = npz["data_max"]
+        self.min_range, self.max_range = npz["range"]
+
+
+class ImagePreProcessingScaler(Normalizer):
+    """Pixel scaling x/255 → [a, b] (reference: ImagePreProcessingScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def _fit_batches(self, batches):
+        pass  # stateless
+
+    def fit(self, data):
+        return self
+
+    def transform(self, features):
+        f = np.asarray(features, np.float32)
+        return f / self.max_pixel * (self.max_range - self.min_range) \
+            + self.min_range
+
+    def revert(self, features):
+        f = np.asarray(features)
+        return (f - self.min_range) / (self.max_range - self.min_range) \
+            * self.max_pixel
+
+    def _state(self):
+        return {"params": np.array([self.min_range, self.max_range,
+                                    self.max_pixel])}
+
+    def _load_state(self, npz):
+        self.min_range, self.max_range, self.max_pixel = npz["params"]

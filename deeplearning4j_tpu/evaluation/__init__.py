@@ -1,0 +1,7 @@
+"""Evaluation metrics (reference: org.nd4j.evaluation)."""
+from deeplearning4j_tpu.evaluation.classification import (
+    Evaluation, EvaluationBinary, ROC, ROCBinary, ROCMultiClass)
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+
+__all__ = ["Evaluation", "EvaluationBinary", "ROC", "ROCBinary",
+           "ROCMultiClass", "RegressionEvaluation"]

@@ -175,6 +175,29 @@ class MultiLayerNetwork:
         """Most recent training loss (reference: MultiLayerNetwork.score)."""
         return self._score
 
+    def evaluate(self, data, labels=None, evaluation=None, batch_size: int = 256):
+        """Evaluate over an iterator or arrays (reference:
+        MultiLayerNetwork.evaluate(DataSetIterator)). Returns the
+        Evaluation (or supplied metric accumulator) after streaming all
+        batches through inference."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = evaluation or Evaluation()
+        if labels is not None:
+            data = _ArrayIterator(np.asarray(data), np.asarray(labels),
+                                  batch_size)
+        if hasattr(data, "reset"):
+            data.reset()
+        for batch in data:
+            if isinstance(batch, dict):
+                feats, labs = batch["input"], batch["labels"]
+            elif hasattr(batch, "features"):
+                feats, labs = batch.features, batch.labels
+            else:
+                feats, labs = batch
+            preds = self.output(feats)
+            ev.eval(labs, preds)
+        return ev
+
     # ------------------------------------------------------------------
     def params(self) -> Dict[str, np.ndarray]:
         self._require_init()

@@ -30,6 +30,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu.parallel import collectives
 from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS, DeviceMesh
 
 
@@ -91,9 +92,8 @@ def ring_attention(q, k, v, mesh: DeviceMesh, causal: bool = False,
                 mask = q_pos[:, None] >= k_pos[None, :]     # (Tq, Tk)
                 mask = mask[None, None, :, :]               # (1,1,Tq,Tk)
             m, l, o = _block_attn(q_blk, k_cur, v_cur, m, l, o, scale, mask)
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            k_nxt = lax.ppermute(k_cur, axis_name, perm)
-            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            k_nxt = collectives.ring_permute(k_cur, axis_name)
+            v_nxt = collectives.ring_permute(v_cur, axis_name)
             return m, l, o, k_nxt, v_nxt
 
         m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
@@ -141,6 +141,7 @@ def ulysses_attention(q, k, v, mesh: DeviceMesh, causal: bool = False,
         p = jax.nn.softmax(s, axis=-1)
         of = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
                         preferred_element_type=jnp.float32)
-        return head_to_seq(of).astype(q_blk.dtype)
+        # cast BEFORE the return all_to_all so bf16 (not f32) rides the ICI
+        return head_to_seq(of.astype(q_blk.dtype))
 
     return _ulysses(q, k, v)

@@ -45,6 +45,8 @@ class _ShardedIterator:
         for batch in self._it:
             if isinstance(batch, dict):
                 yield {k: self._place(v) for k, v in batch.items()}
+            elif hasattr(batch, "features") and hasattr(batch, "labels"):
+                yield (self._place(batch.features), self._place(batch.labels))
             elif isinstance(batch, (tuple, list)) and len(batch) == 2:
                 f, l = batch
                 fs = [self._place(x) for x in (f if isinstance(f, (list, tuple)) else [f])]

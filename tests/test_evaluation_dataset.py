@@ -1,0 +1,276 @@
+"""Evaluation + dataset pipeline tests (reference: nd4j evaluation tests +
+dataset iterator/normalizer tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.dataset import (
+    ArrayDataSetIterator, AsyncDataSetIterator, BenchmarkDataSetIterator,
+    DataSet, DeviceCachedIterator, EarlyTerminationIterator,
+    ImagePreProcessingScaler, ListDataSetIterator, MnistDataSetIterator,
+    MultipleEpochsIterator, NormalizerMinMaxScaler, NormalizerStandardize,
+    SamplingDataSetIterator, synthetic_mnist)
+from deeplearning4j_tpu.evaluation import (
+    Evaluation, EvaluationBinary, ROC, ROCMultiClass, RegressionEvaluation)
+
+
+# ---- evaluation -----------------------------------------------------------
+
+def test_evaluation_accuracy_and_confusion():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]  # 4/6 correct
+    ev.eval(labels, preds)
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    cm = ev.confusion_matrix()
+    assert cm[0, 0] == 1 and cm[0, 1] == 1 and cm[2, 0] == 1
+    assert "Accuracy" in ev.stats()
+
+
+def test_evaluation_precision_recall_f1_per_class():
+    ev = Evaluation()
+    # class 0: tp=2 fp=1 fn=0 → precision 2/3, recall 1
+    labels = np.eye(2)[[0, 0, 1, 1]]
+    preds = np.eye(2)[[0, 0, 0, 1]]
+    ev.eval(labels, preds)
+    assert ev.precision(0) == pytest.approx(2 / 3)
+    assert ev.recall(0) == pytest.approx(1.0)
+    assert ev.f1(0) == pytest.approx(0.8)
+    assert ev.recall(1) == pytest.approx(0.5)
+
+
+def test_evaluation_accumulates_across_batches():
+    ev = Evaluation()
+    for _ in range(3):
+        ev.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]])
+    assert ev.accuracy() == 1.0
+    assert ev._count == 6
+
+
+def test_evaluation_int_labels_and_top_n():
+    ev = Evaluation(top_n=2)
+    scores = np.array([[0.5, 0.3, 0.2],
+                       [0.1, 0.45, 0.45],
+                       [0.2, 0.5, 0.3]])
+    ev.eval(np.array([0, 2, 2]), scores)
+    assert ev.accuracy() == pytest.approx(1 / 3)
+    assert ev.top_n_accuracy() == pytest.approx(3 / 3)
+
+
+def test_matthews_correlation_perfect_and_random():
+    ev = Evaluation()
+    ev.eval(np.eye(2)[[0, 1, 0, 1]], np.eye(2)[[0, 1, 0, 1]])
+    assert ev.matthews_correlation() == pytest.approx(1.0)
+
+
+def test_evaluation_binary():
+    ev = EvaluationBinary()
+    labels = np.array([[1], [1], [0], [0]])
+    preds = np.array([[0.9], [0.4], [0.2], [0.7]])
+    ev.eval(labels, preds)
+    assert ev.accuracy() == pytest.approx(0.5)
+    assert ev.precision() == pytest.approx(0.5)
+    assert ev.recall() == pytest.approx(0.5)
+
+
+def test_roc_auc_perfect_and_chance():
+    roc = ROC()
+    roc.eval(np.array([0, 0, 1, 1]), np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc.auc() == pytest.approx(1.0)
+    roc2 = ROC()
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 2000)
+    roc2.eval(y, rng.uniform(size=2000))
+    assert abs(roc2.auc() - 0.5) < 0.05
+
+
+def test_roc_multiclass():
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 3, 300)
+    scores = np.eye(3)[y] * 2 + rng.normal(size=(300, 3))
+    e = np.exp(scores)
+    p = e / e.sum(-1, keepdims=True)
+    roc = ROCMultiClass()
+    roc.eval(y, p)
+    assert roc.average_auc() > 0.8
+
+
+def test_regression_evaluation():
+    ev = RegressionEvaluation()
+    y = np.array([[1.0], [2.0], [3.0]])
+    p = np.array([[1.1], [2.1], [2.9]])
+    ev.eval(y, p)
+    assert ev.mean_squared_error(0) == pytest.approx(0.01, abs=1e-6)
+    assert ev.mean_absolute_error(0) == pytest.approx(0.1, abs=1e-6)
+    assert ev.r_squared(0) > 0.97
+    assert ev.pearson_correlation(0) > 0.99
+    assert "MSE" in ev.stats()
+
+
+def test_network_evaluate_end_to_end():
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    X = np.tile(np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32), (8, 1))
+    Y = np.eye(2, dtype=np.float32)[
+        (X[:, 0].astype(int) ^ X[:, 1].astype(int))]
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, Y, epochs=60, batch_size=16)
+    ev = net.evaluate(X, Y)
+    assert ev.accuracy() == 1.0
+    assert ev.f1() == 1.0
+
+
+# ---- dataset --------------------------------------------------------------
+
+def test_dataset_shuffle_split_batch():
+    X = np.arange(20).reshape(10, 2).astype(float)
+    Y = np.arange(10)
+    ds = DataSet(X, Y)
+    tr, te = ds.split_test_and_train(0.8, seed=0)
+    assert tr.num_examples() == 8 and te.num_examples() == 2
+    sh = ds.shuffle(seed=1)
+    assert not np.array_equal(sh.features, X)
+    assert sorted(sh.labels.tolist()) == sorted(Y.tolist())
+    batches = ds.batch_by(4)
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+
+
+def test_dataset_save_load(tmp_path):
+    ds = DataSet(np.ones((4, 3)), np.zeros((4, 2)))
+    path = tmp_path / "ds.npz"
+    ds.save(path)
+    ds2 = DataSet.load(path)
+    np.testing.assert_array_equal(ds.features, ds2.features)
+
+
+def test_array_iterator_shuffles_between_epochs():
+    X = np.arange(16).reshape(8, 2).astype(float)
+    Y = np.arange(8)
+    it = ArrayDataSetIterator(X, Y, batch_size=4, shuffle=True, seed=0)
+    e1 = np.concatenate([b[1] for b in it])
+    e2 = np.concatenate([b[1] for b in it])
+    assert sorted(e1.tolist()) == sorted(e2.tolist()) == list(range(8))
+    assert not np.array_equal(e1, e2)
+
+
+def test_device_cached_iterator_yields_device_slices():
+    import jax
+    X = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[np.zeros(64, int)]
+    it = DeviceCachedIterator(X, Y, batch_size=16)
+    batches = list(it)
+    assert len(batches) == 4
+    assert isinstance(batches[0][0], jax.Array)
+    np.testing.assert_allclose(np.asarray(batches[1][0]), X[16:32])
+
+
+def test_device_cached_iterator_trains():
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    X = np.tile(np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32), (8, 1))
+    Y = np.eye(2, dtype=np.float32)[
+        (X[:, 0].astype(int) ^ X[:, 1].astype(int))]
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(2)).build())
+    net = MultiLayerNetwork(conf).init()
+    h = net.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=50)
+    assert h.final_loss() < 0.1
+
+
+def test_async_iterator_matches_sync():
+    X = np.arange(32).reshape(16, 2).astype(float)
+    Y = np.arange(16)
+    sync = ArrayDataSetIterator(X, Y, batch_size=4)
+    out_sync = [b[1].tolist() for b in sync]
+    out_async = [b[1].tolist() for b in AsyncDataSetIterator(
+        ArrayDataSetIterator(X, Y, batch_size=4))]
+    assert out_sync == out_async
+
+
+def test_async_iterator_propagates_errors():
+    class Bad:
+        def __iter__(self):
+            yield (np.zeros(1), np.zeros(1))
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(AsyncDataSetIterator(Bad()))
+
+
+def test_utility_iterators():
+    X = np.zeros((8, 2)); Y = np.zeros(8)
+    base = ArrayDataSetIterator(X, Y, batch_size=4)
+    assert len(list(MultipleEpochsIterator(base, 3))) == 6
+    assert len(list(EarlyTerminationIterator(base, 1))) == 1
+    bench = BenchmarkDataSetIterator((16, 3), 4, n_batches=5)
+    batches = list(bench)
+    assert len(batches) == 5 and batches[0][0].shape == (16, 3)
+    ds = DataSet(np.arange(10.0).reshape(10, 1), np.arange(10))
+    samp = list(SamplingDataSetIterator(ds, 4, 3, seed=0))
+    assert len(samp) == 3 and samp[0][0].shape == (4, 1)
+
+
+def test_normalizer_standardize_round_trip(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.normal(5.0, 3.0, size=(100, 4)).astype(np.float32)
+    norm = NormalizerStandardize().fit(X)
+    t = norm.transform(X)
+    assert abs(t.mean()) < 0.05 and abs(t.std() - 1) < 0.05
+    np.testing.assert_allclose(norm.revert(t), X, rtol=1e-4, atol=1e-4)
+    path = tmp_path / "norm.npz"
+    norm.save(path)
+    norm2 = NormalizerStandardize.load(path)
+    np.testing.assert_allclose(norm2.transform(X), t, rtol=1e-6)
+
+
+def test_normalizer_fits_from_iterator():
+    X = np.random.default_rng(1).normal(2.0, 1.0, size=(64, 3))
+    it = ArrayDataSetIterator(X, np.zeros(64), batch_size=16)
+    norm = NormalizerStandardize().fit(it)
+    np.testing.assert_allclose(norm.mean, X.mean(0), rtol=1e-6)
+
+
+def test_min_max_scaler():
+    X = np.array([[0.0, 10.0], [5.0, 20.0], [10.0, 30.0]])
+    norm = NormalizerMinMaxScaler().fit(X)
+    t = norm.transform(X)
+    assert t.min() == 0.0 and t.max() == 1.0
+    np.testing.assert_allclose(norm.revert(t), X, rtol=1e-6)
+
+
+def test_image_scaler():
+    X = np.array([[0, 127.5, 255]])
+    s = ImagePreProcessingScaler()
+    np.testing.assert_allclose(s.transform(X), [[0, 0.5, 1.0]])
+    np.testing.assert_allclose(s.revert(s.transform(X)), X)
+
+
+def test_mnist_iterator_synthetic_learnable():
+    it = MnistDataSetIterator(batch_size=64, n_synthetic=256)
+    f, l = next(iter(it))
+    assert f.shape == (64, 1, 28, 28) and l.shape == (64, 10)
+    assert f.min() >= 0 and f.max() <= 1
+    # classes are visually distinct — a linear probe separates them
+    X, y = synthetic_mnist(512)
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional(28, 28, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, np.eye(10, dtype=np.float32)[y], epochs=30, batch_size=128)
+    assert (net.predict(X) == y).mean() > 0.9
