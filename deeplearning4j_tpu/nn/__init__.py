@@ -10,11 +10,18 @@ from deeplearning4j_tpu.nn.layers import (
     DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, InputType, LSTMLayer,
     LossLayer, OutputLayer, SubsamplingLayer)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
+    GraphVertex, L2NormalizeVertex, MergeVertex, ScaleVertex, ShiftVertex,
+    SubsetVertex)
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.nn.activations import resolve_activation
 
 __all__ = [
     "NeuralNetConfiguration", "MultiLayerConfiguration", "MultiLayerNetwork",
+    "ComputationGraph", "ComputationGraphConfiguration", "MergeVertex",
+    "ElementWiseVertex", "SubsetVertex", "ScaleVertex", "ShiftVertex",
+    "L2NormalizeVertex", "GraphVertex",
     "InputType", "DenseLayer", "ConvolutionLayer", "SubsamplingLayer",
     "BatchNormalization", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
     "LSTMLayer", "GlobalPoolingLayer", "OutputLayer", "LossLayer",

@@ -123,6 +123,12 @@ class NeuralNetConfiguration:
         def list(self) -> ListBuilder:
             return ListBuilder(self)
 
+        def graph_builder(self):
+            """DAG configuration (reference:
+            NeuralNetConfiguration.Builder().graphBuilder())."""
+            from deeplearning4j_tpu.nn.graph import GraphBuilder
+            return GraphBuilder(self)
+
     @staticmethod
     def builder() -> "NeuralNetConfiguration.Builder":
         return NeuralNetConfiguration.Builder()

@@ -1,0 +1,507 @@
+"""ComputationGraph — DAG networks with multiple inputs/outputs.
+
+Reference parity: org.deeplearning4j.nn.graph.ComputationGraph
+(ComputationGraph.java) + ComputationGraphConfiguration.GraphBuilder
+(nn/conf/ComputationGraphConfiguration.java) + graph vertices
+(nn/conf/graph/: MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
+ShiftVertex, L2NormalizeVertex, StackVertex, UnstackVertex, …).
+
+Same single-execution-path design as MultiLayerNetwork: the whole DAG
+records into one SameDiff graph per mode (train/infer) and compiles to one
+XLA computation; the reference's per-vertex forward/backprop scheduling
+(topological GraphVertex.doForward/doBackward) is replaced by trace order +
+jax.grad.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
+from deeplearning4j_tpu.learning.regularization import Regularization
+from deeplearning4j_tpu.nn.layers import (
+    BaseLayer, BuildContext, InputType, LAYER_TYPES)
+
+
+# ----------------------------------------------------------------------
+# graph vertices (reference: nn/conf/graph/*Vertex)
+class GraphVertex:
+    def build(self, ctx: BuildContext, xs: List, itypes: List[InputType]):
+        raise NotImplementedError
+
+    def output_type(self, itypes: List[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "GraphVertex":
+        d = dict(d)
+        cls = VERTEX_TYPES[d.pop("@class")]
+        kw = {f.name: tuple(d[f.name]) if isinstance(d.get(f.name), list)
+              else d[f.name]
+              for f in dataclasses.fields(cls) if f.name in d}
+        return cls(**kw)
+
+
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concat along the feature axis (reference: MergeVertex)."""
+
+    def output_type(self, itypes):
+        kind = itypes[0].kind
+        if kind == "ff":
+            return InputType.feed_forward(sum(t.dims[0] for t in itypes))
+        if kind == "cnn":
+            c = sum(t.dims[0] for t in itypes)
+            return InputType("cnn", (c,) + itypes[0].dims[1:])
+        if kind == "rnn":
+            return InputType.recurrent(sum(t.dims[0] for t in itypes),
+                                       itypes[0].dims[1])
+        raise ValueError(kind)
+
+    def build(self, ctx, xs, itypes):
+        axis = 1 if itypes[0].kind in ("ff", "cnn") else 2
+        out = ctx.sd.invoke("concat", xs, {"axis": axis},
+                            name=ctx.lname("merge"))
+        return out, self.output_type(itypes)
+
+
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine (reference: ElementWiseVertex Op.{Add,Subtract,
+    Product,Average,Max})."""
+    op: str = "Add"
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def build(self, ctx, xs, itypes):
+        name = ctx.lname("elementwise")
+        op = self.op.lower()
+        if op == "average":
+            acc = xs[0]
+            for x in xs[1:]:
+                acc = acc.add(x)
+            out = acc.mul(ctx.sd.constant(1.0 / len(xs), f"{name}_scale"),
+                          name=name)
+        elif op == "max":
+            acc = xs[0]
+            for i, x in enumerate(xs[1:]):
+                acc = ctx.sd.invoke("maximum", [acc, x], {},
+                                    name=f"{name}_{i}")
+            out = acc
+        else:
+            fn = {"add": "add", "subtract": "subtract",
+                  "product": "multiply"}[op]
+            acc = xs[0]
+            for i, x in enumerate(xs[1:]):
+                acc = ctx.sd.invoke(fn, [acc, x], {}, name=f"{name}_{i}")
+            out = acc
+        return out, itypes[0]
+
+
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive on the FEATURE axis
+    (reference: SubsetVertex subsets features for any input kind)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def output_type(self, itypes):
+        t = itypes[0]
+        n = self.to_idx - self.from_idx + 1
+        if t.kind == "ff":
+            return InputType.feed_forward(n)
+        if t.kind == "cnn":
+            return InputType("cnn", (n,) + t.dims[1:])
+        if t.kind == "rnn":
+            return InputType.recurrent(n, t.dims[1])
+        raise ValueError(t.kind)
+
+    def build(self, ctx, xs, itypes):
+        x = xs[0]
+        t = itypes[0]
+        big = 2 ** 31 - 1
+        # feature axis: 1 for ff/cnn (NCHW channels), 2 for rnn (B, T, C)
+        if t.kind in ("ff", "cnn"):
+            ndim = 2 if t.kind == "ff" else 4
+            begin = (0, self.from_idx) + (0,) * (ndim - 2)
+            end = (big, self.to_idx + 1) + (big,) * (ndim - 2)
+        else:
+            begin = (0, 0, self.from_idx)
+            end = (big, big, self.to_idx + 1)
+        out = ctx.sd.invoke("strided_slice", [x], {"begin": begin, "end": end},
+                            name=ctx.lname("subset"))
+        return out, self.output_type(itypes)
+
+
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    scale_factor: float = 1.0
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def build(self, ctx, xs, itypes):
+        name = ctx.lname("scale")
+        out = xs[0].mul(ctx.sd.constant(self.scale_factor, f"{name}_c"),
+                        name=name)
+        return out, itypes[0]
+
+
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    shift_factor: float = 0.0
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def build(self, ctx, xs, itypes):
+        name = ctx.lname("shift")
+        out = xs[0].add(ctx.sd.constant(self.shift_factor, f"{name}_c"),
+                        name=name)
+        return out, itypes[0]
+
+
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def build(self, ctx, xs, itypes):
+        name = ctx.lname("l2norm")
+        x = xs[0]
+        norm = x.square().sum(dims=-1, keep_dims=True).sqrt()
+        out = x.div(norm.add(ctx.sd.constant(self.eps, f"{name}_eps")),
+                    name=name)
+        return out, itypes[0]
+
+
+VERTEX_TYPES: Dict[str, type] = {c.__name__: c for c in [
+    MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex, ShiftVertex,
+    L2NormalizeVertex,
+]}
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class _Node:
+    name: str
+    op: object                # BaseLayer or GraphVertex
+    inputs: List[str]
+
+
+@dataclasses.dataclass
+class ComputationGraphConfiguration:
+    inputs: List[str]
+    input_types: List[InputType]
+    nodes: List[_Node]
+    outputs: List[str]
+    seed: int = 12345
+    updater: IUpdater = dataclasses.field(default_factory=lambda: Sgd(0.01))
+    regularization: Sequence[Regularization] = ()
+    dtype: str = "float32"
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed, "dtype": self.dtype,
+            "updater": self.updater.to_json(),
+            "regularization": [r.to_json() for r in self.regularization],
+            "inputs": self.inputs,
+            "input_types": [t.to_json() for t in self.input_types],
+            "outputs": self.outputs,
+            "nodes": [{"name": n.name,
+                       "kind": "layer" if isinstance(n.op, BaseLayer) else "vertex",
+                       "op": n.op.to_json(), "inputs": n.inputs}
+                      for n in self.nodes],
+        }, indent=1)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        nodes = []
+        for nd in d["nodes"]:
+            op = BaseLayer.from_json(nd["op"]) if nd["kind"] == "layer" \
+                else GraphVertex.from_json(nd["op"])
+            nodes.append(_Node(nd["name"], op, list(nd["inputs"])))
+        return ComputationGraphConfiguration(
+            inputs=list(d["inputs"]),
+            input_types=[InputType.from_json(t) for t in d["input_types"]],
+            nodes=nodes, outputs=list(d["outputs"]), seed=d["seed"],
+            updater=IUpdater.from_json(d["updater"]),
+            regularization=[Regularization.from_json(r)
+                            for r in d.get("regularization", [])],
+            dtype=d.get("dtype", "float32"))
+
+
+class GraphBuilder:
+    """Reference: ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, parent=None):
+        self._parent = parent
+        self._inputs: List[str] = []
+        self._input_types: List[InputType] = []
+        self._nodes: List[_Node] = []
+        self._outputs: List[str] = []
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._input_types = list(types)
+        return self
+
+    def add_layer(self, name: str, layer: BaseLayer,
+                  *inputs: str) -> "GraphBuilder":
+        self._nodes.append(_Node(name, layer, list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex,
+                   *inputs: str) -> "GraphBuilder":
+        self._nodes.append(_Node(name, vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs or not self._outputs:
+            raise ValueError("graph needs add_inputs(...) and set_outputs(...)")
+        if len(self._input_types) != len(self._inputs):
+            raise ValueError("set_input_types must match add_inputs")
+        if len(set(self._inputs)) != len(self._inputs):
+            raise ValueError("duplicate input names")
+        known = set(self._inputs)
+        for n in self._nodes:
+            if n.name in known:
+                raise ValueError(f"duplicate node name {n.name!r} "
+                                 f"(or it shadows an input)")
+            if isinstance(n.op, BaseLayer) and len(n.inputs) > 1:
+                raise ValueError(
+                    f"layer node {n.name!r} has {len(n.inputs)} inputs; "
+                    f"layers take one — insert a MergeVertex (the reference "
+                    f"auto-merges; here it is explicit)")
+            for i in n.inputs:
+                if i not in known:
+                    raise ValueError(f"node {n.name!r} references unknown "
+                                     f"input {i!r} (define nodes in "
+                                     f"topological order)")
+            known.add(n.name)
+        for o in self._outputs:
+            if o not in known:
+                raise ValueError(f"unknown output {o!r}")
+        p = self._parent
+        kw = {}
+        if p is not None:
+            kw = {"seed": p._seed, "updater": p._updater, "dtype": p._dtype}
+            regs = []
+            from deeplearning4j_tpu.learning.regularization import (
+                L1Regularization, L2Regularization, WeightDecay)
+            if p._l1:
+                regs.append(L1Regularization(l1=p._l1))
+            if p._l2:
+                regs.append(L2Regularization(l2=p._l2))
+            if p._weight_decay:
+                regs.append(WeightDecay(coeff=p._weight_decay))
+            kw["regularization"] = regs
+        return ComputationGraphConfiguration(
+            inputs=self._inputs, input_types=self._input_types,
+            nodes=self._nodes, outputs=self._outputs, **kw)
+
+
+def _build_graph(conf: ComputationGraphConfiguration, training: bool):
+    """Returns (sd, label placeholder names in conf.outputs order,
+    node name -> actual graph variable name map)."""
+    from deeplearning4j_tpu.nn.multilayer import _adapt_input
+    sd = SameDiff()
+    rng = np.random.default_rng(conf.seed)
+    ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype)
+    vars_: Dict[str, object] = {}
+    types_: Dict[str, InputType] = {}
+    for name, itype in zip(conf.inputs, conf.input_types):
+        vars_[name] = sd.placeholder(name, shape=itype.placeholder_shape(),
+                                     dtype=conf.dtype)
+        types_[name] = itype
+
+    labels_of: Dict[str, str] = {}   # loss node name -> labels placeholder
+    for node in conf.nodes:
+        ctx.prefix = node.name
+        if isinstance(node.op, BaseLayer):
+            x = vars_[node.inputs[0]]
+            itype = types_[node.inputs[0]]
+            x, itype = _adapt_input(sd, x, itype, node.op, node.name,
+                                    name_stem=f"{node.name}_preproc")
+            if hasattr(node.op, "loss_function"):
+                # labels placeholder sized from this head's output type
+                otype = node.op.output_type(itype)
+                ln = f"labels_{node.name}"
+                ctx.labels_var = sd.placeholder(
+                    ln, shape=otype.placeholder_shape(), dtype=conf.dtype)
+                labels_of[node.name] = ln
+            out, otype = node.op.build(ctx, x, itype)
+        else:
+            xs = [vars_[i] for i in node.inputs]
+            its = [types_[i] for i in node.inputs]
+            out, otype = node.op.build(ctx, xs, its)
+        # passthrough builds (identity activation, inference dropout, …)
+        # return an upstream var — alias it rather than renaming, which
+        # would corrupt the upstream name
+        vars_[node.name] = out
+        types_[node.name] = otype
+
+    # labels in conf.outputs order first (matches user-supplied label
+    # lists), then any non-output loss heads in node order
+    ordered = [n for n in conf.outputs if n in labels_of] + \
+              [n for n in (nd.name for nd in conf.nodes)
+               if n in labels_of and n not in conf.outputs]
+    label_names = [labels_of[n] for n in ordered]
+    name_map = {n: vars_[n].name for n in vars_}
+    return sd, label_names, name_map
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._sd_train: Optional[SameDiff] = None
+        self._sd_infer: Optional[SameDiff] = None
+        self._label_names: List[str] = []
+        self._map_train: Dict[str, str] = {}
+        self._map_infer: Dict[str, str] = {}
+        self._score = float("nan")
+
+    def init(self) -> "ComputationGraph":
+        self._sd_train, self._label_names, self._map_train = \
+            _build_graph(self.conf, True)
+        self._sd_infer, _, self._map_infer = _build_graph(self.conf, False)
+        self._sd_train.training_config = TrainingConfig(
+            updater=self.conf.updater,
+            data_set_feature_mapping=list(self.conf.inputs),
+            data_set_label_mapping=list(self._label_names),
+            regularization=self.conf.regularization,
+        )
+        return self
+
+    @property
+    def samediff(self) -> SameDiff:
+        return self._sd_train
+
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
+            listeners: Sequence = ()):
+        """Train. ``data`` = iterator of (features-list, labels-list) /
+        MultiDataSet / dict batches; or single-input arrays with labels=."""
+        if labels is not None:
+            from deeplearning4j_tpu.nn.multilayer import _ArrayIterator
+            data = _ArrayIterator(np.asarray(data), np.asarray(labels),
+                                  batch_size)
+        history = self._sd_train.fit(data, epochs=epochs, listeners=listeners)
+        self._score = history.final_loss()
+        return history
+
+    def _sync_infer(self):
+        tgt = self._sd_infer
+        for n, arr in self._sd_train._arrays.items():
+            if n in tgt._vars and n in tgt._arrays:
+                tgt._arrays[n] = arr
+
+    def output(self, *inputs, training: bool = False):
+        """Forward pass; returns list of output NDArrays (reference:
+        ComputationGraph.output(INDArray...))."""
+        sd = self._sd_train if training else self._sd_infer
+        name_map = self._map_train if training else self._map_infer
+        if not training:
+            self._sync_infer()
+        ph = dict(zip(self.conf.inputs, inputs))
+        out_names = [name_map[o] for o in self.conf.outputs]
+        res = sd.output(ph, out_names)
+        return [res[n] for n in out_names]
+
+    def score(self) -> float:
+        return self._score
+
+    def params(self) -> Dict[str, np.ndarray]:
+        return {n: np.asarray(a) for n, a in
+                {**self._sd_train.trainable_params(),
+                 **self._sd_train.state_vars_map()}.items()}
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(a.shape))
+                   for a in self._sd_train.trainable_params().values())
+
+    def evaluate(self, iterator, evaluation=None):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = evaluation or Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for batch in iterator:
+            if hasattr(batch, "features"):
+                feats, labs = batch.features, batch.labels
+            else:
+                feats, labs = batch
+            feats = feats if isinstance(feats, (list, tuple)) else [feats]
+            labs = labs if isinstance(labs, (list, tuple)) else [labs]
+            preds = self.output(*feats)
+            ev.eval(labs[0], preds[0])
+        return ev
+
+    # --- serde --------------------------------------------------------
+    def save(self, path, include_updater_state: bool = True) -> None:
+        import jax
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", self.conf.to_json())
+            buf = io.BytesIO()
+            np.savez(buf, **{n: np.asarray(a)
+                             for n, a in self._sd_train._arrays.items()
+                             if n in self._sd_train._vars})
+            zf.writestr("parameters.npz", buf.getvalue())
+            if include_updater_state and \
+                    self._sd_train._updater_state is not None:
+                leaves = jax.tree_util.tree_leaves(
+                    self._sd_train._updater_state)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+                zf.writestr("updater.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path) -> "ComputationGraph":
+        import jax
+        import jax.numpy as jnp
+        with zipfile.ZipFile(path, "r") as zf:
+            conf = ComputationGraphConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            with np.load(io.BytesIO(zf.read("parameters.npz"))) as npz:
+                arrays = {k: jnp.asarray(npz[k]) for k in npz.files}
+            updater_leaves = None
+            if "updater.npz" in zf.namelist():
+                with np.load(io.BytesIO(zf.read("updater.npz"))) as npz:
+                    updater_leaves = [jnp.asarray(npz[f"leaf_{i}"])
+                                      for i in range(len(npz.files))]
+        net = ComputationGraph(conf).init()
+        sd = net._sd_train
+        for n, arr in arrays.items():
+            if n in sd._vars:
+                sd._arrays[n] = arr
+        if updater_leaves is not None:
+            template = conf.updater.init(sd.trainable_params())
+            treedef = jax.tree_util.tree_structure(template)
+            sd._updater_state = jax.tree_util.tree_unflatten(
+                treedef, updater_leaves)
+        return net
+
+

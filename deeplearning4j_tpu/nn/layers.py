@@ -123,9 +123,15 @@ class BuildContext:
     training: bool
     dtype: str = "float32"
     idx: int = 0                    # current layer index
+    prefix: Optional[str] = None    # vertex name (ComputationGraph builds)
     labels_var: object = None       # labels placeholder (for loss heads)
     output_var: object = None       # set by the output layer
     loss_var: object = None         # set by the output layer
+
+    def lname(self, kind: str) -> str:
+        """Parameter/op name stem: vertex name in graph builds, layer index
+        in sequential builds (reference: param keys '0_W' vs 'dense1_W')."""
+        return self.prefix if self.prefix else f"layer{self.idx}_{kind}"
 
     def param(self, name: str, shape, scheme: str):
         """Create (or look up, for the second graph build) a parameter."""
@@ -160,7 +166,7 @@ class DenseLayer(BaseLayer):
         return InputType.feed_forward(self.n_out)
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_dense"
+        lname = ctx.lname("dense")
         n_in = itype.flat_size
         x = _maybe_dropout(ctx, x, self.dropout, lname)
         w = ctx.param(f"{lname}_W", (n_in, self.n_out), self.weight_init)
@@ -186,7 +192,7 @@ class EmbeddingLayer(BaseLayer):
         return InputType.feed_forward(self.n_out)
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_embedding"
+        lname = ctx.lname("embedding")
         if itype.flat_size != 1:
             raise ValueError(
                 f"EmbeddingLayer expects a single index column "
@@ -227,7 +233,7 @@ class ConvolutionLayer(BaseLayer):
                                  _conv_out(w, kw, sw, self.convolution_mode, dw)))
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_conv"
+        lname = ctx.lname("conv")
         c_in = itype.dims[0]
         kh, kw = _as_pair(self.kernel_size)
         x = _maybe_dropout(ctx, x, self.dropout, lname)
@@ -269,7 +275,7 @@ class SubsamplingLayer(BaseLayer):
                                  _conv_out(w, kw, sw, self.convolution_mode)))
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_pool"
+        lname = ctx.lname("pool")
         op = {"MAX": "max_pool2d", "AVG": "avg_pool2d",
               "PNORM": "pnorm_pool2d"}[self.pooling_type.upper()]
         attrs = {"kernel": _as_pair(self.kernel_size),
@@ -294,7 +300,7 @@ class BatchNormalization(BaseLayer):
         return itype
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_bn"
+        lname = ctx.lname("bn")
         n = itype.dims[0]
         gamma = ctx.sd.var(f"{lname}_gamma", value=np.ones((n,)),
                            dtype=ctx.dtype)
@@ -328,7 +334,7 @@ class ActivationLayer(BaseLayer):
 
     def build(self, ctx, x, itype):
         return (apply_activation(ctx.sd, x, self.activation,
-                                 f"layer{ctx.idx}"), itype)
+                                 ctx.lname("act")), itype)
 
 
 @dataclasses.dataclass
@@ -341,7 +347,7 @@ class DropoutLayer(BaseLayer):
         return itype
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_dropout"
+        lname = ctx.lname("dropout")
         if ctx.training and 0 < self.dropout < 1:
             x = ctx.sd.invoke("dropout", [x], {"p": self.dropout}, name=lname)
         return x, itype
@@ -365,7 +371,7 @@ class LSTMLayer(BaseLayer):
         return InputType.feed_forward(self.n_out)
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_lstm"
+        lname = ctx.lname("lstm")
         n_in = itype.dims[0]
         u = self.n_out
         x = _maybe_dropout(ctx, x, self.dropout, lname)
@@ -400,7 +406,7 @@ class GlobalPoolingLayer(BaseLayer):
 
     def build(self, ctx, x, itype):
         self.output_type(itype)  # validate input kind
-        lname = f"layer{ctx.idx}_gpool"
+        lname = ctx.lname("gpool")
         axis = (2, 3) if itype.kind == "cnn" else (1,)
         opname = {"AVG": "reduce_mean", "MAX": "reduce_max",
                   "SUM": "reduce_sum"}[self.pooling_type.upper()]
@@ -439,7 +445,7 @@ class OutputLayer(BaseLayer):
         return InputType.feed_forward(self.n_out)
 
     def build(self, ctx, x, itype):
-        lname = f"layer{ctx.idx}_out"
+        lname = ctx.lname("out")
         n_in = itype.flat_size
         w = ctx.param(f"{lname}_W", (n_in, self.n_out), self.weight_init)
         z = x.mmul(w, name=f"{lname}_mm")
@@ -471,7 +477,7 @@ class LossLayer(BaseLayer):
         return itype
 
     def build(self, ctx, x, itype):
-        out = apply_activation(ctx.sd, x, self.activation, f"layer{ctx.idx}")
+        out = apply_activation(ctx.sd, x, self.activation, ctx.lname("act"))
         ctx.output_var = out
         loss_op = _LOSS_OPS[self.loss_function.upper()]
         loss_in = x if loss_op in ("softmax_cross_entropy",

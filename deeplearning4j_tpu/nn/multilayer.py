@@ -59,13 +59,15 @@ def _adapt_itype(itype: InputType, layer: BaseLayer, idx: int) -> InputType:
                      f"(layer {idx}, {type(layer).__name__})")
 
 
-def _adapt_input(sd, x, itype: InputType, layer: BaseLayer, idx: int):
-    """Apply _adapt_itype's decision to the graph (emit the reshape)."""
+def _adapt_input(sd, x, itype: InputType, layer: BaseLayer, idx,
+                 name_stem: Optional[str] = None):
+    """Apply _adapt_itype's decision to the graph (emit the reshape).
+    Shared by MultiLayerNetwork and ComputationGraph builds."""
     new_itype = _adapt_itype(itype, layer, idx)
     if new_itype is itype:
         return x, itype
     x = sd.invoke("reshape", [x], {"shape": (-1, new_itype.flat_size)},
-                  name=f"layer{idx}_cnn2ff")
+                  name=name_stem or f"layer{idx}_cnn2ff")
     return x, new_itype
 
 
@@ -276,12 +278,21 @@ class MultiLayerNetwork:
 
 
 class _ArrayIterator:
+    """In-memory batch iterator over one or more feature/label arrays
+    (shared by MultiLayerNetwork and ComputationGraph fit(X, Y) paths)."""
+
     def __init__(self, X, Y, batch: int):
-        self.X, self.Y, self.batch = X, Y, batch
+        self.Xs = list(X) if isinstance(X, (list, tuple)) else [X]
+        self.Ys = list(Y) if isinstance(Y, (list, tuple)) else [Y]
+        self.batch = batch
 
     def reset(self):
         pass
 
     def __iter__(self):
-        for i in range(0, len(self.X), self.batch):
-            yield self.X[i:i + self.batch], self.Y[i:i + self.batch]
+        n = len(self.Xs[0])
+        for i in range(0, n, self.batch):
+            feats = [X[i:i + self.batch] for X in self.Xs]
+            labs = [Y[i:i + self.batch] for Y in self.Ys]
+            yield (feats if len(feats) > 1 else feats[0],
+                   labs if len(labs) > 1 else labs[0])

@@ -1,0 +1,270 @@
+"""ComputationGraph tests (reference: dl4jcore/nn/graph tests — multi-input
+DAGs, vertex ops, serde round-trip)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn import (
+    ComputationGraph, ComputationGraphConfiguration, DenseLayer,
+    ElementWiseVertex, InputType, L2NormalizeVertex, MergeVertex,
+    NeuralNetConfiguration, OutputLayer, ScaleVertex, ShiftVertex,
+    SubsetVertex)
+
+
+def _two_input_graph():
+    return (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("inA", "inB")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(2))
+            .add_layer("denseA", DenseLayer(n_out=8, activation="tanh"), "inA")
+            .add_layer("denseB", DenseLayer(n_out=8, activation="tanh"), "inB")
+            .add_vertex("merge", MergeVertex(), "denseA", "denseB")
+            .add_layer("out", OutputLayer(n_out=2), "merge")
+            .set_outputs("out")
+            .build())
+
+
+def test_graph_builds_and_outputs():
+    net = ComputationGraph(_two_input_graph()).init()
+    a = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(4, 2)).astype(np.float32)
+    outs = net.output(a, b)
+    assert len(outs) == 1
+    assert outs[0].to_numpy().shape == (4, 2)
+    np.testing.assert_allclose(outs[0].to_numpy().sum(-1), np.ones(4),
+                               rtol=1e-5)
+
+
+def test_graph_trains_two_inputs():
+    rng = np.random.default_rng(3)
+    A = rng.normal(size=(64, 3)).astype(np.float32)
+    B = rng.normal(size=(64, 2)).astype(np.float32)
+    y = ((A[:, 0] + B[:, 0]) > 0).astype(int)
+    Y = np.eye(2, dtype=np.float32)[y]
+
+    class It:
+        def reset(self): ...
+        def __iter__(self):
+            for i in range(0, 64, 32):
+                yield [A[i:i+32], B[i:i+32]], [Y[i:i+32]]
+
+    net = ComputationGraph(_two_input_graph()).init()
+    h = net.fit(It(), epochs=60)
+    assert h.final_loss() < 0.2
+    preds = net.output(A, B)[0].to_numpy().argmax(-1)
+    assert (preds == y).mean() > 0.9
+
+
+def test_elementwise_vertex_residual_block():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(8))
+            .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_vertex("residual", ElementWiseVertex(op="Add"), "d1", "in")
+            .add_layer("out", OutputLayer(n_out=2), "residual")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    assert net.output(x)[0].to_numpy().shape == (4, 2)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("Add", lambda a, b: a + b),
+    ("Subtract", lambda a, b: a - b),
+    ("Product", lambda a, b: a * b),
+    ("Average", lambda a, b: (a + b) / 2),
+    ("Max", np.maximum),
+])
+def test_elementwise_vertex_math(op, fn):
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(3))
+            .add_vertex("ew", ElementWiseVertex(op=op), "a", "b")
+            .set_outputs("ew").build())
+    net = ComputationGraph(conf).init()
+    a = np.array([[1.0, 2.0, 3.0]], np.float32)
+    b = np.array([[4.0, 0.5, -1.0]], np.float32)
+    out = net.output(a, b)[0].to_numpy()
+    np.testing.assert_allclose(out, fn(a, b), rtol=1e-6)
+
+
+def test_scale_shift_subset_l2_vertices():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(6))
+            .add_vertex("sub", SubsetVertex(from_idx=1, to_idx=3), "in")
+            .add_vertex("scaled", ScaleVertex(scale_factor=2.0), "sub")
+            .add_vertex("shifted", ShiftVertex(shift_factor=1.0), "scaled")
+            .add_vertex("l2", L2NormalizeVertex(), "shifted")
+            .set_outputs("l2").build())
+    net = ComputationGraph(conf).init()
+    x = np.arange(6, dtype=np.float32)[None, :]
+    out = net.output(x)[0].to_numpy()
+    expected = x[:, 1:4] * 2 + 1
+    expected = expected / np.linalg.norm(expected, axis=-1, keepdims=True)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_multi_output_graph():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("shared", DenseLayer(n_out=16, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_out=2), "shared")
+            .add_layer("out2", OutputLayer(n_out=3), "shared")
+            .set_outputs("out1", "out2").build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    Y1 = np.eye(2, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+    Y2 = np.eye(3, dtype=np.float32)[np.clip(X[:, 1].astype(int) + 1, 0, 2)]
+
+    class It:
+        def reset(self): ...
+        def __iter__(self):
+            yield [X], [Y1, Y2]
+
+    h = net.fit(It(), epochs=50)
+    assert np.isfinite(h.final_loss())
+    o1, o2 = net.output(X)
+    assert o1.to_numpy().shape == (64, 2)
+    assert o2.to_numpy().shape == (64, 3)
+    acc1 = (o1.to_numpy().argmax(-1) == Y1.argmax(-1)).mean()
+    assert acc1 > 0.9
+
+
+def test_graph_config_json_round_trip():
+    conf = _two_input_graph()
+    s = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert [n.name for n in conf2.nodes] == ["denseA", "denseB", "merge", "out"]
+    net = ComputationGraph(conf2).init()
+    assert net.num_params() > 0
+
+
+def test_graph_serde_round_trip(tmp_path):
+    net = ComputationGraph(_two_input_graph()).init()
+    a = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(4, 2)).astype(np.float32)
+    before = net.output(a, b)[0].to_numpy()
+    path = tmp_path / "graph.zip"
+    net.save(path)
+    net2 = ComputationGraph.load(path)
+    np.testing.assert_allclose(net2.output(a, b)[0].to_numpy(), before,
+                               rtol=1e-6)
+
+
+def test_graph_rejects_unknown_input():
+    with pytest.raises(ValueError, match="unknown"):
+        (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(2))
+         .add_layer("d", DenseLayer(n_out=2), "missing")
+         .set_outputs("d").build())
+
+
+def test_graph_cnn_to_dense_preprocessor():
+    from deeplearning4j_tpu.nn import ConvolutionLayer
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("img")
+            .set_input_types(InputType.convolutional(8, 8, 1))
+            .add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                                activation="relu"), "img")
+            .add_layer("dense", DenseLayer(n_out=8), "conv")
+            .add_layer("out", OutputLayer(n_out=2), "dense")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    x = np.zeros((2, 1, 8, 8), np.float32)
+    assert net.output(x)[0].to_numpy().shape == (2, 2)
+
+
+# ---- regression tests for review findings ----
+
+def test_passthrough_node_does_not_corrupt_graph():
+    from deeplearning4j_tpu.nn import DropoutLayer
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("drop", DropoutLayer(dropout=0.5), "in")
+            .add_layer("out", OutputLayer(n_out=2), "drop")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    x = np.zeros((2, 4), np.float32)
+    # infer graph: dropout is identity — the input var must not be renamed
+    assert net.output(x)[0].to_numpy().shape == (2, 2)
+    net.fit(x, np.eye(2, dtype=np.float32)[[0, 1]], epochs=1, batch_size=2)
+
+
+def test_layer_with_multiple_inputs_rejected():
+    with pytest.raises(ValueError, match="MergeVertex"):
+        (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("a", "b")
+         .set_input_types(InputType.feed_forward(2), InputType.feed_forward(2))
+         .add_layer("d", DenseLayer(n_out=2), "a", "b")
+         .set_outputs("d").build())
+
+
+def test_duplicate_node_name_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(2))
+         .add_layer("d", DenseLayer(n_out=2), "in")
+         .add_layer("d", DenseLayer(n_out=2), "in")
+         .set_outputs("d").build())
+
+
+def test_label_mapping_follows_set_outputs_order():
+    # loss heads declared in reverse of set_outputs order
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("shared", DenseLayer(n_out=8), "in")
+            .add_layer("out2", OutputLayer(n_out=3), "shared")
+            .add_layer("out1", OutputLayer(n_out=2), "shared")
+            .set_outputs("out1", "out2").build())
+    net = ComputationGraph(conf).init()
+    assert net._label_names == ["labels_out1", "labels_out2"]
+    # fit with labels in set_outputs order: (B,2) then (B,3)
+    X = np.zeros((4, 4), np.float32)
+    Y1 = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    Y2 = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+
+    class It:
+        def reset(self): ...
+        def __iter__(self):
+            yield [X], [Y1, Y2]
+
+    h = net.fit(It(), epochs=1)
+    assert np.isfinite(h.final_loss())
+
+
+def test_subset_vertex_on_rnn_slices_features():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .graph_builder()
+            .add_inputs("seq")
+            .set_input_types(InputType.recurrent(5, 6))
+            .add_vertex("sub", SubsetVertex(from_idx=1, to_idx=2), "seq")
+            .set_outputs("sub").build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 6, 5)).astype(np.float32)
+    out = net.output(x)[0].to_numpy()
+    assert out.shape == (2, 6, 2)
+    np.testing.assert_allclose(out, x[:, :, 1:3], rtol=1e-6)
