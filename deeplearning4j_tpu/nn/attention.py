@@ -1,0 +1,262 @@
+"""Attention and transformer layers.
+
+Reference parity: nn/conf/layers/{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer}.java over the native
+fused attention ops (libnd4j generic/nn/multi_head_dot_product_attention
+.cpp:34), plus EmbeddingSequenceLayer (nn/conf/layers/
+EmbeddingSequenceLayer.java). TransformerEncoderLayer and
+PositionalEmbeddingLayer are NEW capability — the reference predates
+transformer blocks as first-class layers (SURVEY.md §5); built TPU-first:
+(B, T, C) layout, bf16-friendly matmuls, whole-block fusion by XLA, and a
+causal flag for LM training.
+
+Sequence layout: (batch, time, features). Token inputs use
+InputType kind "ids" with placeholder (batch, time) int32.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers import (
+    BaseLayer, BuildContext, InputType, LAYER_TYPES, _maybe_dropout)
+
+
+def sequence_ids(timesteps: int) -> InputType:
+    """InputType for token-id sequences: placeholder (B, T) int."""
+    return InputType("ids", (int(timesteps),))
+
+
+# patched into InputType for discoverability
+InputType.sequence_ids = staticmethod(sequence_ids)
+
+_orig_placeholder_shape = InputType.placeholder_shape
+
+
+def _placeholder_shape(self):
+    if self.kind == "ids":
+        return (-1, self.dims[0])
+    return _orig_placeholder_shape(self)
+
+
+InputType.placeholder_shape = _placeholder_shape
+
+
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(BaseLayer):
+    """Token ids (B, T) → embeddings (B, T, n_out) (reference:
+    nn/conf/layers/EmbeddingSequenceLayer)."""
+    n_in: int = 0        # vocabulary
+    n_out: int = 0
+    weight_init: str = "NORMAL"
+
+    def output_type(self, itype):
+        if itype.kind != "ids":
+            raise ValueError("EmbeddingSequenceLayer needs "
+                             "InputType.sequence_ids(T) input")
+        return InputType.recurrent(self.n_out, itype.dims[0])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("embedseq")
+        self.output_type(itype)
+        table = ctx.param(f"{lname}_W", (self.n_in, self.n_out),
+                          self.weight_init)
+        ids = x.cast("int32")
+        out = ctx.sd.invoke("embedding_lookup", [table, ids], {},
+                            name=f"{lname}_out")
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class PositionalEmbeddingLayer(BaseLayer):
+    """Adds a learned positional embedding over the time axis (new
+    capability; no reference analogue)."""
+    max_len: int = 512
+    weight_init: str = "NORMAL"
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("posemb")
+        d = itype.dims[0]
+        t = itype.dims[1]
+        if t <= 0:
+            t = self.max_len
+        pos = ctx.param(f"{lname}_P", (t, d), self.weight_init)
+        out = x.add(pos, name=f"{lname}_out")   # broadcasts over batch
+        return out, itype
+
+
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head self-attention projecting to n_out (reference:
+    nn/conf/layers/SelfAttentionLayer over native MHA op)."""
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.dims[1])
+
+    def _head_size(self):
+        if self.head_size:
+            return self.head_size
+        if self.n_out % self.n_heads:
+            raise ValueError("n_out must divide by n_heads (or set head_size)")
+        return self.n_out // self.n_heads
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("selfattn")
+        d_in = itype.dims[0]
+        hk = self._head_size() * self.n_heads
+        wq = ctx.param(f"{lname}_Wq", (d_in, hk), self.weight_init)
+        wk = ctx.param(f"{lname}_Wk", (d_in, hk), self.weight_init)
+        wv = ctx.param(f"{lname}_Wv", (d_in, hk), self.weight_init)
+        wo = ctx.param(f"{lname}_Wo", (hk, self.n_out), self.weight_init)
+        out = ctx.sd.invoke(
+            "multi_head_dot_product_attention", [x, x, x, wq, wk, wv, wo],
+            {"nheads": self.n_heads}, name=f"{lname}_out")
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(BaseLayer):
+    """Attention with N learned query vectors → fixed-length output
+    (B, n_queries, n_out) (reference: LearnedSelfAttentionLayer)."""
+    n_out: int = 0
+    n_heads: int = 1
+    n_queries: int = 1
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("learnedattn")
+        d_in = itype.dims[0]
+        if self.n_out % self.n_heads:
+            raise ValueError("n_out must divide by n_heads")
+        hk = self.n_out
+        q = ctx.param(f"{lname}_Q", (self.n_queries, d_in), self.weight_init)
+        wq = ctx.param(f"{lname}_Wq", (d_in, hk), self.weight_init)
+        wk = ctx.param(f"{lname}_Wk", (d_in, hk), self.weight_init)
+        wv = ctx.param(f"{lname}_Wv", (d_in, hk), self.weight_init)
+        wo = ctx.param(f"{lname}_Wo", (hk, self.n_out), self.weight_init)
+        # broadcast the learned queries over the batch: zeros (B,nq,d) + Q
+        zeros = ctx.sd.invoke("rnn_init_state", [x],
+                              {"units": self.n_queries * d_in},
+                              name=f"{lname}_z")
+        zeros = zeros.reshape(-1, self.n_queries, d_in)
+        qb = zeros.add(q, name=f"{lname}_qb")
+        out = ctx.sd.invoke(
+            "multi_head_dot_product_attention", [qb, x, x, wq, wk, wv, wo],
+            {"nheads": self.n_heads}, name=f"{lname}_out")
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class LayerNormLayer(BaseLayer):
+    """Layer normalization over the feature axis (native layer_norm op;
+    the reference exposes layernorm only as an op — first-class layer is
+    transformer-era capability)."""
+    eps: float = 1e-5
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("ln")
+        d = itype.dims[0]
+        gamma = ctx.sd.var(f"{lname}_g", value=np.ones((d,)), dtype=ctx.dtype)
+        beta = ctx.sd.var(f"{lname}_b", value=np.zeros((d,)), dtype=ctx.dtype)
+        out = ctx.sd.invoke("layer_norm", [x, gamma, beta],
+                            {"axis": -1, "epsilon": self.eps}, name=lname)
+        return out, itype
+
+
+@dataclasses.dataclass
+class TransformerEncoderLayer(BaseLayer):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x)).
+
+    New capability (no reference analogue). ``causal=True`` masks future
+    positions for LM training.
+    """
+    n_heads: int = 4
+    d_ff: int = 0            # default 4*d_model
+    dropout: float = 0.0     # retain prob NOT used here: p = drop prob
+    causal: bool = False
+    activation: str = "gelu"
+    weight_init: str = "XAVIER"
+    eps: float = 1e-5
+
+    def output_type(self, itype):
+        return itype
+
+    def _ln(self, ctx, x, d, name):
+        gamma = ctx.sd.var(f"{name}_g", value=np.ones((d,)), dtype=ctx.dtype)
+        beta = ctx.sd.var(f"{name}_b", value=np.zeros((d,)), dtype=ctx.dtype)
+        return ctx.sd.invoke("layer_norm", [x, gamma, beta],
+                             {"axis": -1, "epsilon": self.eps}, name=name)
+
+    def build(self, ctx, x, itype):
+        from deeplearning4j_tpu.nn.activations import apply_activation
+        lname = ctx.lname("encoder")
+        d = itype.dims[0]
+        t = itype.dims[1]
+        d_ff = self.d_ff or 4 * d
+        if d % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+
+        # --- attention sublayer (pre-LN) -------------------------------
+        h = self._ln(ctx, x, d, f"{lname}_ln1")
+        wq = ctx.param(f"{lname}_Wq", (d, d), self.weight_init)
+        wk = ctx.param(f"{lname}_Wk", (d, d), self.weight_init)
+        wv = ctx.param(f"{lname}_Wv", (d, d), self.weight_init)
+        wo = ctx.param(f"{lname}_Wo", (d, d), self.weight_init)
+        attrs = {"nheads": self.n_heads}
+        if self.causal:
+            if t <= 0:
+                raise ValueError("causal encoder needs a static timestep "
+                                 "count in the InputType")
+            mask = np.tril(np.ones((t, t), np.float32))[None, None]
+            cmask = ctx.sd.constant(mask, f"{lname}_mask")
+            attrs["mask"] = None  # mask passed as input below
+            attn = ctx.sd.invoke(
+                "multi_head_dot_product_attention",
+                [h, h, h, wq, wk, wv, wo, cmask], {"nheads": self.n_heads},
+                name=f"{lname}_mha")
+        else:
+            attn = ctx.sd.invoke(
+                "multi_head_dot_product_attention",
+                [h, h, h, wq, wk, wv, wo], attrs, name=f"{lname}_mha")
+        if self.dropout and ctx.training:
+            attn = ctx.sd.invoke("dropout", [attn], {"p": 1.0 - self.dropout},
+                                 name=f"{lname}_adrop")
+        x = x.add(attn, name=f"{lname}_res1")
+
+        # --- feed-forward sublayer (pre-LN) ----------------------------
+        h2 = self._ln(ctx, x, d, f"{lname}_ln2")
+        w1 = ctx.param(f"{lname}_Wff1", (d, d_ff), self.weight_init)
+        b1 = ctx.sd.var(f"{lname}_bff1", value=np.zeros((d_ff,)),
+                        dtype=ctx.dtype)
+        w2 = ctx.param(f"{lname}_Wff2", (d_ff, d), self.weight_init)
+        b2 = ctx.sd.var(f"{lname}_bff2", value=np.zeros((d,)),
+                        dtype=ctx.dtype)
+        ff = h2.mmul(w1).add(b1)
+        ff = apply_activation(ctx.sd, ff, self.activation, f"{lname}_ffact")
+        ff = ff.mmul(w2).add(b2)
+        if self.dropout and ctx.training:
+            ff = ctx.sd.invoke("dropout", [ff], {"p": 1.0 - self.dropout},
+                               name=f"{lname}_fdrop")
+        out = x.add(ff, name=f"{lname}_out")
+        return out, itype
+
+
+for _cls in [EmbeddingSequenceLayer, PositionalEmbeddingLayer,
+             SelfAttentionLayer, LearnedSelfAttentionLayer, LayerNormLayer,
+             TransformerEncoderLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls
