@@ -99,6 +99,17 @@ class SameDiff:
         self._version += 1
         self._fn_cache.clear()
 
+    @property
+    def training_config(self):
+        return self._training_config
+
+    @training_config.setter
+    def training_config(self, tc):
+        # assigning a new config must invalidate compiled train steps — the
+        # closure bakes in updater/regularization/clip hyperparameters
+        self._training_config = tc
+        self._mutated()
+
     # ------------------------------------------------------------------
     # variable creation (reference: SameDiff.var/constant/placeHolder)
     def var(self, name: str = "var", shape: Optional[Sequence[int]] = None,
@@ -237,6 +248,12 @@ class SameDiff:
             node.inputs = [new if i == old else i for i in node.inputs]
             node.outputs = [new if o == old else o for o in node.outputs]
         self.loss_variables = [new if n == old else n for n in self.loss_variables]
+        if old in self._state_var_names:
+            self._state_var_names.discard(old)
+            self._state_var_names.add(new)
+        self._state_updates = {
+            (new if k == old else k): (new if s == old else s)
+            for k, s in self._state_updates.items()}
         self._mutated()
         return v
 

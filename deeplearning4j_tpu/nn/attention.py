@@ -187,7 +187,8 @@ class TransformerEncoderLayer(BaseLayer):
     """
     n_heads: int = 4
     d_ff: int = 0            # default 4*d_model
-    dropout: float = 0.0     # retain prob NOT used here: p = drop prob
+    drop_prob: float = 0.0   # DROP probability (unlike the retain-prob
+                             # `dropout` field on DL4J-parity layers)
     causal: bool = False
     activation: str = "gelu"
     weight_init: str = "XAVIER"
@@ -233,8 +234,8 @@ class TransformerEncoderLayer(BaseLayer):
             attn = ctx.sd.invoke(
                 "multi_head_dot_product_attention",
                 [h, h, h, wq, wk, wv, wo], attrs, name=f"{lname}_mha")
-        if self.dropout and ctx.training:
-            attn = ctx.sd.invoke("dropout", [attn], {"p": 1.0 - self.dropout},
+        if self.drop_prob and ctx.training:
+            attn = ctx.sd.invoke("dropout", [attn], {"p": 1.0 - self.drop_prob},
                                  name=f"{lname}_adrop")
         x = x.add(attn, name=f"{lname}_res1")
 
@@ -249,8 +250,8 @@ class TransformerEncoderLayer(BaseLayer):
         ff = h2.mmul(w1).add(b1)
         ff = apply_activation(ctx.sd, ff, self.activation, f"{lname}_ffact")
         ff = ff.mmul(w2).add(b2)
-        if self.dropout and ctx.training:
-            ff = ctx.sd.invoke("dropout", [ff], {"p": 1.0 - self.dropout},
+        if self.drop_prob and ctx.training:
+            ff = ctx.sd.invoke("dropout", [ff], {"p": 1.0 - self.drop_prob},
                                name=f"{lname}_fdrop")
         out = x.add(ff, name=f"{lname}_out")
         return out, itype

@@ -177,7 +177,11 @@ class ShiftVertex(GraphVertex):
 
 @dataclasses.dataclass
 class L2NormalizeVertex(GraphVertex):
+    """Normalizes over all non-batch dimensions by default, matching the
+    reference L2NormalizeVertex (nn/conf/graph/L2NormalizeVertex.java);
+    pass ``dimensions`` to restrict."""
     eps: float = 1e-8
+    dimensions: Optional[Tuple[int, ...]] = None
 
     def output_type(self, itypes):
         return itypes[0]
@@ -185,7 +189,11 @@ class L2NormalizeVertex(GraphVertex):
     def build(self, ctx, xs, itypes):
         name = ctx.lname("l2norm")
         x = xs[0]
-        norm = x.square().sum(dims=-1, keep_dims=True).sqrt()
+        # input rank = batch axis + itype dims (ff:2, recurrent:3, cnn:4)
+        rank = 1 + len(itypes[0].dims)
+        dims = tuple(self.dimensions) if self.dimensions is not None \
+            else tuple(range(1, rank))
+        norm = x.square().sum(dims=dims, keep_dims=True).sqrt()
         out = x.div(norm.add(ctx.sd.constant(self.eps, f"{name}_eps")),
                     name=name)
         return out, itypes[0]
@@ -461,47 +469,17 @@ class ComputationGraph:
 
     # --- serde --------------------------------------------------------
     def save(self, path, include_updater_state: bool = True) -> None:
-        import jax
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("configuration.json", self.conf.to_json())
-            buf = io.BytesIO()
-            np.savez(buf, **{n: np.asarray(a)
-                             for n, a in self._sd_train._arrays.items()
-                             if n in self._sd_train._vars})
-            zf.writestr("parameters.npz", buf.getvalue())
-            if include_updater_state and \
-                    self._sd_train._updater_state is not None:
-                leaves = jax.tree_util.tree_leaves(
-                    self._sd_train._updater_state)
-                buf = io.BytesIO()
-                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                                 for i, l in enumerate(leaves)})
-                zf.writestr("updater.npz", buf.getvalue())
+        from deeplearning4j_tpu.nn.model_serde import save_net_zip
+        save_net_zip(path, self.conf.to_json(), self._sd_train,
+                     include_updater_state)
 
     @staticmethod
     def load(path) -> "ComputationGraph":
-        import jax
-        import jax.numpy as jnp
-        with zipfile.ZipFile(path, "r") as zf:
-            conf = ComputationGraphConfiguration.from_json(
-                zf.read("configuration.json").decode())
-            with np.load(io.BytesIO(zf.read("parameters.npz"))) as npz:
-                arrays = {k: jnp.asarray(npz[k]) for k in npz.files}
-            updater_leaves = None
-            if "updater.npz" in zf.namelist():
-                with np.load(io.BytesIO(zf.read("updater.npz"))) as npz:
-                    updater_leaves = [jnp.asarray(npz[f"leaf_{i}"])
-                                      for i in range(len(npz.files))]
+        from deeplearning4j_tpu.nn.model_serde import (read_net_zip,
+                                                       restore_net_state)
+        conf_json, arrays, updater_leaves, iteration = read_net_zip(path)
+        conf = ComputationGraphConfiguration.from_json(conf_json)
         net = ComputationGraph(conf).init()
-        sd = net._sd_train
-        for n, arr in arrays.items():
-            if n in sd._vars:
-                sd._arrays[n] = arr
-        if updater_leaves is not None:
-            template = conf.updater.init(sd.trainable_params())
-            treedef = jax.tree_util.tree_structure(template)
-            sd._updater_state = jax.tree_util.tree_unflatten(
-                treedef, updater_leaves)
-        return net
+        return restore_net_state(net, conf, arrays, updater_leaves, iteration)
 
 

@@ -103,10 +103,16 @@ class BaseLayer:
             d[f.name] = list(v) if isinstance(v, tuple) else v
         return d
 
+    # legacy field renames: {class name: {old json key: new field name}}
+    _FIELD_ALIASES = {"TransformerEncoderLayer": {"dropout": "drop_prob"}}
+
     @staticmethod
     def from_json(d: dict) -> "BaseLayer":
         d = dict(d)
         cls = LAYER_TYPES[d.pop("@class")]
+        for old, new in BaseLayer._FIELD_ALIASES.get(cls.__name__, {}).items():
+            if old in d and new not in d:
+                d[new] = d.pop(old)
         kw = {}
         for f in dataclasses.fields(cls):
             if f.name in d:

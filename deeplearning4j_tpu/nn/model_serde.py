@@ -1,0 +1,72 @@
+"""Shared zip-container serde for layer-based networks.
+
+Reference parity: util/ModelSerializer.java — a zip of configuration JSON,
+flattened parameters, updater state, and training iteration count. Both
+MultiLayerNetwork and ComputationGraph write the same container format
+through these helpers.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as np
+
+
+def save_net_zip(path, conf_json: str, sd, include_updater_state: bool = True
+                 ) -> None:
+    """Write the ModelSerializer-style container for a network whose
+    parameters live in SameDiff graph ``sd``."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", conf_json)
+        buf = io.BytesIO()
+        np.savez(buf, **{n: np.asarray(a) for n, a in sd._arrays.items()
+                         if n in sd._vars})
+        zf.writestr("parameters.npz", buf.getvalue())
+        if include_updater_state and sd._updater_state is not None:
+            import jax
+            leaves = jax.tree_util.tree_leaves(sd._updater_state)
+            buf = io.BytesIO()
+            np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                             for i, l in enumerate(leaves)})
+            zf.writestr("updater.npz", buf.getvalue())
+        zf.writestr("iteration.json", json.dumps({
+            "iteration_count": sd.training_config.iteration_count
+            if sd.training_config else 0}))
+
+
+def read_net_zip(path):
+    """Read the container → (conf_json, arrays, updater_leaves, iteration)."""
+    import jax.numpy as jnp
+    with zipfile.ZipFile(path, "r") as zf:
+        conf_json = zf.read("configuration.json").decode()
+        with np.load(io.BytesIO(zf.read("parameters.npz"))) as npz:
+            arrays = {k: jnp.asarray(npz[k]) for k in npz.files}
+        updater_leaves = None
+        if "updater.npz" in zf.namelist():
+            with np.load(io.BytesIO(zf.read("updater.npz"))) as npz:
+                updater_leaves = [jnp.asarray(npz[f"leaf_{i}"])
+                                  for i in range(len(npz.files))]
+        iteration = 0
+        if "iteration.json" in zf.namelist():
+            iteration = json.loads(zf.read("iteration.json"))\
+                .get("iteration_count", 0)
+    return conf_json, arrays, updater_leaves, iteration
+
+
+def restore_net_state(net, conf, arrays, updater_leaves, iteration):
+    """Copy loaded arrays/updater state/iteration into an initialized net."""
+    import jax
+    sd = net._sd_train
+    for n, arr in arrays.items():
+        if n in sd._vars:
+            sd._arrays[n] = arr
+    if updater_leaves is not None:
+        template = conf.updater.init(sd.trainable_params())
+        treedef = jax.tree_util.tree_structure(template)
+        sd._updater_state = jax.tree_util.tree_unflatten(
+            treedef, updater_leaves)
+    if sd.training_config is not None:
+        sd.training_config.iteration_count = iteration
+    return net

@@ -227,54 +227,19 @@ class MultiLayerNetwork:
     # serde (reference: util/ModelSerializer zip of config JSON + params +
     # updater state)
     def save(self, path, include_updater_state: bool = True) -> None:
+        from deeplearning4j_tpu.nn.model_serde import save_net_zip
         self._require_init()
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("configuration.json", self.conf.to_json())
-            buf = io.BytesIO()
-            np.savez(buf, **{n: np.asarray(a)
-                             for n, a in self._sd_train._arrays.items()
-                             if n in self._sd_train._vars})
-            zf.writestr("parameters.npz", buf.getvalue())
-            if include_updater_state and self._sd_train._updater_state is not None:
-                import jax
-                leaves = jax.tree_util.tree_leaves(self._sd_train._updater_state)
-                buf = io.BytesIO()
-                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                                 for i, l in enumerate(leaves)})
-                zf.writestr("updater.npz", buf.getvalue())
-            zf.writestr("iteration.json", json.dumps({
-                "iteration_count":
-                    self._sd_train.training_config.iteration_count
-                    if self._sd_train.training_config else 0}))
+        save_net_zip(path, self.conf.to_json(), self._sd_train,
+                     include_updater_state)
 
     @staticmethod
     def load(path) -> "MultiLayerNetwork":
-        import jax
-        import jax.numpy as jnp
-        with zipfile.ZipFile(path, "r") as zf:
-            conf = MultiLayerConfiguration.from_json(
-                zf.read("configuration.json").decode())
-            with np.load(io.BytesIO(zf.read("parameters.npz"))) as npz:
-                arrays = {k: jnp.asarray(npz[k]) for k in npz.files}
-            updater_leaves = None
-            if "updater.npz" in zf.namelist():
-                with np.load(io.BytesIO(zf.read("updater.npz"))) as npz:
-                    updater_leaves = [jnp.asarray(npz[f"leaf_{i}"])
-                                      for i in range(len(npz.files))]
-            iteration = json.loads(zf.read("iteration.json"))\
-                .get("iteration_count", 0)
+        from deeplearning4j_tpu.nn.model_serde import (read_net_zip,
+                                                       restore_net_state)
+        conf_json, arrays, updater_leaves, iteration = read_net_zip(path)
+        conf = MultiLayerConfiguration.from_json(conf_json)
         net = MultiLayerNetwork(conf).init()
-        sd = net._sd_train
-        for n, arr in arrays.items():
-            if n in sd._vars:
-                sd._arrays[n] = arr
-        if updater_leaves is not None:
-            template = conf.updater.init(sd.trainable_params())
-            treedef = jax.tree_util.tree_structure(template)
-            sd._updater_state = jax.tree_util.tree_unflatten(
-                treedef, updater_leaves)
-        sd.training_config.iteration_count = iteration
-        return net
+        return restore_net_state(net, conf, arrays, updater_leaves, iteration)
 
 
 class _ArrayIterator:

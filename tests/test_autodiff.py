@@ -415,3 +415,41 @@ def test_performance_listener_autofills_batch_size():
     sd.fit(_ToyIterator(X, Y, batch=16), epochs=1, listeners=[pl])
     assert pl.batch_size == 16
     assert np.isfinite(pl.samples_per_sec)
+
+
+def test_new_training_config_invalidates_cached_step():
+    # ADVICE r1: swapping training_config must not reuse the compiled step
+    # that baked in the old hyperparameters.
+    sd = SameDiff()
+    _build_mlp(sd)
+    sd.training_config = (TrainingConfig.builder()
+                          .updater(Sgd(learning_rate=0.1))
+                          .data_set_feature_mapping("x")
+                          .data_set_label_mapping("labels")
+                          .build())
+    X, Y = _xor_problem()
+    sd.fit(_ToyIterator(X, Y, batch=32), epochs=1)
+    before = {k: np.asarray(v) for k, v in sd.trainable_params().items()}
+    sd.training_config = (TrainingConfig.builder()
+                          .updater(Sgd(learning_rate=0.0))
+                          .data_set_feature_mapping("x")
+                          .data_set_label_mapping("labels")
+                          .build())
+    sd.fit(_ToyIterator(X, Y, batch=32), epochs=1)
+    after = sd.trainable_params()
+    for k in before:
+        np.testing.assert_allclose(np.asarray(after[k]), before[k],
+                                   err_msg=f"lr=0 fit changed {k}")
+
+
+def test_rename_variable_rewrites_state_tracking():
+    import jax.numpy as jnp
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 3))
+    s = sd.state_var("running", value=np.zeros((3,)))
+    upd = s.add(x.mean(dims=0), name="upd")
+    sd.update_state(s, upd)
+    sd.rename_variable("running", "running2")
+    assert "running2" in sd._state_var_names
+    assert "running" not in sd._state_var_names
+    assert sd._state_updates == {"running2": "upd"}

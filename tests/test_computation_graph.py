@@ -268,3 +268,38 @@ def test_subset_vertex_on_rnn_slices_features():
     out = net.output(x)[0].to_numpy()
     assert out.shape == (2, 6, 2)
     np.testing.assert_allclose(out, x[:, :, 1:3], rtol=1e-6)
+
+
+def test_graph_save_load_preserves_iteration_count(tmp_path):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=2), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    X = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 16)]
+    net.fit([(X, Y)], epochs=3)
+    it = net._sd_train.training_config.iteration_count
+    assert it > 0
+    p = tmp_path / "g.zip"
+    net.save(p)
+    net2 = ComputationGraph.load(p)
+    assert net2._sd_train.training_config.iteration_count == it
+
+
+def test_l2_normalize_vertex_cnn_all_nonbatch_dims():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(3, 3, 2))
+            .add_vertex("l2", L2NormalizeVertex(), "in")
+            .set_outputs("l2").build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(5).normal(size=(2, 2, 3, 3)).astype(np.float32)
+    out = net.output(x)[0].to_numpy()
+    norm = np.sqrt((x ** 2).sum(axis=(1, 2, 3), keepdims=True))
+    np.testing.assert_allclose(out, x / norm, rtol=1e-5)
