@@ -14,6 +14,13 @@ from deeplearning4j_tpu.nn.graph import (
     ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
     GraphVertex, L2NormalizeVertex, MergeVertex, ScaleVertex, ShiftVertex,
     SubsetVertex)
+from deeplearning4j_tpu.nn.conv_layers import (
+    Convolution1DLayer, Convolution3DLayer, Cropping2DLayer,
+    Deconvolution2DLayer, DepthwiseConvolution2DLayer,
+    LocalResponseNormalization, SeparableConvolution2DLayer,
+    Subsampling3DLayer, Upsampling2DLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.recurrent_layers import (
+    Bidirectional, LastTimeStepLayer, RnnOutputLayer, SimpleRnnLayer)
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.nn.activations import resolve_activation
 
@@ -25,5 +32,10 @@ __all__ = [
     "InputType", "DenseLayer", "ConvolutionLayer", "SubsamplingLayer",
     "BatchNormalization", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
     "LSTMLayer", "GlobalPoolingLayer", "OutputLayer", "LossLayer",
+    "Convolution1DLayer", "Convolution3DLayer", "Subsampling3DLayer",
+    "Deconvolution2DLayer", "DepthwiseConvolution2DLayer",
+    "SeparableConvolution2DLayer", "LocalResponseNormalization",
+    "Upsampling2DLayer", "ZeroPaddingLayer", "Cropping2DLayer",
+    "SimpleRnnLayer", "Bidirectional", "LastTimeStepLayer", "RnnOutputLayer",
     "init_weights", "resolve_activation",
 ]

@@ -1,0 +1,381 @@
+"""Convolutional layer breadth: 1D/3D, transposed, separable, depthwise,
+LRN, upsampling, padding/cropping.
+
+Reference parity: nn/conf/layers/{Convolution1DLayer, Convolution3D,
+Deconvolution2D, SeparableConvolution2D, DepthwiseConvolution2D,
+LocalResponseNormalization, Upsampling2D, ZeroPaddingLayer,
+Cropping2D}.java. TPU-native: each config's ``build`` records one fused
+XLA conv (lax.conv_general_dilated under the named op) instead of the
+reference's im2col+gemm helper chain; layouts NCHW/HWIO, NCW sequences
+presented as (B, T, C).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.activations import apply_activation
+from deeplearning4j_tpu.nn.layers import (
+    BaseLayer, InputType, LAYER_TYPES, _as_pair, _conv_out, _maybe_dropout,
+    _pad_mode)
+
+
+def _as_triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@dataclasses.dataclass
+class Convolution1DLayer(BaseLayer):
+    """1D conv over sequences (B, T, C) (reference:
+    nn/conf/layers/Convolution1DLayer; native conv1d,
+    generic/nn/convo/conv1d.cpp)."""
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    convolution_mode: str = "SAME"
+    dilation: int = 1
+    activation: str = "identity"
+    weight_init: str = "RELU"
+    bias_init: float = 0.0
+    has_bias: bool = True
+    dropout: float = 0.0
+
+    def output_type(self, itype):
+        c, t = itype.dims
+        t_out = _conv_out(t, self.kernel_size, self.stride,
+                          self.convolution_mode, self.dilation) \
+            if t > 0 else t
+        return InputType.recurrent(self.n_out, t_out)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("conv1d")
+        c_in = itype.dims[0]
+        x = _maybe_dropout(ctx, x, self.dropout, lname)
+        w = ctx.param(f"{lname}_W", (self.kernel_size, c_in, self.n_out),
+                      self.weight_init)
+        inputs = [x, w]
+        if self.has_bias:
+            b = ctx.sd.var(f"{lname}_b",
+                           value=np.full((self.n_out,), self.bias_init),
+                           dtype=ctx.dtype)
+            inputs.append(b)
+        z = ctx.sd.invoke("conv1d", inputs,
+                          {"stride": self.stride,
+                           "padding": _pad_mode(self.convolution_mode),
+                           "dilation": self.dilation,
+                           "data_format": "NWC"},
+                          name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Convolution3DLayer(BaseLayer):
+    """3D conv over volumes (B, C, D, H, W) (reference:
+    nn/conf/layers/Convolution3D; native conv3dnew)."""
+    n_out: int = 0
+    kernel_size: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    convolution_mode: str = "SAME"
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    activation: str = "identity"
+    weight_init: str = "RELU"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        c = itype.dims[0]
+        ks, ss, ds = _as_triple(self.kernel_size), _as_triple(self.stride), \
+            _as_triple(self.dilation)
+        spatial = tuple(
+            _conv_out(itype.dims[1 + i], ks[i], ss[i],
+                      self.convolution_mode, ds[i]) for i in range(3))
+        return InputType("cnn3d", (self.n_out,) + spatial)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("conv3d")
+        c_in = itype.dims[0]
+        kd, kh, kw = _as_triple(self.kernel_size)
+        w = ctx.param(f"{lname}_W", (kd, kh, kw, c_in, self.n_out),
+                      self.weight_init)
+        inputs = [x, w]
+        if self.has_bias:
+            b = ctx.sd.var(f"{lname}_b",
+                           value=np.full((self.n_out,), self.bias_init),
+                           dtype=ctx.dtype)
+            inputs.append(b)
+        z = ctx.sd.invoke("conv3d", inputs,
+                          {"strides": _as_triple(self.stride),
+                           "padding": _pad_mode(self.convolution_mode),
+                           "dilation": _as_triple(self.dilation),
+                           "data_format": "NCDHW"},
+                          name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Subsampling3DLayer(BaseLayer):
+    """3D pooling (reference: nn/conf/layers/Subsampling3DLayer)."""
+    pooling_type: str = "MAX"
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Optional[Tuple[int, int, int]] = None
+    convolution_mode: str = "VALID"
+
+    def output_type(self, itype):
+        c = itype.dims[0]
+        ks = _as_triple(self.kernel_size)
+        ss = _as_triple(self.stride or self.kernel_size)
+        spatial = tuple(
+            _conv_out(itype.dims[1 + i], ks[i], ss[i],
+                      self.convolution_mode) for i in range(3))
+        return InputType("cnn3d", (c,) + spatial)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("pool3d")
+        op = {"MAX": "max_pool3d", "AVG": "avg_pool3d"}[
+            self.pooling_type.upper()]
+        out = ctx.sd.invoke(op, [x],
+                            {"kernel": _as_triple(self.kernel_size),
+                             "strides": _as_triple(self.stride
+                                                   or self.kernel_size),
+                             "padding": _pad_mode(self.convolution_mode),
+                             "data_format": "NCDHW"},
+                            name=lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Deconvolution2DLayer(BaseLayer):
+    """Transposed conv (reference: nn/conf/layers/Deconvolution2D; native
+    deconv2d, generic/nn/convo/deconv2d.cpp)."""
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    convolution_mode: str = "SAME"
+    activation: str = "identity"
+    weight_init: str = "RELU"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        kh, kw = _as_pair(self.kernel_size)
+        sh, sw = _as_pair(self.stride)
+        if self.convolution_mode.upper() == "SAME":
+            oh, ow = h * sh, w * sw
+        else:
+            oh, ow = (h - 1) * sh + kh, (w - 1) * sw + kw
+        return InputType("cnn", (self.n_out, oh, ow))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("deconv")
+        c_in = itype.dims[0]
+        kh, kw = _as_pair(self.kernel_size)
+        # weights stored like the fwd conv they transpose: (kH,kW,oC,iC)
+        w = ctx.param(f"{lname}_W", (kh, kw, self.n_out, c_in),
+                      self.weight_init)
+        inputs = [x, w]
+        if self.has_bias:
+            b = ctx.sd.var(f"{lname}_b",
+                           value=np.full((self.n_out,), self.bias_init),
+                           dtype=ctx.dtype)
+            inputs.append(b)
+        z = ctx.sd.invoke("deconv2d", inputs,
+                          {"strides": _as_pair(self.stride),
+                           "padding": _pad_mode(self.convolution_mode),
+                           "data_format": "NCHW"},
+                          name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class DepthwiseConvolution2DLayer(BaseLayer):
+    """Depthwise conv (reference: nn/conf/layers/DepthwiseConvolution2D;
+    native depthwise_conv2d)."""
+    depth_multiplier: int = 1
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "SAME"
+    dilation: Tuple[int, int] = (1, 1)
+    activation: str = "identity"
+    weight_init: str = "RELU"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        kh, kw = _as_pair(self.kernel_size)
+        sh, sw = _as_pair(self.stride)
+        dh, dw = _as_pair(self.dilation)
+        return InputType("cnn", (c * self.depth_multiplier,
+                                 _conv_out(h, kh, sh, self.convolution_mode, dh),
+                                 _conv_out(w, kw, sw, self.convolution_mode, dw)))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("dwconv")
+        c_in = itype.dims[0]
+        kh, kw = _as_pair(self.kernel_size)
+        w = ctx.param(f"{lname}_W", (kh, kw, c_in, self.depth_multiplier),
+                      self.weight_init)
+        inputs = [x, w]
+        if self.has_bias:
+            b = ctx.sd.var(
+                f"{lname}_b",
+                value=np.full((c_in * self.depth_multiplier,),
+                              self.bias_init),
+                dtype=ctx.dtype)
+            inputs.append(b)
+        z = ctx.sd.invoke("depthwise_conv2d", inputs,
+                          {"strides": _as_pair(self.stride),
+                           "padding": _pad_mode(self.convolution_mode),
+                           "dilation": _as_pair(self.dilation),
+                           "data_format": "NCHW"},
+                          name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class SeparableConvolution2DLayer(BaseLayer):
+    """Depthwise-separable conv (reference:
+    nn/conf/layers/SeparableConvolution2D; native sconv2d)."""
+    n_out: int = 0
+    depth_multiplier: int = 1
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "SAME"
+    dilation: Tuple[int, int] = (1, 1)
+    activation: str = "identity"
+    weight_init: str = "RELU"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        kh, kw = _as_pair(self.kernel_size)
+        sh, sw = _as_pair(self.stride)
+        dh, dw = _as_pair(self.dilation)
+        return InputType("cnn", (self.n_out,
+                                 _conv_out(h, kh, sh, self.convolution_mode, dh),
+                                 _conv_out(w, kw, sw, self.convolution_mode, dw)))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("sepconv")
+        c_in = itype.dims[0]
+        kh, kw = _as_pair(self.kernel_size)
+        dw = ctx.param(f"{lname}_dW", (kh, kw, c_in, self.depth_multiplier),
+                       self.weight_init)
+        pw = ctx.param(f"{lname}_pW",
+                       (1, 1, c_in * self.depth_multiplier, self.n_out),
+                       self.weight_init)
+        inputs = [x, dw, pw]
+        if self.has_bias:
+            b = ctx.sd.var(f"{lname}_b",
+                           value=np.full((self.n_out,), self.bias_init),
+                           dtype=ctx.dtype)
+            inputs.append(b)
+        z = ctx.sd.invoke("separable_conv2d", inputs,
+                          {"strides": _as_pair(self.stride),
+                           "padding": _pad_mode(self.convolution_mode),
+                           "dilation": _as_pair(self.dilation),
+                           "data_format": "NCHW"},
+                          name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class LocalResponseNormalization(BaseLayer):
+    """LRN across channels (reference:
+    nn/conf/layers/LocalResponseNormalization — k/n/alpha/beta; native
+    generic/nn/lrn.cpp)."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("lrn")
+        # op takes depth = half window n/2, reference convention
+        out = ctx.sd.invoke("lrn", [x],
+                            {"depth": int(self.n) // 2, "bias": self.k,
+                             "alpha": self.alpha, "beta": self.beta,
+                             "data_format": "NCHW"},
+                            name=lname)
+        return out, itype
+
+
+@dataclasses.dataclass
+class Upsampling2DLayer(BaseLayer):
+    """Nearest-neighbour upsampling (reference:
+    nn/conf/layers/Upsampling2D)."""
+    size: Tuple[int, int] = (2, 2)
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        fh, fw = _as_pair(self.size)
+        return InputType("cnn", (c, h * fh, w * fw))
+
+    def build(self, ctx, x, itype):
+        out = ctx.sd.invoke("upsampling2d", [x],
+                            {"factor": _as_pair(self.size),
+                             "data_format": "NCHW"},
+                            name=ctx.lname("upsample"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class ZeroPaddingLayer(BaseLayer):
+    """Spatial zero padding (reference: nn/conf/layers/ZeroPaddingLayer).
+    padding = (top, bottom, left, right)."""
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        t, b, l, r = self.padding
+        return InputType("cnn", (c, h + t + b, w + l + r))
+
+    def build(self, ctx, x, itype):
+        t, b, l, r = self.padding
+        out = ctx.sd.invoke(
+            "pad", [x],
+            {"paddings": ((0, 0), (0, 0), (t, b), (l, r))},
+            name=ctx.lname("zeropad"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Cropping2DLayer(BaseLayer):
+    """Spatial cropping (reference: nn/conf/layers/convolutional/
+    Cropping2D). cropping = (top, bottom, left, right)."""
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        t, b, l, r = self.cropping
+        return InputType("cnn", (c, h - t - b, w - l - r))
+
+    def build(self, ctx, x, itype):
+        c, h, w = itype.dims
+        t, b, l, r = self.cropping
+        out = ctx.sd.invoke(
+            "strided_slice", [x],
+            {"begin": (0, 0, t, l), "end": (2**31 - 1, 2**31 - 1,
+                                            h - b, w - r),
+             "strides": (1, 1, 1, 1)},
+            name=ctx.lname("crop"))
+        return out, self.output_type(itype)
+
+
+for _cls in [Convolution1DLayer, Convolution3DLayer, Subsampling3DLayer,
+             Deconvolution2DLayer, DepthwiseConvolution2DLayer,
+             SeparableConvolution2DLayer, LocalResponseNormalization,
+             Upsampling2DLayer, ZeroPaddingLayer, Cropping2DLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls

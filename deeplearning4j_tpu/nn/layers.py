@@ -33,8 +33,10 @@ from deeplearning4j_tpu.nn.weights import init_weights
 # InputType (reference: nn/conf/inputs/InputType)
 @dataclasses.dataclass(frozen=True)
 class InputType:
-    kind: str                      # "ff" | "cnn" | "rnn"
-    dims: Tuple[int, ...]          # ff: (n,); cnn: (c, h, w); rnn: (features, timesteps)
+    kind: str                      # "ff" | "cnn" | "cnn3d" | "rnn" | "ids"
+    dims: Tuple[int, ...]          # ff: (n,); cnn: (c, h, w);
+    #                                cnn3d: (c, d, h, w);
+    #                                rnn: (features, timesteps); ids: (t,)
 
     @staticmethod
     def feed_forward(n: int) -> "InputType":
@@ -45,6 +47,14 @@ class InputType:
         return InputType("cnn", (int(channels), int(height), int(width)))
 
     @staticmethod
+    def convolutional3d(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """Volumetric data, placeholder (B, C, D, H, W) (reference:
+        InputType.convolutional3D)."""
+        return InputType("cnn3d", (int(channels), int(depth), int(height),
+                                   int(width)))
+
+    @staticmethod
     def recurrent(size: int, timesteps: int = -1) -> "InputType":
         return InputType("rnn", (int(size), int(timesteps)))
 
@@ -52,14 +62,14 @@ class InputType:
     def flat_size(self) -> int:
         if self.kind == "ff":
             return self.dims[0]
-        if self.kind == "cnn":
+        if self.kind in ("cnn", "cnn3d"):
             return int(np.prod(self.dims))
         raise ValueError(f"cannot flatten {self}")
 
     def placeholder_shape(self) -> Tuple[int, ...]:
         if self.kind == "ff":
             return (-1, self.dims[0])
-        if self.kind == "cnn":
+        if self.kind in ("cnn", "cnn3d"):
             return (-1,) + self.dims
         if self.kind == "rnn":
             return (-1, self.dims[1], self.dims[0])  # (B, T, C)
@@ -82,6 +92,20 @@ def _conv_out(size: int, k: int, s: int, mode: str, d: int = 1) -> int:
         return -(-size // s)
     k_eff = (k - 1) * d + 1
     return (size - k_eff) // s + 1
+
+
+def _pad_mode(mode: str) -> str:
+    """ConvolutionMode → XLA padding string (reference: ConvolutionMode
+    {Same, Truncate, Strict, Causal}; Truncate/Strict share the VALID
+    output formula — the reference differs only in whether it *errors* on
+    non-exact sizes, which static XLA shapes make moot)."""
+    m = mode.upper()
+    if m == "SAME":
+        return "SAME"
+    if m in ("VALID", "TRUNCATE", "STRICT"):
+        return "VALID"
+    raise ValueError(f"unsupported convolution_mode {mode!r} "
+                     f"(use Same/Truncate/Strict/Valid)")
 
 
 # ----------------------------------------------------------------------
@@ -110,6 +134,8 @@ class BaseLayer:
     def from_json(d: dict) -> "BaseLayer":
         d = dict(d)
         cls = LAYER_TYPES[d.pop("@class")]
+        if hasattr(cls, "_from_json_fields"):   # nested-layer configs
+            return cls._from_json_fields(d)
         for old, new in BaseLayer._FIELD_ALIASES.get(cls.__name__, {}).items():
             if old in d and new not in d:
                 d[new] = d.pop(old)
@@ -169,11 +195,17 @@ class DenseLayer(BaseLayer):
     has_bias: bool = True
 
     def output_type(self, itype):
+        if itype.kind == "rnn":
+            # per-timestep dense — the reference reaches the same semantics
+            # via the RnnToFeedForward/FeedForwardToRnn preprocessor pair
+            # (merge time into batch, dense, split back); here the matmul
+            # broadcasts over (B, T) directly
+            return InputType.recurrent(self.n_out, itype.dims[1])
         return InputType.feed_forward(self.n_out)
 
     def build(self, ctx, x, itype):
         lname = ctx.lname("dense")
-        n_in = itype.flat_size
+        n_in = itype.dims[0] if itype.kind == "rnn" else itype.flat_size
         x = _maybe_dropout(ctx, x, self.dropout, lname)
         w = ctx.param(f"{lname}_W", (n_in, self.n_out), self.weight_init)
         z = x.mmul(w, name=f"{lname}_mm")
@@ -247,9 +279,7 @@ class ConvolutionLayer(BaseLayer):
                       self.weight_init)
         inputs = [x, w]
         attrs = {"strides": _as_pair(self.stride),
-                 "padding": self.convolution_mode.upper()
-                 if self.convolution_mode.upper() in ("SAME", "VALID")
-                 else "VALID",
+                 "padding": _pad_mode(self.convolution_mode),
                  "dilation": _as_pair(self.dilation),
                  "data_format": "NCHW"}
         if self.has_bias:
@@ -286,7 +316,7 @@ class SubsamplingLayer(BaseLayer):
               "PNORM": "pnorm_pool2d"}[self.pooling_type.upper()]
         attrs = {"kernel": _as_pair(self.kernel_size),
                  "strides": _as_pair(self.stride or self.kernel_size),
-                 "padding": self.convolution_mode.upper(),
+                 "padding": _pad_mode(self.convolution_mode),
                  "data_format": "NCHW"}
         if self.pooling_type.upper() == "PNORM":
             attrs["pnorm"] = self.pnorm
@@ -405,7 +435,7 @@ class GlobalPoolingLayer(BaseLayer):
     pooling_type: str = "AVG"
 
     def output_type(self, itype):
-        if itype.kind in ("cnn", "rnn"):
+        if itype.kind in ("cnn", "cnn3d", "rnn"):
             return InputType.feed_forward(itype.dims[0])
         raise ValueError("GlobalPoolingLayer needs cnn or rnn input "
                          "(reference GlobalPoolingLayer rejects FF input too)")
@@ -413,7 +443,7 @@ class GlobalPoolingLayer(BaseLayer):
     def build(self, ctx, x, itype):
         self.output_type(itype)  # validate input kind
         lname = ctx.lname("gpool")
-        axis = (2, 3) if itype.kind == "cnn" else (1,)
+        axis = {"cnn": (2, 3), "cnn3d": (2, 3, 4), "rnn": (1,)}[itype.kind]
         opname = {"AVG": "reduce_mean", "MAX": "reduce_max",
                   "SUM": "reduce_sum"}[self.pooling_type.upper()]
         out = ctx.sd.invoke(opname, [x], {"axis": axis}, name=lname)

@@ -30,9 +30,19 @@ from deeplearning4j_tpu.nn.layers import (
     GlobalPoolingLayer, InputType, LSTMLayer, OutputLayer, SubsamplingLayer)
 
 _WANTED_KIND = {
-    "DenseLayer": "ff", "OutputLayer": "ff", "EmbeddingLayer": "ff",
-    "ConvolutionLayer": "cnn", "SubsamplingLayer": "cnn",
-    "LSTMLayer": "rnn",
+    # accepted input kinds per layer class; first entry = preferred kind a
+    # preprocessor should convert to when none of the accepted kinds match
+    "DenseLayer": ("ff", "rnn"),   # rnn input = per-timestep dense
+    "OutputLayer": ("ff",), "EmbeddingLayer": ("ff",),
+    "ConvolutionLayer": ("cnn",), "SubsamplingLayer": ("cnn",),
+    "LSTMLayer": ("rnn",), "SimpleRnnLayer": ("rnn",),
+    "Bidirectional": ("rnn",), "RnnOutputLayer": ("rnn",),
+    "LastTimeStepLayer": ("rnn",), "Convolution1DLayer": ("rnn",),
+    "Convolution3DLayer": ("cnn3d",), "Subsampling3DLayer": ("cnn3d",),
+    "Deconvolution2DLayer": ("cnn",), "DepthwiseConvolution2DLayer": ("cnn",),
+    "SeparableConvolution2DLayer": ("cnn",),
+    "LocalResponseNormalization": ("cnn",), "Upsampling2DLayer": ("cnn",),
+    "ZeroPaddingLayer": ("cnn",), "Cropping2DLayer": ("cnn",),
 }
 
 
@@ -42,10 +52,11 @@ def _adapt_itype(itype: InputType, layer: BaseLayer, idx: int) -> InputType:
     nn/conf/preprocessor/{CnnToFeedForward,...}PreProcessor, added
     automatically by setInputType). Used by both graph build and type
     walking so they cannot desynchronize."""
-    wanted = _WANTED_KIND.get(type(layer).__name__)
-    if wanted is None or wanted == itype.kind:
+    accepted = _WANTED_KIND.get(type(layer).__name__)
+    if accepted is None or itype.kind in accepted:
         return itype
-    if itype.kind == "cnn" and wanted == "ff":
+    wanted = accepted[0]
+    if itype.kind in ("cnn", "cnn3d") and wanted == "ff":
         return InputType.feed_forward(itype.flat_size)
     if itype.kind == "rnn" and wanted == "ff":
         # reference RnnToFeedForwardPreProcessor merges time into batch;

@@ -1,0 +1,174 @@
+"""Recurrent layer breadth: SimpleRnn, Bidirectional wrapper,
+RnnOutputLayer, LastTimeStep.
+
+Reference parity: nn/conf/layers/{recurrent/SimpleRnn, recurrent/
+Bidirectional, RnnOutputLayer, recurrent/LastTimeStep}.java. TPU-native:
+recurrences are lax.scan under the named ops (one XLA While loop), the
+bidirectional wrapper runs the wrapped layer on a time-reversed copy and
+merges — XLA schedules both directions in one computation.
+
+Sequence layout: (batch, time, features).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.activations import apply_activation
+from deeplearning4j_tpu.nn.layers import (
+    BaseLayer, InputType, LAYER_TYPES, _LOSS_OPS, _maybe_dropout)
+
+
+@dataclasses.dataclass
+class SimpleRnnLayer(BaseLayer):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} U + b) (reference:
+    nn/conf/layers/recurrent/SimpleRnn)."""
+    n_out: int = 0
+    activation: str = "tanh"
+    weight_init: str = "XAVIER"
+    return_sequences: bool = True
+    dropout: float = 0.0
+
+    def output_type(self, itype):
+        if self.return_sequences:
+            return InputType.recurrent(self.n_out, itype.dims[1])
+        return InputType.feed_forward(self.n_out)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("rnn")
+        n_in = itype.dims[0]
+        u = self.n_out
+        x = _maybe_dropout(ctx, x, self.dropout, lname)
+        w = ctx.param(f"{lname}_W", (n_in, u), self.weight_init)
+        r = ctx.param(f"{lname}_U", (u, u), self.weight_init)
+        b = ctx.sd.var(f"{lname}_b", value=np.zeros((u,)), dtype=ctx.dtype)
+        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
+                           name=f"{lname}_h0")
+        from deeplearning4j_tpu.nn.activations import resolve_activation
+        out, hT = ctx.sd.invoke(
+            "simple_rnn_layer", [x, h0, w, r, b],
+            {"activation": resolve_activation(self.activation)},
+            name=lname, n_outputs=2)
+        result = out if self.return_sequences else hT
+        return result, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Bidirectional(BaseLayer):
+    """Wraps a recurrent layer; runs forward + time-reversed passes and
+    merges (reference: nn/conf/layers/recurrent/Bidirectional with Mode
+    {CONCAT, ADD, MUL, AVERAGE})."""
+    layer: Optional[BaseLayer] = None
+    mode: str = "CONCAT"
+
+    def output_type(self, itype):
+        inner = self.layer.output_type(itype)
+        if self.mode.upper() == "CONCAT":
+            if inner.kind == "rnn":
+                return InputType.recurrent(2 * inner.dims[0], inner.dims[1])
+            return InputType.feed_forward(2 * inner.dims[0])
+        return inner
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("bidir")
+        saved_prefix = ctx.prefix
+        # distinct parameter namespaces for the two directions
+        ctx.prefix = f"{lname}_fwd"
+        fwd, inner_t = self.layer.build(ctx, x, itype)
+        x_rev = ctx.sd.invoke("reverse", [x], {"axis": (1,)},
+                              name=f"{lname}_xrev")
+        ctx.prefix = f"{lname}_bwd"
+        bwd, _ = self.layer.build(ctx, x_rev, itype)
+        ctx.prefix = saved_prefix
+        if inner_t.kind == "rnn":
+            # re-reverse so backward outputs align with forward time order
+            bwd = ctx.sd.invoke("reverse", [bwd], {"axis": (1,)},
+                                name=f"{lname}_orev")
+        mode = self.mode.upper()
+        if mode == "CONCAT":
+            axis = 2 if inner_t.kind == "rnn" else 1
+            out = ctx.sd.invoke("concat", [fwd, bwd], {"axis": axis},
+                                name=f"{lname}_out")
+        elif mode == "ADD":
+            out = fwd.add(bwd, name=f"{lname}_out")
+        elif mode == "MUL":
+            out = fwd.mul(bwd, name=f"{lname}_out")
+        elif mode == "AVERAGE":
+            half = ctx.sd.constant(0.5, f"{lname}_half")
+            out = fwd.add(bwd).mul(half, name=f"{lname}_out")
+        else:
+            raise ValueError(f"unknown Bidirectional mode {self.mode}")
+        return out, self.output_type(itype)
+
+    def to_json(self) -> dict:
+        return {"@class": "Bidirectional", "mode": self.mode,
+                "layer": self.layer.to_json()}
+
+    @staticmethod
+    def _from_json_fields(d: dict) -> "Bidirectional":
+        return Bidirectional(layer=BaseLayer.from_json(d["layer"]),
+                             mode=d.get("mode", "CONCAT"))
+
+
+@dataclasses.dataclass
+class LastTimeStepLayer(BaseLayer):
+    """Extracts the final timestep of a sequence → FF (reference:
+    nn/conf/layers/recurrent/LastTimeStep wrapper semantics, mask-free)."""
+
+    def output_type(self, itype):
+        return InputType.feed_forward(itype.dims[0])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("laststep")
+        t = itype.dims[1]
+        if t <= 0:
+            raise ValueError("LastTimeStepLayer needs static timesteps")
+        out = ctx.sd.invoke(
+            "strided_slice", [x],
+            {"begin": (0, t - 1, 0), "end": (2**31 - 1, t, 2**31 - 1),
+             "strides": (1, 1, 1)}, name=f"{lname}_slice")
+        out = out.reshape(-1, itype.dims[0])
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class RnnOutputLayer(BaseLayer):
+    """Per-timestep dense + loss over all timesteps (reference:
+    nn/conf/layers/RnnOutputLayer — loss averaged over batch and time)."""
+    n_out: int = 0
+    loss_function: str = "MCXENT"
+    activation: str = "softmax"
+    weight_init: str = "XAVIER"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.dims[1])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("rnnout")
+        n_in = itype.dims[0]
+        w = ctx.param(f"{lname}_W", (n_in, self.n_out), self.weight_init)
+        z = x.mmul(w, name=f"{lname}_mm")    # (B,T,in)@(in,out) broadcasts
+        if self.has_bias:
+            b = ctx.sd.var(f"{lname}_b",
+                           value=np.full((self.n_out,), self.bias_init),
+                           dtype=ctx.dtype)
+            z = z.add(b, name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, self.activation, lname)
+        ctx.output_var = out
+        loss_op = _LOSS_OPS[self.loss_function.upper()]
+        loss_in = z if loss_op in ("softmax_cross_entropy",
+                                   "sigm_cross_entropy") else out
+        loss = ctx.sd.invoke(loss_op, [loss_in, ctx.labels_var], {},
+                             name="loss")
+        loss.mark_as_loss()
+        ctx.loss_var = loss
+        return out, self.output_type(itype)
+
+
+for _cls in [SimpleRnnLayer, Bidirectional, LastTimeStepLayer,
+             RnnOutputLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls

@@ -527,17 +527,31 @@ def gru_layer(x, h0, w_ih, w_hh, b_ih, b_hh, time_major: bool = False):
     return out, hT
 
 
+def _rnn_activation(name: str):
+    """Resolve an activation registry-op name to its jnp-level fn (any
+    elementwise activation op works; layers pre-resolve DL4J aliases)."""
+    from deeplearning4j_tpu.ops import registry
+    key = name.lower()
+    if key in ("identity", "linear"):
+        return lambda z: z
+    if registry.has_op(key):
+        return registry.get_op(key).fn
+    raise ValueError(f"unknown rnn activation {name!r}")
+
+
 @op("simple_rnn_cell", _N, aliases=("sru_cell_simple",))
-def simple_rnn_cell(x, h_prev, w_ih, w_hh, b):
-    return jnp.tanh(jnp.matmul(x, w_ih) + jnp.matmul(h_prev, w_hh) + b)
+def simple_rnn_cell(x, h_prev, w_ih, w_hh, b, activation: str = "tanh"):
+    act = _rnn_activation(activation)
+    return act(jnp.matmul(x, w_ih) + jnp.matmul(h_prev, w_hh) + b)
 
 
 @op("simple_rnn_layer", _N)
-def simple_rnn_layer(x, h0, w_ih, w_hh, b, time_major: bool = False):
+def simple_rnn_layer(x, h0, w_ih, w_hh, b, time_major: bool = False,
+                     activation: str = "tanh"):
     xs = x if time_major else jnp.swapaxes(x, 0, 1)
 
     def step(h, xt):
-        h2 = simple_rnn_cell(xt, h, w_ih, w_hh, b)
+        h2 = simple_rnn_cell(xt, h, w_ih, w_hh, b, activation)
         return h2, h2
 
     hT, hs = lax.scan(step, h0, xs)

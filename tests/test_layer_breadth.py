@@ -1,0 +1,280 @@
+"""Layer-breadth wave-1 tests: conv 1D/3D, transposed, separable,
+depthwise, LRN, upsampling, pad/crop, SimpleRnn, Bidirectional,
+RnnOutputLayer, per-timestep Dense (reference test model:
+dl4jcore/nn layer tests + gradientcheck suites)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn import (
+    Bidirectional, Convolution1DLayer, Convolution3DLayer, Cropping2DLayer,
+    Deconvolution2DLayer, DenseLayer, DepthwiseConvolution2DLayer,
+    GlobalPoolingLayer, InputType, LastTimeStepLayer, LSTMLayer,
+    LocalResponseNormalization, MultiLayerConfiguration, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+    SeparableConvolution2DLayer, SimpleRnnLayer, Subsampling3DLayer,
+    Upsampling2DLayer, ZeroPaddingLayer)
+
+
+def _net(layers, itype, updater=None, seed=5):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Adam(learning_rate=0.01)).list())
+    for l in layers:
+        b = b.layer(l)
+    return MultiLayerNetwork(b.set_input_type(itype).build()).init()
+
+
+rng = np.random.default_rng(42)
+
+
+# --------------------------------------------------------------- shapes
+def test_conv1d_shapes_and_training():
+    net = _net([Convolution1DLayer(n_out=8, kernel_size=3, activation="relu"),
+                GlobalPoolingLayer(),
+                OutputLayer(n_out=3)],
+               InputType.recurrent(4, 10))
+    x = rng.normal(size=(6, 10, 4)).astype(np.float32)
+    out = net.output(x).to_numpy()
+    assert out.shape == (6, 3)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 6)]
+    h = net.fit([(x, y)], epochs=3)
+    assert np.isfinite(h.final_loss())
+
+
+def test_conv1d_valid_shrinks_time():
+    net = _net([Convolution1DLayer(n_out=2, kernel_size=3,
+                                   convolution_mode="VALID"),
+                RnnOutputLayer(n_out=2)],
+               InputType.recurrent(4, 10))
+    x = rng.normal(size=(2, 10, 4)).astype(np.float32)
+    assert net.output(x).to_numpy().shape == (2, 8, 2)
+
+
+def test_conv3d_and_pool3d_shapes():
+    net = _net([Convolution3DLayer(n_out=4, kernel_size=(3, 3, 3),
+                                   activation="relu"),
+                Subsampling3DLayer(kernel_size=(2, 2, 2)),
+                GlobalPoolingLayer(),
+                OutputLayer(n_out=2)],
+               InputType.convolutional3d(8, 8, 8, 1))
+    x = rng.normal(size=(2, 1, 8, 8, 8)).astype(np.float32)
+    out = net.output(x).to_numpy()
+    assert out.shape == (2, 2)
+
+
+def test_deconv_upsamples():
+    net = _net([Deconvolution2DLayer(n_out=3, kernel_size=(2, 2),
+                                     stride=(2, 2)),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.convolutional(5, 5, 2))
+    x = rng.normal(size=(2, 2, 5, 5)).astype(np.float32)
+    net.output(x)
+    # internal type walk says deconv doubled the spatial dims
+    from deeplearning4j_tpu.nn.multilayer import _type_walk
+    types = [otype for _, _, _, otype in _type_walk(net.conf)]
+    assert types[0].dims == (3, 10, 10)
+
+
+def test_depthwise_multiplier_channels():
+    net = _net([DepthwiseConvolution2DLayer(depth_multiplier=3,
+                                            kernel_size=(3, 3)),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.convolutional(6, 6, 2))
+    from deeplearning4j_tpu.nn.multilayer import _type_walk
+    types = [otype for _, _, _, otype in _type_walk(net.conf)]
+    assert types[0].dims[0] == 6  # 2 in-channels * multiplier 3
+
+
+def test_separable_conv_trains():
+    net = _net([SeparableConvolution2DLayer(n_out=8, kernel_size=(3, 3),
+                                            activation="relu"),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.convolutional(6, 6, 2))
+    x = rng.normal(size=(8, 2, 6, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    h = net.fit([(x, y)], epochs=3)
+    assert np.isfinite(h.final_loss())
+
+
+def test_lrn_preserves_shape_and_matches_formula():
+    net = _net([LocalResponseNormalization(k=2.0, n=5.0, alpha=1e-4,
+                                           beta=0.75),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.convolutional(4, 4, 8))
+    x = rng.normal(size=(2, 8, 4, 4)).astype(np.float32)
+    net.output(x)  # shape-compatible through the net
+    # formula check against the raw op
+    from deeplearning4j_tpu.ops import registry
+    out = registry.exec_op("lrn", x, depth=2, bias=2.0, alpha=1e-4, beta=0.75)
+    sq = np.zeros_like(x)
+    padded = np.pad(x ** 2, ((0, 0), (2, 2), (0, 0), (0, 0)))
+    for i in range(5):
+        sq += padded[:, i:i + 8]
+    expected = x / (2.0 + 1e-4 * sq) ** 0.75
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-5)
+
+
+def test_upsampling_zeropad_crop_shapes():
+    net = _net([Upsampling2DLayer(size=(2, 2)),
+                ZeroPaddingLayer(padding=(1, 1, 2, 2)),
+                Cropping2DLayer(cropping=(0, 1, 0, 1)),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.convolutional(3, 3, 2))
+    from deeplearning4j_tpu.nn.multilayer import _type_walk
+    types = [otype for _, _, _, otype in _type_walk(net.conf)]
+    assert types[0].dims == (2, 6, 6)      # upsampled
+    assert types[1].dims == (2, 8, 10)     # padded
+    assert types[2].dims == (2, 7, 9)      # cropped
+    x = rng.normal(size=(2, 2, 3, 3)).astype(np.float32)
+    assert net.output(x).to_numpy().shape == (2, 2)
+
+
+# ----------------------------------------------------------- recurrent
+def test_simple_rnn_trains():
+    net = _net([SimpleRnnLayer(n_out=8, return_sequences=False),
+                OutputLayer(n_out=2)],
+               InputType.recurrent(3, 6))
+    x = rng.normal(size=(10, 6, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=(1, 2)) > 0).astype(int)]
+    h = net.fit([(x, y)], epochs=10)
+    assert np.isfinite(h.final_loss())
+
+
+def test_bidirectional_concat_doubles_features():
+    net = _net([Bidirectional(layer=LSTMLayer(n_out=5), mode="CONCAT"),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.recurrent(3, 6))
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    assert net.output(x).to_numpy().shape == (4, 2)
+    from deeplearning4j_tpu.nn.multilayer import _type_walk
+    types = [otype for _, _, _, otype in _type_walk(net.conf)]
+    assert types[0].dims == (10, 6)
+
+
+@pytest.mark.parametrize("mode", ["ADD", "MUL", "AVERAGE"])
+def test_bidirectional_elementwise_modes(mode):
+    net = _net([Bidirectional(layer=SimpleRnnLayer(n_out=4), mode=mode),
+                GlobalPoolingLayer(), OutputLayer(n_out=2)],
+               InputType.recurrent(3, 5))
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    assert net.output(x).to_numpy().shape == (2, 2)
+
+
+def test_bidirectional_backward_direction_sees_reversed_input():
+    """fwd pass of the bwd direction on reversed input, re-reversed =
+    running the wrapped layer on the flipped sequence."""
+    net = _net([Bidirectional(layer=SimpleRnnLayer(n_out=4), mode="CONCAT"),
+                RnnOutputLayer(n_out=4, loss_function="MSE",
+                               activation="identity")],
+               InputType.recurrent(2, 5))
+    x = rng.normal(size=(1, 5, 2)).astype(np.float32)
+    out = net.output(x).to_numpy()
+    assert out.shape == (1, 5, 4)
+
+
+def test_last_time_step_layer():
+    net = _net([LSTMLayer(n_out=4),
+                LastTimeStepLayer(),
+                OutputLayer(n_out=2)],
+               InputType.recurrent(3, 7))
+    x = rng.normal(size=(3, 7, 3)).astype(np.float32)
+    assert net.output(x).to_numpy().shape == (3, 2)
+
+
+def test_rnn_output_layer_sequence_loss():
+    net = _net([LSTMLayer(n_out=6), RnnOutputLayer(n_out=3)],
+               InputType.recurrent(2, 4))
+    x = rng.normal(size=(5, 4, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (5, 4))]
+    h = net.fit([(x, y)], epochs=3)
+    assert np.isfinite(h.final_loss())
+    out = net.output(x).to_numpy()
+    assert out.shape == (5, 4, 3)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_dense_on_sequence_is_per_timestep():
+    net = _net([DenseLayer(n_out=7, activation="tanh"),
+                RnnOutputLayer(n_out=2)],
+               InputType.recurrent(3, 5))
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    out = net.output(x).to_numpy()
+    assert out.shape == (2, 5, 2)
+    # permuting timesteps permutes outputs identically (no cross-time mixing)
+    perm = rng.permutation(5)
+    out_p = net.output(x[:, perm]).to_numpy()
+    np.testing.assert_allclose(out_p, out[:, perm], rtol=1e-5)
+
+
+# -------------------------------------------------------- serde + grads
+def test_new_layers_config_serde_round_trip():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(Convolution1DLayer(n_out=4, kernel_size=3))
+            .layer(Bidirectional(layer=LSTMLayer(n_out=5), mode="ADD"))
+            .layer(GlobalPoolingLayer())
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, 8)).build())
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert isinstance(conf2.layers[1], Bidirectional)
+    assert isinstance(conf2.layers[1].layer, LSTMLayer)
+    assert conf2.layers[1].layer.n_out == 5
+
+
+def _fd_grad_check(layers, itype, x_shape, seed=3, eps=1e-4, rtol=2e-2):
+    """Finite-difference check of dLoss/dParam through the full net (the
+    reference's GradientCheckUtil strategy, f64 CPU)."""
+    import jax.numpy as jnp
+    net = _net(layers, itype, updater=Sgd(learning_rate=0.0), seed=seed)
+    sd = net._sd_train
+    x = rng.normal(size=x_shape).astype(np.float32)
+    otype = net.conf.layers[-1].output_type(
+        net.conf.layers[-2].output_type(itype)) \
+        if len(layers) > 1 else None
+    # labels from a forward pass → loss is smooth wrt params
+    out = net.output(x.astype(np.float32)).to_numpy()
+    y = np.abs(out) / np.abs(out).sum(-1, keepdims=True)
+    grads = sd.calculate_gradients({"input": x, "labels": y},
+                                   list(sd.trainable_params().keys()))
+    pname = sorted(grads.keys())[0]
+    g = np.asarray(grads[pname])
+    base = sd._arrays[pname]
+    idx = tuple(0 for _ in base.shape)
+    for sign in (+1,):
+        pert = np.asarray(base).copy()
+        pert[idx] += eps
+        sd._arrays[pname] = jnp.asarray(pert)
+        lp = float(np.asarray(sd.output(
+            {"input": x, "labels": y}, ["loss"])["loss"]))
+        pert[idx] -= 2 * eps
+        sd._arrays[pname] = jnp.asarray(pert)
+        lm = float(np.asarray(sd.output(
+            {"input": x, "labels": y}, ["loss"])["loss"]))
+        sd._arrays[pname] = base
+        fd = (lp - lm) / (2 * eps)
+        assert abs(fd - g[idx]) <= rtol * max(1.0, abs(fd)), \
+            f"{pname}{idx}: fd={fd} analytic={g[idx]}"
+
+
+def test_fd_gradients_conv1d():
+    _fd_grad_check(
+        [Convolution1DLayer(n_out=3, kernel_size=3, activation="tanh"),
+         GlobalPoolingLayer(), OutputLayer(n_out=2)],
+        InputType.recurrent(2, 6), (4, 6, 2))
+
+
+def test_fd_gradients_separable_conv():
+    _fd_grad_check(
+        [SeparableConvolution2DLayer(n_out=3, kernel_size=(3, 3),
+                                     activation="tanh"),
+         GlobalPoolingLayer(), OutputLayer(n_out=2)],
+        InputType.convolutional(5, 5, 2), (3, 2, 5, 5))
+
+
+def test_fd_gradients_bidirectional_rnn():
+    _fd_grad_check(
+        [Bidirectional(layer=SimpleRnnLayer(n_out=3), mode="CONCAT"),
+         GlobalPoolingLayer(), OutputLayer(n_out=2)],
+        InputType.recurrent(2, 4), (3, 4, 2))
