@@ -167,7 +167,9 @@ class Deconvolution2DLayer(BaseLayer):
         if self.convolution_mode.upper() == "SAME":
             oh, ow = h * sh, w * sw
         else:
-            oh, ow = (h - 1) * sh + kh, (w - 1) * sw + kw
+            # lax.conv_transpose VALID: (h-1)*s + max(k, s)
+            oh = (h - 1) * sh + max(kh, sh)
+            ow = (w - 1) * sw + max(kw, sw)
         return InputType("cnn", (self.n_out, oh, ow))
 
     def build(self, ctx, x, itype):
@@ -303,6 +305,10 @@ class LocalResponseNormalization(BaseLayer):
 
     def build(self, ctx, x, itype):
         lname = ctx.lname("lrn")
+        if int(self.n) % 2 == 0:
+            raise ValueError(
+                f"LRN window n={self.n} must be odd (symmetric window "
+                f"2*(n//2)+1); even n would silently widen the window")
         # op takes depth = half window n/2, reference convention
         out = ctx.sd.invoke("lrn", [x],
                             {"depth": int(self.n) // 2, "bias": self.k,

@@ -463,6 +463,22 @@ _LOSS_OPS = {
     "COSINE_PROXIMITY": "cosine_distance_loss",
 }
 
+# losses that fuse the activation and therefore take PRE-activation logits
+_FUSED_LOGIT_LOSSES = ("softmax_cross_entropy", "sigm_cross_entropy")
+
+
+def _attach_loss_head(ctx, z, out, loss_function: str):
+    """Wire a loss head: pick the loss op, feed it logits (fused losses)
+    or activations, mark it, and record output/loss on the build context.
+    Shared by OutputLayer, LossLayer, RnnOutputLayer."""
+    ctx.output_var = out
+    loss_op = _LOSS_OPS[loss_function.upper()]
+    loss_in = z if loss_op in _FUSED_LOGIT_LOSSES else out
+    loss = ctx.sd.invoke(loss_op, [loss_in, ctx.labels_var], {}, name="loss")
+    loss.mark_as_loss()
+    ctx.loss_var = loss
+    return loss
+
 
 @dataclasses.dataclass
 class OutputLayer(BaseLayer):
@@ -491,15 +507,7 @@ class OutputLayer(BaseLayer):
                            dtype=ctx.dtype)
             z = z.add(b, name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
-        ctx.output_var = out
-        loss_op = _LOSS_OPS[self.loss_function.upper()]
-        labels = ctx.labels_var
-        # fused losses take logits; plain losses take activations
-        loss_in = z if loss_op in ("softmax_cross_entropy",
-                                   "sigm_cross_entropy") else out
-        loss = ctx.sd.invoke(loss_op, [loss_in, labels], {}, name="loss")
-        loss.mark_as_loss()
-        ctx.loss_var = loss
+        _attach_loss_head(ctx, z, out, self.loss_function)
         return out, self.output_type(itype)
 
 
@@ -514,14 +522,7 @@ class LossLayer(BaseLayer):
 
     def build(self, ctx, x, itype):
         out = apply_activation(ctx.sd, x, self.activation, ctx.lname("act"))
-        ctx.output_var = out
-        loss_op = _LOSS_OPS[self.loss_function.upper()]
-        loss_in = x if loss_op in ("softmax_cross_entropy",
-                                   "sigm_cross_entropy") else out
-        loss = ctx.sd.invoke(loss_op, [loss_in, ctx.labels_var], {},
-                             name="loss")
-        loss.mark_as_loss()
-        ctx.loss_var = loss
+        _attach_loss_head(ctx, x, out, self.loss_function)
         return out, itype
 
 

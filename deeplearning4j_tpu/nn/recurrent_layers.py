@@ -18,7 +18,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.activations import apply_activation
 from deeplearning4j_tpu.nn.layers import (
-    BaseLayer, InputType, LAYER_TYPES, _LOSS_OPS, _maybe_dropout)
+    BaseLayer, InputType, LAYER_TYPES, _attach_loss_head, _maybe_dropout)
 
 
 @dataclasses.dataclass
@@ -158,14 +158,7 @@ class RnnOutputLayer(BaseLayer):
                            dtype=ctx.dtype)
             z = z.add(b, name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
-        ctx.output_var = out
-        loss_op = _LOSS_OPS[self.loss_function.upper()]
-        loss_in = z if loss_op in ("softmax_cross_entropy",
-                                   "sigm_cross_entropy") else out
-        loss = ctx.sd.invoke(loss_op, [loss_in, ctx.labels_var], {},
-                             name="loss")
-        loss.mark_as_loss()
-        ctx.loss_var = loss
+        _attach_loss_head(ctx, z, out, self.loss_function)
         return out, self.output_type(itype)
 
 

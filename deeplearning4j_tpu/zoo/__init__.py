@@ -1,0 +1,13 @@
+"""Model zoo (reference: deeplearning4j-zoo zoo/model/*.java).
+
+Architecture definitions only — the reference's pretrained-weight download
+machinery (ZooModel.initPretrained) is replaced by Keras/TF import and
+checkpoint loading. Each model exposes ``build() -> network`` (initialized,
+ready for fit/output), mirroring ZooModel.init().
+"""
+from deeplearning4j_tpu.zoo.models import (
+    AlexNet, LeNet, ResNet50, SimpleCNN, TextGenLSTM, TransformerEncoder,
+    VGG16)
+
+__all__ = ["LeNet", "SimpleCNN", "AlexNet", "VGG16", "ResNet50",
+           "TextGenLSTM", "TransformerEncoder"]
