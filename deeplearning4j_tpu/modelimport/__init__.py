@@ -1,0 +1,10 @@
+"""Model import (reference: deeplearning4j-modelimport + samediff-import).
+
+Keras .h5 → layer-API networks. TF/ONNX graph import arrives separately.
+"""
+from deeplearning4j_tpu.modelimport.keras_import import (
+    KerasModelImport, import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights)
+
+__all__ = ["KerasModelImport", "import_keras_model_and_weights",
+           "import_keras_sequential_model_and_weights"]

@@ -1,0 +1,620 @@
+"""Keras .h5 model import.
+
+Reference parity: deeplearning4j-modelimport —
+KerasModelImport.java:45,83 → KerasModel.java:61 / KerasSequentialModel,
+per-layer KerasLayer mappers (keras/layers/*, 61 classes), weights copied
+from the HDF5 archive (Hdf5Archive.java:43). Here: h5py reads the archive,
+~20 core Keras layer types map onto the existing config DSL, and weights
+copy into the built SameDiff graph by the layer API's deterministic
+parameter names.
+
+Layout policy (same as the reference): Keras channels_last models import
+into this framework's NCHW convention — callers feed NCHW inputs
+(transpose of the Keras NHWC input). Flatten-then-Dense kernels are
+row-permuted from HWC to CHW flat order exactly like the reference's
+KerasFlatten preprocessor handling.
+
+Supports the Keras "legacy H5" format written by tf.keras model.save
+(Keras 2 `batch_input_shape` and Keras 3 `batch_shape` configs).
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ----------------------------------------------------------------------
+# HDF5 archive (reference: keras/Hdf5Archive.java:43)
+class _H5Archive:
+    def __init__(self, path):
+        import h5py
+        self._f = h5py.File(path, "r")
+
+    def model_config(self) -> dict:
+        raw = self._f.attrs["model_config"]
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        return json.loads(raw)
+
+    def layer_weights(self, layer_name: str) -> List[np.ndarray]:
+        """Weights for one layer in Keras weight_names order."""
+        mw = self._f["model_weights"]
+        if layer_name not in mw:
+            return []
+        g = mw[layer_name]
+        names = [n.decode() if isinstance(n, bytes) else n
+                 for n in g.attrs.get("weight_names", [])]
+        out = []
+        for n in names:
+            # weight paths are rooted at model_weights, not the layer group
+            node = mw[n] if n in mw else g[n]
+            out.append(np.asarray(node))
+        return out
+
+    def close(self):
+        self._f.close()
+
+
+# ----------------------------------------------------------------------
+def _input_type_from_shape(shape):
+    """Keras batch shape → InputType (NHWC → NCHW convention flip)."""
+    from deeplearning4j_tpu.nn import InputType
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:          # (T, C) sequence
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:          # (H, W, C) image
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    raise ValueError(f"unsupported Keras input shape {shape}")
+
+
+def _act(name) -> str:
+    if name in (None, "linear"):
+        return "identity"
+    if isinstance(name, dict):
+        name = name.get("class_name", "linear").lower()
+    return name
+
+
+def _pad(cfg) -> str:
+    return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+class _Ctx:
+    """Carries cross-layer import state (pending Flatten permutation)."""
+
+    def __init__(self):
+        # (h, w, c) recorded when Flatten consumed spatial input; the next
+        # Dense kernel's rows get permuted HWC→CHW
+        self.flatten_hwc: Optional[Tuple[int, int, int]] = None
+
+
+def _reject_unsupported(cfg: dict, layer_cls: str, checks: Dict[str, object]):
+    """Raise on semantically significant config this import cannot honor
+    (reference: UnsupportedKerasConfigurationException) — silent drops
+    would import a model whose outputs diverge from Keras."""
+    for key, allowed in checks.items():
+        val = cfg.get(key, allowed if not isinstance(allowed, tuple)
+                      else allowed[0])
+        ok = val in allowed if isinstance(allowed, tuple) else val == allowed
+        if not ok:
+            raise ValueError(
+                f"Keras {layer_cls} config {key}={val!r} is not supported "
+                f"by import (supported: {allowed!r})")
+
+
+# each mapper: (keras_cfg, ctx, itype) -> (layer | None, weight_setter)
+# weight_setter: (sd, lname_stem, keras_weights) -> None
+def _set_simple(wmap: Dict[str, int]):
+    """Setter assigning keras weights[i] to param '<stem>_<suffix>'."""
+    def setter(sd, stem, weights):
+        for suffix, i in wmap.items():
+            if i < len(weights):
+                _assign(sd, f"{stem}_{suffix}", weights[i])
+    return setter
+
+
+def _assign(sd, name, value):
+    import jax.numpy as jnp
+    if name not in sd._vars:
+        raise ValueError(f"import: no parameter {name!r} in built graph")
+    expect = sd._arrays[name].shape
+    if tuple(value.shape) != tuple(expect):
+        raise ValueError(f"import: {name} shape {value.shape} != {expect}")
+    sd._arrays[name] = jnp.asarray(value, sd._arrays[name].dtype)
+
+
+def _map_dense(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import DenseLayer
+    layer = DenseLayer(n_out=cfg["units"], activation=_act(cfg["activation"]),
+                       has_bias=cfg.get("use_bias", True))
+    flat = ctx.flatten_hwc
+    ctx.flatten_hwc = None
+
+    def setter(sd, stem, weights):
+        w = weights[0]
+        if flat is not None:
+            h, wd, c = flat
+            w = (w.reshape(h, wd, c, -1).transpose(2, 0, 1, 3)
+                 .reshape(h * wd * c, -1))
+        _assign(sd, f"{stem}_W", w)
+        if len(weights) > 1:
+            _assign(sd, f"{stem}_b", weights[1])
+    return layer, setter
+
+
+def _map_conv2d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ConvolutionLayer
+    _reject_unsupported(cfg, "Conv2D", {"data_format": "channels_last",
+                                        "groups": 1})
+    layer = ConvolutionLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    return layer, _set_simple({"W": 0, "b": 1})
+
+
+def _map_conv1d(cfg, ctx, itype):
+    _reject_unsupported(cfg, "Conv1D", {"data_format": "channels_last"})
+    from deeplearning4j_tpu.nn import Convolution1DLayer
+    layer = Convolution1DLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"])[0],
+        stride=_pair(cfg.get("strides", 1))[0], convolution_mode=_pad(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1))[0],
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    return layer, _set_simple({"W": 0, "b": 1})
+
+
+def _map_depthwise(cfg, ctx, itype):
+    _reject_unsupported(cfg, "DepthwiseConv2D", {"data_format": "channels_last"})
+    from deeplearning4j_tpu.nn import DepthwiseConvolution2DLayer
+    layer = DepthwiseConvolution2DLayer(
+        depth_multiplier=cfg.get("depth_multiplier", 1),
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    return layer, _set_simple({"W": 0, "b": 1})
+
+
+def _map_separable(cfg, ctx, itype):
+    _reject_unsupported(cfg, "SeparableConv2D", {"data_format": "channels_last"})
+    from deeplearning4j_tpu.nn import SeparableConvolution2DLayer
+    layer = SeparableConvolution2DLayer(
+        n_out=cfg["filters"], depth_multiplier=cfg.get("depth_multiplier", 1),
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    # keras order: depthwise_kernel, pointwise_kernel, bias
+    return layer, _set_simple({"dW": 0, "pW": 1, "b": 2})
+
+
+def _map_conv2d_transpose(cfg, ctx, itype):
+    _reject_unsupported(cfg, "Conv2DTranspose", {"data_format": "channels_last"})
+    from deeplearning4j_tpu.nn import Deconvolution2DLayer
+    layer = Deconvolution2DLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    # keras kernel (kh, kw, out, in) == this framework's deconv layout
+    return layer, _set_simple({"W": 0, "b": 1})
+
+
+def _map_pool(pool_type):
+    def mapper(cfg, ctx, itype):
+        from deeplearning4j_tpu.nn import SubsamplingLayer
+        layer = SubsamplingLayer(
+            pooling_type=pool_type, kernel_size=_pair(cfg["pool_size"]),
+            stride=_pair(cfg.get("strides") or cfg["pool_size"]),
+            convolution_mode=_pad(cfg))
+        return layer, None
+    return mapper
+
+
+def _map_global_pool(pool_type):
+    def mapper(cfg, ctx, itype):
+        from deeplearning4j_tpu.nn import GlobalPoolingLayer
+        return GlobalPoolingLayer(pooling_type=pool_type), None
+    return mapper
+
+
+def _map_batchnorm(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import BatchNormalization
+    layer = BatchNormalization(decay=cfg.get("momentum", 0.99),
+                               eps=cfg.get("epsilon", 1e-3))
+    scale = cfg.get("scale", True)
+    center = cfg.get("center", True)
+
+    def setter(sd, stem, weights):
+        i = 0
+        if scale:
+            _assign(sd, f"{stem}_gamma", weights[i]); i += 1
+        if center:
+            _assign(sd, f"{stem}_beta", weights[i]); i += 1
+        _assign(sd, f"{stem}_mean", weights[i]); i += 1
+        _assign(sd, f"{stem}_var", weights[i])
+    return layer, setter
+
+
+def _map_dropout(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import DropoutLayer
+    # keras rate = drop prob; this framework uses retain prob
+    return DropoutLayer(dropout=1.0 - cfg["rate"]), None
+
+
+def _map_activation(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ActivationLayer
+    return ActivationLayer(activation=_act(cfg["activation"])), None
+
+
+def _map_flatten(cfg, ctx, itype):
+    # no layer: the cnn→ff preprocessor emits the reshape; record the HWC
+    # permutation for the next Dense (reference: KerasFlatten)
+    if itype.kind == "cnn":
+        c, h, w = itype.dims
+        ctx.flatten_hwc = (h, w, c)
+    return None, None
+
+
+def _map_embedding(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn.attention import EmbeddingSequenceLayer
+    layer = EmbeddingSequenceLayer(n_in=cfg["input_dim"],
+                                   n_out=cfg["output_dim"])
+    return layer, _set_simple({"W": 0})
+
+
+def _map_lstm(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import LSTMLayer
+    _reject_unsupported(cfg, "LSTM", {
+        "activation": "tanh", "recurrent_activation": "sigmoid",
+        "go_backwards": False, "use_bias": True})
+    layer = LSTMLayer(n_out=cfg["units"],
+                      return_sequences=cfg.get("return_sequences", False))
+    # keras gate order [i, f, c, o] == lstm_cell's [i, f, g, o]
+    return layer, _set_simple({"Wih": 0, "Whh": 1, "b": 2})
+
+
+def _map_simple_rnn(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import SimpleRnnLayer
+    _reject_unsupported(cfg, "SimpleRNN", {"go_backwards": False,
+                                           "use_bias": True})
+    layer = SimpleRnnLayer(n_out=cfg["units"],
+                           activation=_act(cfg.get("activation", "tanh")),
+                           return_sequences=cfg.get("return_sequences",
+                                                    False))
+    return layer, _set_simple({"W": 0, "U": 1, "b": 2})
+
+
+def _map_bidirectional(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Bidirectional
+    inner_cfg = cfg["layer"]
+    inner_cls = inner_cfg["class_name"]
+    inner_map = _MAPPERS[inner_cls]
+    inner_layer, inner_setter = inner_map(inner_cfg["config"], ctx, itype)
+    merge = {"concat": "CONCAT", "sum": "ADD", "ave": "AVERAGE",
+             "mul": "MUL"}[cfg.get("merge_mode", "concat")]
+    layer = Bidirectional(layer=inner_layer, mode=merge)
+
+    def setter(sd, stem, weights):
+        half = len(weights) // 2
+        inner_setter(sd, f"{stem}_fwd", weights[:half])
+        inner_setter(sd, f"{stem}_bwd", weights[half:])
+    return layer, setter
+
+
+def _map_zeropad(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ZeroPaddingLayer
+    p = cfg["padding"]
+    if isinstance(p, int):
+        pad = (p, p, p, p)
+    else:
+        (t, b), (l, r) = p
+        pad = (t, b, l, r)
+    return ZeroPaddingLayer(padding=pad), None
+
+
+def _map_cropping(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Cropping2DLayer
+    cr = cfg["cropping"]
+    if isinstance(cr, int):
+        crop = (cr, cr, cr, cr)
+    else:
+        (t, b), (l, r) = cr
+        crop = (t, b, l, r)
+    return Cropping2DLayer(cropping=crop), None
+
+
+def _map_upsampling(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Upsampling2DLayer
+    return Upsampling2DLayer(size=_pair(cfg["size"])), None
+
+
+_MAPPERS: Dict[str, Callable] = {
+    "Dense": _map_dense,
+    "Conv2D": _map_conv2d,
+    "Conv1D": _map_conv1d,
+    "DepthwiseConv2D": _map_depthwise,
+    "SeparableConv2D": _map_separable,
+    "Conv2DTranspose": _map_conv2d_transpose,
+    "MaxPooling2D": _map_pool("MAX"),
+    "AveragePooling2D": _map_pool("AVG"),
+    "GlobalAveragePooling2D": _map_global_pool("AVG"),
+    "GlobalMaxPooling2D": _map_global_pool("MAX"),
+    "GlobalAveragePooling1D": _map_global_pool("AVG"),
+    "GlobalMaxPooling1D": _map_global_pool("MAX"),
+    "BatchNormalization": _map_batchnorm,
+    "Dropout": _map_dropout,
+    "Activation": _map_activation,
+    "Flatten": _map_flatten,
+    "Embedding": _map_embedding,
+    "LSTM": _map_lstm,
+    "SimpleRNN": _map_simple_rnn,
+    "Bidirectional": _map_bidirectional,
+    "ZeroPadding2D": _map_zeropad,
+    "Cropping2D": _map_cropping,
+    "UpSampling2D": _map_upsampling,
+}
+
+
+def _batch_shape(cfg: dict):
+    return cfg.get("batch_input_shape") or cfg.get("batch_shape")
+
+
+def _import_sequential(model_cfg: dict, archive: _H5Archive):
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    layers_cfg = model_cfg["config"]["layers"]
+    itype = _initial_itype(layers_cfg)      # single source of input typing
+    ctx = _Ctx()
+    built = []               # (our_layer, keras_name, setter)
+    for lc in layers_cfg:
+        cls = lc["class_name"]
+        cfg = lc["config"]
+        if cls == "InputLayer":
+            continue
+        if cls not in _MAPPERS:
+            raise ValueError(f"Keras layer {cls} not supported by import "
+                             f"(supported: {sorted(_MAPPERS)})")
+        layer, setter = _MAPPERS[cls](cfg, ctx, itype)
+        if layer is not None:
+            built.append((layer, cfg["name"], setter))
+            itype = layer.output_type(_adapt(itype, layer))
+        elif cls == "Flatten":
+            itype = _flatten_itype(itype)
+
+    b = NeuralNetConfiguration.builder().seed(0).list()
+    for layer, _, _ in built:
+        b = b.layer(layer)
+    conf = b.set_input_type(_initial_itype(layers_cfg)).build()
+    net = MultiLayerNetwork(conf).init()
+    _copy_weights(net, built, archive)
+    return net
+
+
+def _initial_itype(layers_cfg):
+    """Derive the model InputType: int-dtype 2D inputs and Embedding-first
+    models are token ids; everything else maps by rank."""
+    from deeplearning4j_tpu.nn.attention import sequence_ids
+    for lc in layers_cfg:
+        cfg = lc["config"]
+        shape = _batch_shape(cfg)
+        if shape is None:
+            continue
+        nxt = [l for l in layers_cfg if l["class_name"] != "InputLayer"]
+        is_ids = len(shape) == 2 and (
+            (nxt and nxt[0]["class_name"] == "Embedding")
+            or "int" in str(cfg.get("dtype", "")))
+        if is_ids:
+            return sequence_ids(shape[1])
+        return _input_type_from_shape(shape)
+    raise ValueError("no input shape in Keras config")
+
+
+def _adapt(itype, layer):
+    from deeplearning4j_tpu.nn.multilayer import _adapt_itype
+    return _adapt_itype(itype, layer, 0)
+
+
+def _flatten_itype(itype):
+    from deeplearning4j_tpu.nn import InputType
+    return InputType.feed_forward(itype.flat_size) \
+        if itype.kind in ("cnn", "cnn3d") else itype
+
+
+def _copy_weights(net, built, archive: _H5Archive):
+    """Copy Keras weights into the train graph by deterministic param
+    names (layer{idx}_{kind} stems), then sync the inference graph."""
+    sd = net._sd_train
+    stems = _layer_stems(net)
+    for idx, (layer, keras_name, setter) in enumerate(built):
+        if setter is None:
+            continue
+        weights = archive.layer_weights(keras_name)
+        if not weights:
+            raise ValueError(f"no weights for Keras layer {keras_name!r}")
+        setter(sd, stems[idx], weights)
+    net._sync_infer()
+
+
+_KIND_STEM = {
+    "DenseLayer": "dense", "ConvolutionLayer": "conv",
+    "Convolution1DLayer": "conv1d", "DepthwiseConvolution2DLayer": "dwconv",
+    "SeparableConvolution2DLayer": "sepconv",
+    "Deconvolution2DLayer": "deconv", "BatchNormalization": "bn",
+    "LSTMLayer": "lstm", "SimpleRnnLayer": "rnn", "Bidirectional": "bidir",
+    "EmbeddingSequenceLayer": "embedseq", "EmbeddingLayer": "embedding",
+}
+
+
+def _layer_stems(net) -> List[str]:
+    """Parameter-name stem per layer index (mirrors ctx.lname)."""
+    return [f"layer{i}_{_KIND_STEM.get(type(l).__name__, 'x')}"
+            for i, l in enumerate(net.conf.layers)]
+
+
+# ----------------------------------------------------------------------
+def import_keras_sequential_model_and_weights(path):
+    """Import a Sequential .h5 → MultiLayerNetwork (reference:
+    KerasModelImport.importKerasSequentialModelAndWeights,
+    KerasModelImport.java:83)."""
+    archive = _H5Archive(path)
+    try:
+        cfg = archive.model_config()
+        if cfg["class_name"] != "Sequential":
+            raise ValueError(f"not a Sequential model: {cfg['class_name']} "
+                             f"(use import_keras_model_and_weights)")
+        return _import_sequential(cfg, archive)
+    finally:
+        archive.close()
+
+
+def import_keras_model_and_weights(path):
+    """Import a Sequential or functional .h5 (reference:
+    KerasModelImport.importKerasModelAndWeights, KerasModelImport.java:45).
+    Functional models map onto ComputationGraph."""
+    archive = _H5Archive(path)
+    try:
+        cfg = archive.model_config()
+        if cfg["class_name"] == "Sequential":
+            return _import_sequential(cfg, archive)
+        if cfg["class_name"] in ("Functional", "Model"):
+            return _import_functional(cfg, archive)
+        raise ValueError(f"unsupported Keras model class "
+                         f"{cfg['class_name']}")
+    finally:
+        archive.close()
+
+
+def _import_functional(model_cfg: dict, archive: _H5Archive):
+    """Functional API → ComputationGraph. Supports the merge vertices the
+    graph API has (Add/Average/Maximum/Multiply/Subtract/Concatenate)."""
+    from deeplearning4j_tpu.nn import (ComputationGraph, ElementWiseVertex,
+                                       MergeVertex, NeuralNetConfiguration)
+    cfg = model_cfg["config"]
+    layers_cfg = {lc["config"]["name"]: lc for lc in cfg["layers"]}
+    order = [lc["config"]["name"] for lc in cfg["layers"]]
+
+    def inbound(lc) -> List[str]:
+        nodes = lc.get("inbound_nodes", [])
+        if not nodes:
+            return []
+        if len(nodes) > 1:
+            raise ValueError(
+                f"Keras layer {lc['config']['name']!r} is called "
+                f"{len(nodes)} times (shared layer) — import supports one "
+                f"call site per layer")
+        node = nodes[0]
+        if isinstance(node, dict):       # keras 3 style
+            args = node.get("args", [])
+            names = []
+
+            def walk(a):
+                if isinstance(a, dict) and "config" in a and \
+                        "keras_history" in a["config"]:
+                    names.append(a["config"]["keras_history"][0])
+                elif isinstance(a, (list, tuple)):
+                    for x in a:
+                        walk(x)
+            walk(args)
+            return names
+        return [n[0] for n in node]      # keras 2 style [[name, 0, 0, {}]]
+
+    def _names(spec) -> List[str]:
+        # keras 2: [["name", 0, 0], ...]; keras 3 single: ["name", 0, 0]
+        if isinstance(spec, list) and spec and isinstance(spec[0], str):
+            return [spec[0]]
+        return [n[0] if isinstance(n, list) else n for n in spec]
+
+    g = NeuralNetConfiguration.builder().seed(0).graph_builder()
+    inputs = _names(cfg["input_layers"])
+    outputs = _names(cfg["output_layers"])
+    g = g.add_inputs(*inputs)
+    itypes = {}
+    ctx = _Ctx()
+    built = {}
+    input_types = []
+    for name in inputs:
+        shape = _batch_shape(layers_cfg[name]["config"])
+        it = _input_type_from_shape(shape)
+        itypes[name] = it
+        input_types.append(it)
+    g = g.set_input_types(*input_types)
+
+    _MERGE = {"Add": ("ew", "Add"), "Subtract": ("ew", "Subtract"),
+              "Multiply": ("ew", "Product"), "Average": ("ew", "Average"),
+              "Maximum": ("ew", "Max"), "Concatenate": ("merge", None)}
+    flat_hwc = {}            # flatten vertex name -> (h, w, c) permutation
+    for name in order:
+        lc = layers_cfg[name]
+        cls = lc["class_name"]
+        if cls == "InputLayer":
+            continue
+        srcs = inbound(lc)
+        src_itype = itypes[srcs[0]]
+        if cls in _MERGE:
+            kind, op = _MERGE[cls]
+            in_types = [itypes[s] for s in srcs]
+            if kind == "ew":
+                vertex = ElementWiseVertex(op=op)
+            else:
+                vertex = MergeVertex()
+            g = g.add_vertex(name, vertex, *srcs)
+            itypes[name] = vertex.output_type(in_types)
+            continue
+        if cls not in _MAPPERS:
+            raise ValueError(f"Keras layer {cls} not supported by import")
+        # per-branch Flatten permutation: a Dense consuming a flatten alias
+        # permutes with THAT branch's spatial dims
+        ctx.flatten_hwc = flat_hwc.get(srcs[0])
+        layer, setter = _MAPPERS[cls](lc["config"], ctx, src_itype)
+        ctx.flatten_hwc = None
+        if layer is None:                # Flatten: alias to its source
+            itypes[name] = _flatten_itype(src_itype)
+            if src_itype.kind == "cnn":
+                c, h, w = src_itype.dims
+                flat_hwc[name] = (h, w, c)
+            built[name] = ("alias", srcs[0], None)
+            continue
+        g = g.add_layer(name, layer, *[_resolve_alias(built, s)
+                                       for s in srcs])
+        itypes[name] = layer.output_type(_adapt(src_itype, layer))
+        built[name] = ("layer", layer, setter)
+    g = g.set_outputs(*[_resolve_alias(built, o) for o in outputs])
+    net = ComputationGraph(g.build()).init()
+    sd = net._sd_train
+    for name, entry in built.items():
+        if entry[0] == "layer" and entry[2] is not None:
+            weights = archive.layer_weights(name)
+            if not weights:
+                raise ValueError(f"no weights for Keras layer {name!r}")
+            entry[2](sd, name, weights)   # graph builds: stem = vertex name
+    net._sync_infer()
+    return net
+
+
+def _resolve_alias(built, name):
+    while name in built and built[name][0] == "alias":
+        name = built[name][1]
+    return name
+
+
+class KerasModelImport:
+    """Static facade matching the reference entry points
+    (KerasModelImport.java:45,83)."""
+    import_keras_model_and_weights = staticmethod(
+        import_keras_model_and_weights)
+    import_keras_sequential_model_and_weights = staticmethod(
+        import_keras_sequential_model_and_weights)

@@ -1,0 +1,70 @@
+"""Regenerates the Keras .h5 import fixtures + expected outputs.
+
+Run with tf.keras available:  python generate_fixtures.py
+Each fixture saves the legacy-H5 model and an npz with a test input and
+the Keras prediction on it; tests compare the imported model to 1e-5.
+"""
+import os
+
+import numpy as np
+
+
+def main():
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    import tensorflow as tf
+    tf.keras.utils.set_random_seed(7)
+    out = os.path.dirname(os.path.abspath(__file__))
+    L = tf.keras.layers
+
+    m = tf.keras.Sequential([
+        L.Input((20,)), L.Dense(32, activation="relu"),
+        L.Dense(16, activation="tanh"), L.Dense(5, activation="softmax")])
+    x = np.random.default_rng(0).normal(size=(6, 20)).astype(np.float32)
+    np.savez(f"{out}/mlp_expected.npz", x=x, y=m.predict(x, verbose=0))
+    m.save(f"{out}/mlp.h5")
+
+    m = tf.keras.Sequential([
+        L.Input((12, 12, 2)),
+        L.Conv2D(8, 3, activation="relu", padding="same"),
+        L.MaxPooling2D(2), L.BatchNormalization(),
+        L.Conv2D(12, 3, activation="relu", padding="valid"),
+        L.AveragePooling2D(2), L.Flatten(), L.Dropout(0.4),
+        L.Dense(20, activation="relu"), L.Dense(4, activation="softmax")])
+    xt = np.random.default_rng(1).normal(size=(64, 12, 12, 2)).astype(np.float32)
+    yt = np.eye(4)[np.random.default_rng(2).integers(0, 4, 64)]
+    m.compile(optimizer="adam", loss="categorical_crossentropy")
+    m.fit(xt, yt, epochs=2, verbose=0)      # fold nontrivial BN stats
+    x = np.random.default_rng(3).normal(size=(5, 12, 12, 2)).astype(np.float32)
+    np.savez(f"{out}/cnn_expected.npz", x=x, y=m.predict(x, verbose=0))
+    m.save(f"{out}/cnn.h5")
+
+    m = tf.keras.Sequential([
+        L.Input((9, 6)), L.LSTM(11, return_sequences=True), L.LSTM(7),
+        L.Dense(3, activation="softmax")])
+    x = np.random.default_rng(4).normal(size=(4, 9, 6)).astype(np.float32)
+    np.savez(f"{out}/lstm_expected.npz", x=x, y=m.predict(x, verbose=0))
+    m.save(f"{out}/lstm.h5")
+
+    m = tf.keras.Sequential([
+        L.Input((7,), dtype="int32"), L.Embedding(30, 8),
+        L.Bidirectional(L.LSTM(5, return_sequences=True)),
+        L.GlobalAveragePooling1D(), L.Dense(2, activation="softmax")])
+    x = np.random.default_rng(5).integers(0, 30, size=(4, 7)).astype(np.int32)
+    np.savez(f"{out}/embed_bilstm_expected.npz", x=x,
+             y=m.predict(x, verbose=0))
+    m.save(f"{out}/embed_bilstm.h5")
+
+    inp = L.Input((10,))
+    h = L.Dense(10, activation="relu")(inp)
+    h2 = L.Dense(10, activation="relu")(h)
+    s = L.Add()([h, h2])
+    o = L.Dense(3, activation="softmax")(s)
+    m = tf.keras.Model(inp, o)
+    x = np.random.default_rng(6).normal(size=(5, 10)).astype(np.float32)
+    np.savez(f"{out}/functional_expected.npz", x=x, y=m.predict(x, verbose=0))
+    m.save(f"{out}/functional.h5")
+    print("fixtures regenerated")
+
+
+if __name__ == "__main__":
+    main()
