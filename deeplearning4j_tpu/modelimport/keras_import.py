@@ -80,7 +80,16 @@ def _act(name) -> str:
 
 
 def _pad(cfg) -> str:
-    return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
+    """Keras padding mode → convolution_mode. CAUSAL (Conv1D-only in
+    Keras) is not supported — reject with a descriptive error rather
+    than a raw KeyError."""
+    mode = cfg.get("padding", "valid")
+    table = {"valid": "VALID", "same": "SAME"}
+    if mode not in table:
+        raise ValueError(
+            f"Keras padding={mode!r} is not supported by import "
+            f"(supported: {sorted(table)})")
+    return table[mode]
 
 
 def _pair(v):
@@ -567,11 +576,23 @@ def _import_functional(model_cfg: dict, archive: _H5Archive):
         if cls in _MERGE:
             kind, op = _MERGE[cls]
             in_types = [itypes[s] for s in srcs]
+            # A spatial Flatten feeding a merge cannot be rewired to its
+            # source: channel-concat of 4D maps is a different element
+            # order than concat of HWC-flattened vectors, and the
+            # downstream Dense kernel permutation is per-branch. Reject
+            # loudly; no-op flattens (already-flat input) resolve fine.
+            for s in srcs:
+                if s in flat_hwc:
+                    raise ValueError(
+                        f"Keras {cls} {name!r} consumes Flatten {s!r} of "
+                        f"a spatial tensor — Flatten-before-merge "
+                        f"topologies are not supported by import")
             if kind == "ew":
                 vertex = ElementWiseVertex(op=op)
             else:
                 vertex = MergeVertex()
-            g = g.add_vertex(name, vertex, *srcs)
+            g = g.add_vertex(name, vertex,
+                             *[_resolve_alias(built, s) for s in srcs])
             itypes[name] = vertex.output_type(in_types)
             continue
         if cls not in _MAPPERS:

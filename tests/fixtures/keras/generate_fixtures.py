@@ -63,6 +63,19 @@ def main():
     x = np.random.default_rng(6).normal(size=(5, 10)).astype(np.float32)
     np.savez(f"{out}/functional_expected.npz", x=x, y=m.predict(x, verbose=0))
     m.save(f"{out}/functional.h5")
+
+    # two conv branches → Concatenate → Flatten → Dense: exercises the
+    # merge-vertex wiring and the concat-then-flatten HWC→CHW permutation
+    inp = L.Input((8, 8, 2))
+    a = L.Conv2D(4, 3, padding="same", activation="relu")(inp)
+    b = L.Conv2D(6, 3, padding="same", activation="tanh")(inp)
+    cat = L.Concatenate()([a, b])
+    o = L.Dense(3, activation="softmax")(L.Flatten()(cat))
+    m = tf.keras.Model(inp, o)
+    x = np.random.default_rng(7).normal(size=(3, 8, 8, 2)).astype(np.float32)
+    np.savez(f"{out}/functional_concat_expected.npz", x=x,
+             y=m.predict(x, verbose=0))
+    m.save(f"{out}/functional_concat.h5")
     print("fixtures regenerated")
 
 
