@@ -7,7 +7,12 @@ measured configs (step-time ms, samples/sec, MFU estimate each).
 Configs (BASELINE.md):
 1. lenet_mnist      — MultiLayerNetwork.fit(), batch 128 (zoo LeNet)
 2. samediff_mlp     — SameDiff graph-autodiff MLP train step, batch 128
-3. resnet50         — zoo ResNet-50, 224x224 ImageNet shapes, batch 32
+3. resnet50         — zoo ResNet-50, 224x224 ImageNet shapes, batch 128,
+                      bf16 mixed precision (f32 master params)
+
+All configs train through the scanned whole-epoch step (one device
+dispatch per epoch) with device-cached data — the same code path fit()
+takes for any listener-free DeviceCachedIterator run.
 
 The reference publishes no benchmark numbers (BASELINE.json
 "published": {}), so vs_baseline is null — an honest "no measured
@@ -102,27 +107,34 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256)):
             "batch": batch}
 
 
-def bench_resnet50(batch=32, steps=8, image=224):
-    """BASELINE config 3: zoo ResNet-50 training step, ImageNet shapes."""
+def bench_resnet50(batch=128, steps=4, image=224, mixed_precision=True):
+    """BASELINE config 3: zoo ResNet-50 training step, ImageNet shapes,
+    bf16 mixed precision (f32 master params) at MXU-saturating batch."""
+    from deeplearning4j_tpu.autodiff import MixedPrecision
+    from deeplearning4j_tpu.nn import ComputationGraph
     from deeplearning4j_tpu.zoo import ResNet50
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     rng = np.random.default_rng(0)
-    net = ResNet50(height=image, width=image, channels=3,
-                   num_classes=1000).build()
+    conf = ResNet50(height=image, width=image, channels=3,
+                    num_classes=1000).conf()
+    if mixed_precision:
+        conf.mixed_precision = MixedPrecision()
+    net = ComputationGraph(conf).init()
     n = batch * steps
     X = rng.normal(size=(n, 3, image, image)).astype(np.float32)
     Y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, n)]
     it = DeviceCachedIterator(X, Y, batch_size=batch)
     net.fit(it, epochs=1)                       # warmup/compile
-    sps = _median_rate(lambda: net.fit(it, epochs=1), n)
+    sps = _median_rate(lambda: net.fit(it, epochs=2), 2 * n)
     # ResNet-50 fwd FLOPs/image: 4.1e9 at 224x224; conv FLOPs scale with
     # spatial area for other image sizes
     fwd_flops = 4.1e9 * (image / 224.0) ** 2
     return {"samples_per_sec": round(sps, 1),
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
-            "batch": batch}
+            "batch": batch,
+            "precision": "bf16_mixed" if mixed_precision else "f32"}
 
 
 def main():

@@ -7,12 +7,13 @@ reference's op-by-op Java interpreter.
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, OpNode
 from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
 from deeplearning4j_tpu.autodiff.training import (
-    TrainingConfig, History, Listener, ScoreIterationListener,
-    PerformanceListener, CheckpointListener, EarlyStoppingListener,
+    TrainingConfig, MixedPrecision, History, Listener,
+    ScoreIterationListener, PerformanceListener, CheckpointListener,
+    EarlyStoppingListener,
 )
 
 __all__ = [
     "SameDiff", "SDVariable", "VariableType", "OpNode", "TrainingConfig",
-    "History", "Listener", "ScoreIterationListener", "PerformanceListener",
-    "CheckpointListener", "EarlyStoppingListener",
+    "MixedPrecision", "History", "Listener", "ScoreIterationListener",
+    "PerformanceListener", "CheckpointListener", "EarlyStoppingListener",
 ]

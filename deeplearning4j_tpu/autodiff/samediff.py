@@ -473,8 +473,12 @@ class SameDiff:
     # training (reference: SameDiff.fit → TrainingSession.java:74; here the
     # step — forward+backward+updater+param update — is ONE jitted fn with
     # donated param/state buffers)
-    def make_train_step(self, donate: bool = True):
-        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+    def _build_step_body(self):
+        """The train-step body shared by the per-batch step and the
+        scanned whole-epoch step: forward + backward + updater + param
+        update, with the optional mixed-precision policy applied (cast
+        params/inputs to the compute dtype inside the trace; gradients
+        flow back through the casts as float32 master-param grads)."""
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
@@ -489,19 +493,49 @@ class SameDiff:
         pre_regs = [r for r in regs if r.apply_step == "BEFORE_UPDATER"]
         post_regs = [r for r in regs if r.apply_step == "POST_UPDATER"]
 
-        def step(params, svars, state, iteration, constants, phv, base_key):
+        mp = getattr(tc, "mixed_precision", None)
+        if mp is not None:
+            cdt = DataType.from_any(mp.compute_dtype).jnp
+            loss_scale = mp.loss_scale
+
+            def _cast(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: x.astype(cdt)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+        else:
+            loss_scale = None
+            _cast = None
+
+        def step_body(params, svars, state, iteration, constants, phv,
+                      base_key):
             # per-step key derived ON DEVICE (a host-side jax.random.key per
             # step costs a tunnel round-trip; fold_in is free inside the jit)
             key = jax.random.fold_in(base_key, iteration)
 
             def loss_fn(p):
-                outs = fn({**p, **jax.lax.stop_gradient(svars)},
-                          constants, phv, key)
-                return sum(jnp.sum(outs[ln]) for ln in loss_names), outs
+                if _cast is not None:
+                    # bf16 compute: params/inputs/constants cast at the top
+                    # of the trace (XLA fuses the casts); state vars (BN
+                    # running stats) stay f32 — the norm ops keep their
+                    # statistics math in f32 and emit x-dtype activations
+                    outs = fn({**_cast(p), **jax.lax.stop_gradient(svars)},
+                              _cast(constants), _cast(phv), key)
+                else:
+                    outs = fn({**p, **jax.lax.stop_gradient(svars)},
+                              constants, phv, key)
+                loss = sum(jnp.sum(outs[ln]).astype(jnp.float32)
+                           for ln in loss_names)
+                if loss_scale is not None:
+                    return loss * loss_scale, (outs, loss)
+                return loss, (outs, loss)
 
-            (data_loss, outs), grads = jax.value_and_grad(
+            (_, (outs, data_loss)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_svars = {sn: outs[src] for sn, src in state_updates.items()}
+            if loss_scale is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / loss_scale, grads)
+            new_svars = {sn: outs[src].astype(svars[sn].dtype)
+                         for sn, src in state_updates.items()}
             # state vars with no declared update carry over unchanged
             new_svars = {**svars, **new_svars}
             lr = resolve_lr(getattr(updater, "learning_rate", 0.0), iteration, 0)
@@ -510,10 +544,7 @@ class SameDiff:
             for r in pre_regs:
                 grads = jax.tree_util.tree_map(
                     lambda p, g: r.apply(p, g, lr), params, grads)
-            if tc.grad_clip_value is not None:
-                c = tc.grad_clip_value
-                grads = jax.tree_util.tree_map(
-                    lambda g: jnp.clip(g, -c, c), grads)
+            grads = tc.clip_gradients(grads)
             updates, new_state = updater.apply(grads, state, iteration)
             for r in post_regs:
                 updates = jax.tree_util.tree_map(
@@ -523,10 +554,46 @@ class SameDiff:
             # iteration advances on device — no per-step int transfer
             return new_params, new_svars, new_state, iteration + 1, data_loss
 
+        return step_body, loss_names
+
+    def make_train_step(self, donate: bool = True):
+        step_body, loss_names = self._build_step_body()
         cache_key = ("train_step", self._version, loss_names, donate)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
-            compiled = jax.jit(step,
+            compiled = jax.jit(step_body,
+                               donate_argnums=(0, 1, 2, 3) if donate else ())
+            self._fn_cache[cache_key] = compiled
+        return compiled
+
+    def make_train_epoch(self, donate: bool = True, unroll: int = 1):
+        """Whole-epoch train step: lax.scan of the step body over batches
+        stacked on a leading steps axis. ONE device dispatch per epoch —
+        on a tunneled/host-bottlenecked chip this removes the per-step
+        dispatch latency that dominates small models (no reference
+        analogue; the reference pays per-OP dispatch, SURVEY §3.2).
+        ``unroll`` unrolls the scan body (fewer while-loop iterations at
+        the cost of compile time; the runtime's per-iteration sync can
+        dominate small step bodies)."""
+        step_body, loss_names = self._build_step_body()
+
+        def epoch_fn(params, svars, state, iteration, constants, stacked_phv,
+                     base_key):
+            def body(carry, phv):
+                params, svars, state, it = carry
+                new_params, new_svars, new_state, new_it, loss = step_body(
+                    params, svars, state, it, constants, phv, base_key)
+                return (new_params, new_svars, new_state, new_it), loss
+
+            (params, svars, state, iteration), losses = jax.lax.scan(
+                body, (params, svars, state, iteration), stacked_phv,
+                unroll=unroll)
+            return params, svars, state, iteration, losses
+
+        cache_key = ("train_epoch", self._version, loss_names, donate, unroll)
+        compiled = self._fn_cache.get(cache_key)
+        if compiled is None:
+            compiled = jax.jit(epoch_fn,
                                donate_argnums=(0, 1, 2, 3) if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
@@ -539,6 +606,13 @@ class SameDiff:
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
+        # scan fast path: when no listeners need per-iteration scalars and
+        # the iterator exposes device-stacked batches, run the WHOLE epoch
+        # as one compiled lax.scan — one dispatch per epoch instead of one
+        # per step (the per-step dispatch latency dominates small models
+        # on a tunneled chip)
+        if not listeners and hasattr(dataset_iterator, "stacked_batches"):
+            return self._fit_scanned(dataset_iterator, epochs)
         step = self.make_train_step()
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
@@ -633,6 +707,48 @@ class SameDiff:
         tc.iteration_count = iteration
         for l in listeners:
             l.on_training_end(self)
+        return history
+
+    def _fit_scanned(self, dataset_iterator, epochs: int):
+        """fit() fast path: epochs of lax.scan over device-stacked batches."""
+        from deeplearning4j_tpu.autodiff.training import History
+        tc = self.training_config
+        epoch_step = self.make_train_epoch(
+            unroll=getattr(tc, "scan_unroll", 1) or 1)
+        params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
+        svars = jax.tree_util.tree_map(jnp.copy, self.state_vars_map())
+        if self._updater_state is not None and \
+                set(self._updater_state.keys()) == set(params.keys()):
+            state = jax.tree_util.tree_map(jnp.copy, self._updater_state)
+        else:
+            state = tc.updater.init(params)
+        constants = self.constants_map()
+        iteration = getattr(tc, "iteration_count", 0)
+        it_dev = jnp.asarray(iteration, jnp.int32)
+        base_key = jax.random.key(self._seed)
+        self._seed += 1
+        feats, labels = dataset_iterator.stacked_batches()
+        stacked = {}
+        for name, arr in list(zip(tc.data_set_feature_mapping, feats)) + \
+                list(zip(tc.data_set_label_mapping, labels)):
+            dt = self._vars[name].dtype if name in self._vars else None
+            stacked[name] = _to_jnp(arr, dt)
+        n_steps = next(iter(stacked.values())).shape[0]
+        history = History()
+        epoch_means = []
+        for _ in range(epochs):
+            params, svars, state, it_dev, losses = epoch_step(
+                params, svars, state, it_dev, constants, stacked, base_key)
+            epoch_means.append(jnp.mean(losses))
+            iteration += n_steps
+        # ONE device fetch for all epoch means at fit end
+        fetched = np.asarray(jnp.stack(epoch_means))
+        for e in range(epochs):
+            history.add_epoch(e, float(fetched[e]))
+        for n, p in {**params, **svars}.items():
+            self._arrays[n] = p
+        self._updater_state = state
+        tc.iteration_count = iteration
         return history
 
     # ------------------------------------------------------------------

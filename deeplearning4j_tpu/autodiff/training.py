@@ -25,6 +25,35 @@ from deeplearning4j_tpu.learning.regularization import Regularization
 
 
 @dataclasses.dataclass
+class MixedPrecision:
+    """Mixed-precision training policy: compute in ``compute_dtype``
+    (bf16 → the MXU's native input format), keep float32 master params.
+
+    The reference has no analogue (its DataType plumbing switches the
+    whole net's dtype); this is the TPU-native design: the train step
+    casts params + inputs to the compute dtype at the top of the
+    forward trace, XLA fuses the casts into the producing/consuming
+    ops, gradients flow back through the casts as float32 into the
+    updater, and loss-sensitive reductions (loss ops, BN statistics)
+    stay float32 internally. ``loss_scale`` is optional static loss
+    scaling (rarely needed with bf16 — same exponent range as f32).
+    """
+    compute_dtype: str = "bfloat16"
+    loss_scale: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return {"compute_dtype": self.compute_dtype,
+                "loss_scale": self.loss_scale}
+
+    @staticmethod
+    def from_json(d) -> "Optional[MixedPrecision]":
+        if d is None:
+            return None
+        return MixedPrecision(compute_dtype=d.get("compute_dtype", "bfloat16"),
+                              loss_scale=d.get("loss_scale"))
+
+
+@dataclasses.dataclass
 class TrainingConfig:
     updater: IUpdater
     data_set_feature_mapping: Sequence[str] = ()
@@ -34,6 +63,47 @@ class TrainingConfig:
     minibatch: bool = True
     iteration_count: int = 0
     epoch_count: int = 0
+    mixed_precision: Optional[MixedPrecision] = None
+    # gradient normalization mode (reference:
+    # BaseMultiLayerUpdater.preApply :395 / GradientNormalization enum):
+    # None | "clip_element_wise_absolute_value" | "clip_l2_per_layer" |
+    # "clip_l2_global" | "renormalize_l2_per_layer"
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    # unroll factor for the scanned whole-epoch fit path (compile-time
+    # cost vs fewer while-loop iterations; runtime-tuning knob, not serde)
+    scan_unroll: int = 1
+
+    def clip_gradients(self, grads):
+        """Apply elementwise clip + the configured normalization mode to a
+        gradient pytree (traced inside the compiled train step)."""
+        import jax
+        import jax.numpy as jnp
+        if self.grad_clip_value is not None:
+            c = self.grad_clip_value
+            grads = jax.tree_util.tree_map(lambda g: jnp.clip(g, -c, c),
+                                           grads)
+        mode = (self.gradient_normalization or "none").lower()
+        if mode in ("none", ""):
+            return grads
+        t = self.gradient_normalization_threshold
+        eps = 1e-8
+        if mode == "clip_element_wise_absolute_value":
+            return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t), grads)
+        if mode == "clip_l2_per_layer":
+            def _clip(g):
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                return g * jnp.minimum(1.0, t / (n + eps))
+            return jax.tree_util.tree_map(_clip, grads)
+        if mode in ("clip_l2_global", "clip_by_global_norm"):
+            leaves = jax.tree_util.tree_leaves(grads)
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+            scale = jnp.minimum(1.0, t / (gn + eps))
+            return jax.tree_util.tree_map(lambda g: g * scale, grads)
+        if mode == "renormalize_l2_per_layer":
+            return jax.tree_util.tree_map(
+                lambda g: g / (jnp.sqrt(jnp.sum(jnp.square(g))) + eps), grads)
+        raise ValueError(f"unknown gradient_normalization {mode!r}")
 
     def to_json(self) -> dict:
         return {
@@ -45,6 +115,11 @@ class TrainingConfig:
             "minibatch": self.minibatch,
             "iteration_count": self.iteration_count,
             "epoch_count": self.epoch_count,
+            "mixed_precision": (self.mixed_precision.to_json()
+                                if self.mixed_precision else None),
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
         }
 
     @staticmethod
@@ -59,6 +134,10 @@ class TrainingConfig:
             minibatch=d.get("minibatch", True),
             iteration_count=d.get("iteration_count", 0),
             epoch_count=d.get("epoch_count", 0),
+            mixed_precision=MixedPrecision.from_json(d.get("mixed_precision")),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
         )
 
     class Builder:
@@ -75,6 +154,14 @@ class TrainingConfig:
         def regularization(self, *regs):  self._kw["regularization"] = list(regs); return self
         def grad_clip_value(self, v):     self._kw["grad_clip_value"] = v; return self
         def minibatch(self, b):           self._kw["minibatch"] = b; return self
+        def mixed_precision(self, mp):
+            if mp is True:
+                mp = MixedPrecision()
+            self._kw["mixed_precision"] = mp; return self
+        def gradient_normalization(self, mode, threshold: float = 1.0):
+            self._kw["gradient_normalization"] = mode
+            self._kw["gradient_normalization_threshold"] = threshold
+            return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 

@@ -99,6 +99,14 @@ class DeviceCachedIterator(DataSetIterator):
         for i in range(0, self._n, self._batch):
             yield self.X[i:i + self._batch], self.Y[i:i + self._batch]
 
+    def stacked_batches(self):
+        """Device-resident batches stacked on a leading steps axis —
+        feeds SameDiff's scanned whole-epoch train step (([X], [Y]) with
+        X of shape (steps, batch, ...))."""
+        steps = self._n // self._batch
+        return ([self.X.reshape(steps, self._batch, *self.X.shape[1:])],
+                [self.Y.reshape(steps, self._batch, *self.Y.shape[1:])])
+
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference: AsyncDataSetIterator.java:32,

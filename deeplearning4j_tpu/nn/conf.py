@@ -26,6 +26,7 @@ import dataclasses
 import json
 from typing import List, Optional, Sequence
 
+from deeplearning4j_tpu.autodiff.training import MixedPrecision
 from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
 from deeplearning4j_tpu.learning.regularization import (
     L1Regularization, L2Regularization, Regularization, WeightDecay)
@@ -41,6 +42,9 @@ class MultiLayerConfiguration:
     regularization: Sequence[Regularization] = ()
     dtype: str = "float32"
     grad_clip_value: Optional[float] = None
+    mixed_precision: Optional[MixedPrecision] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
 
     # --- serde (reference: MultiLayerConfiguration.toJson/fromJson) -----
     def to_json(self) -> str:
@@ -48,6 +52,11 @@ class MultiLayerConfiguration:
             "seed": self.seed,
             "dtype": self.dtype,
             "grad_clip_value": self.grad_clip_value,
+            "mixed_precision": (self.mixed_precision.to_json()
+                                if self.mixed_precision else None),
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
             "updater": self.updater.to_json(),
             "regularization": [r.to_json() for r in self.regularization],
             "input_type": self.input_type.to_json(),
@@ -66,6 +75,10 @@ class MultiLayerConfiguration:
                             for r in d.get("regularization", [])],
             dtype=d.get("dtype", "float32"),
             grad_clip_value=d.get("grad_clip_value"),
+            mixed_precision=MixedPrecision.from_json(d.get("mixed_precision")),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
         )
 
 
@@ -98,7 +111,9 @@ class ListBuilder:
         return MultiLayerConfiguration(
             layers=self._layers, input_type=self._input_type, seed=p._seed,
             updater=p._updater, regularization=regs, dtype=p._dtype,
-            grad_clip_value=p._grad_clip)
+            grad_clip_value=p._grad_clip, mixed_precision=p._mixed_precision,
+            gradient_normalization=p._grad_norm,
+            gradient_normalization_threshold=p._grad_norm_threshold)
 
 
 class NeuralNetConfiguration:
@@ -111,6 +126,9 @@ class NeuralNetConfiguration:
             self._weight_decay = 0.0
             self._dtype = "float32"
             self._grad_clip = None
+            self._mixed_precision = None
+            self._grad_norm = None
+            self._grad_norm_threshold = 1.0
 
         def seed(self, s: int):            self._seed = int(s); return self
         def updater(self, u: IUpdater):    self._updater = u; return self
@@ -119,6 +137,20 @@ class NeuralNetConfiguration:
         def weight_decay(self, v: float):  self._weight_decay = v; return self
         def data_type(self, dt: str):      self._dtype = dt; return self
         def gradient_clip(self, v: float): self._grad_clip = v; return self
+
+        def mixed_precision(self, mp=True):
+            """bf16-compute / f32-master-param training policy (pass a
+            MixedPrecision for a custom compute dtype / loss scale)."""
+            self._mixed_precision = MixedPrecision() if mp is True else mp
+            return self
+
+        def gradient_normalization(self, mode: str, threshold: float = 1.0):
+            """clip_l2_per_layer | clip_l2_global | renormalize_l2_per_layer
+            | clip_element_wise_absolute_value (reference:
+            GradientNormalization enum, BaseMultiLayerUpdater.preApply)."""
+            self._grad_norm = mode
+            self._grad_norm_threshold = threshold
+            return self
 
         def list(self) -> ListBuilder:
             return ListBuilder(self)

@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff import (MixedPrecision, SameDiff,
+                                         TrainingConfig)
 from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
 from deeplearning4j_tpu.learning.regularization import Regularization
 from deeplearning4j_tpu.nn.layers import (
@@ -223,10 +224,13 @@ class ComputationGraphConfiguration:
     updater: IUpdater = dataclasses.field(default_factory=lambda: Sgd(0.01))
     regularization: Sequence[Regularization] = ()
     dtype: str = "float32"
+    mixed_precision: Optional[object] = None    # MixedPrecision policy
 
     def to_json(self) -> str:
         return json.dumps({
             "seed": self.seed, "dtype": self.dtype,
+            "mixed_precision": (self.mixed_precision.to_json()
+                                if self.mixed_precision else None),
             "updater": self.updater.to_json(),
             "regularization": [r.to_json() for r in self.regularization],
             "inputs": self.inputs,
@@ -253,7 +257,9 @@ class ComputationGraphConfiguration:
             updater=IUpdater.from_json(d["updater"]),
             regularization=[Regularization.from_json(r)
                             for r in d.get("regularization", [])],
-            dtype=d.get("dtype", "float32"))
+            dtype=d.get("dtype", "float32"),
+            mixed_precision=MixedPrecision.from_json(
+                d.get("mixed_precision")))
 
 
 class GraphBuilder:
@@ -317,7 +323,8 @@ class GraphBuilder:
         p = self._parent
         kw = {}
         if p is not None:
-            kw = {"seed": p._seed, "updater": p._updater, "dtype": p._dtype}
+            kw = {"seed": p._seed, "updater": p._updater, "dtype": p._dtype,
+                  "mixed_precision": p._mixed_precision}
             regs = []
             from deeplearning4j_tpu.learning.regularization import (
                 L1Regularization, L2Regularization, WeightDecay)
@@ -402,6 +409,7 @@ class ComputationGraph:
             data_set_feature_mapping=list(self.conf.inputs),
             data_set_label_mapping=list(self._label_names),
             regularization=self.conf.regularization,
+            mixed_precision=self.conf.mixed_precision,
         )
         return self
 

@@ -139,6 +139,10 @@ class MultiLayerNetwork:
             data_set_label_mapping=["labels"],
             regularization=self.conf.regularization,
             grad_clip_value=self.conf.grad_clip_value,
+            mixed_precision=self.conf.mixed_precision,
+            gradient_normalization=self.conf.gradient_normalization,
+            gradient_normalization_threshold=
+                self.conf.gradient_normalization_threshold,
         )
         return self
 

@@ -17,6 +17,14 @@ from deeplearning4j_tpu.ops.registry import op
 _L = "loss"
 
 
+def _f32(x):
+    """Loss math runs internally in float32: under bf16 compute the
+    log-softmax/log reductions would otherwise lose the precision the
+    training signal lives in. XLA fuses the cast into the producer."""
+    return x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) \
+        else x
+
+
 def _reduce_loss(per_ex, weights, reduction: str):
     if weights is None:
         weights = jnp.ones_like(per_ex)
@@ -37,6 +45,7 @@ def _reduce_loss(per_ex, weights, reduction: str):
 
 @op("mean_sqerr_loss", _L, aliases=("mse_loss", "l2_loss_full"))
 def mean_sqerr_loss(predictions, labels, weights=None, reduction: str = "mean"):
+    predictions, labels = _f32(predictions), _f32(labels)
     per = jnp.mean(jnp.square(predictions - labels), axis=-1)
     return _reduce_loss(per, weights, reduction)
 
@@ -52,6 +61,7 @@ def softmax_cross_entropy(logits, labels, weights=None, reduction: str = "mean",
                           label_smoothing: float = 0.0):
     """(reference: generic/loss/softmaxCrossEntropy.cpp) labels are
     one-hot/probability distributions."""
+    logits, labels = _f32(logits), _f32(labels)
     if label_smoothing > 0.0:
         n = labels.shape[-1]
         labels = labels * (1.0 - label_smoothing) + label_smoothing / n
@@ -64,6 +74,7 @@ def softmax_cross_entropy(logits, labels, weights=None, reduction: str = "mean",
 def sparse_softmax_cross_entropy(logits, labels, weights=None, reduction: str = "mean"):
     """labels are integer class ids (reference:
     sparseSoftmaxCrossEntropyWithLogits.cpp)."""
+    logits = _f32(logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
     per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
     return _reduce_loss(per, weights, reduction)
@@ -72,6 +83,7 @@ def sparse_softmax_cross_entropy(logits, labels, weights=None, reduction: str = 
 @op("sigm_cross_entropy", _L, aliases=("sigmoid_cross_entropy",))
 def sigm_cross_entropy(logits, labels, weights=None, reduction: str = "mean",
                        label_smoothing: float = 0.0):
+    logits, labels = _f32(logits), _f32(labels)
     if label_smoothing > 0.0:
         labels = labels * (1.0 - label_smoothing) + 0.5 * label_smoothing
     # numerically stable: max(x,0) - x*z + log(1+exp(-|x|))
@@ -109,6 +121,7 @@ def huber_loss(predictions, labels, weights=None, delta: float = 1.0,
 @op("log_loss", _L)
 def log_loss(predictions, labels, weights=None, epsilon: float = 1e-7,
              reduction: str = "mean"):
+    predictions, labels = _f32(predictions), _f32(labels)
     p = jnp.clip(predictions, epsilon, 1.0 - epsilon)
     per_el = -labels * jnp.log(p) - (1.0 - labels) * jnp.log(1.0 - p)
     per = jnp.mean(per_el, axis=-1)
@@ -118,6 +131,7 @@ def log_loss(predictions, labels, weights=None, epsilon: float = 1e-7,
 @op("poisson_loss", _L)
 def poisson_loss(predictions, labels, weights=None, reduction: str = "mean",
                  log_input: bool = False):
+    predictions, labels = _f32(predictions), _f32(labels)
     if log_input:
         per_el = jnp.exp(predictions) - labels * predictions
     else:
@@ -128,6 +142,7 @@ def poisson_loss(predictions, labels, weights=None, reduction: str = "mean",
 
 @op("kl_divergence_loss", _L, aliases=("kld_loss",))
 def kl_divergence_loss(predictions, labels, weights=None, reduction: str = "mean"):
+    predictions, labels = _f32(predictions), _f32(labels)
     per = jnp.sum(labels * (jnp.log(jnp.maximum(labels, 1e-12)) -
                             jnp.log(jnp.maximum(predictions, 1e-12))), axis=-1)
     return _reduce_loss(per, weights, reduction)

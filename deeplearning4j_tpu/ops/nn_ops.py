@@ -301,16 +301,21 @@ def global_max_pool(x, data_format: str = "NCHW", keep_dims: bool = False):
 def batchnorm(x, mean, variance, gamma=None, beta=None, epsilon: float = 1e-5,
               axis: int = 1):
     """Inference-form batch norm (reference: generic/nn/batchnorm.cpp —
-    applyScale/applyOffset flags map to gamma/beta being present)."""
+    applyScale/applyOffset flags map to gamma/beta being present).
+
+    Output is always x's dtype: under the mixed-precision policy the
+    running stats stay float32 masters while activations are bf16 —
+    without the final cast, jax type promotion would silently upcast the
+    whole downstream graph to f32."""
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(variance.reshape(shape) + epsilon)
-    out = (x - mean.reshape(shape)) * inv
-    if gamma is not None:
-        out = out * gamma.reshape(shape)
+    inv = lax.rsqrt(variance.astype(jnp.float32) + epsilon)
+    a = inv if gamma is None else gamma.astype(jnp.float32) * inv
+    b = -mean.astype(jnp.float32) * a
     if beta is not None:
-        out = out + beta.reshape(shape)
-    return out
+        b = b + beta.astype(jnp.float32)
+    # one elementwise pass in x's dtype (per-channel a,b precomputed)
+    return x * a.astype(x.dtype).reshape(shape) + b.astype(x.dtype).reshape(shape)
 
 
 @op("batchnorm_train", _N)
@@ -320,18 +325,33 @@ def batchnorm_train(x, gamma, beta, running_mean, running_var,
 
     Returns (out, new_running_mean, new_running_var). Reference decay
     semantics (BatchNormalization.java 'decay'): new = decay*old + (1-decay)*batch.
+
+    Batch statistics are computed in float32 regardless of x's dtype —
+    bf16 mean/variance reductions over large batches lose the low bits
+    that the running-stat EMA depends on. The big-tensor math stays in
+    x's dtype: the reductions accumulate in f32 (XLA fuses the convert
+    into the reduce, reading bf16 from HBM once), and the normalization
+    is a per-channel scale+shift a*x+b with a/b derived from the f32
+    stats — so no f32 copy of the activation is ever materialized
+    (HBM bandwidth is the TPU bottleneck, not FLOPs).
     """
     red = tuple(i for i in range(x.ndim) if i != axis)
-    mean = jnp.mean(x, axis=red)
-    var = jnp.var(x, axis=red)
+    lowp = x.dtype in (jnp.bfloat16, jnp.float16)
+    xf = x.astype(jnp.float32) if lowp else x
+    mean = jnp.mean(xf, axis=red)                 # convert fused into reduce
+    var = jnp.var(xf, axis=red)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(var.reshape(shape) + epsilon)
-    out = (x - mean.reshape(shape)) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    inv = lax.rsqrt(var + epsilon)
+    # per-channel (tiny) f32 math, then one bf16 elementwise pass
+    a = (gamma.astype(jnp.float32) * inv).astype(x.dtype)
+    b = (beta.astype(jnp.float32)
+         - gamma.astype(jnp.float32) * inv * mean).astype(x.dtype)
+    out = x * a.reshape(shape) + b.reshape(shape)
     n = x.size // x.shape[axis]
     unbiased = var * n / max(n - 1, 1)
-    new_mean = momentum * running_mean + (1 - momentum) * mean
-    new_var = momentum * running_var + (1 - momentum) * unbiased
+    new_mean = momentum * running_mean + (1 - momentum) * mean.astype(running_mean.dtype)
+    new_var = momentum * running_var + (1 - momentum) * unbiased.astype(running_var.dtype)
     return out, new_mean, new_var
 
 
