@@ -1,0 +1,228 @@
+"""TF-compat ops: structural args arrive as *tensors*, TF-style.
+
+Reference parity: libnd4j ships a `compat` op category for framework-import
+semantics (ops/declarable/generic/compat/) and the TF importer maps nodes
+whose shape/axis arguments are tensors (Reshape's shape input, Mean's axes
+input, StridedSlice's begin/end/strides) onto ops that accept them as
+inputs (ImportGraph.kt:218 mapping rules).
+
+TPU-native twist: under jit every array shape is static, so a `Shape` op
+returns a *concrete* (non-tracer) array at trace time and any arithmetic on
+it stays concrete. These compat ops convert their structural-arg inputs
+with np.asarray at trace time — which succeeds exactly when the value is
+trace-time-concrete (i.e. derived from shapes and constants, not from
+placeholder *data*). Genuinely data-dependent shapes raise jax's
+TracerArrayConversionError with a clear chain back to the offending op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import op
+
+_C = "compat"
+
+
+def _ints(v):
+    """Structural tensor -> tuple of python ints (trace-time concrete)."""
+    a = np.asarray(v)
+    return tuple(int(x) for x in a.reshape(-1))
+
+
+def _int1(v):
+    a = np.asarray(v)
+    return int(a.reshape(()))
+
+
+@op("tf_reshape", _C, n_inputs=2)
+def tf_reshape(x, shape):
+    """Reshape with the target shape as a tensor input (TF Reshape)."""
+    return jnp.reshape(x, _ints(shape))
+
+
+@op("tf_fill", _C, n_inputs=2, differentiable=False)
+def tf_fill(dims, value):
+    return jnp.full(_ints(dims), value)
+
+
+@op("tf_range", _C, n_inputs=3, differentiable=False)
+def tf_range(start, limit, delta):
+    return jnp.arange(_int1(start), _int1(limit), _int1(delta),
+                      dtype=jnp.asarray(start).dtype)
+
+
+@op("tf_broadcast_to", _C, n_inputs=2)
+def tf_broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _ints(shape))
+
+
+@op("tf_tile", _C, n_inputs=2)
+def tf_tile(x, multiples):
+    return jnp.tile(x, _ints(multiples))
+
+
+@op("tf_expand_dims", _C, n_inputs=2)
+def tf_expand_dims(x, axis):
+    return jnp.expand_dims(x, _int1(axis))
+
+
+@op("tf_squeeze", _C, n_inputs=1)
+def tf_squeeze(x, axis=None):
+    if axis:
+        axis = tuple(a % max(x.ndim, 1) for a in axis)
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis) if axis else x
+    return jnp.squeeze(x)
+
+
+@op("tf_reduce", _C, n_inputs=2)
+def tf_reduce(x, axes, reduction: str = "mean", keepdims: bool = False):
+    ax = _ints(axes) or None
+    fn = {"mean": jnp.mean, "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+          "prod": jnp.prod, "any": jnp.any, "all": jnp.all}[reduction]
+    return fn(x, axis=ax, keepdims=keepdims)
+
+
+@op("tf_transpose", _C, n_inputs=2)
+def tf_transpose(x, perm):
+    return jnp.transpose(x, _ints(perm))
+
+
+@op("tf_concat", _C)
+def tf_concat(*args):
+    """ConcatV2: last input is the axis tensor."""
+    *xs, axis = args
+    return jnp.concatenate(xs, axis=_int1(axis))
+
+
+@op("tf_slice", _C, n_inputs=3)
+def tf_slice(x, begin, size):
+    begin = _ints(begin)
+    size = [x.shape[i] - b if s == -1 else s
+            for i, (b, s) in enumerate(zip(begin, _ints(size)))]
+    return jax.lax.slice(x, begin, tuple(b + s for b, s in zip(begin, size)))
+
+
+@op("tf_strided_slice", _C, n_inputs=4)
+def tf_strided_slice(x, begin, end, strides, begin_mask: int = 0,
+                     end_mask: int = 0, ellipsis_mask: int = 0,
+                     new_axis_mask: int = 0, shrink_axis_mask: int = 0):
+    """Full TF StridedSlice semantics with static begin/end/strides."""
+    begin, end, strides = _ints(begin), _ints(end), _ints(strides)
+    idx = []
+    for i in range(len(begin)):
+        if ellipsis_mask & (1 << i):
+            idx.append(Ellipsis)
+        elif new_axis_mask & (1 << i):
+            idx.append(jnp.newaxis)
+        elif shrink_axis_mask & (1 << i):
+            idx.append(begin[i])
+        else:
+            b = None if (begin_mask & (1 << i)) else begin[i]
+            e = None if (end_mask & (1 << i)) else end[i]
+            idx.append(slice(b, e, strides[i]))
+    return x[tuple(idx)]
+
+
+@op("tf_gather", _C, n_inputs=3)
+def tf_gather(params, indices, axis, batch_dims: int = 0):
+    return jnp.take_along_axis(params, indices, axis=None) if False else \
+        _gather_impl(params, indices, _int1(axis), batch_dims)
+
+
+def _gather_impl(params, indices, axis, batch_dims):
+    if batch_dims == 0:
+        return jnp.take(params, indices, axis=axis)
+    # batched gather: vmap take over leading batch dims
+    fn = lambda p, i: jnp.take(p, i, axis=axis - batch_dims)
+    for _ in range(batch_dims):
+        fn = jax.vmap(fn)
+    return fn(params, indices)
+
+
+@op("tf_one_hot", _C, n_inputs=4)
+def tf_one_hot(indices, depth, on_value, off_value, axis: int = -1):
+    d = _int1(depth)
+    oh = jax.nn.one_hot(indices, d, axis=axis)
+    on = jnp.asarray(on_value)
+    off = jnp.asarray(off_value)
+    return (oh * (on - off) + off).astype(on.dtype)
+
+
+@op("tf_split", _C, n_inputs=2)
+def tf_split(axis, value, num_split: int = 1):
+    """TF Split: (axis, value) input order."""
+    return tuple(jnp.split(value, num_split, axis=_int1(axis)))
+
+
+@op("tf_split_v", _C, n_inputs=3)
+def tf_split_v(value, size_splits, axis):
+    sizes = _ints(size_splits)
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(value, bounds, axis=_int1(axis)))
+
+
+@op("tf_pad", _C, n_inputs=2)
+def tf_pad(x, paddings, mode: str = "CONSTANT", constant: float = 0.0):
+    pads = np.asarray(paddings).reshape(-1, 2).tolist()
+    mode = {"CONSTANT": "constant", "REFLECT": "reflect",
+            "SYMMETRIC": "symmetric"}[mode.upper()]
+    if mode == "constant":
+        return jnp.pad(x, pads, mode=mode, constant_values=constant)
+    return jnp.pad(x, pads, mode=mode)
+
+
+@op("tf_cumsum", _C, n_inputs=2)
+def tf_cumsum(x, axis, exclusive: bool = False, reverse: bool = False):
+    ax = _int1(axis)
+    if reverse:
+        x = jnp.flip(x, ax)
+    out = jnp.cumsum(x, axis=ax)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, ax)
+    return out
+
+
+@op("tf_argmax", _C, n_inputs=2, differentiable=False)
+def tf_argmax(x, axis, output_dtype: str = "int64"):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jnp.argmax(x, axis=_int1(axis)).astype(
+        DataType.from_any(output_dtype).jnp)
+
+
+@op("tf_argmin", _C, n_inputs=2, differentiable=False)
+def tf_argmin(x, axis, output_dtype: str = "int64"):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    return jnp.argmin(x, axis=_int1(axis)).astype(
+        DataType.from_any(output_dtype).jnp)
+
+
+@op("tf_addn", _C)
+def tf_addn(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@op("tf_fused_batch_norm", _C, n_inputs=5)
+def tf_fused_batch_norm(x, scale, offset, mean, variance,
+                        epsilon: float = 1e-3, data_format: str = "NHWC",
+                        is_training: bool = False):
+    """FusedBatchNormV3 (inference or batch-stats training forward)."""
+    caxis = 3 if data_format == "NHWC" else 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    if is_training:
+        m = jnp.mean(x, axes, dtype=jnp.float32)
+        v = jnp.var(x.astype(jnp.float32), axes)
+    else:
+        m, v = mean, variance
+    sh = [1] * x.ndim
+    sh[caxis] = -1
+    scale_ = (scale * jax.lax.rsqrt(v + epsilon)).reshape(sh).astype(x.dtype)
+    shift_ = (offset - scale * m * jax.lax.rsqrt(v + epsilon)).reshape(sh).astype(x.dtype)
+    return x * scale_ + shift_, m, v
