@@ -409,10 +409,12 @@ class SameDiff:
             return v._shape
         fn = self._trace_fn((name,))
         ph_specs = {}
+        faked_dims = False
         for pn in self.placeholders():
             pv = self._vars[pn]
             if pv._shape is None or any(d == -1 for d in pv._shape):
                 shape = tuple(1 if d == -1 else d for d in (pv._shape or (1,)))
+                faked_dims = True
             else:
                 shape = pv._shape
             ph_specs[pn] = jax.ShapeDtypeStruct(shape, DataType.from_any(pv.dtype).jnp)
@@ -423,8 +425,21 @@ class SameDiff:
                                  self.constants_map(),
                                  ph_specs, jax.random.key(0))
             return tuple(out[name].shape)
-        except Exception:
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # ops with structural-tensor args (tf_compat Reshape etc.) need
+            # concrete values the abstract tracer can't provide — the shape
+            # is genuinely not statically inferable here.
             return None
+        except (TypeError, ValueError):
+            if faked_dims:
+                # unknown placeholder dims were substituted with 1 to make
+                # abstract eval possible; a shape-compat failure is then an
+                # artifact of the fake dims, not a user bug
+                return None
+            # fully-known shapes that still fail to trace = a real graph
+            # error the caller must see (round-2 Weak #3: don't swallow)
+            raise
 
     # ------------------------------------------------------------------
     # gradients (reference: createGradFunction + calculateGradients,
@@ -644,8 +659,29 @@ class SameDiff:
                 ph.update(zip(tc.data_set_label_mapping, labels))
             return self._prep_placeholders(ph)
 
+        # listeners get loss scalars in BURSTS: per-step losses stay on
+        # device and one stacked fetch every flush_every steps feeds
+        # iterations_done — the listener path no longer serializes the
+        # dispatch pipeline with a float() per step (one round-trip per
+        # burst instead of per iteration)
+        flush_every = min((max(1, int(getattr(l, "frequency", 10)))
+                           for l in listeners), default=0)
+
         for epoch in range(epochs):
             epoch_losses = []
+            pending: List[Tuple[int, jax.Array]] = []
+
+            def _flush(pending):
+                if not pending:
+                    return
+                iters = [it for it, _ in pending]
+                vals = [float(v) for v in
+                        np.asarray(jnp.stack([lv for _, lv in pending]))]
+                epoch_losses.extend(vals)
+                for l in listeners:
+                    l.iterations_done(self, epoch, iters, vals)
+                pending.clear()
+
             for l in listeners:
                 l.on_epoch_start(self, epoch)
             if hasattr(dataset_iterator, "reset"):
@@ -666,15 +702,15 @@ class SameDiff:
                 # without listeners, never force a device sync: losses stay
                 # async device scalars (a scalar fetch = tunnel round-trip)
                 if listeners:
-                    loss_f = float(loss_val)
-                    epoch_losses.append(loss_f)
-                    for l in listeners:
-                        l.iteration_done(self, epoch, iteration, loss_f)
+                    pending.append((iteration, loss_val))
+                    if len(pending) >= flush_every:
+                        _flush(pending)
                 else:
                     epoch_losses.append(loss_val)
                 iteration += 1
                 ph = nxt
             if listeners:
+                _flush(pending)
                 mean_loss = float(np.mean(epoch_losses)) \
                     if epoch_losses else float("nan")
             else:

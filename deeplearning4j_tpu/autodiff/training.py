@@ -203,13 +203,28 @@ class History:
 
 class Listener:
     """Training listener (reference: autodiff.listeners.Listener /
-    dl4j TrainingListener). Return False from on_epoch_end to stop."""
+    dl4j TrainingListener). Return False from on_epoch_end to stop.
+
+    Loss scalars live on device; forcing one to a python float costs a
+    device round-trip that serializes the dispatch pipeline. fit()
+    therefore buffers per-step losses and delivers them in bursts via
+    ``iterations_done`` every ``frequency`` steps (ONE transfer per
+    burst). The default implementation replays ``iteration_done`` per
+    step, so simple listeners just implement that."""
+
+    #: how often (in iterations) this listener needs scalars delivered
+    frequency: int = 10
 
     def on_training_start(self, sd): ...
     def on_training_end(self, sd): ...
     def on_epoch_start(self, sd, epoch: int): ...
     def on_epoch_end(self, sd, epoch: int, mean_loss: float): ...
     def iteration_done(self, sd, epoch: int, iteration: int, loss: float): ...
+
+    def iterations_done(self, sd, epoch: int, iterations: Sequence[int],
+                        losses: Sequence[float]):
+        for it, lo in zip(iterations, losses):
+            self.iteration_done(sd, epoch, it, lo)
 
 
 class ScoreIterationListener(Listener):
@@ -218,6 +233,7 @@ class ScoreIterationListener(Listener):
 
     def __init__(self, print_every: int = 10, print_fn=print):
         self.print_every = print_every
+        self.frequency = print_every
         self.print_fn = print_fn
 
     def iteration_done(self, sd, epoch, iteration, loss):
@@ -235,18 +251,30 @@ class PerformanceListener(Listener):
         self.batch_size = None  # auto-filled by fit() from the first batch
         self._last_time = None
         self._last_iter = None
+        self._last_print_iter = None
         self.samples_per_sec = float("nan")
         self.batches_per_sec = float("nan")
 
     def iteration_done(self, sd, epoch, iteration, loss):
+        self.iterations_done(sd, epoch, [iteration], [loss])
+
+    def iterations_done(self, sd, epoch, iterations, losses):
+        # burst delivery: timing spans the whole burst, so rates stay
+        # honest — and the listener no longer forces per-step syncs
         now = time.perf_counter()
+        iteration = iterations[-1]
         if self._last_time is not None and iteration > self._last_iter:
             dt = now - self._last_time
             n_batches = iteration - self._last_iter
             self.batches_per_sec = n_batches / dt
             if self.batch_size:
                 self.samples_per_sec = self.batch_size * self.batches_per_sec
-            if iteration % self.frequency == 0:
+            # bursts may arrive more often than this listener's frequency
+            # (the fit loop flushes at the MIN frequency across listeners) —
+            # keep printing on our own cadence
+            if self._last_print_iter is None or \
+                    iteration - self._last_print_iter >= self.frequency:
+                self._last_print_iter = iteration
                 self.print_fn(
                     f"iteration {iteration}: {self.batches_per_sec:.1f} batches/sec"
                     + (f", {self.samples_per_sec:.1f} samples/sec"

@@ -55,12 +55,20 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
         elif wire == WIRE_BYTES:
             ln, pos = read_varint(data, pos)
             val = data[pos:pos + ln]
+            if len(val) != ln:
+                raise ValueError(
+                    f"truncated protobuf: field {field} declares {ln} bytes, "
+                    f"{len(val)} available")
             pos += ln
         elif wire == WIRE_FIXED64:
             val = data[pos:pos + 8]
+            if len(val) != 8:
+                raise ValueError(f"truncated protobuf: fixed64 field {field}")
             pos += 8
         elif wire == WIRE_FIXED32:
             val = data[pos:pos + 4]
+            if len(val) != 4:
+                raise ValueError(f"truncated protobuf: fixed32 field {field}")
             pos += 4
         elif wire == 3 or wire == 4:  # group start/end (legacy, unused)
             raise ValueError("protobuf groups unsupported")

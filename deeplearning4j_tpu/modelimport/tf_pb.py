@@ -148,7 +148,7 @@ class AttrValue:
     def list(self) -> Dict[str, list]:
         lv = self._f.message(1)
         if lv is None:
-            return {"s": [], "i": [], "f": [], "b": [], "type": []}
+            return {"s": [], "i": [], "f": [], "b": [], "type": [], "shape": []}
         return {
             "s": [b.decode("utf-8", "replace") for b in lv.repeated_bytes(2)],
             "i": lv.repeated_svarint(3),

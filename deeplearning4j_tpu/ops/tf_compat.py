@@ -79,10 +79,14 @@ def tf_squeeze(x, axis=None):
 
 @op("tf_reduce", _C, n_inputs=2)
 def tf_reduce(x, axes, reduction: str = "mean", keepdims: bool = False):
-    ax = _ints(axes) or None
+    ax = _ints(axes)
+    # TF semantics: an explicitly EMPTY reduction_indices tensor reduces over
+    # no axes (identity), while a scalar/None means reduce over all axes.
+    if np.asarray(axes).ndim > 0 and len(ax) == 0:
+        return x
     fn = {"mean": jnp.mean, "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
           "prod": jnp.prod, "any": jnp.any, "all": jnp.all}[reduction]
-    return fn(x, axis=ax, keepdims=keepdims)
+    return fn(x, axis=ax or None, keepdims=keepdims)
 
 
 @op("tf_transpose", _C, n_inputs=2)
@@ -128,11 +132,11 @@ def tf_strided_slice(x, begin, end, strides, begin_mask: int = 0,
 
 @op("tf_gather", _C, n_inputs=3)
 def tf_gather(params, indices, axis, batch_dims: int = 0):
-    return jnp.take_along_axis(params, indices, axis=None) if False else \
-        _gather_impl(params, indices, _int1(axis), batch_dims)
+    return _gather_impl(params, indices, _int1(axis), batch_dims)
 
 
 def _gather_impl(params, indices, axis, batch_dims):
+    axis = axis % params.ndim
     if batch_dims == 0:
         return jnp.take(params, indices, axis=axis)
     # batched gather: vmap take over leading batch dims

@@ -1,0 +1,80 @@
+"""Registry reachability + ADVICE-fix regression tests.
+
+Guards against the round-3 failure mode where a whole op module
+(ops/tf_compat.py) was merged but never imported by
+registry._ensure_loaded(), leaving its ops unreachable.
+"""
+import importlib
+import pathlib
+import pkgutil
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import registry
+
+OPS_DIR = pathlib.Path(registry.__file__).parent
+
+
+def test_every_ops_module_is_loaded_by_registry():
+    """Every module under deeplearning4j_tpu/ops that registers ops must be
+    imported by _ensure_loaded() — i.e. after get-op machinery runs, each
+    module's @op-decorated functions are reachable by name."""
+    registry._ensure_loaded()
+    loaded_names = set(registry.op_names())
+    for info in pkgutil.iter_modules([str(OPS_DIR)]):
+        if info.name in ("registry",):
+            continue
+        mod = importlib.import_module(f"deeplearning4j_tpu.ops.{info.name}")
+        # find names registered by this module's source
+        src = pathlib.Path(mod.__file__).read_text()
+        import re
+        declared = re.findall(r'@op\(\s*"([^"]+)"', src)
+        missing = [d for d in declared if not registry.has_op(d)]
+        assert not missing, (
+            f"ops module {info.name!r} declares ops not reachable via the "
+            f"registry (is it missing from _ensure_loaded()?): {missing}")
+
+
+def test_tf_compat_category_present():
+    cats = registry.ops_by_category()
+    assert "compat" in cats
+    assert "tf_reshape" in cats["compat"]
+    assert registry.has_op("tf_reshape")
+
+
+def test_tf_reduce_empty_axes_is_identity():
+    """TF semantics: empty reduction_indices tensor => identity."""
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = registry.exec_op("tf_reduce", x, np.array([], dtype=np.int32),
+                           reduction="mean")
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(out.data), x)
+    # scalar 0-d axes tensor still means that axis
+    out2 = registry.exec_op("tf_reduce", x, np.array(0, dtype=np.int32),
+                            reduction="sum")
+    assert out2.shape == (3, 4)
+
+
+def test_tf_gather_negative_axis():
+    p = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([0, 2], dtype=np.int32)
+    out = registry.exec_op("tf_gather", p, idx, np.array(-1))
+    assert out.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(out.data), p[:, [0, 2]])
+
+
+def test_protowire_truncation_raises():
+    from deeplearning4j_tpu.modelimport.protowire import Fields
+    # field 1, wire type 2 (bytes), declared length 100, only 2 bytes present
+    data = bytes([0x0A, 100, 0x01, 0x02])
+    with pytest.raises(ValueError, match="truncated"):
+        Fields(data)
+
+
+def test_attrvalue_empty_list_has_all_keys():
+    from deeplearning4j_tpu.modelimport.protowire import Fields
+    from deeplearning4j_tpu.modelimport.tf_pb import AttrValue
+    av = AttrValue(Fields(b""))
+    lst = av.list
+    assert set(lst.keys()) >= {"s", "i", "f", "b", "type", "shape"}
