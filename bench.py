@@ -137,13 +137,49 @@ def bench_resnet50(batch=128, steps=4, image=224, mixed_precision=True):
             "precision": "bf16_mixed" if mixed_precision else "f32"}
 
 
+def bench_bert_base(batch=16, seq_len=128, steps=4, mixed_precision=True):
+    """BASELINE config 4: BERT-base imported from a frozen TF GraphDef,
+    fine-tune step (pooled-output classifier, softmax-CE, Adam)."""
+    from deeplearning4j_tpu.autodiff import MixedPrecision, TrainingConfig
+    from deeplearning4j_tpu.dataset import DeviceCachedIterator
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.zoo.bert import BERT_BASE, bert_base
+
+    sd = bert_base(BERT_BASE, batch=batch, seq_len=seq_len, num_labels=2)
+    sd.training_config = TrainingConfig(
+        updater=Adam(2e-5),
+        data_set_feature_mapping=["input_ids", "input_mask",
+                                  "token_type_ids"],
+        data_set_label_mapping=["labels"],
+        mixed_precision=MixedPrecision() if mixed_precision else None)
+    rng = np.random.default_rng(0)
+    n = batch * steps
+    ids = rng.integers(0, BERT_BASE.vocab_size, (n, seq_len)).astype(np.int32)
+    mask = np.ones((n, seq_len), np.int32)
+    tt = np.zeros((n, seq_len), np.int32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    it = DeviceCachedIterator([ids, mask, tt], [labels], batch_size=batch)
+    sd.fit(it, epochs=1)                        # warmup/compile
+    sps = _median_rate(lambda: sd.fit(it, epochs=2), 2 * n)
+    # fwd matmul FLOPs per example: per layer qkv+attn-out (8h^2/token) +
+    # ffn (16h^2/token) + attention scores/context (4*s*h/token)
+    h, L, s = BERT_BASE.hidden_size, BERT_BASE.num_layers, seq_len
+    fwd_flops = L * (24 * s * h * h + 4 * s * s * h)
+    return {"samples_per_sec": round(sps, 1),
+            "step_time_ms": round(1000.0 * batch / sps, 3),
+            "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
+            "batch": batch, "seq_len": seq_len,
+            "precision": "bf16_mixed" if mixed_precision else "f32"}
+
+
 def main():
     import sys
     import traceback
     configs = {}
     for name, fn in (("lenet_mnist", bench_lenet),
                      ("samediff_mlp", bench_samediff_mlp),
-                     ("resnet50", bench_resnet50)):
+                     ("resnet50", bench_resnet50),
+                     ("bert_base", bench_bert_base)):
         try:
             configs[name] = fn()
         except Exception:

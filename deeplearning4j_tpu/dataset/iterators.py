@@ -81,31 +81,56 @@ class DeviceCachedIterator(DataSetIterator):
     def __init__(self, features, labels, batch_size: int = 32, sharding=None):
         import jax
         import jax.numpy as jnp
-        X = np.asarray(features)
-        Y = np.asarray(labels)
-        n = (len(X) // batch_size) * batch_size
+        feats = [np.asarray(f) for f in features] \
+            if isinstance(features, (list, tuple)) else [np.asarray(features)]
+        labs = [np.asarray(l) for l in labels] \
+            if isinstance(labels, (list, tuple)) else [np.asarray(labels)]
+        self._multi_f = isinstance(features, (list, tuple))
+        self._multi_l = isinstance(labels, (list, tuple))
+        lens = {len(a) for a in feats + labs}
+        if len(lens) != 1:
+            raise ValueError(
+                f"all feature/label arrays must share the leading length; "
+                f"got {[len(a) for a in feats]} / {[len(a) for a in labs]}")
+        n = (len(feats[0]) // batch_size) * batch_size
         if n == 0:
             raise ValueError("dataset smaller than one batch")
         self._batch = batch_size
         self._n = n
-        if sharding is not None:
-            self.X = jax.device_put(X[:n], sharding)
-            self.Y = jax.device_put(Y[:n], sharding)
-        else:
-            self.X = jnp.asarray(X[:n])
-            self.Y = jnp.asarray(Y[:n])
+
+        def _put(a):
+            return jax.device_put(a[:n], sharding) if sharding is not None \
+                else jnp.asarray(a[:n])
+
+        self.Xs = [_put(f) for f in feats]
+        self.Ys = [_put(l) for l in labs]
+
+    # single-input views (back-compat)
+    @property
+    def X(self):
+        return self.Xs[0]
+
+    @property
+    def Y(self):
+        return self.Ys[0]
 
     def __iter__(self):
         for i in range(0, self._n, self._batch):
-            yield self.X[i:i + self._batch], self.Y[i:i + self._batch]
+            fs = [x[i:i + self._batch] for x in self.Xs]
+            ls = [y[i:i + self._batch] for y in self.Ys]
+            yield (fs if self._multi_f else fs[0],
+                   ls if self._multi_l else ls[0])
 
     def stacked_batches(self):
         """Device-resident batches stacked on a leading steps axis —
-        feeds SameDiff's scanned whole-epoch train step (([X], [Y]) with
-        X of shape (steps, batch, ...))."""
+        feeds SameDiff's scanned whole-epoch train step (([X...], [Y...])
+        with each array of shape (steps, batch, ...))."""
         steps = self._n // self._batch
-        return ([self.X.reshape(steps, self._batch, *self.X.shape[1:])],
-                [self.Y.reshape(steps, self._batch, *self.Y.shape[1:])])
+
+        def _stk(a):
+            return a.reshape(steps, self._batch, *a.shape[1:])
+
+        return [_stk(x) for x in self.Xs], [_stk(y) for y in self.Ys]
 
 
 class AsyncDataSetIterator(DataSetIterator):

@@ -1,10 +1,13 @@
 """Model import (reference: deeplearning4j-modelimport + samediff-import).
 
-Keras .h5 → layer-API networks. TF/ONNX graph import arrives separately.
+Keras .h5 → layer-API networks; frozen TF GraphDef .pb → SameDiff graphs.
 """
 from deeplearning4j_tpu.modelimport.keras_import import (
     KerasModelImport, import_keras_model_and_weights,
     import_keras_sequential_model_and_weights)
+from deeplearning4j_tpu.modelimport.tf_import import (
+    TFImportError, import_tf_graph, supported_tf_ops)
 
 __all__ = ["KerasModelImport", "import_keras_model_and_weights",
-           "import_keras_sequential_model_and_weights"]
+           "import_keras_sequential_model_and_weights",
+           "TFImportError", "import_tf_graph", "supported_tf_ops"]

@@ -1,0 +1,784 @@
+"""TensorFlow frozen-GraphDef → SameDiff importer.
+
+Reference parity: ImportGraph.importGraph (samediff-import-api/src/main/
+kotlin/org/nd4j/samediff/frameworkimport/ImportGraph.kt:218) and the legacy
+TFGraphMapper (nd4j-api/.../imports/graphmapper/tf/TFGraphMapper.java:56):
+walk GraphDef.node, resolve Const/Placeholder/control inputs/`name:i`
+output refs, and map each NodeDef (op + attrs) onto framework ops. The
+op-name table mirrors ImportClassMapping.java:40's role.
+
+TPU-native redesign: XLA wants static shapes, so the importer CONST-FOLDS
+every structural tensor (Reshape shapes, reduce axes, StridedSlice specs,
+Range/Fill dims) at import time and emits registry ops with *static attrs* —
+the traced graph stays purely data-flow and jit-compiles to one XLA
+computation. TF `Shape` nodes resolve against the static shapes flowing
+through the import (batch dims must be concrete for shape-math folding; the
+usual frozen-graph pattern Shape→StridedSlice→Pack→Reshape folds away
+entirely). Control inputs (`^node`) order side effects in TF; every emitted
+op here is pure, so they are dropped.
+
+Weights come in as CONSTANTs by default (inference import). With
+``trainable="auto"`` floating-point consts of rank>=1 become VARIABLEs —
+the fine-tuning path (BASELINE config 4's BERT fine-tune step); a predicate
+``trainable=lambda name, arr: ...`` gives explicit control.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.variable import SDVariable
+from deeplearning4j_tpu.modelimport.tf_pb import (
+    GraphDef, NodeDef, tf_dtype_to_np)
+from deeplearning4j_tpu.ops import registry
+
+
+class TFImportError(ValueError):
+    pass
+
+
+class _Val:
+    """One TF tensor during import: a graph variable and/or a folded
+    numpy constant (structural values keep the constant side)."""
+
+    __slots__ = ("var", "const", "_name")
+
+    def __init__(self, var=None, const=None, name=""):
+        self.var = var
+        self.const = const
+        self._name = name
+
+    @property
+    def is_const(self):
+        return self.const is not None
+
+
+def _norm_ref(ref: str) -> Tuple[str, int]:
+    """'node:2' -> ('node', 2); 'node' == 'node:0'."""
+    if ":" in ref:
+        name, idx = ref.rsplit(":", 1)
+        return name, int(idx)
+    return ref, 0
+
+
+class TFImporter:
+    """Imports one GraphDef; see import_tf_graph() for the entry point."""
+
+    def __init__(self, graph: GraphDef,
+                 trainable: Union[None, str, Callable] = None,
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None):
+        self.graph = graph
+        self.sd = SameDiff()
+        self.input_shapes = dict(input_shapes or {})
+        self._tensors: Dict[Tuple[str, int], _Val] = {}
+        self._nodes: Dict[str, NodeDef] = {n.name: n for n in graph.nodes}
+        if trainable == "auto":
+            self._trainable = lambda name, arr: (
+                np.issubdtype(arr.dtype, np.floating) and arr.ndim >= 1)
+        elif callable(trainable):
+            self._trainable = trainable
+        else:
+            self._trainable = lambda name, arr: False
+        self.placeholder_names: List[str] = []
+        self.variable_names: List[str] = []
+
+    # ------------------------------------------------------------------
+    def run(self) -> SameDiff:
+        for node in self._topo_order():
+            try:
+                self._import_node(node)
+            except TFImportError:
+                raise
+            except Exception as e:
+                raise TFImportError(
+                    f"while importing node {node.op} {node.name!r}: {e}") from e
+        return self.sd
+
+    def _topo_order(self) -> List[NodeDef]:
+        """Kahn topo sort on data deps (GraphDef node order is arbitrary)."""
+        indeg: Dict[str, int] = {}
+        consumers: Dict[str, List[str]] = {}
+        for n in self.graph.nodes:
+            deps = {_norm_ref(i.lstrip("^"))[0] for i in n.inputs}
+            deps = {d for d in deps if d in self._nodes and d != n.name}
+            indeg[n.name] = len(deps)
+            for d in deps:
+                consumers.setdefault(d, []).append(n.name)
+        ready = [n.name for n in self.graph.nodes if indeg[n.name] == 0]
+        order: List[NodeDef] = []
+        seen = set()
+        while ready:
+            nm = ready.pop()
+            if nm in seen:
+                continue
+            seen.add(nm)
+            order.append(self._nodes[nm])
+            for c in consumers.get(nm, []):
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        if len(order) != len(self.graph.nodes):
+            stuck = [n for n in indeg if n not in seen]
+            raise TFImportError(f"graph has a dataflow cycle (or v1 control "
+                                f"flow frames): unplaced nodes {stuck[:5]}")
+        return order
+
+    # ------------------------------------------------------------------
+    # input resolution
+    def _resolve(self, ref: str) -> _Val:
+        name, idx = _norm_ref(ref)
+        try:
+            return self._tensors[(name, idx)]
+        except KeyError:
+            raise TFImportError(
+                f"input {ref!r} not produced by any imported node") from None
+
+    def _ins(self, node: NodeDef) -> List[_Val]:
+        return [self._resolve(r) for r in node.inputs if not r.startswith("^")]
+
+    def _set(self, name: str, outs: Sequence[_Val]):
+        for i, v in enumerate(outs):
+            self._tensors[(name, i)] = v
+
+    def _materialize(self, v: _Val) -> SDVariable:
+        """Graph variable for a value; folded constants become sd.constant
+        lazily (first data use)."""
+        if v.var is None:
+            v.var = self.sd.constant(np.asarray(v.const), name=v._name or "imported_const")
+        return v.var
+
+    # static helpers for structural args -------------------------------
+    def _const_np(self, v: _Val, what: str) -> np.ndarray:
+        if not v.is_const:
+            raise TFImportError(
+                f"{what} must be trace-time constant (derived from consts "
+                f"and static shapes); got a data-dependent tensor")
+        return np.asarray(v.const)
+
+    def _ints(self, v: _Val, what: str) -> Tuple[int, ...]:
+        return tuple(int(x) for x in self._const_np(v, what).reshape(-1))
+
+    def _int1(self, v: _Val, what: str) -> int:
+        return int(self._const_np(v, what).reshape(()))
+
+    # ------------------------------------------------------------------
+    def emit(self, op_name: str, ins: Sequence[_Val], attrs: Dict,
+             name: str, n_outputs: int = 1) -> List[_Val]:
+        """Emit a registry op — or fold it eagerly when every input is
+        constant (constant-propagation; keeps Shape-math and frozen
+        weight-preprocessing out of the runtime graph)."""
+        if all(v.is_const for v in ins):
+            fn = registry.get_op(op_name).fn
+            res = fn(*[np.asarray(v.const) for v in ins], **attrs)
+            res = res if isinstance(res, (tuple, list)) else [res]
+            return [_Val(const=np.asarray(r), name=f"{name}:{i}" if i else name)
+                    for i, r in enumerate(res)]
+        vars_ = [self._materialize(v) for v in ins]
+        out = self.sd.invoke(op_name, vars_, attrs=attrs, name=name,
+                             n_outputs=n_outputs)
+        outs = out if isinstance(out, list) else [out]
+        return [_Val(var=o) for o in outs]
+
+    def _static_shape(self, v: _Val, node_name: str) -> Tuple[int, ...]:
+        if v.is_const:
+            return tuple(np.asarray(v.const).shape)
+        shape = v.var.shape
+        if shape is None or any(d is None or d < 0 for d in shape):
+            raise TFImportError(
+                f"Shape node {node_name!r}: input has non-static shape "
+                f"{shape}; pass input_shapes= with concrete dims")
+        return tuple(shape)
+
+    # ------------------------------------------------------------------
+    def _import_node(self, node: NodeDef):
+        op = node.op
+        if op == "NoOp":
+            return
+        if op == "Const":
+            arr = node.attrs["value"].tensor
+            if self._trainable(node.name, arr):
+                var = self.sd.var(node.name, value=arr,
+                                  dtype=str(arr.dtype))
+                self.variable_names.append(var.name)
+                self._set(node.name, [_Val(var=var)])
+            else:
+                self._set(node.name, [_Val(const=arr, name=node.name)])
+            return
+        if op in ("Placeholder", "PlaceholderWithDefault"):
+            a = node.attr("shape")
+            shape = self.input_shapes.get(node.name)
+            if shape is None and a is not None:
+                shape = a.shape
+            dt = node.attr("dtype")
+            np_dt = tf_dtype_to_np(dt.type) if dt else np.dtype(np.float32)
+            ph = self.sd.placeholder(node.name, shape=shape, dtype=str(np_dt))
+            self.placeholder_names.append(ph.name)
+            self._set(node.name, [_Val(var=ph)])
+            return
+
+        mapper = _MAPPERS.get(op)
+        if mapper is None:
+            raise TFImportError(
+                f"unmapped TF op {op!r} (node {node.name!r}); "
+                f"{len(_MAPPERS)} ops supported")
+        outs = mapper(self, node, self._ins(node))
+        if isinstance(outs, _Val):
+            outs = [outs]
+        self._set(node.name, outs)
+
+
+# ---------------------------------------------------------------------------
+# mapper table (reference: ImportClassMapping.java:40's name->class table)
+_MAPPERS: Dict[str, Callable] = {}
+
+
+def _mapper(*tf_names):
+    def deco(fn):
+        for n in tf_names:
+            _MAPPERS[n] = fn
+        return fn
+    return deco
+
+
+def _attr_b(node, name, default=False):
+    a = node.attr(name)
+    return a.b if a is not None else default
+
+
+def _attr_i(node, name, default=0):
+    a = node.attr(name)
+    return a.i if a is not None else default
+
+
+def _attr_f(node, name, default=0.0):
+    a = node.attr(name)
+    return a.f if a is not None else default
+
+
+def _attr_s(node, name, default=""):
+    a = node.attr(name)
+    return a.s if a is not None else default
+
+
+def _attr_ilist(node, name, default=()):
+    a = node.attr(name)
+    return list(a.list["i"]) if a is not None else list(default)
+
+
+# --- passthrough / identity ------------------------------------------------
+@_mapper("Identity", "Snapshot", "PreventGradient", "CheckNumerics",
+         "EnsureShape")
+def _m_identity(imp, node, ins):
+    return ins[0]
+
+
+@_mapper("IdentityN")
+def _m_identity_n(imp, node, ins):
+    return list(ins)
+
+
+@_mapper("StopGradient")
+def _m_stop_gradient(imp, node, ins):
+    return imp.emit("stop_gradient", ins, {}, node.name)
+
+
+# --- unary elementwise -----------------------------------------------------
+_UNARY = {
+    "Relu": "relu", "Relu6": "relu6", "Elu": "elu", "Selu": "selu",
+    "Softplus": "softplus", "Softsign": "softsign", "Sigmoid": "sigmoid",
+    "Tanh": "tanh", "Exp": "exp", "Log": "log", "Log1p": "log1p",
+    "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Square": "square", "Abs": "abs",
+    "Neg": "neg", "Sign": "sign", "Floor": "floor", "Ceil": "ceil",
+    "Round": "round", "Rint": "rint", "Erf": "erf", "Erfc": "erfc",
+    "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "asin",
+    "Acos": "acos", "Atan": "atan", "Sinh": "sinh", "Cosh": "cosh",
+    "Asinh": "asinh", "Acosh": "acosh", "Atanh": "atanh",
+    "Reciprocal": "reciprocal", "Inv": "reciprocal", "Expm1": "expm1",
+    "Digamma": "digamma", "Lgamma": "lgamma", "LogicalNot": "not",
+    "IsNan": "isnan", "IsInf": "isinf", "IsFinite": "isfinite",
+}
+
+
+def _make_unary(reg_name):
+    def m(imp, node, ins):
+        return imp.emit(reg_name, ins, {}, node.name)
+    return m
+
+
+for _tf, _reg in _UNARY.items():
+    _MAPPERS[_tf] = _make_unary(_reg)
+
+
+@_mapper("LeakyRelu")
+def _m_leaky_relu(imp, node, ins):
+    return imp.emit("leaky_relu", ins, {"alpha": _attr_f(node, "alpha", 0.2)},
+                    node.name)
+
+
+@_mapper("Softmax")
+def _m_softmax(imp, node, ins):
+    return imp.emit("softmax", ins, {"axis": -1}, node.name)
+
+
+@_mapper("LogSoftmax")
+def _m_log_softmax(imp, node, ins):
+    return imp.emit("log_softmax", ins, {"axis": -1}, node.name)
+
+
+# --- binary elementwise ----------------------------------------------------
+_BINARY = {
+    "Add": "add", "AddV2": "add", "Sub": "subtract", "Mul": "multiply",
+    "Div": "divide", "RealDiv": "divide", "DivNoNan": "divide_no_nan",
+    "FloorDiv": "floordiv", "FloorMod": "floormod", "Mod": "mod",
+    "Maximum": "maximum", "Minimum": "minimum", "Pow": "pow_pairwise",
+    "SquaredDifference": "squaredsubtract", "Atan2": "atan2",
+    "Equal": "equals", "NotEqual": "not_equals", "Greater": "greater",
+    "GreaterEqual": "greater_equal", "Less": "less",
+    "LessEqual": "less_equal", "LogicalAnd": "boolean_and",
+    "LogicalOr": "boolean_or", "TruncateDiv": "truncatediv",
+    "Igamma": "igamma", "Igammac": "igammac", "Hypot": "hypot",
+}
+
+
+def _make_binary(reg_name):
+    def m(imp, node, ins):
+        return imp.emit(reg_name, ins, {}, node.name)
+    return m
+
+
+for _tf, _reg in _BINARY.items():
+    _MAPPERS[_tf] = _make_binary(_reg)
+
+
+@_mapper("AddN", "AccumulateNV2")
+def _m_addn(imp, node, ins):
+    return imp.emit("tf_addn", ins, {}, node.name)
+
+
+@_mapper("Select", "SelectV2")
+def _m_select(imp, node, ins):
+    return imp.emit("where_op", ins, {}, node.name)
+
+
+@_mapper("ClipByValue")
+def _m_clip(imp, node, ins):
+    lo = imp._const_np(ins[1], "ClipByValue min")
+    hi = imp._const_np(ins[2], "ClipByValue max")
+    return imp.emit("clip_by_value", [ins[0]],
+                    {"clip_min": float(lo), "clip_max": float(hi)}, node.name)
+
+
+# --- matmul family ---------------------------------------------------------
+@_mapper("MatMul")
+def _m_matmul(imp, node, ins):
+    return imp.emit("matmul", ins,
+                    {"transpose_a": _attr_b(node, "transpose_a"),
+                     "transpose_b": _attr_b(node, "transpose_b")}, node.name)
+
+
+@_mapper("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _m_batch_matmul(imp, node, ins):
+    return imp.emit("batched_matmul", ins,
+                    {"transpose_a": _attr_b(node, "adj_x"),
+                     "transpose_b": _attr_b(node, "adj_y")}, node.name)
+
+
+@_mapper("Einsum")
+def _m_einsum(imp, node, ins):
+    return imp.emit("einsum", ins, {"equation": _attr_s(node, "equation")},
+                    node.name)
+
+
+@_mapper("BiasAdd")
+def _m_bias_add(imp, node, ins):
+    return imp.emit("bias_add", ins,
+                    {"data_format": _attr_s(node, "data_format", "NHWC")},
+                    node.name)
+
+
+@_mapper("L2Loss")
+def _m_l2_loss(imp, node, ins):
+    sq = imp.emit("square", ins, {}, node.name + "/sq")
+    s = imp.emit("reduce_sum", sq, {}, node.name + "/sum")
+    return imp.emit("scalar_mul", s, {"scalar": 0.5}, node.name)
+
+
+# --- conv / pool / norm ----------------------------------------------------
+@_mapper("Conv2D")
+def _m_conv2d(imp, node, ins):
+    df = _attr_s(node, "data_format", "NHWC")
+    strides = _attr_ilist(node, "strides", (1, 1, 1, 1))
+    dil = _attr_ilist(node, "dilations", (1, 1, 1, 1))
+    sp = (1, 2) if df == "NHWC" else (2, 3)
+    return imp.emit("conv2d", ins, {
+        "strides": (strides[sp[0]], strides[sp[1]]),
+        "dilation": (dil[sp[0]], dil[sp[1]]),
+        "padding": _attr_s(node, "padding", "SAME"),
+        "data_format": df}, node.name)
+
+
+@_mapper("DepthwiseConv2dNative")
+def _m_depthwise_conv2d(imp, node, ins):
+    df = _attr_s(node, "data_format", "NHWC")
+    strides = _attr_ilist(node, "strides", (1, 1, 1, 1))
+    sp = (1, 2) if df == "NHWC" else (2, 3)
+    return imp.emit("depthwise_conv2d", ins, {
+        "strides": (strides[sp[0]], strides[sp[1]]),
+        "padding": _attr_s(node, "padding", "SAME"),
+        "data_format": df}, node.name)
+
+
+def _pool(imp, node, ins, reg_name):
+    df = _attr_s(node, "data_format", "NHWC")
+    ks = _attr_ilist(node, "ksize", (1, 2, 2, 1))
+    st = _attr_ilist(node, "strides", (1, 2, 2, 1))
+    sp = (1, 2) if df == "NHWC" else (2, 3)
+    return imp.emit(reg_name, ins, {
+        "kernel": (ks[sp[0]], ks[sp[1]]),
+        "strides": (st[sp[0]], st[sp[1]]),
+        "padding": _attr_s(node, "padding", "VALID"),
+        "data_format": df}, node.name)
+
+
+@_mapper("MaxPool")
+def _m_max_pool(imp, node, ins):
+    return _pool(imp, node, ins, "max_pool2d")
+
+
+@_mapper("AvgPool")
+def _m_avg_pool(imp, node, ins):
+    return _pool(imp, node, ins, "avg_pool2d")
+
+
+@_mapper("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _m_fused_batch_norm(imp, node, ins):
+    outs = imp.emit("tf_fused_batch_norm", ins, {
+        "epsilon": _attr_f(node, "epsilon", 1e-3),
+        "data_format": _attr_s(node, "data_format", "NHWC"),
+        "is_training": _attr_b(node, "is_training", False)},
+        node.name, n_outputs=3)
+    # V3 declares 6 outputs (y, mean, var, 3 reserve spaces); reserves are
+    # only consumed by the TF-side grad op — alias them to mean/var
+    return outs + [outs[1], outs[2], outs[1]]
+
+
+@_mapper("LRN")
+def _m_lrn(imp, node, ins):
+    return imp.emit("lrn", ins, {
+        "depth": _attr_i(node, "depth_radius", 5),
+        "bias": _attr_f(node, "bias", 1.0),
+        "alpha": _attr_f(node, "alpha", 1.0),
+        "beta": _attr_f(node, "beta", 0.5),
+        "data_format": "NHWC"}, node.name)
+
+
+# --- shape / structure (structural args const-folded) ----------------------
+@_mapper("Shape")
+def _m_shape(imp, node, ins):
+    shape = imp._static_shape(ins[0], node.name)
+    out_dt = tf_dtype_to_np(_attr_i(node, "out_type", 3))
+    return _Val(const=np.asarray(shape, dtype=out_dt), name=node.name)
+
+
+@_mapper("ShapeN")
+def _m_shape_n(imp, node, ins):
+    out_dt = tf_dtype_to_np(_attr_i(node, "out_type", 3))
+    return [_Val(const=np.asarray(imp._static_shape(v, node.name), out_dt))
+            for v in ins]
+
+
+@_mapper("Size")
+def _m_size(imp, node, ins):
+    shape = imp._static_shape(ins[0], node.name)
+    return _Val(const=np.asarray(int(np.prod(shape)), dtype=np.int32))
+
+
+@_mapper("Rank")
+def _m_rank(imp, node, ins):
+    shape = imp._static_shape(ins[0], node.name)
+    return _Val(const=np.asarray(len(shape), dtype=np.int32))
+
+
+@_mapper("Reshape")
+def _m_reshape(imp, node, ins):
+    shape = imp._ints(ins[1], "Reshape shape")
+    return imp.emit("reshape", [ins[0]], {"shape": shape}, node.name)
+
+
+@_mapper("Transpose")
+def _m_transpose(imp, node, ins):
+    perm = imp._ints(ins[1], "Transpose perm")
+    return imp.emit("permute", [ins[0]], {"axes": perm}, node.name)
+
+
+@_mapper("ExpandDims")
+def _m_expand_dims(imp, node, ins):
+    axis = imp._int1(ins[1], "ExpandDims dim")
+    return imp.emit("expand_dims", [ins[0]], {"axis": axis}, node.name)
+
+
+@_mapper("Squeeze")
+def _m_squeeze(imp, node, ins):
+    dims = _attr_ilist(node, "squeeze_dims") or _attr_ilist(node, "axis")
+    return imp.emit("squeeze", [ins[0]],
+                    {"axis": tuple(dims) if dims else None}, node.name)
+
+
+@_mapper("ConcatV2")
+def _m_concat_v2(imp, node, ins):
+    axis = imp._int1(ins[-1], "ConcatV2 axis")
+    return imp.emit("concat", ins[:-1], {"axis": axis}, node.name)
+
+
+@_mapper("Concat")
+def _m_concat(imp, node, ins):
+    axis = imp._int1(ins[0], "Concat axis")   # legacy: axis FIRST
+    return imp.emit("concat", ins[1:], {"axis": axis}, node.name)
+
+
+@_mapper("Pack")
+def _m_pack(imp, node, ins):
+    return imp.emit("stack", ins, {"axis": _attr_i(node, "axis", 0)},
+                    node.name)
+
+
+@_mapper("Unpack")
+def _m_unpack(imp, node, ins):
+    num = _attr_i(node, "num", 1)
+    return imp.emit("unstack", ins, {"axis": _attr_i(node, "axis", 0)},
+                    node.name, n_outputs=num)
+
+
+@_mapper("Split")
+def _m_split(imp, node, ins):
+    axis = imp._int1(ins[0], "Split axis")    # (axis, value) input order
+    num = _attr_i(node, "num_split", 1)
+    return imp.emit("split", [ins[1]], {"num_split": num, "axis": axis},
+                    node.name, n_outputs=num)
+
+
+@_mapper("SplitV")
+def _m_split_v(imp, node, ins):
+    sizes = imp._ints(ins[1], "SplitV size_splits")
+    axis = imp._int1(ins[2], "SplitV axis")
+    return imp.emit("split_v", [ins[0]], {"sizes": sizes, "axis": axis},
+                    node.name, n_outputs=len(sizes))
+
+
+@_mapper("StridedSlice")
+def _m_strided_slice(imp, node, ins):
+    return imp.emit("strided_slice_masked", [ins[0]], {
+        "begin": imp._ints(ins[1], "StridedSlice begin"),
+        "end": imp._ints(ins[2], "StridedSlice end"),
+        "strides": imp._ints(ins[3], "StridedSlice strides"),
+        "begin_mask": _attr_i(node, "begin_mask"),
+        "end_mask": _attr_i(node, "end_mask"),
+        "ellipsis_mask": _attr_i(node, "ellipsis_mask"),
+        "new_axis_mask": _attr_i(node, "new_axis_mask"),
+        "shrink_axis_mask": _attr_i(node, "shrink_axis_mask")}, node.name)
+
+
+@_mapper("Slice")
+def _m_slice(imp, node, ins):
+    begin = imp._ints(ins[1], "Slice begin")
+    size = imp._ints(ins[2], "Slice size")
+    return imp.emit("slice", [ins[0]], {"begin": begin, "size": size},
+                    node.name)
+
+
+@_mapper("Gather", "GatherV2")
+def _m_gather(imp, node, ins):
+    axis = imp._int1(ins[2], "Gather axis") if len(ins) > 2 else 0
+    bd = _attr_i(node, "batch_dims", 0)
+    if bd:
+        return imp.emit("gather_batch_dims", ins[:2],
+                        {"axis": axis, "batch_dims": bd}, node.name)
+    return imp.emit("gather", ins[:2], {"axis": axis}, node.name)
+
+
+@_mapper("GatherNd")
+def _m_gather_nd(imp, node, ins):
+    return imp.emit("gather_nd", ins, {}, node.name)
+
+
+@_mapper("OneHot")
+def _m_one_hot(imp, node, ins):
+    depth = imp._int1(ins[1], "OneHot depth")
+    on = float(imp._const_np(ins[2], "OneHot on_value"))
+    off = float(imp._const_np(ins[3], "OneHot off_value"))
+    dt = node.attr("T")
+    return imp.emit("one_hot", [ins[0]], {
+        "depth": depth, "on_value": on, "off_value": off,
+        "axis": _attr_i(node, "axis", -1),
+        "dtype": str(tf_dtype_to_np(dt.type)) if dt else "float32"},
+        node.name)
+
+
+@_mapper("Fill")
+def _m_fill(imp, node, ins):
+    dims = imp._ints(ins[0], "Fill dims")
+    if ins[1].is_const:
+        value = np.asarray(ins[1].const)
+        return _Val(const=np.full(dims, value), name=node.name)
+    return imp.emit("broadcast_to", [ins[1]], {"shape": dims}, node.name)
+
+
+@_mapper("ZerosLike")
+def _m_zeros_like(imp, node, ins):
+    return imp.emit("zeros_like", ins, {}, node.name)
+
+
+@_mapper("OnesLike")
+def _m_ones_like(imp, node, ins):
+    return imp.emit("ones_like", ins, {}, node.name)
+
+
+@_mapper("Range")
+def _m_range(imp, node, ins):
+    start = imp._const_np(ins[0], "Range start")
+    limit = imp._const_np(ins[1], "Range limit")
+    delta = imp._const_np(ins[2], "Range delta")
+    return _Val(const=np.arange(start, limit, delta), name=node.name)
+
+
+@_mapper("Tile")
+def _m_tile(imp, node, ins):
+    reps = imp._ints(ins[1], "Tile multiples")
+    return imp.emit("tile", [ins[0]], {"reps": reps}, node.name)
+
+
+@_mapper("Pad", "PadV2", "MirrorPad")
+def _m_pad(imp, node, ins):
+    pads = imp._const_np(ins[1], "Pad paddings").reshape(-1, 2).tolist()
+    mode = "constant"
+    if node.op == "MirrorPad":
+        mode = {"REFLECT": "reflect", "SYMMETRIC": "symmetric"}[
+            _attr_s(node, "mode", "REFLECT")]
+    const = 0.0
+    if node.op == "PadV2" and len(ins) > 2:
+        const = float(imp._const_np(ins[2], "PadV2 constant_values"))
+    return imp.emit("pad", [ins[0]],
+                    {"paddings": pads, "mode": mode, "constant": const},
+                    node.name)
+
+
+@_mapper("BroadcastTo")
+def _m_broadcast_to(imp, node, ins):
+    shape = imp._ints(ins[1], "BroadcastTo shape")
+    return imp.emit("broadcast_to", [ins[0]], {"shape": shape}, node.name)
+
+
+@_mapper("Cast")
+def _m_cast(imp, node, ins):
+    dst = tf_dtype_to_np(_attr_i(node, "DstT", 1))
+    return imp.emit("cast", ins, {"dtype": str(dst)}, node.name)
+
+
+@_mapper("Reverse", "ReverseV2")
+def _m_reverse(imp, node, ins):
+    axis = imp._ints(ins[1], "Reverse axis")
+    return imp.emit("reverse", [ins[0]], {"axis": axis}, node.name)
+
+
+@_mapper("InvertPermutation")
+def _m_invert_permutation(imp, node, ins):
+    perm = imp._ints(ins[0], "InvertPermutation x")
+    inv = np.argsort(perm).astype(np.int32)
+    return _Val(const=inv, name=node.name)
+
+
+# --- reductions ------------------------------------------------------------
+_REDUCE = {"Mean": "reduce_mean", "Sum": "reduce_sum", "Max": "reduce_max",
+           "Min": "reduce_min", "Prod": "reduce_prod", "All": "reduce_all",
+           "Any": "reduce_any", "EuclideanNorm": "reduce_norm2"}
+
+
+def _make_reduce(reg_name):
+    def m(imp, node, ins):
+        axes_np = imp._const_np(ins[1], f"{node.op} reduction_indices")
+        axes = tuple(int(x) for x in axes_np.reshape(-1))
+        if axes_np.ndim > 0 and len(axes) == 0:
+            return ins[0]  # TF: empty axes list = identity
+        return imp.emit(reg_name, [ins[0]],
+                        {"axis": axes or None,
+                         "keep_dims": _attr_b(node, "keep_dims", False)},
+                        node.name)
+    return m
+
+
+for _tf, _reg in _REDUCE.items():
+    _MAPPERS[_tf] = _make_reduce(_reg)
+
+
+@_mapper("ArgMax")
+def _m_argmax(imp, node, ins):
+    axis = imp._int1(ins[1], "ArgMax dimension")
+    out = imp.emit("argmax", [ins[0]], {"axis": axis}, node.name + "/arg")
+    dt = tf_dtype_to_np(_attr_i(node, "output_type", 9))
+    return imp.emit("cast", out, {"dtype": str(dt)}, node.name)
+
+
+@_mapper("ArgMin")
+def _m_argmin(imp, node, ins):
+    axis = imp._int1(ins[1], "ArgMin dimension")
+    out = imp.emit("argmin", [ins[0]], {"axis": axis}, node.name + "/arg")
+    dt = tf_dtype_to_np(_attr_i(node, "output_type", 9))
+    return imp.emit("cast", out, {"dtype": str(dt)}, node.name)
+
+
+@_mapper("Cumsum")
+def _m_cumsum(imp, node, ins):
+    axis = imp._int1(ins[1], "Cumsum axis")
+    return imp.emit("cumsum", [ins[0]], {
+        "axis": axis, "exclusive": _attr_b(node, "exclusive"),
+        "reverse": _attr_b(node, "reverse")}, node.name)
+
+
+@_mapper("TopKV2")
+def _m_top_k(imp, node, ins):
+    k = imp._int1(ins[1], "TopKV2 k")
+    return imp.emit("top_k", [ins[0]],
+                    {"k": k, "sorted": _attr_b(node, "sorted", True)},
+                    node.name, n_outputs=2)
+
+
+@_mapper("SegmentSum")
+def _m_segment_sum(imp, node, ins):
+    seg = imp._const_np(ins[1], "SegmentSum segment_ids")
+    return imp.emit("segment_sum", ins,
+                    {"num_segments": int(seg.max()) + 1}, node.name)
+
+
+# ---------------------------------------------------------------------------
+def import_tf_graph(source: Union[str, bytes, GraphDef],
+                    trainable: Union[None, str, Callable] = None,
+                    input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                    ) -> SameDiff:
+    """Import a frozen TF GraphDef (.pb path, bytes, or decoded GraphDef)
+    into a runnable SameDiff graph.
+
+    Reference: TFGraphMapper.importGraph (TFGraphMapper.java:56) /
+    ImportGraph.importGraph (ImportGraph.kt:218).
+
+    trainable: None (all consts stay CONSTANT — inference),
+      "auto" (float consts of rank>=1 become trainable VARIABLEs), or a
+      predicate ``fn(node_name, np_array) -> bool``.
+    input_shapes: overrides for placeholder shapes (concrete batch dims
+      let Shape-derived reshapes fold statically).
+    """
+    if isinstance(source, (str, bytes)):
+        graph = GraphDef.from_file(source) if isinstance(source, str) \
+            else GraphDef(source)
+    else:
+        graph = source
+    return TFImporter(graph, trainable=trainable,
+                      input_shapes=input_shapes).run()
+
+
+def supported_tf_ops() -> List[str]:
+    """All mapped NodeDef op names (plus Const/Placeholder/NoOp handled
+    inline) — the coverage ledger for the importer."""
+    return sorted(set(_MAPPERS) | {"Const", "Placeholder",
+                                   "PlaceholderWithDefault", "NoOp"})

@@ -109,12 +109,8 @@ def tf_slice(x, begin, size):
     return jax.lax.slice(x, begin, tuple(b + s for b, s in zip(begin, size)))
 
 
-@op("tf_strided_slice", _C, n_inputs=4)
-def tf_strided_slice(x, begin, end, strides, begin_mask: int = 0,
-                     end_mask: int = 0, ellipsis_mask: int = 0,
-                     new_axis_mask: int = 0, shrink_axis_mask: int = 0):
-    """Full TF StridedSlice semantics with static begin/end/strides."""
-    begin, end, strides = _ints(begin), _ints(end), _ints(strides)
+def _strided_slice_index(begin, end, strides, begin_mask, end_mask,
+                         ellipsis_mask, new_axis_mask, shrink_axis_mask):
     idx = []
     for i in range(len(begin)):
         if ellipsis_mask & (1 << i):
@@ -127,12 +123,43 @@ def tf_strided_slice(x, begin, end, strides, begin_mask: int = 0,
             b = None if (begin_mask & (1 << i)) else begin[i]
             e = None if (end_mask & (1 << i)) else end[i]
             idx.append(slice(b, e, strides[i]))
-    return x[tuple(idx)]
+    return tuple(idx)
+
+
+@op("tf_strided_slice", _C, n_inputs=4)
+def tf_strided_slice(x, begin, end, strides, begin_mask: int = 0,
+                     end_mask: int = 0, ellipsis_mask: int = 0,
+                     new_axis_mask: int = 0, shrink_axis_mask: int = 0):
+    """Full TF StridedSlice semantics with static begin/end/strides."""
+    idx = _strided_slice_index(_ints(begin), _ints(end), _ints(strides),
+                               begin_mask, end_mask, ellipsis_mask,
+                               new_axis_mask, shrink_axis_mask)
+    return x[idx]
+
+
+@op("strided_slice_masked", _C, n_inputs=1)
+def strided_slice_masked(x, begin=(), end=(), strides=(), begin_mask: int = 0,
+                         end_mask: int = 0, ellipsis_mask: int = 0,
+                         new_axis_mask: int = 0, shrink_axis_mask: int = 0):
+    """tf_strided_slice with begin/end/strides as STATIC attrs — the TF
+    importer folds the structural inputs at import time and emits this,
+    keeping the traced graph free of trace-time np.asarray conversions."""
+    idx = _strided_slice_index(tuple(begin), tuple(end),
+                               tuple(strides) or (1,) * len(tuple(begin)),
+                               begin_mask, end_mask, ellipsis_mask,
+                               new_axis_mask, shrink_axis_mask)
+    return x[idx]
 
 
 @op("tf_gather", _C, n_inputs=3)
 def tf_gather(params, indices, axis, batch_dims: int = 0):
     return _gather_impl(params, indices, _int1(axis), batch_dims)
+
+
+@op("gather_batch_dims", _C, n_inputs=2)
+def gather_batch_dims(params, indices, axis: int = 0, batch_dims: int = 0):
+    """GatherV2 with static axis/batch_dims attrs (importer-emitted)."""
+    return _gather_impl(params, indices, axis, batch_dims)
 
 
 def _gather_impl(params, indices, axis, batch_dims):

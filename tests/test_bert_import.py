@@ -1,0 +1,127 @@
+"""BERT frozen-graph import: golden forward + fine-tune step.
+
+The generated GraphDef (zoo/bert.build_bert_graphdef) is decoded twice:
+once by the importer (graph under test) and once here to read the weight
+constants for an independent numpy reference forward pass.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.tf_pb import GraphDef
+from deeplearning4j_tpu.modelimport.tf_import import import_tf_graph
+from deeplearning4j_tpu.zoo.bert import (
+    BERT_TINY, BertConfig, bert_base, build_bert_graphdef)
+
+B, S = 2, 16
+
+
+@pytest.fixture(scope="module")
+def tiny_pb():
+    return build_bert_graphdef(BERT_TINY, batch=B, seq_len=S, seed=7)
+
+
+@pytest.fixture(scope="module")
+def weights(tiny_pb):
+    g = GraphDef(tiny_pb)
+    out = {}
+    for n in g.nodes:
+        if n.op == "Const":
+            out[n.name] = n.attrs["value"].tensor
+    return out
+
+
+def _np_layer_norm(x, gamma, beta, eps):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps) * gamma + beta
+
+
+def _np_gelu(x):
+    from scipy.special import erf
+    return x * 0.5 * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+def _np_bert_forward(cfg: BertConfig, w, input_ids, input_mask,
+                     token_type_ids):
+    H, A, D = cfg.hidden_size, cfg.num_heads, cfg.head_size
+    eps = cfg.layer_norm_eps
+    emb = w["bert/embeddings/word_embeddings"][input_ids]
+    oh = np.eye(cfg.type_vocab_size, dtype=np.float32)[token_type_ids]
+    emb = emb + oh @ w["bert/embeddings/token_type_embeddings"]
+    emb = emb + w["bert/embeddings/position_embeddings"][:S]
+    x = _np_layer_norm(emb, w["bert/embeddings/LayerNorm/gamma"],
+                       w["bert/embeddings/LayerNorm/beta"], eps)
+    adder = (1.0 - input_mask.astype(np.float32))[:, None, None, :] * -10000.0
+    x2 = x.reshape(B * S, H)
+
+    def dense(scope, t):
+        return t @ w[f"{scope}/kernel"] + w[f"{scope}/bias"]
+
+    for i in range(cfg.num_layers):
+        sc = f"bert/encoder/layer_{i}"
+        q = dense(f"{sc}/attention/self/query", x2)
+        k = dense(f"{sc}/attention/self/key", x2)
+        v = dense(f"{sc}/attention/self/value", x2)
+
+        def heads(t):
+            return t.reshape(B, S, A, D).transpose(0, 2, 1, 3)
+
+        qh, kh, vh = heads(q), heads(k), heads(v)
+        scores = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(D) + adder
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        ctx = (probs @ vh).transpose(0, 2, 1, 3).reshape(B * S, H)
+        attn = dense(f"{sc}/attention/output/dense", ctx) + x2
+        attn = _np_layer_norm(attn, w[f"{sc}/attention/output/LayerNorm/gamma"],
+                              w[f"{sc}/attention/output/LayerNorm/beta"], eps)
+        inter = _np_gelu(dense(f"{sc}/intermediate/dense", attn))
+        out = dense(f"{sc}/output/dense", inter) + attn
+        x2 = _np_layer_norm(out, w[f"{sc}/output/LayerNorm/gamma"],
+                            w[f"{sc}/output/LayerNorm/beta"], eps)
+    seq = x2.reshape(B, S, H)
+    pooled = np.tanh(dense("bert/pooler/dense", seq[:, 0]))
+    return seq, pooled
+
+
+def test_bert_tiny_forward_matches_numpy(tiny_pb, weights):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, BERT_TINY.vocab_size, (B, S)).astype(np.int32)
+    mask = np.ones((B, S), np.int32)
+    mask[0, S // 2:] = 0   # ragged mask exercises the additive bias
+    tt = np.zeros((B, S), np.int32)
+
+    sd = import_tf_graph(tiny_pb)
+    res = sd.output(
+        placeholders={"input_ids": ids, "input_mask": mask,
+                      "token_type_ids": tt},
+        outputs=["bert/encoder/sequence_output", "bert/pooler/output"])
+    got_seq = np.asarray(res["bert/encoder/sequence_output"].data)
+    got_pooled = np.asarray(res["bert/pooler/output"].data)
+
+    want_seq, want_pooled = _np_bert_forward(BERT_TINY, weights, ids, mask, tt)
+    np.testing.assert_allclose(got_seq, want_seq, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(got_pooled, want_pooled, rtol=1e-3, atol=1e-4)
+
+
+def test_bert_tiny_finetune_step():
+    from deeplearning4j_tpu.autodiff.training import TrainingConfig
+    from deeplearning4j_tpu.learning.updaters import Adam
+    sd = bert_base(BERT_TINY, batch=B, seq_len=S, num_labels=2, seed=7)
+    n_params = len(sd.trainable_params())
+    # 2 emb tables + pos + LN(g,b) + per-layer 16 + pooler 2 + classifier 2
+    assert n_params > 10
+    sd.training_config = TrainingConfig(
+        updater=Adam(1e-3),
+        data_set_feature_mapping=["input_ids", "input_mask",
+                                  "token_type_ids"],
+        data_set_label_mapping=["labels"])
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, BERT_TINY.vocab_size, (B, S)).astype(np.int32)
+    mask = np.ones((B, S), np.int32)
+    tt = np.zeros((B, S), np.int32)
+    labels = np.eye(2, dtype=np.float32)[rng.randint(0, 2, B)]
+    batch = ([ids, mask, tt], [labels])
+    h = sd.fit([batch] * 8, epochs=2)
+    losses = h.loss_curve.losses
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"fine-tune loss not decreasing: {losses}"
