@@ -107,7 +107,7 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256)):
             "batch": batch}
 
 
-def bench_resnet50(batch=128, steps=4, image=224, mixed_precision=True):
+def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
     """BASELINE config 3: zoo ResNet-50 training step, ImageNet shapes,
     bf16 mixed precision (f32 master params) at MXU-saturating batch."""
     from deeplearning4j_tpu.autodiff import MixedPrecision

@@ -81,12 +81,19 @@ class DeviceCachedIterator(DataSetIterator):
     def __init__(self, features, labels, batch_size: int = 32, sharding=None):
         import jax
         import jax.numpy as jnp
-        feats = [np.asarray(f) for f in features] \
-            if isinstance(features, (list, tuple)) else [np.asarray(features)]
-        labs = [np.asarray(l) for l in labels] \
-            if isinstance(labels, (list, tuple)) else [np.asarray(labels)]
-        self._multi_f = isinstance(features, (list, tuple))
-        self._multi_l = isinstance(labels, (list, tuple))
+        def _is_multi(v):
+            # multi-input = a list/tuple OF ARRAYS; nested python lists
+            # (e.g. [[1., 2.], [3., 4.]]) stay a single 2-d array exactly
+            # as np.asarray always treated them
+            return isinstance(v, (list, tuple)) and len(v) > 0 and \
+                all(hasattr(e, "ndim") for e in v)
+
+        self._multi_f = _is_multi(features)
+        self._multi_l = _is_multi(labels)
+        feats = [np.asarray(f) for f in features] if self._multi_f \
+            else [np.asarray(features)]
+        labs = [np.asarray(l) for l in labels] if self._multi_l \
+            else [np.asarray(labels)]
         lens = {len(a) for a in feats + labs}
         if len(lens) != 1:
             raise ValueError(

@@ -101,8 +101,12 @@ class _Ctx:
 
     def __init__(self):
         # (h, w, c) recorded when Flatten consumed spatial input; the next
-        # Dense kernel's rows get permuted HWC→CHW
+        # Dense kernel's rows get permuted HWC→CHW (only when the built
+        # network runs the NCHW layout internally)
         self.flatten_hwc: Optional[Tuple[int, int, int]] = None
+        # cnn_data_format of the configuration actually built — set by the
+        # import driver after .build(); weight setters read it
+        self.cnn_format: Optional[str] = None
 
 
 def _reject_unsupported(cfg: dict, layer_cls: str, checks: Dict[str, object]):
@@ -149,7 +153,10 @@ def _map_dense(cfg, ctx, itype):
 
     def setter(sd, stem, weights):
         w = weights[0]
-        if flat is not None:
+        if flat is not None and (ctx.cnn_format or "NHWC") == "NCHW":
+            # NCHW runtime flatten order is CHW; Keras kernels are HWC —
+            # permute rows. The NHWC runtime (default) flattens HWC
+            # already, exactly matching the Keras kernel row order.
             h, wd, c = flat
             w = (w.reshape(h, wd, c, -1).transpose(2, 0, 1, 3)
                  .reshape(h * wd * c, -1))
@@ -408,6 +415,7 @@ def _import_sequential(model_cfg: dict, archive: _H5Archive):
     for layer, _, _ in built:
         b = b.layer(layer)
     conf = b.set_input_type(_initial_itype(layers_cfg)).build()
+    ctx.cnn_format = conf.cnn_data_format
     net = MultiLayerNetwork(conf).init()
     _copy_weights(net, built, archive)
     return net
@@ -614,7 +622,9 @@ def _import_functional(model_cfg: dict, archive: _H5Archive):
         itypes[name] = layer.output_type(_adapt(src_itype, layer))
         built[name] = ("layer", layer, setter)
     g = g.set_outputs(*[_resolve_alias(built, o) for o in outputs])
-    net = ComputationGraph(g.build()).init()
+    gconf = g.build()
+    ctx.cnn_format = gconf.cnn_data_format
+    net = ComputationGraph(gconf).init()
     sd = net._sd_train
     for name, entry in built.items():
         if entry[0] == "layer" and entry[2] is not None:

@@ -83,6 +83,8 @@ class TFImporter:
             self._trainable = lambda name, arr: False
         self.placeholder_names: List[str] = []
         self.variable_names: List[str] = []
+        # PlaceholderWithDefault nodes bound to their constant default
+        self.placeholder_defaults: Dict[str, np.ndarray] = {}
 
     # ------------------------------------------------------------------
     def run(self) -> SameDiff:
@@ -206,6 +208,18 @@ class TFImporter:
             else:
                 self._set(node.name, [_Val(const=arr, name=node.name)])
             return
+        if op == "PlaceholderWithDefault":
+            # a static graph can't be "fed or defaulted" both ways; frozen-
+            # graph semantics (keep_prob flags etc.) want the default, so a
+            # constant default imports as that constant. The value is kept
+            # in placeholder_defaults so callers can see what was bound; a
+            # data-dependent default falls through to a real placeholder.
+            ins = self._ins(node)
+            if ins and ins[0].is_const:
+                self.placeholder_defaults[node.name] = np.asarray(ins[0].const)
+                self._set(node.name, [_Val(const=np.asarray(ins[0].const),
+                                           name=node.name)])
+                return
         if op in ("Placeholder", "PlaceholderWithDefault"):
             a = node.attr("shape")
             shape = self.input_shapes.get(node.name)
@@ -265,6 +279,17 @@ def _attr_s(node, name, default=""):
 def _attr_ilist(node, name, default=()):
     a = node.attr(name)
     return list(a.list["i"]) if a is not None else list(default)
+
+
+def _attr_type(node, name, default: int):
+    """DataType attr (Cast DstT, ArgMax output_type, Shape out_type, ...).
+
+    TF serializes these as AttrValue.type (field 6); graphs written by
+    tf_builder may carry a plain int (field 3) — accept both."""
+    a = node.attr(name)
+    if a is None:
+        return default
+    return a.type or a.i or default
 
 
 # --- passthrough / identity ------------------------------------------------
@@ -478,13 +503,13 @@ def _m_lrn(imp, node, ins):
 @_mapper("Shape")
 def _m_shape(imp, node, ins):
     shape = imp._static_shape(ins[0], node.name)
-    out_dt = tf_dtype_to_np(_attr_i(node, "out_type", 3))
+    out_dt = tf_dtype_to_np(_attr_type(node, "out_type", 3))
     return _Val(const=np.asarray(shape, dtype=out_dt), name=node.name)
 
 
 @_mapper("ShapeN")
 def _m_shape_n(imp, node, ins):
-    out_dt = tf_dtype_to_np(_attr_i(node, "out_type", 3))
+    out_dt = tf_dtype_to_np(_attr_type(node, "out_type", 3))
     return [_Val(const=np.asarray(imp._static_shape(v, node.name), out_dt))
             for v in ins]
 
@@ -672,7 +697,7 @@ def _m_broadcast_to(imp, node, ins):
 
 @_mapper("Cast")
 def _m_cast(imp, node, ins):
-    dst = tf_dtype_to_np(_attr_i(node, "DstT", 1))
+    dst = tf_dtype_to_np(_attr_type(node, "DstT", 1))
     return imp.emit("cast", ins, {"dtype": str(dst)}, node.name)
 
 
@@ -716,7 +741,7 @@ for _tf, _reg in _REDUCE.items():
 def _m_argmax(imp, node, ins):
     axis = imp._int1(ins[1], "ArgMax dimension")
     out = imp.emit("argmax", [ins[0]], {"axis": axis}, node.name + "/arg")
-    dt = tf_dtype_to_np(_attr_i(node, "output_type", 9))
+    dt = tf_dtype_to_np(_attr_type(node, "output_type", 9))
     return imp.emit("cast", out, {"dtype": str(dt)}, node.name)
 
 
@@ -724,7 +749,7 @@ def _m_argmax(imp, node, ins):
 def _m_argmin(imp, node, ins):
     axis = imp._int1(ins[1], "ArgMin dimension")
     out = imp.emit("argmin", [ins[0]], {"axis": axis}, node.name + "/arg")
-    dt = tf_dtype_to_np(_attr_i(node, "output_type", 9))
+    dt = tf_dtype_to_np(_attr_type(node, "output_type", 9))
     return imp.emit("cast", out, {"dtype": str(dt)}, node.name)
 
 

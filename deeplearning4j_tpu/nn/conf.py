@@ -43,6 +43,11 @@ class MultiLayerConfiguration:
     dtype: str = "float32"
     grad_clip_value: Optional[float] = None
     mixed_precision: Optional[MixedPrecision] = None
+    # internal cnn tensor layout; "NHWC" is TPU-native (12x conv speedup vs
+    # logical NCHW, see PROFILE.md). External API stays NCHW either way.
+    # from_json defaults to "NCHW" so checkpoints saved before this field
+    # existed keep their trained flatten-order weights valid.
+    cnn_data_format: str = "NHWC"
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
 
@@ -51,6 +56,7 @@ class MultiLayerConfiguration:
         return json.dumps({
             "seed": self.seed,
             "dtype": self.dtype,
+            "cnn_data_format": self.cnn_data_format,
             "grad_clip_value": self.grad_clip_value,
             "mixed_precision": (self.mixed_precision.to_json()
                                 if self.mixed_precision else None),
@@ -74,6 +80,7 @@ class MultiLayerConfiguration:
             regularization=[Regularization.from_json(r)
                             for r in d.get("regularization", [])],
             dtype=d.get("dtype", "float32"),
+            cnn_data_format=d.get("cnn_data_format", "NCHW"),
             grad_clip_value=d.get("grad_clip_value"),
             mixed_precision=MixedPrecision.from_json(d.get("mixed_precision")),
             gradient_normalization=d.get("gradient_normalization"),

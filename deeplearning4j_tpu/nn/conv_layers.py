@@ -106,11 +106,12 @@ class Convolution3DLayer(BaseLayer):
                            value=np.full((self.n_out,), self.bias_init),
                            dtype=ctx.dtype)
             inputs.append(b)
+        fmt3d = "NDHWC" if ctx.cnn_format == "NHWC" else "NCDHW"
         z = ctx.sd.invoke("conv3d", inputs,
                           {"strides": _as_triple(self.stride),
                            "padding": _pad_mode(self.convolution_mode),
                            "dilation": _as_triple(self.dilation),
-                           "data_format": "NCDHW"},
+                           "data_format": fmt3d},
                           name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
         return out, self.output_type(itype)
@@ -137,12 +138,13 @@ class Subsampling3DLayer(BaseLayer):
         lname = ctx.lname("pool3d")
         op = {"MAX": "max_pool3d", "AVG": "avg_pool3d"}[
             self.pooling_type.upper()]
+        fmt3d = "NDHWC" if ctx.cnn_format == "NHWC" else "NCDHW"
         out = ctx.sd.invoke(op, [x],
                             {"kernel": _as_triple(self.kernel_size),
                              "strides": _as_triple(self.stride
                                                    or self.kernel_size),
                              "padding": _pad_mode(self.convolution_mode),
-                             "data_format": "NCDHW"},
+                             "data_format": fmt3d},
                             name=lname)
         return out, self.output_type(itype)
 
@@ -188,7 +190,7 @@ class Deconvolution2DLayer(BaseLayer):
         z = ctx.sd.invoke("deconv2d", inputs,
                           {"strides": _as_pair(self.stride),
                            "padding": _pad_mode(self.convolution_mode),
-                           "data_format": "NCHW"},
+                           "data_format": ctx.cnn_format},
                           name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
         return out, self.output_type(itype)
@@ -235,7 +237,7 @@ class DepthwiseConvolution2DLayer(BaseLayer):
                           {"strides": _as_pair(self.stride),
                            "padding": _pad_mode(self.convolution_mode),
                            "dilation": _as_pair(self.dilation),
-                           "data_format": "NCHW"},
+                           "data_format": ctx.cnn_format},
                           name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
         return out, self.output_type(itype)
@@ -284,7 +286,7 @@ class SeparableConvolution2DLayer(BaseLayer):
                           {"strides": _as_pair(self.stride),
                            "padding": _pad_mode(self.convolution_mode),
                            "dilation": _as_pair(self.dilation),
-                           "data_format": "NCHW"},
+                           "data_format": ctx.cnn_format},
                           name=f"{lname}_z")
         out = apply_activation(ctx.sd, z, self.activation, lname)
         return out, self.output_type(itype)
@@ -313,7 +315,7 @@ class LocalResponseNormalization(BaseLayer):
         out = ctx.sd.invoke("lrn", [x],
                             {"depth": int(self.n) // 2, "bias": self.k,
                              "alpha": self.alpha, "beta": self.beta,
-                             "data_format": "NCHW"},
+                             "data_format": ctx.cnn_format},
                             name=lname)
         return out, itype
 
@@ -332,7 +334,7 @@ class Upsampling2DLayer(BaseLayer):
     def build(self, ctx, x, itype):
         out = ctx.sd.invoke("upsampling2d", [x],
                             {"factor": _as_pair(self.size),
-                             "data_format": "NCHW"},
+                             "data_format": ctx.cnn_format},
                             name=ctx.lname("upsample"))
         return out, self.output_type(itype)
 
@@ -350,10 +352,12 @@ class ZeroPaddingLayer(BaseLayer):
 
     def build(self, ctx, x, itype):
         t, b, l, r = self.padding
+        if ctx.cnn_format == "NHWC":
+            pads = ((0, 0), (t, b), (l, r), (0, 0))
+        else:
+            pads = ((0, 0), (0, 0), (t, b), (l, r))
         out = ctx.sd.invoke(
-            "pad", [x],
-            {"paddings": ((0, 0), (0, 0), (t, b), (l, r))},
-            name=ctx.lname("zeropad"))
+            "pad", [x], {"paddings": pads}, name=ctx.lname("zeropad"))
         return out, self.output_type(itype)
 
 
@@ -371,11 +375,14 @@ class Cropping2DLayer(BaseLayer):
     def build(self, ctx, x, itype):
         c, h, w = itype.dims
         t, b, l, r = self.cropping
+        big = 2**31 - 1
+        if ctx.cnn_format == "NHWC":
+            begin, end = (0, t, l, 0), (big, h - b, w - r, big)
+        else:
+            begin, end = (0, 0, t, l), (big, big, h - b, w - r)
         out = ctx.sd.invoke(
             "strided_slice", [x],
-            {"begin": (0, 0, t, l), "end": (2**31 - 1, 2**31 - 1,
-                                            h - b, w - r),
-             "strides": (1, 1, 1, 1)},
+            {"begin": begin, "end": end, "strides": (1, 1, 1, 1)},
             name=ctx.lname("crop"))
         return out, self.output_type(itype)
 

@@ -73,7 +73,12 @@ class MergeVertex(GraphVertex):
         raise ValueError(kind)
 
     def build(self, ctx, xs, itypes):
-        axis = 1 if itypes[0].kind in ("ff", "cnn") else 2
+        if itypes[0].kind == "cnn" and ctx.cnn_format == "NHWC":
+            axis = 3          # channels-last runtime layout
+        elif itypes[0].kind in ("ff", "cnn"):
+            axis = 1
+        else:
+            axis = 2
         out = ctx.sd.invoke("concat", xs, {"axis": axis},
                             name=ctx.lname("merge"))
         return out, self.output_type(itypes)
@@ -135,8 +140,12 @@ class SubsetVertex(GraphVertex):
         x = xs[0]
         t = itypes[0]
         big = 2 ** 31 - 1
-        # feature axis: 1 for ff/cnn (NCHW channels), 2 for rnn (B, T, C)
-        if t.kind in ("ff", "cnn"):
+        # feature axis: 1 for ff / cnn-NCHW, 3 for cnn-NHWC runtime
+        # tensors, 2 for rnn (B, T, C)
+        if t.kind == "cnn" and ctx.cnn_format == "NHWC":
+            begin = (0, 0, 0, self.from_idx)
+            end = (big, big, big, self.to_idx + 1)
+        elif t.kind in ("ff", "cnn"):
             ndim = 2 if t.kind == "ff" else 4
             begin = (0, self.from_idx) + (0,) * (ndim - 2)
             end = (big, self.to_idx + 1) + (big,) * (ndim - 2)
@@ -225,10 +234,13 @@ class ComputationGraphConfiguration:
     regularization: Sequence[Regularization] = ()
     dtype: str = "float32"
     mixed_precision: Optional[object] = None    # MixedPrecision policy
+    # internal cnn layout ("NHWC" = TPU-native; see MultiLayerConfiguration)
+    cnn_data_format: str = "NHWC"
 
     def to_json(self) -> str:
         return json.dumps({
             "seed": self.seed, "dtype": self.dtype,
+            "cnn_data_format": self.cnn_data_format,
             "mixed_precision": (self.mixed_precision.to_json()
                                 if self.mixed_precision else None),
             "updater": self.updater.to_json(),
@@ -258,6 +270,7 @@ class ComputationGraphConfiguration:
             regularization=[Regularization.from_json(r)
                             for r in d.get("regularization", [])],
             dtype=d.get("dtype", "float32"),
+            cnn_data_format=d.get("cnn_data_format", "NCHW"),
             mixed_precision=MixedPrecision.from_json(
                 d.get("mixed_precision")))
 
@@ -343,15 +356,20 @@ class GraphBuilder:
 def _build_graph(conf: ComputationGraphConfiguration, training: bool):
     """Returns (sd, label placeholder names in conf.outputs order,
     node name -> actual graph variable name map)."""
-    from deeplearning4j_tpu.nn.multilayer import _adapt_input
+    from deeplearning4j_tpu.nn.multilayer import (
+        _adapt_input, _to_external_layout, _to_internal_layout)
     sd = SameDiff()
     rng = np.random.default_rng(conf.seed)
-    ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype)
+    fmt = getattr(conf, "cnn_data_format", "NHWC")
+    ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype,
+                       cnn_format=fmt)
     vars_: Dict[str, object] = {}
     types_: Dict[str, InputType] = {}
     for name, itype in zip(conf.inputs, conf.input_types):
-        vars_[name] = sd.placeholder(name, shape=itype.placeholder_shape(),
-                                     dtype=conf.dtype)
+        ph = sd.placeholder(name, shape=itype.placeholder_shape(),
+                            dtype=conf.dtype)
+        vars_[name] = _to_internal_layout(sd, ph, itype, fmt,
+                                          f"{name}_nhwc")
         types_[name] = itype
 
     labels_of: Dict[str, str] = {}   # loss node name -> labels placeholder
@@ -379,6 +397,12 @@ def _build_graph(conf: ComputationGraphConfiguration, training: bool):
         # would corrupt the upstream name
         vars_[node.name] = out
         types_[node.name] = otype
+
+    # cnn-typed graph outputs go back to the external NCHW contract
+    for oname in conf.outputs:
+        if types_[oname].kind in ("cnn", "cnn3d"):
+            vars_[oname] = _to_external_layout(
+                sd, vars_[oname], types_[oname], fmt, f"{oname}_nchw")
 
     # labels in conf.outputs order first (matches user-supplied label
     # lists), then any non-output loss heads in node order

@@ -159,6 +159,13 @@ class BuildContext:
     labels_var: object = None       # labels placeholder (for loss heads)
     output_var: object = None       # set by the output layer
     loss_var: object = None         # set by the output layer
+    # runtime layout for cnn tensors. InputType dims stay (c, h, w) and the
+    # network's EXTERNAL contract stays NCHW (reference convention; users
+    # feed/receive NCHW) — but internally the compiled graph runs NHWC:
+    # logical-NCHW convs on TPU force physical transposes of every
+    # activation, measured 12x slower than the same net in NHWC (see
+    # PROFILE.md). One permute at the network input; zero in the body.
+    cnn_format: str = "NHWC"
 
     def lname(self, kind: str) -> str:
         """Parameter/op name stem: vertex name in graph builds, layer index
@@ -281,7 +288,7 @@ class ConvolutionLayer(BaseLayer):
         attrs = {"strides": _as_pair(self.stride),
                  "padding": _pad_mode(self.convolution_mode),
                  "dilation": _as_pair(self.dilation),
-                 "data_format": "NCHW"}
+                 "data_format": ctx.cnn_format}
         if self.has_bias:
             b = ctx.sd.var(f"{lname}_b",
                            value=np.full((self.n_out,), self.bias_init),
@@ -317,7 +324,7 @@ class SubsamplingLayer(BaseLayer):
         attrs = {"kernel": _as_pair(self.kernel_size),
                  "strides": _as_pair(self.stride or self.kernel_size),
                  "padding": _pad_mode(self.convolution_mode),
-                 "data_format": "NCHW"}
+                 "data_format": ctx.cnn_format}
         if self.pooling_type.upper() == "PNORM":
             attrs["pnorm"] = self.pnorm
         out = ctx.sd.invoke(op, [x], attrs, name=lname)
@@ -344,8 +351,14 @@ class BatchNormalization(BaseLayer):
                           dtype=ctx.dtype)
         mean = ctx.state(f"{lname}_mean", np.zeros((n,)))
         var = ctx.state(f"{lname}_var", np.ones((n,)))
-        # feature axis: 1 for NCHW / (B, n); 2 for (B, T, C) sequences
-        axis = 2 if itype.kind == "rnn" else 1
+        # feature axis: 2 for (B, T, C) sequences; -1 for NHWC cnn tensors;
+        # 1 for NCHW / (B, n)
+        if itype.kind == "rnn":
+            axis = 2
+        elif itype.kind in ("cnn", "cnn3d") and ctx.cnn_format.endswith("C"):
+            axis = -1
+        else:
+            axis = 1
         if ctx.training:
             out, new_mean, new_var = ctx.sd.invoke(
                 "batchnorm_train", [x, gamma, beta, mean, var],
@@ -443,7 +456,10 @@ class GlobalPoolingLayer(BaseLayer):
     def build(self, ctx, x, itype):
         self.output_type(itype)  # validate input kind
         lname = ctx.lname("gpool")
-        axis = {"cnn": (2, 3), "cnn3d": (2, 3, 4), "rnn": (1,)}[itype.kind]
+        if itype.kind in ("cnn", "cnn3d") and ctx.cnn_format.endswith("C"):
+            axis = {"cnn": (1, 2), "cnn3d": (1, 2, 3)}[itype.kind]
+        else:
+            axis = {"cnn": (2, 3), "cnn3d": (2, 3, 4), "rnn": (1,)}[itype.kind]
         opname = {"AVG": "reduce_mean", "MAX": "reduce_max",
                   "SUM": "reduce_sum"}[self.pooling_type.upper()]
         out = ctx.sd.invoke(opname, [x], {"axis": axis}, name=lname)

@@ -101,22 +101,44 @@ def _final_output_type(conf: MultiLayerConfiguration) -> InputType:
     return itype
 
 
+def _to_internal_layout(sd, x, itype: InputType, fmt: str, name: str):
+    """Users feed NCHW (reference convention); internally cnn tensors run
+    NHWC on TPU (one permute here, none in the network body — logical-NCHW
+    convs cost a physical transpose per op on TPU, see PROFILE.md)."""
+    if fmt != "NHWC" or itype.kind not in ("cnn", "cnn3d"):
+        return x
+    axes = (0, 2, 3, 1) if itype.kind == "cnn" else (0, 2, 3, 4, 1)
+    return sd.invoke("permute", [x], {"axes": axes}, name=name)
+
+
+def _to_external_layout(sd, x, itype: InputType, fmt: str, name: str):
+    """Inverse of _to_internal_layout for cnn-typed network outputs."""
+    if fmt != "NHWC" or itype.kind not in ("cnn", "cnn3d"):
+        return x
+    axes = (0, 3, 1, 2) if itype.kind == "cnn" else (0, 4, 1, 2, 3)
+    return sd.invoke("permute", [x], {"axes": axes}, name=name)
+
+
 def _build_graph(conf: MultiLayerConfiguration, training: bool):
     sd = SameDiff()
     rng = np.random.default_rng(conf.seed)
-    ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype)
+    fmt = getattr(conf, "cnn_data_format", "NHWC")
+    ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype,
+                       cnn_format=fmt)
     x = sd.placeholder("input", shape=conf.input_type.placeholder_shape(),
                        dtype=conf.dtype)
     final = _final_output_type(conf)
     ctx.labels_var = sd.placeholder("labels", shape=final.placeholder_shape(),
                                     dtype=conf.dtype)
-    cur, itype = x, conf.input_type
+    cur = _to_internal_layout(sd, x, conf.input_type, fmt, "input_nhwc")
+    itype = conf.input_type
     for idx, layer in enumerate(conf.layers):
         cur, itype = _adapt_input(sd, cur, itype, layer, idx)
         ctx.idx = idx
         cur, itype = layer.build(ctx, cur, itype)
     if ctx.output_var is None:
-        ctx.output_var = cur
+        ctx.output_var = _to_external_layout(sd, cur, itype, fmt,
+                                             "output_nchw")
     ctx.output_var.rename("output")
     return sd, ctx
 

@@ -338,8 +338,14 @@ def batchnorm_train(x, gamma, beta, running_mean, running_var,
     red = tuple(i for i in range(x.ndim) if i != axis)
     lowp = x.dtype in (jnp.bfloat16, jnp.float16)
     xf = x.astype(jnp.float32) if lowp else x
+    # ONE-PASS moments: jnp.var is two-pass (read x for the mean, re-read
+    # for (x-mean)^2) — profiled at ~30% of the ResNet-50 step as
+    # subtract_subtract/convert_reduce fusions. Sibling mean reductions
+    # fuse into a single multi-output fusion that reads x from HBM once;
+    # E[x^2]-E[x]^2 in f32 is plenty for normalization statistics.
     mean = jnp.mean(xf, axis=red)                 # convert fused into reduce
-    var = jnp.var(xf, axis=red)
+    m2 = jnp.mean(xf * xf, axis=red)
+    var = jnp.maximum(m2 - mean * mean, 0.0)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     inv = lax.rsqrt(var + epsilon)
@@ -360,9 +366,18 @@ def layer_norm(x, gamma, beta=None, axis=-1, epsilon: float = 1e-5):
     """Layer norm (reference: generic/nn/layer_norm.cpp — standardize +
     scale + optional shift)."""
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
-    mean = jnp.mean(x, axis=ax, keepdims=True)
-    var = jnp.var(x, axis=ax, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + epsilon) * gamma
+    # one-pass moments (see batchnorm_train): sibling means fuse into one
+    # read of x; avoids jnp.var's second full pass. Statistics in f32 —
+    # E[x^2]-E[x]^2 cancels catastrophically in bf16 when |mean| >> std;
+    # XLA fuses the convert into the reduces so x is still read once in
+    # its own dtype and no f32 copy is materialized.
+    lowp = x.dtype in (jnp.bfloat16, jnp.float16)
+    xf = x.astype(jnp.float32) if lowp else x
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    m2 = jnp.mean(xf * xf, axis=ax, keepdims=True)
+    var = jnp.maximum(m2 - mean * mean, 0.0)
+    inv = lax.rsqrt(var + epsilon)
+    out = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) * gamma
     if beta is not None:
         out = out + beta
     return out

@@ -13,12 +13,17 @@ from deeplearning4j_tpu.parallel.trainer import (
     ParallelInference, ParallelTrainer)
 from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention, ulysses_attention)
-from deeplearning4j_tpu.parallel import collectives
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_forward, pipeline_train_step, place_stage_params,
+    sequential_forward, split_microbatches)
+from deeplearning4j_tpu.parallel import collectives, multihost
 
 __all__ = [
     "DeviceMesh", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
     "ShardingRule", "ShardingStrategy", "data_parallel",
     "data_and_tensor_parallel", "tensor_parallel_rules",
     "ParallelTrainer", "ParallelInference", "ring_attention",
-    "ulysses_attention", "collectives",
+    "ulysses_attention", "collectives", "multihost",
+    "pipeline_forward", "pipeline_train_step", "place_stage_params",
+    "sequential_forward", "split_microbatches",
 ]

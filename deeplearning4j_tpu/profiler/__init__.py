@@ -1,0 +1,17 @@
+"""Profiler subsystem (reference: nd4j linalg/profiler — OpProfiler.java:41,
+UnifiedProfiler.java:40, EventLogger.java:74).
+
+TPU-native redesign: per-op timing comes from the XLA/TPU runtime trace
+(jax.profiler XSpace), not from dispatch hooks — under whole-graph jit
+there is no per-op dispatch to hook. ``ProfilerSession`` wraps trace
+capture; ``xplane`` decodes the artifact; ``OpProfile`` reports per-op /
+per-category device time.
+"""
+from deeplearning4j_tpu.profiler.session import OpProfile, ProfilerSession
+from deeplearning4j_tpu.profiler.xplane import (
+    OpTime, category_times, decode_xspace, device_op_times, load_xspace,
+    step_times_ms)
+
+__all__ = ["ProfilerSession", "OpProfile", "OpTime", "decode_xspace",
+           "load_xspace", "device_op_times", "category_times",
+           "step_times_ms"]

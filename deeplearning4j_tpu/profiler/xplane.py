@@ -1,0 +1,180 @@
+"""XPlane (jax.profiler / XLA trace) decoder.
+
+Reference parity: OpProfiler (nd4j-api/.../linalg/profiler/OpProfiler.java:41)
+aggregates per-op invocation counts/timings via executioner hooks;
+UnifiedProfiler (UnifiedProfiler.java:40) logs op events for offline
+analysis by contrib/unified-profiler-analyzer. On TPU the runtime already
+emits the authoritative trace — XLA's XSpace protobuf written by
+``jax.profiler.start_trace`` — so the profiler's job is decoding and
+aggregating it, not hooking dispatch.
+
+Schema constants are the frozen public fields of
+tensorflow/tsl/profiler/protobuf/xplane.proto:
+  XSpace:  planes=1
+  XPlane:  id=1 name=2 lines=3 event_metadata=4(map: key=1,value=2)
+           stat_metadata=5
+  XLine:   id=1 name=2 timestamp_ns=3 events=4
+  XEvent:  metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+  XEventMetadata: id=1 name=2 metadata=3 display_name=4
+  XStat:   metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+  XStatMetadata:  id=1 name=2
+Decoded with the same wire-format decoder the TF model importer uses
+(modelimport/protowire.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from deeplearning4j_tpu.modelimport.protowire import Fields
+
+
+@dataclasses.dataclass
+class XEvent:
+    name: str
+    offset_ps: int
+    duration_ps: int
+    stats: Dict[str, object]
+
+
+@dataclasses.dataclass
+class XLine:
+    name: str
+    events: List[XEvent]
+
+
+@dataclasses.dataclass
+class XPlane:
+    name: str
+    lines: List[XLine]
+
+
+def _decode_stat(stat: Fields, stat_meta: Dict[int, str]) -> Tuple[str, object]:
+    name = stat_meta.get(stat.varint(1), str(stat.varint(1)))
+    if stat.has(2):
+        return name, stat.f64(2)
+    if stat.has(3):
+        return name, stat.varint(3)
+    if stat.has(4):
+        return name, stat.svarint(4)
+    if stat.has(5):
+        return name, stat.string(5)
+    if stat.has(6):
+        return name, stat.bytes_(6)
+    if stat.has(7):
+        return name, stat.varint(7)
+    return name, None
+
+
+def decode_xspace(data: bytes) -> List[XPlane]:
+    space = Fields(data)
+    planes = []
+    for pf in space.repeated_message(1):
+        ev_meta: Dict[int, Fields] = {}
+        for entry in pf.repeated_message(4):
+            val = entry.message(2)
+            if val is not None:
+                ev_meta[entry.varint(1)] = val
+        stat_meta: Dict[int, str] = {}
+        for entry in pf.repeated_message(5):
+            val = entry.message(2)
+            if val is not None:
+                stat_meta[entry.varint(1)] = val.string(2)
+        ev_names = {mid: m.string(2) for mid, m in ev_meta.items()}
+        lines = []
+        for lf in pf.repeated_message(3):
+            events = []
+            for ef in lf.repeated_message(4):
+                stats = dict(_decode_stat(s, stat_meta)
+                             for s in ef.repeated_message(4))
+                events.append(XEvent(
+                    name=ev_names.get(ef.varint(1), ""),
+                    offset_ps=ef.varint(2),
+                    duration_ps=ef.varint(3),
+                    stats=stats))
+            lines.append(XLine(name=lf.string(2), events=events))
+        planes.append(XPlane(name=pf.string(2), lines=lines))
+    return planes
+
+
+def load_xspace(path: str) -> List[XPlane]:
+    with open(path, "rb") as fh:
+        return decode_xspace(fh.read())
+
+
+@dataclasses.dataclass
+class OpTime:
+    """Aggregated device time for one op (XLA fusion/instruction)."""
+    name: str
+    count: int = 0
+    total_ps: int = 0
+    category: str = ""
+
+    @property
+    def total_ms(self) -> float:
+        return self.total_ps / 1e9
+
+
+def _op_category(ev: XEvent) -> str:
+    cat = ev.stats.get("hlo_category")
+    if cat:
+        return str(cat)
+    # optimized-HLO instruction names follow '%<opcode>.<n> = ...'
+    nm = ev.name
+    if nm.startswith("%"):
+        head = nm[1:].split(" ", 1)[0]
+        return head.rsplit(".", 1)[0]
+    return ""
+
+
+def device_op_times(planes: List[XPlane],
+                    include_async: bool = False) -> List[OpTime]:
+    """Per-op device time from the synchronous 'XLA Ops' trace line of each
+    device plane ('/device:TPU:N'). The 'Async XLA Ops' line records
+    copy-start/done pairs whose durations OVERLAP compute — excluded by
+    default (they would double-count the timeline); pass include_async=True
+    to see them (labelled 'async:').
+    """
+    agg: Dict[str, OpTime] = {}
+
+    def _add(ev: XEvent, prefix=""):
+        key = prefix + ev.name
+        o = agg.setdefault(key, OpTime(name=key))
+        o.count += 1
+        o.total_ps += ev.duration_ps
+        if not o.category:
+            o.category = prefix + _op_category(ev)
+
+    for plane in planes:
+        if "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                for ev in line.events:
+                    _add(ev)
+            elif include_async and line.name == "Async XLA Ops":
+                for ev in line.events:
+                    _add(ev, prefix="async:")
+    return sorted(agg.values(), key=lambda o: -o.total_ps)
+
+
+def step_times_ms(planes: List[XPlane]) -> List[float]:
+    """Device step durations from the 'Steps' line (one entry per traced
+    step)."""
+    out = []
+    for plane in planes:
+        if "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name == "Steps":
+                out.extend(e.duration_ps / 1e9 for e in line.events)
+    return out
+
+
+def category_times(op_times: List[OpTime]) -> Dict[str, float]:
+    """Total ms per hlo_category (convolution / fusion / copy / ...)."""
+    out: Dict[str, float] = {}
+    for o in op_times:
+        cat = o.category or "(uncategorized)"
+        out[cat] = out.get(cat, 0.0) + o.total_ms
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))

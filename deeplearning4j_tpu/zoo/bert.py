@@ -152,7 +152,7 @@ class _BertGraphBuilder:
 
         # --- attention mask: (1 - mask) * -10000, [B,1,1,S] ---------------
         mask_f = b.node("Cast", "bert/encoder/mask_cast", "input_mask",
-                        DstT=1)
+                        DstT=("dtype", 1))     # AttrValue.type, as TF writes it
         mask_r = b.node("Reshape", "bert/encoder/mask_reshape", mask_f,
                         self._c([B, 1, 1, S]))
         one = self._c(1.0, np.float32)

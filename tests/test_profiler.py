@@ -1,0 +1,90 @@
+"""Profiler subsystem: xplane decoding + op aggregation.
+
+The xplane fixture is synthesized with the protowire-inverse encoder
+(tf_builder's primitives target the same wire format), so decoding is
+tested against real protobuf bytes without needing a TPU trace in CI.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.tf_builder import (
+    field_bytes, field_string, field_varint)
+from deeplearning4j_tpu.profiler import (
+    OpProfile, decode_xspace, device_op_times, step_times_ms)
+
+
+def _xevent(metadata_id, offset_ps, duration_ps):
+    return (field_varint(1, metadata_id) + field_varint(2, offset_ps)
+            + field_varint(3, duration_ps))
+
+
+def _xline(name, events):
+    out = field_string(2, name)
+    for e in events:
+        out += field_bytes(4, e)
+    return out
+
+
+def _event_meta(mid, name):
+    md = field_varint(1, mid) + field_string(2, name)   # XEventMetadata
+    entry = field_varint(1, mid) + field_bytes(2, md)   # map entry k=1,v=2
+    return field_bytes(4, entry)                        # XPlane field 4
+
+
+def _xplane(name, lines, ev_meta):
+    out = field_string(2, name)
+    for m in ev_meta:
+        out += m
+    for l in lines:
+        out += field_bytes(3, l)
+    return out
+
+
+def _make_space():
+    meta = [
+        _event_meta(1, "%fusion.1 = bf16[8,8] fusion(...)"),
+        _event_meta(2, "%convolution.7 = bf16[8,8] convolution(...)"),
+        _event_meta(3, "2"),
+    ]
+    # metadata entries are field 4 of XPlane; events reference them
+    ops_line = _xline("XLA Ops", [
+        _xevent(1, 0, 5_000_000_000), _xevent(2, 5_000_000_000, 2_000_000_000),
+        _xevent(1, 8_000_000_000, 5_000_000_000)])
+    async_line = _xline("Async XLA Ops", [_xevent(2, 0, 50_000_000_000)])
+    steps_line = _xline("Steps", [_xevent(3, 0, 12_000_000_000)])
+    plane = _xplane("/device:TPU:0", [ops_line, async_line, steps_line], meta)
+    host_plane = _xplane("/host:CPU", [_xline("python", [_xevent(1, 0, 9)])],
+                         meta)
+    return field_bytes(1, plane) + field_bytes(1, host_plane)
+
+
+def test_decode_and_aggregate():
+    planes = decode_xspace(_make_space())
+    assert [p.name for p in planes] == ["/device:TPU:0", "/host:CPU"]
+    ops = device_op_times(planes)
+    # host plane and async line excluded; 2 distinct ops
+    assert len(ops) == 2
+    top = ops[0]
+    assert top.name.startswith("%fusion.1")
+    assert top.count == 2
+    assert abs(top.total_ms - 10.0) < 1e-9
+    assert top.category == "fusion"
+    assert ops[1].category == "convolution"
+
+
+def test_async_line_opt_in():
+    planes = decode_xspace(_make_space())
+    ops = device_op_times(planes, include_async=True)
+    names = [o.name for o in ops]
+    assert any(n.startswith("async:") for n in names)
+
+
+def test_step_times_and_report():
+    planes = decode_xspace(_make_space())
+    steps = step_times_ms(planes)
+    assert steps == [12.0]
+    prof = OpProfile(device_op_times(planes))
+    rep = prof.report(top=5)
+    assert "fusion" in rep and "ms" in rep
+    assert abs(prof.total_ms() - 12.0) < 1e-9
+    cats = prof.by_category()
+    assert abs(cats["fusion"] - 10.0) < 1e-9

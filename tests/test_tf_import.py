@@ -257,3 +257,33 @@ def test_erf_gelu_pattern():
     from scipy.special import erf as sperf  # scipy ships with numpy stack
     want = x * 0.5 * (1 + sperf(x / np.sqrt(2)))
     np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5)
+
+
+def test_dtype_attrs_in_tf_native_encoding():
+    """Real TF GraphDefs encode Cast DstT / ArgMax output_type as
+    AttrValue.type (field 6), not as a plain int — both must import."""
+    b = GraphDefBuilder()
+    b.placeholder("x", shape=[2, 3])
+    b.node("Cast", "xi", "x", DstT=("dtype", 3))          # -> int32
+    b.const("dim", np.array(1, np.int32))
+    b.node("ArgMax", "am", "x", "dim", output_type=("dtype", 3))
+    x = np.array([[1.5, -2.0, 3.25], [0.5, 5.0, 2.0]], np.float32)
+    got = _run(b.build(), {"x": x}, ["xi", "am"])
+    assert got["xi"].dtype == np.int32
+    np.testing.assert_allclose(got["xi"], x.astype(np.int32))
+    assert got["am"].dtype == np.int32
+    np.testing.assert_allclose(got["am"], [2, 1])
+
+
+def test_placeholder_with_default_uses_const_default():
+    b = GraphDefBuilder()
+    b.placeholder("x", shape=[2, 2])
+    b.const("kp_default", np.array(0.75, np.float32))
+    b.raw_node("keep_prob", "PlaceholderWithDefault", ["kp_default"],
+               {"dtype": ("dtype", 1), "shape": ("shape", [])})
+    b.node("Mul", "out", "x", "keep_prob")
+    x = np.ones((2, 2), np.float32)
+    sd = import_tf_graph(b.build())
+    # evaluates WITHOUT feeding keep_prob (TF default semantics)
+    res = sd.output(placeholders={"x": x}, outputs=["out"])
+    np.testing.assert_allclose(np.asarray(res["out"].data), x * 0.75)
