@@ -1,0 +1,27 @@
+"""ETL subsystem (reference: datavec — datavec-api RecordReader/
+TransformProcess/Schema + datavec-data-image, SURVEY.md §2.4).
+
+TPU-native redesign: transforms execute as vectorized numpy passes over
+columnar arrays (not a row-of-Writables interpreter) and feed
+device-stacked batches; images decode to HWC float32 with no native
+binding layer.
+"""
+from deeplearning4j_tpu.etl.schema import (
+    CATEGORICAL, FLOAT, INTEGER, STRING, TIME, ColumnMeta, Schema,
+    columnar, to_rows)
+from deeplearning4j_tpu.etl.records import (
+    CollectionRecordReader, CSVRecordReader, ImageRecordReader,
+    LineRecordReader, RecordReader)
+from deeplearning4j_tpu.etl.transform import (
+    ColumnAnalysis, DataAnalysis, TransformProcess, analyze)
+from deeplearning4j_tpu.etl.iterator import (
+    ImageRecordReaderDataSetIterator, RecordReaderDataSetIterator)
+
+__all__ = [
+    "Schema", "ColumnMeta", "columnar", "to_rows",
+    "INTEGER", "FLOAT", "CATEGORICAL", "STRING", "TIME",
+    "RecordReader", "CSVRecordReader", "LineRecordReader",
+    "CollectionRecordReader", "ImageRecordReader",
+    "TransformProcess", "analyze", "DataAnalysis", "ColumnAnalysis",
+    "RecordReaderDataSetIterator", "ImageRecordReaderDataSetIterator",
+]

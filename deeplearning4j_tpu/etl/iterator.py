@@ -1,0 +1,145 @@
+"""RecordReaderDataSetIterator: records -> training batches.
+
+Reference parity: deeplearning4j-datavec-iterators
+RecordReaderDataSetIterator.java:54 — wraps a RecordReader (+ optional
+TransformProcess), splits each record into features/labels (label column
+index, one-hot for classification), and yields minibatches a network's
+fit() consumes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.etl.records import ImageRecordReader, RecordReader
+from deeplearning4j_tpu.etl.schema import Schema
+from deeplearning4j_tpu.etl.transform import TransformProcess
+
+
+class RecordReaderDataSetIterator:
+    """Tabular records -> (features, labels) batches.
+
+    label_column: name (with schema/transform) or index of the label.
+    num_classes: one-hot width for classification; None = regression
+    (label kept as float column).
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_column=None, num_classes: Optional[int] = None,
+                 transform_process: Optional[TransformProcess] = None,
+                 schema: Optional[Schema] = None,
+                 shuffle: bool = False, seed: Optional[int] = None):
+        self._reader = reader
+        self._tp = transform_process
+        self._schema = schema or (transform_process.initial_schema
+                                  if transform_process else None)
+        self._batch = int(batch_size)
+        self._label = label_column
+        self._num_classes = num_classes
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._cache = None
+
+    def reset(self):
+        if hasattr(self._reader, "reset"):
+            self._reader.reset()
+        self._cache = None
+
+    def _matrix(self):
+        if self._cache is not None:
+            return self._cache
+        if self._tp is not None:
+            cols = self._tp.execute_columnar(self._reader)
+            names = self._tp.final_schema().names()
+        elif self._schema is not None:
+            from deeplearning4j_tpu.etl.schema import columnar
+            cols = columnar(self._schema, list(self._reader))
+            names = self._schema.names()
+        else:
+            rows = [list(map(float, r)) for r in self._reader]
+            arr = np.asarray(rows, np.float32)
+            names = [str(i) for i in range(arr.shape[1])]
+            cols = {n: arr[:, i] for i, n in enumerate(names)}
+        if isinstance(self._label, int):
+            label_name = names[self._label]
+        else:
+            label_name = self._label
+        feat_names = [n for n in names if n != label_name]
+        feats = np.stack([cols[n].astype(np.float32) for n in feat_names],
+                         axis=1)
+        if label_name is None:
+            labels = None
+        else:
+            lab = cols[label_name]
+            if self._num_classes is not None:
+                labels = np.eye(self._num_classes, dtype=np.float32)[
+                    lab.astype(np.int64)]
+            else:
+                labels = lab.astype(np.float32).reshape(-1, 1)
+        self._cache = (feats, labels)
+        return self._cache
+
+    def __iter__(self):
+        feats, labels = self._matrix()
+        idx = np.arange(len(feats))
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        # final partial batch included (reference
+        # RecordReaderDataSetIterator behavior)
+        for i in range(0, len(idx), self._batch):
+            sel = idx[i:i + self._batch]
+            yield (feats[sel], labels[sel] if labels is not None
+                   else feats[sel])
+
+    def all_data(self):
+        return self._matrix()
+
+
+class ImageRecordReaderDataSetIterator:
+    """Image-directory records -> (NCHW float images, one-hot labels)
+    batches (reference: RecordReaderDataSetIterator over an
+    ImageRecordReader + ImagePreProcessingScaler semantics via ``scale``).
+    """
+
+    def __init__(self, reader: ImageRecordReader, batch_size: int,
+                 scale: float = 1.0 / 255.0, shuffle: bool = False,
+                 seed: Optional[int] = None):
+        self._reader = reader
+        self._batch = int(batch_size)
+        self._scale = scale
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._cache = None
+
+    @property
+    def labels(self) -> List[str]:
+        return self._reader.labels
+
+    def num_classes(self) -> int:
+        return len(self._reader.labels)
+
+    def reset(self):
+        self._cache = None
+
+    def _load_all(self):
+        if self._cache is not None:
+            return self._cache
+        table = {lab: i for i, lab in enumerate(self._reader.labels)}
+        xs, ys = [], []
+        for img, lab in self._reader:
+            xs.append(np.transpose(img, (2, 0, 1)) * self._scale)  # HWC->CHW
+            ys.append(table[lab])
+        X = np.stack(xs).astype(np.float32)
+        Y = np.eye(len(table), dtype=np.float32)[np.asarray(ys, np.int64)]
+        self._cache = (X, Y)
+        return self._cache
+
+    def __iter__(self):
+        X, Y = self._load_all()
+        idx = np.arange(len(X))
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        for i in range(0, len(idx), self._batch):   # incl. partial tail
+            sel = idx[i:i + self._batch]
+            yield X[sel], Y[sel]

@@ -1,0 +1,158 @@
+"""Record readers: file formats -> record rows.
+
+Reference parity: org.datavec.api.records.reader — CSVRecordReader,
+LineRecordReader, CollectionRecordReader (datavec-api records/reader/impl)
+and org.datavec.image.recordreader.ImageRecordReader (datavec-data-image,
+NativeImageLoader): each yields one record (list of values) per source
+row/file, label derived from the parent directory for images.
+
+TPU-native notes: image decode goes through PIL into HWC float32 (the
+layer API transposes to its internal layout); there is no JavaCPP/OpenCV
+binding layer to mirror because numpy IS the interchange format.
+"""
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RecordReader:
+    """Iterable over records (reference: records/reader/RecordReader)."""
+
+    def __iter__(self) -> Iterator[List]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Re-read from the start (file readers are re-iterable)."""
+
+    def num_records(self) -> Optional[int]:
+        return None
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference: impl/collection/
+    CollectionRecordReader.java)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self._records)
+
+    def num_records(self):
+        return len(self._records)
+
+
+class CSVRecordReader(RecordReader):
+    """CSV file/str reader (reference: impl/csv/CSVRecordReader.java —
+    skipNumLines + delimiter; quoting per csv module)."""
+
+    def __init__(self, path: Optional[str] = None, *, text: Optional[str] = None,
+                 delimiter: str = ",", skip_num_lines: int = 0):
+        if (path is None) == (text is None):
+            raise ValueError("pass exactly one of path= or text=")
+        self._path = path
+        self._text = text
+        self._delim = delimiter
+        self._skip = skip_num_lines
+
+    def _stream(self):
+        if self._path is not None:
+            return open(self._path, "r", newline="")
+        return io.StringIO(self._text)
+
+    def __iter__(self):
+        with self._stream() as fh:
+            r = csv.reader(fh, delimiter=self._delim)
+            for i, row in enumerate(r):
+                if i < self._skip or not row:
+                    continue
+                yield [c.strip() for c in row]
+
+    def num_records(self):
+        return sum(1 for _ in self)
+
+
+class LineRecordReader(RecordReader):
+    """One record per line (reference: impl/LineRecordReader.java)."""
+
+    def __init__(self, path: Optional[str] = None, *, text: Optional[str] = None):
+        if (path is None) == (text is None):
+            raise ValueError("pass exactly one of path= or text=")
+        self._path = path
+        self._text = text
+
+    def __iter__(self):
+        if self._path is not None:
+            with open(self._path, "r") as fh:
+                for line in fh:
+                    yield [line.rstrip("\n")]
+        else:
+            for line in self._text.splitlines():
+                yield [line]
+
+
+_IMG_EXT = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".pgm", ".gif", ".npy")
+
+
+class ImageRecordReader(RecordReader):
+    """Image-directory reader (reference: org.datavec.image.recordreader.
+    ImageRecordReader + ParentPathLabelGenerator): walks
+    root/<label>/<image>, yields [HWC float32 image array, label string].
+    Images resize to (height, width); grayscale when channels == 1.
+    .npy files load directly (shape (H, W, C) or (H, W))."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 root: Optional[str] = None):
+        self.height, self.width, self.channels = height, width, channels
+        self._files: List[Tuple[str, str]] = []
+        self.labels: List[str] = []
+        if root is not None:
+            self.initialize(root)
+
+    def initialize(self, root: str) -> "ImageRecordReader":
+        labels = sorted(d for d in os.listdir(root)
+                        if os.path.isdir(os.path.join(root, d)))
+        self.labels = labels
+        self._files = []
+        for lab in labels:
+            d = os.path.join(root, lab)
+            for f in sorted(os.listdir(d)):
+                if f.lower().endswith(_IMG_EXT):
+                    self._files.append((os.path.join(d, f), lab))
+        if not self._files:
+            raise ValueError(f"no images under {root!r} "
+                             f"(expected root/<label>/<image>)")
+        return self
+
+    def _load(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            arr = np.load(path).astype(np.float32)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+        else:
+            from PIL import Image
+            img = Image.open(path)
+            img = img.convert("L" if self.channels == 1 else "RGB")
+            img = img.resize((self.width, self.height))
+            arr = np.asarray(img, np.float32)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+        if arr.shape[:2] != (self.height, self.width):
+            raise ValueError(f"{path}: image {arr.shape[:2]} != "
+                             f"({self.height}, {self.width})")
+        if arr.shape[2] != self.channels:
+            raise ValueError(f"{path}: {arr.shape[2]} channels, "
+                             f"want {self.channels}")
+        return arr
+
+    def __iter__(self):
+        for path, label in self._files:
+            yield [self._load(path), label]
+
+    def num_records(self):
+        return len(self._files)

@@ -1,0 +1,145 @@
+"""Column schema for record ETL.
+
+Reference parity: org.datavec.api.transform.schema.Schema (datavec-api —
+column names + ColumnType {Integer, Long, Double, Float, Categorical,
+String, Time, Bytes} with per-column metadata) and its fluent Builder.
+
+TPU-native redesign: columns are numpy-typed and transform execution is
+COLUMNAR (vectorized numpy over whole column arrays), not the reference's
+row-of-Writables interpreter — rows only exist at the RecordReader
+boundary. The type set collapses to what a device pipeline distinguishes:
+integer, float, categorical (string values + known vocabulary), string,
+time (int64 epoch millis).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+INTEGER = "integer"
+FLOAT = "float"
+CATEGORICAL = "categorical"
+STRING = "string"
+TIME = "time"
+
+_NP_OF = {INTEGER: np.int64, FLOAT: np.float32, TIME: np.int64,
+          CATEGORICAL: object, STRING: object}
+
+
+@dataclasses.dataclass
+class ColumnMeta:
+    name: str
+    ctype: str
+    categories: Optional[Tuple[str, ...]] = None    # CATEGORICAL only
+
+    def np_dtype(self):
+        return _NP_OF[self.ctype]
+
+
+class Schema:
+    """Ordered column metadata (reference: transform/schema/Schema.java)."""
+
+    def __init__(self, columns: Sequence[ColumnMeta]):
+        self.columns: List[ColumnMeta] = list(columns)
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {names}")
+
+    # -- queries ---------------------------------------------------------
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column(self, name: str) -> ColumnMeta:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"no column {name!r}; have {self.names()}")
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def has(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def __repr__(self):
+        cols = ", ".join(f"{c.name}:{c.ctype}" for c in self.columns)
+        return f"Schema({cols})"
+
+    # -- serde ------------------------------------------------------------
+    def to_json(self) -> dict:
+        return {"columns": [{"name": c.name, "type": c.ctype,
+                             "categories": list(c.categories)
+                             if c.categories else None}
+                            for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: dict) -> "Schema":
+        return Schema([ColumnMeta(c["name"], c["type"],
+                                  tuple(c["categories"])
+                                  if c.get("categories") else None)
+                       for c in d["columns"]])
+
+    # -- builder (reference: Schema.Builder) ------------------------------
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMeta] = []
+
+        def add_column_integer(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMeta(name, INTEGER)); return self
+
+        def add_column_float(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMeta(name, FLOAT)); return self
+
+        add_column_double = add_column_float
+
+        def add_column_categorical(self, name: str,
+                                   *categories: str) -> "Schema.Builder":
+            self._cols.append(ColumnMeta(name, CATEGORICAL,
+                                         tuple(categories))); return self
+
+        def add_column_string(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMeta(name, STRING)); return self
+
+        def add_column_time(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMeta(name, TIME)); return self
+
+        def build(self) -> "Schema":
+            return Schema(self._cols)
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+
+def columnar(schema: Schema, rows: Sequence[Sequence]) -> Dict[str, np.ndarray]:
+    """Rows -> {column name: typed numpy array} (the internal execution
+    format: every transform is a vectorized numpy op over these)."""
+    n = schema.num_columns()
+    for i, r in enumerate(rows):
+        if len(r) != n:
+            raise ValueError(f"record {i}: width {len(r)} != schema "
+                             f"width {n} ({schema.names()})")
+    out: Dict[str, np.ndarray] = {}
+    for j, col in enumerate(schema.columns):
+        vals = [r[j] for r in rows]
+        if col.ctype == INTEGER or col.ctype == TIME:
+            out[col.name] = np.asarray([int(v) for v in vals], np.int64)
+        elif col.ctype == FLOAT:
+            out[col.name] = np.asarray([float(v) for v in vals], np.float32)
+        else:
+            out[col.name] = np.asarray([str(v) for v in vals], object)
+    return out
+
+
+def to_rows(schema: Schema, cols: Dict[str, np.ndarray]) -> List[List]:
+    names = schema.names()
+    n_rows = len(cols[names[0]]) if names else 0
+    return [[cols[nm][i] for nm in names] for i in range(n_rows)]

@@ -21,6 +21,14 @@ from deeplearning4j_tpu.nn.conv_layers import (
     Subsampling3DLayer, Upsampling2DLayer, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.recurrent_layers import (
     Bidirectional, LastTimeStepLayer, RnnOutputLayer, SimpleRnnLayer)
+from deeplearning4j_tpu.nn.layers_ext import (
+    CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer, CnnLossLayer,
+    Cropping1DLayer, DepthToSpaceLayer, DotProductAttentionLayer,
+    ElementWiseMultiplicationLayer, FrozenLayer, GravesLSTMLayer, GRULayer,
+    PReLULayer, PrimaryCapsulesLayer, RecurrentAttentionLayer,
+    RepeatVectorLayer, RnnLossLayer, SpaceToDepthLayer, Subsampling1DLayer,
+    Upsampling1DLayer, Upsampling3DLayer, VariationalAutoencoderLayer,
+    Yolo2OutputLayer, ZeroPadding1DLayer, ZeroPadding3DLayer)
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.nn.activations import resolve_activation
 

@@ -1,0 +1,752 @@
+"""Layer breadth wave 2: VAE, object detection, capsules, attention,
+peephole recurrence, and structural layers.
+
+Reference parity (deeplearning4j-nn nn/conf/layers unless noted):
+- VariationalAutoencoderLayer: variational/VariationalAutoencoder.java —
+  encoder/decoder MLPs, reparameterized latent, ELBO (reconstruction +
+  KL) as an unsupervised loss contribution.
+- Yolo2OutputLayer: objdetect/Yolo2OutputLayer.java (+ util NMS through
+  the image ops / nn/objdetect.py helpers).
+- CapsuleLayer / PrimaryCapsulesLayer / CapsuleStrengthLayer:
+  CapsuleLayer.java trio (Sabour et al. routing).
+- DotProductAttentionLayer / RecurrentAttentionLayer: the attention layer
+  family (RecurrentAttentionLayer.java; dot_product_attention native op).
+- GravesLSTMLayer: GravesLSTM.java (peephole LSTM).
+- GRULayer: recurrent GRU (nd4j gruCell / libnd4j gruCell.cpp).
+- structural: RepeatVector, PReLU, ElementWiseMultiplicationLayer,
+  Subsampling1DLayer, ZeroPadding1D/3D, Cropping1D, Upsampling1D/3D,
+  SpaceToDepth/DepthToSpace, CnnLossLayer, RnnLossLayer,
+  CenterLossOutputLayer, FrozenLayer (+FrozenLayerWithBackprop alias
+  semantics), MaskZeroLayer omitted (masking arrives with padded-batch
+  support).
+
+All layers compile through the same SameDiff path; losses attach by
+mark_as_loss so multiple heads/aux losses sum (reference:
+multiple-output ComputationGraph loss accumulation).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.activations import apply_activation
+from deeplearning4j_tpu.nn.layers import (
+    BaseLayer, InputType, LAYER_TYPES, _as_pair, _conv_out, _pad_mode)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class VariationalAutoencoderLayer(BaseLayer):
+    """VAE pretrain layer (reference: variational/
+    VariationalAutoencoder.java). Output = latent (mean at inference,
+    reparameterized sample in training); training adds the negative ELBO
+    (reconstruction + kl_weight * KL) as a loss contribution."""
+    n_out: int = 0                       # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (256,)
+    decoder_layer_sizes: Tuple[int, ...] = (256,)
+    activation: str = "relu"
+    # 'gaussian' -> MSE reconstruction; 'bernoulli' -> sigmoid BCE
+    reconstruction_distribution: str = "gaussian"
+    kl_weight: float = 1.0
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def _mlp(self, ctx, lname, x, n_in, sizes):
+        cur, width = x, n_in
+        for i, h in enumerate(sizes):
+            w = ctx.param(f"{lname}_W{i}", (width, h), self.weight_init)
+            b = ctx.sd.var(f"{lname}_b{i}", value=np.zeros(h),
+                           dtype=ctx.dtype)
+            cur = apply_activation(ctx.sd, cur.mmul(w).add(b),
+                                   self.activation, f"{lname}_h{i}")
+            width = h
+        return cur, width
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("vae")
+        n_in = itype.flat_size
+        enc, width = self._mlp(ctx, f"{lname}_enc", x, n_in,
+                               self.encoder_layer_sizes)
+        w_mu = ctx.param(f"{lname}_Wmu", (width, self.n_out),
+                         self.weight_init)
+        b_mu = ctx.sd.var(f"{lname}_bmu", value=np.zeros(self.n_out),
+                          dtype=ctx.dtype)
+        w_lv = ctx.param(f"{lname}_Wlv", (width, self.n_out),
+                         self.weight_init)
+        b_lv = ctx.sd.var(f"{lname}_blv", value=np.zeros(self.n_out),
+                          dtype=ctx.dtype)
+        mean = enc.mmul(w_mu).add(b_mu, name=f"{lname}_mean")
+        logvar = enc.mmul(w_lv).add(b_lv, name=f"{lname}_logvar")
+        if ctx.training:
+            # z = mean + exp(logvar/2) * eps via noise on a zero tensor
+            std = ctx.sd.invoke("exp", [logvar.mul(0.5)], {},
+                                name=f"{lname}_std")
+            eps = ctx.sd.invoke(
+                "gaussian_noise", [mean.mul(0.0)], {"stddev": 1.0},
+                name=f"{lname}_eps")
+            z = mean.add(std.mul(eps), name=f"{lname}_z")
+            # decoder + ELBO
+            dec, dwidth = self._mlp(ctx, f"{lname}_dec", z, self.n_out,
+                                    self.decoder_layer_sizes)
+            w_r = ctx.param(f"{lname}_Wrec", (dwidth, n_in),
+                            self.weight_init)
+            b_r = ctx.sd.var(f"{lname}_brec", value=np.zeros(n_in),
+                             dtype=ctx.dtype)
+            recon_logits = dec.mmul(w_r).add(b_r, name=f"{lname}_rec")
+            if self.reconstruction_distribution == "bernoulli":
+                recon = ctx.sd.invoke("sigm_cross_entropy",
+                                      [recon_logits, x], {},
+                                      name=f"{lname}_recon_loss")
+            else:
+                recon = ctx.sd.invoke("mean_sqerr_loss", [recon_logits, x],
+                                      {}, name=f"{lname}_recon_loss")
+            # KL(q(z|x) || N(0,I)) = -0.5 mean(1 + lv - mu^2 - e^lv)
+            kl_terms = logvar.add(1.0).sub(mean.square()).sub(
+                ctx.sd.invoke("exp", [logvar], {}, name=f"{lname}_elv"))
+            kl = kl_terms.mean().mul(-0.5, name=f"{lname}_kl")
+            elbo = recon.add(kl.mul(self.kl_weight), name=f"{lname}_elbo")
+            elbo.mark_as_loss()
+            return z, self.output_type(itype)
+        return mean, self.output_type(itype)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Yolo2OutputLayer(BaseLayer):
+    """YOLOv2 detection head (reference: objdetect/Yolo2OutputLayer.java).
+
+    Input: cnn feature map with A*(5+C) channels on an (H, W) grid.
+    Labels: (B, 4+C, H, W) — corner bbox in grid units + class one-hot.
+    Output passes the raw grid through (decode with nn/objdetect.py).
+    """
+    anchors: Tuple[float, ...] = (1.0, 1.0)    # flat (w,h) pairs
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("yolo2")
+        c, h, w = itype.dims
+        n_anchors = len(self.anchors) // 2
+        if c % n_anchors:
+            raise ValueError(f"channels {c} not divisible by "
+                             f"{n_anchors} anchors")
+        # labels arrive NCHW (external contract); the runtime tensor is
+        # ctx.cnn_format. yolo2_loss wants channels-last for both.
+        labels = ctx.labels_var
+        if labels is not None and ctx.training:
+            lab_nhwc = ctx.sd.invoke("permute", [labels],
+                                     {"axes": (0, 2, 3, 1)},
+                                     name=f"{lname}_lab_nhwc")
+            pred = x if ctx.cnn_format == "NHWC" else ctx.sd.invoke(
+                "permute", [x], {"axes": (0, 2, 3, 1)},
+                name=f"{lname}_pred_nhwc")
+            loss = ctx.sd.invoke(
+                "yolo2_loss", [pred, lab_nhwc],
+                {"anchors": tuple(self.anchors),
+                 "lambda_coord": self.lambda_coord,
+                 "lambda_noobj": self.lambda_noobj}, name=f"{lname}_loss")
+            loss.mark_as_loss()
+            ctx.loss_var = loss
+        ctx.output_var = x
+        return x, itype
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class PrimaryCapsulesLayer(BaseLayer):
+    """Conv -> capsule groups -> squash (reference: PrimaryCapsules.java)."""
+    capsules: int = 8                 # capsule channel groups
+    capsule_dimensions: int = 8
+    kernel_size: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+    weight_init: str = "RELU"
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        kh, kw = _as_pair(self.kernel_size)
+        sh, sw = _as_pair(self.stride)
+        oh = _conv_out(h, kh, sh, "VALID")
+        ow = _conv_out(w, kw, sw, "VALID")
+        n_caps = self.capsules * oh * ow
+        return InputType("caps", (n_caps, self.capsule_dimensions))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("primcaps")
+        c_in = itype.dims[0]
+        kh, kw = _as_pair(self.kernel_size)
+        n_out = self.capsules * self.capsule_dimensions
+        w = ctx.param(f"{lname}_W", (kh, kw, c_in, n_out), self.weight_init)
+        z = ctx.sd.invoke("conv2d", [x, w],
+                          {"strides": _as_pair(self.stride),
+                           "padding": "VALID",
+                           "data_format": ctx.cnn_format},
+                          name=f"{lname}_conv")
+        if ctx.cnn_format != "NHWC":
+            # capsule vectors are contiguous groups of the CHANNEL axis;
+            # bring channels last before grouping
+            z = ctx.sd.invoke("permute", [z], {"axes": (0, 2, 3, 1)},
+                              name=f"{lname}_cl")
+        otype = self.output_type(itype)
+        n_caps, d = otype.dims
+        z = ctx.sd.invoke("reshape", [z], {"shape": (-1, n_caps, d)},
+                          name=f"{lname}_caps")
+        out = ctx.sd.invoke("capsule_squash", [z], {},
+                            name=f"{lname}_squash")
+        return out, otype
+
+
+@dataclasses.dataclass
+class CapsuleLayer(BaseLayer):
+    """Dynamic-routing capsules (reference: CapsuleLayer.java)."""
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType("caps", (self.capsules, self.capsule_dimensions))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("caps")
+        n_in, d_in = itype.dims
+        w = ctx.param(f"{lname}_W",
+                      (n_in, self.capsules, d_in, self.capsule_dimensions),
+                      self.weight_init)
+        out = ctx.sd.invoke(
+            "capsule_routing", [x, w],
+            {"n_capsules": self.capsules,
+             "capsule_dim": self.capsule_dimensions,
+             "routings": self.routings}, name=lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class CapsuleStrengthLayer(BaseLayer):
+    """Capsule vector norms -> class scores (reference:
+    CapsuleStrengthLayer.java)."""
+
+    def output_type(self, itype):
+        return InputType.feed_forward(itype.dims[0])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("capstrength")
+        sq = ctx.sd.invoke("reduce_sum",
+                           [ctx.sd.invoke("square", [x], {},
+                                          name=f"{lname}_sq")],
+                           {"axis": (2,)}, name=f"{lname}_sum")
+        out = ctx.sd.invoke("sqrt", [sq], {}, name=lname)
+        return out, self.output_type(itype)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class DotProductAttentionLayer(BaseLayer):
+    """Scaled dot-product attention over a sequence with learned Q/K/V
+    projections (reference: the dot_product_attention native op family +
+    attention layer configs; multi-head when n_heads > 1)."""
+    n_out: int = 0
+    n_heads: int = 1
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.dims[1])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("dpattn")
+        n_in = itype.dims[0]
+        if self.n_out % self.n_heads:
+            raise ValueError("n_out must divide by n_heads")
+        wq = ctx.param(f"{lname}_Wq", (n_in, self.n_out), self.weight_init)
+        wk = ctx.param(f"{lname}_Wk", (n_in, self.n_out), self.weight_init)
+        wv = ctx.param(f"{lname}_Wv", (n_in, self.n_out), self.weight_init)
+        wo = ctx.param(f"{lname}_Wo", (self.n_out, self.n_out),
+                       self.weight_init)
+        out = ctx.sd.invoke(
+            "multi_head_dot_product_attention", [x, x, x, wq, wk, wv, wo],
+            {"nheads": self.n_heads}, name=lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class RecurrentAttentionLayer(BaseLayer):
+    """Recurrent cell with per-step attention over the full input sequence
+    (reference: RecurrentAttentionLayer.java — r_t combines the recurrent
+    state with an attention readout where the query is the current step)."""
+    n_out: int = 0
+    weight_init: str = "XAVIER"
+    activation: str = "tanh"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.dims[1])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("recattn")
+        n_in = itype.dims[0]
+        wq = ctx.param(f"{lname}_Wq", (n_in, n_in), self.weight_init)
+        w_ih = ctx.param(f"{lname}_W", (2 * n_in, self.n_out),
+                         self.weight_init)
+        w_hh = ctx.param(f"{lname}_Wr", (self.n_out, self.n_out),
+                         self.weight_init)
+        b = ctx.sd.var(f"{lname}_b", value=np.zeros(self.n_out),
+                       dtype=ctx.dtype)
+        # attention readout per step: q = x W_q, attn = softmax(q k^T) v
+        # with k = v = x (single-head dot-product attention)
+        q = ctx.sd.invoke("einsum", [x, wq], {"equation": "btc,cd->btd"},
+                          name=f"{lname}_q")
+        attn = ctx.sd.invoke("dot_product_attention", [q, x, x], {},
+                             name=f"{lname}_attn")
+        cat = ctx.sd.invoke("concat", [x, attn], {"axis": -1},
+                            name=f"{lname}_cat")
+        h0 = ctx.sd.invoke("rnn_init_state", [cat], {"units": self.n_out},
+                           name=f"{lname}_h0")
+        out, _ = ctx.sd.invoke(
+            "simple_rnn_layer", [cat, h0, w_ih, w_hh, b],
+            {"activation": self.activation}, name=lname, n_outputs=2)
+        return out, self.output_type(itype)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class GravesLSTMLayer(BaseLayer):
+    """Peephole LSTM (reference: GravesLSTM.java)."""
+    n_out: int = 0
+    weight_init: str = "XAVIER"
+    forget_gate_bias_init: float = 1.0
+    return_sequences: bool = True
+
+    def output_type(self, itype):
+        if self.return_sequences:
+            return InputType.recurrent(self.n_out, itype.dims[1])
+        return InputType.feed_forward(self.n_out)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("glstm")
+        n_in, u = itype.dims[0], self.n_out
+        w_ih = ctx.param(f"{lname}_Wih", (n_in, 4 * u), self.weight_init)
+        w_hh = ctx.param(f"{lname}_Whh", (u, 4 * u), self.weight_init)
+        w_p = ctx.sd.var(f"{lname}_Wp", value=np.zeros((3, u)),
+                         dtype=ctx.dtype)
+        b0 = np.zeros((4 * u,))
+        b0[u:2 * u] = self.forget_gate_bias_init
+        b = ctx.sd.var(f"{lname}_b", value=b0, dtype=ctx.dtype)
+        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
+                           name=f"{lname}_h0")
+        c0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
+                           name=f"{lname}_c0")
+        out, hT, _ = ctx.sd.invoke(
+            "graves_lstm_layer", [x, h0, c0, w_ih, w_hh, w_p, b],
+            {"return_sequences": self.return_sequences}, name=lname,
+            n_outputs=3)
+        return (out if self.return_sequences else hT), \
+            self.output_type(itype)
+
+
+@dataclasses.dataclass
+class GRULayer(BaseLayer):
+    """GRU over sequences (reference: nd4j gruCell, libnd4j gruCell.cpp)."""
+    n_out: int = 0
+    weight_init: str = "XAVIER"
+    return_sequences: bool = True
+
+    def output_type(self, itype):
+        if self.return_sequences:
+            return InputType.recurrent(self.n_out, itype.dims[1])
+        return InputType.feed_forward(self.n_out)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("gru")
+        n_in, u = itype.dims[0], self.n_out
+        w_ih = ctx.param(f"{lname}_Wih", (n_in, 3 * u), self.weight_init)
+        w_hh = ctx.param(f"{lname}_Whh", (u, 3 * u), self.weight_init)
+        b_ih = ctx.sd.var(f"{lname}_bih", value=np.zeros(3 * u),
+                          dtype=ctx.dtype)
+        b_hh = ctx.sd.var(f"{lname}_bhh", value=np.zeros(3 * u),
+                          dtype=ctx.dtype)
+        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
+                           name=f"{lname}_h0")
+        out, hT = ctx.sd.invoke("gru_layer", [x, h0, w_ih, w_hh, b_ih, b_hh],
+                                {}, name=lname, n_outputs=2)
+        return (out if self.return_sequences else hT), \
+            self.output_type(itype)
+
+
+# ---------------------------------------------------------------------------
+# structural layers
+@dataclasses.dataclass
+class RepeatVectorLayer(BaseLayer):
+    """(B, n) -> (B, T, n) (reference: misc/RepeatVector.java)."""
+    n: int = 1
+
+    def output_type(self, itype):
+        return InputType.recurrent(itype.dims[0], self.n)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("repeat")
+        x2 = ctx.sd.invoke("expand_dims", [x], {"axis": 1},
+                           name=f"{lname}_e")
+        out = ctx.sd.invoke("tile", [x2], {"reps": (1, self.n, 1)},
+                            name=lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class PReLULayer(BaseLayer):
+    """Learned leaky slope (reference: PReLULayer.java; per-feature
+    alpha)."""
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("prelu")
+        # feature count is dims[0] for every InputType kind (rnn dims are
+        # (features, timesteps) even though the runtime tensor is (B, T, C))
+        n = itype.dims[0]
+        if itype.kind == "cnn" and ctx.cnn_format == "NHWC":
+            shape = (1, 1, 1, n)
+        elif itype.kind == "cnn":
+            shape = (1, n, 1, 1)
+        elif itype.kind == "rnn":
+            shape = (1, 1, n)
+        else:
+            shape = (1, n)
+        alpha = ctx.sd.var(f"{lname}_alpha", value=np.full(shape, 0.25),
+                           dtype=ctx.dtype)
+        out = ctx.sd.invoke("prelu", [x, alpha], {}, name=lname)
+        return out, itype
+
+
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(BaseLayer):
+    """out = activation(w * x + b) elementwise (reference:
+    misc/ElementWiseMultiplicationLayer.java)."""
+    activation: str = "identity"
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("ewmul")
+        n = itype.dims[0]
+        w = ctx.sd.var(f"{lname}_W", value=np.ones(n), dtype=ctx.dtype)
+        b = ctx.sd.var(f"{lname}_b", value=np.zeros(n), dtype=ctx.dtype)
+        out = apply_activation(ctx.sd, x.mul(w).add(b), self.activation,
+                               lname)
+        return out, itype
+
+
+@dataclasses.dataclass
+class Subsampling1DLayer(BaseLayer):
+    """1D pooling over (B, T, C) (reference: Subsampling1DLayer.java)."""
+    pooling_type: str = "MAX"
+    kernel_size: int = 2
+    stride: Optional[int] = None
+    convolution_mode: str = "VALID"
+
+    def output_type(self, itype):
+        c, t = itype.dims
+        s = self.stride or self.kernel_size
+        return InputType.recurrent(
+            c, _conv_out(t, self.kernel_size, s, self.convolution_mode)
+            if t > 0 else t)
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("pool1d")
+        # (B, T, C) -> (B, T, 1, C): reuse the 2d pool in NHWC
+        x4 = ctx.sd.invoke("expand_dims", [x], {"axis": 2},
+                           name=f"{lname}_e")
+        op = {"MAX": "max_pool2d", "AVG": "avg_pool2d"}[
+            self.pooling_type.upper()]
+        z = ctx.sd.invoke(op, [x4], {
+            "kernel": (self.kernel_size, 1),
+            "strides": (self.stride or self.kernel_size, 1),
+            "padding": _pad_mode(self.convolution_mode),
+            "data_format": "NHWC"}, name=f"{lname}_p")
+        out = ctx.sd.invoke("squeeze", [z], {"axis": (2,)}, name=lname)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class ZeroPadding1DLayer(BaseLayer):
+    """(reference: ZeroPadding1DLayer.java) padding=(left, right) on T."""
+    padding: Tuple[int, int] = (1, 1)
+
+    def output_type(self, itype):
+        c, t = itype.dims
+        return InputType.recurrent(c, t + sum(self.padding) if t > 0 else t)
+
+    def build(self, ctx, x, itype):
+        l, r = self.padding
+        out = ctx.sd.invoke("pad", [x],
+                            {"paddings": ((0, 0), (l, r), (0, 0))},
+                            name=ctx.lname("zeropad1d"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Cropping1DLayer(BaseLayer):
+    """(reference: convolutional/Cropping1D.java)."""
+    cropping: Tuple[int, int] = (0, 0)
+
+    def output_type(self, itype):
+        c, t = itype.dims
+        return InputType.recurrent(c, t - sum(self.cropping) if t > 0 else t)
+
+    def build(self, ctx, x, itype):
+        l, r = self.cropping
+        t = itype.dims[1]
+        big = 2 ** 31 - 1
+        # timesteps may be unknown (-1): use a negative python-slice end
+        end_t = t - r if t > 0 else (big if r == 0 else -r)
+        out = ctx.sd.invoke("strided_slice", [x],
+                            {"begin": (0, l, 0),
+                             "end": (big, end_t, big),
+                             "strides": (1, 1, 1)},
+                            name=ctx.lname("crop1d"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Upsampling1DLayer(BaseLayer):
+    """(reference: Upsampling1D.java): repeat timesteps."""
+    size: int = 2
+
+    def output_type(self, itype):
+        c, t = itype.dims
+        return InputType.recurrent(c, t * self.size if t > 0 else t)
+
+    def build(self, ctx, x, itype):
+        out = ctx.sd.invoke("repeat", [x],
+                            {"repeats": self.size, "axis": 1},
+                            name=ctx.lname("upsample1d"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class Upsampling3DLayer(BaseLayer):
+    """(reference: Upsampling3D.java): nearest-neighbour volume scale."""
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def output_type(self, itype):
+        c, d, h, w = itype.dims
+        fd, fh, fw = self.size
+        return InputType("cnn3d", (c, d * fd, h * fh, w * fw))
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("upsample3d")
+        # channels-last runtime: (B, D, H, W, C); NCDHW otherwise
+        axes = (1, 2, 3) if ctx.cnn_format == "NHWC" else (2, 3, 4)
+        out = x
+        for ax, f in zip(axes, self.size):
+            if f > 1:
+                out = ctx.sd.invoke("repeat", [out],
+                                    {"repeats": f, "axis": ax},
+                                    name=f"{lname}_ax{ax}")
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class ZeroPadding3DLayer(BaseLayer):
+    """(reference: ZeroPadding3DLayer.java) padding=(d0,d1,h0,h1,w0,w1)."""
+    padding: Tuple[int, int, int, int, int, int] = (1, 1, 1, 1, 1, 1)
+
+    def output_type(self, itype):
+        c, d, h, w = itype.dims
+        p = self.padding
+        return InputType("cnn3d", (c, d + p[0] + p[1], h + p[2] + p[3],
+                                   w + p[4] + p[5]))
+
+    def build(self, ctx, x, itype):
+        p = self.padding
+        spatial = ((p[0], p[1]), (p[2], p[3]), (p[4], p[5]))
+        if ctx.cnn_format == "NHWC":
+            pads = ((0, 0),) + spatial + ((0, 0),)
+        else:
+            pads = ((0, 0), (0, 0)) + spatial
+        out = ctx.sd.invoke("pad", [x], {"paddings": pads},
+                            name=ctx.lname("zeropad3d"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class SpaceToDepthLayer(BaseLayer):
+    """(reference: SpaceToDepthLayer.java)."""
+    block_size: int = 2
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        b = self.block_size
+        return InputType("cnn", (c * b * b, h // b, w // b))
+
+    def build(self, ctx, x, itype):
+        out = ctx.sd.invoke("space_to_depth", [x],
+                            {"block_size": self.block_size,
+                             "data_format": ctx.cnn_format},
+                            name=ctx.lname("s2d"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class DepthToSpaceLayer(BaseLayer):
+    """(reference: the depth_to_space op / SpaceToDepth inverse)."""
+    block_size: int = 2
+
+    def output_type(self, itype):
+        c, h, w = itype.dims
+        b = self.block_size
+        return InputType("cnn", (c // (b * b), h * b, w * b))
+
+    def build(self, ctx, x, itype):
+        out = ctx.sd.invoke("depth_to_space", [x],
+                            {"block_size": self.block_size,
+                             "data_format": ctx.cnn_format},
+                            name=ctx.lname("d2s"))
+        return out, self.output_type(itype)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class CnnLossLayer(BaseLayer):
+    """Per-pixel loss on a cnn map (reference: CnnLossLayer.java);
+    labels NCHW like the output contract."""
+    loss_function: str = "MSE"
+    activation: str = "identity"
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        from deeplearning4j_tpu.nn.layers import (_FUSED_LOGIT_LOSSES,
+                                                  _LOSS_OPS)
+        lname = ctx.lname("cnnloss")
+        out = apply_activation(ctx.sd, x, self.activation, f"{lname}_act")
+        labels = ctx.labels_var
+        if labels is not None:
+            lab = labels
+            if ctx.cnn_format == "NHWC":
+                lab = ctx.sd.invoke("permute", [labels],
+                                    {"axes": (0, 2, 3, 1)},
+                                    name=f"{lname}_lab")
+            loss_op = _LOSS_OPS[self.loss_function.upper()]
+            loss_in = x if loss_op in _FUSED_LOGIT_LOSSES else out
+            loss = ctx.sd.invoke(loss_op, [loss_in, lab], {},
+                                 name=f"{lname}_loss")
+            loss.mark_as_loss()
+            ctx.loss_var = loss
+        ctx.output_var = out
+        return out, itype
+
+
+@dataclasses.dataclass
+class RnnLossLayer(BaseLayer):
+    """Per-timestep loss (reference: RnnLossLayer.java)."""
+    loss_function: str = "MCXENT"
+    activation: str = "softmax"
+
+    def output_type(self, itype):
+        return itype
+
+    def build(self, ctx, x, itype):
+        from deeplearning4j_tpu.nn.layers import (_FUSED_LOGIT_LOSSES,
+                                                  _LOSS_OPS)
+        lname = ctx.lname("rnnloss")
+        out = apply_activation(ctx.sd, x, self.activation, f"{lname}_act")
+        labels = ctx.labels_var
+        if labels is not None:
+            loss_op = _LOSS_OPS[self.loss_function.upper()]
+            loss_in = x if loss_op in _FUSED_LOGIT_LOSSES else out
+            loss = ctx.sd.invoke(loss_op, [loss_in, labels], {},
+                                 name=f"{lname}_loss")
+            loss.mark_as_loss()
+            ctx.loss_var = loss
+        ctx.output_var = out
+        return out, itype
+
+
+@dataclasses.dataclass
+class CenterLossOutputLayer(BaseLayer):
+    """Softmax head + center loss (reference:
+    CenterLossOutputLayer.java — per-class feature centers pulled toward
+    their class's embeddings; centers update as non-trainable state)."""
+    n_out: int = 0
+    alpha: float = 0.05         # center update rate
+    lambda_: float = 0.5        # center-loss weight
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def build(self, ctx, x, itype):
+        from deeplearning4j_tpu.nn.layers import _attach_loss_head
+        lname = ctx.lname("centerout")
+        n_in = itype.flat_size
+        w = ctx.param(f"{lname}_W", (n_in, self.n_out), self.weight_init)
+        b = ctx.sd.var(f"{lname}_b", value=np.zeros(self.n_out),
+                       dtype=ctx.dtype)
+        z = x.mmul(w).add(b, name=f"{lname}_z")
+        out = apply_activation(ctx.sd, z, "softmax", lname)
+        _attach_loss_head(ctx, z, out, "MCXENT")
+        if ctx.training and ctx.labels_var is not None:
+            centers = ctx.state(f"{lname}_centers",
+                                np.zeros((self.n_out, n_in)))
+            # class centers for this batch: labels (B,C) one-hot @ centers
+            my_center = ctx.sd.invoke("matmul", [ctx.labels_var, centers],
+                                      {}, name=f"{lname}_mycenter")
+            diff = x.sub(my_center, name=f"{lname}_diff")
+            closs = diff.square().mean().mul(0.5 * self.lambda_,
+                                             name=f"{lname}_closs")
+            closs.mark_as_loss()
+            # EMA center update: c_k += alpha * mean_batch(x - c_k) per class
+            upd = ctx.sd.invoke(
+                "matmul", [ctx.labels_var, diff],
+                {"transpose_a": True}, name=f"{lname}_updsum")
+            cnt = ctx.sd.invoke("reduce_sum", [ctx.labels_var],
+                                {"axis": (0,), "keep_dims": True},
+                                name=f"{lname}_cnt")
+            new_centers = centers.add(
+                upd.div(cnt.transpose().add(1e-8)).mul(self.alpha),
+                name=f"{lname}_newc")
+            ctx.sd.update_state(centers, new_centers)
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class FrozenLayer(BaseLayer):
+    """Wraps a layer and freezes its parameters (reference:
+    misc/FrozenLayer.java — gradients neither computed nor applied)."""
+    layer: Optional[BaseLayer] = None
+
+    def output_type(self, itype):
+        return self.layer.output_type(itype)
+
+    def build(self, ctx, x, itype):
+        before = set(ctx.sd.trainable_params())
+        out, otype = self.layer.build(ctx, x, itype)
+        for name in set(ctx.sd.trainable_params()) - before:
+            ctx.sd.convert_to_constant(ctx.sd.get_variable(name))
+        return out, otype
+
+    def to_json(self):
+        return {"@class": "FrozenLayer", "layer": self.layer.to_json()}
+
+    @staticmethod
+    def _from_json_fields(d):
+        return FrozenLayer(layer=BaseLayer.from_json(d["layer"]))
+
+
+for _cls in [VariationalAutoencoderLayer, Yolo2OutputLayer,
+             PrimaryCapsulesLayer, CapsuleLayer, CapsuleStrengthLayer,
+             DotProductAttentionLayer, RecurrentAttentionLayer,
+             GravesLSTMLayer, GRULayer, RepeatVectorLayer, PReLULayer,
+             ElementWiseMultiplicationLayer, Subsampling1DLayer,
+             ZeroPadding1DLayer, Cropping1DLayer, Upsampling1DLayer,
+             Upsampling3DLayer, ZeroPadding3DLayer, SpaceToDepthLayer,
+             DepthToSpaceLayer, CnnLossLayer, RnnLossLayer,
+             CenterLossOutputLayer, FrozenLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls

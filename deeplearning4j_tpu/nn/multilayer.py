@@ -43,6 +43,20 @@ _WANTED_KIND = {
     "SeparableConvolution2DLayer": ("cnn",),
     "LocalResponseNormalization": ("cnn",), "Upsampling2DLayer": ("cnn",),
     "ZeroPaddingLayer": ("cnn",), "Cropping2DLayer": ("cnn",),
+    # wave 2 (layers_ext)
+    "VariationalAutoencoderLayer": ("ff",),
+    "Yolo2OutputLayer": ("cnn",), "PrimaryCapsulesLayer": ("cnn",),
+    "DotProductAttentionLayer": ("rnn",),
+    "RecurrentAttentionLayer": ("rnn",),
+    "GravesLSTMLayer": ("rnn",), "GRULayer": ("rnn",),
+    "RepeatVectorLayer": ("ff",),
+    "ElementWiseMultiplicationLayer": ("ff",),
+    "Subsampling1DLayer": ("rnn",), "ZeroPadding1DLayer": ("rnn",),
+    "Cropping1DLayer": ("rnn",), "Upsampling1DLayer": ("rnn",),
+    "Upsampling3DLayer": ("cnn3d",), "ZeroPadding3DLayer": ("cnn3d",),
+    "SpaceToDepthLayer": ("cnn",), "DepthToSpaceLayer": ("cnn",),
+    "CnnLossLayer": ("cnn",), "RnnLossLayer": ("rnn",),
+    "CenterLossOutputLayer": ("ff",),
 }
 
 
@@ -137,7 +151,11 @@ def _build_graph(conf: MultiLayerConfiguration, training: bool):
         ctx.idx = idx
         cur, itype = layer.build(ctx, cur, itype)
     if ctx.output_var is None:
-        ctx.output_var = _to_external_layout(sd, cur, itype, fmt,
+        ctx.output_var = cur
+    if itype.kind in ("cnn", "cnn3d"):
+        # cnn-typed network output goes back to the external NCHW contract
+        # (also when a loss head set output_var itself, e.g. Yolo2/CnnLoss)
+        ctx.output_var = _to_external_layout(sd, ctx.output_var, itype, fmt,
                                              "output_nchw")
     ctx.output_var.rename("output")
     return sd, ctx

@@ -114,5 +114,5 @@ def _ensure_loaded() -> None:
     _LOADED = True
     from deeplearning4j_tpu.ops import (  # noqa: F401
         elementwise, pairwise, reduce as _reduce, shape_ops, random as _random,
-        linalg, nn_ops, loss, bitwise, image, tf_compat,
+        linalg, nn_ops, nn_ext, loss, bitwise, image, tf_compat,
     )
