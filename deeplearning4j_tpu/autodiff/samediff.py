@@ -36,6 +36,11 @@ from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ops import registry
 
 
+class NumericsException(ArithmeticError):
+    """Raised by numerics panic modes (reference: the ND4JIllegalState
+    thrown by DefaultOpExecutioner NAN_PANIC/INF_PANIC checks)."""
+
+
 def _to_jnp(value, dtype=None):
     if isinstance(value, NDArray):
         value = value.data
@@ -388,6 +393,74 @@ class SameDiff:
     exec = output
     batch_output = output
 
+    def exec_debug(self, placeholders=None, outputs=None, key=None,
+                   check: str = "nan_inf"):
+        """Eager op-by-op execution with per-op numerics checks — the
+        NAN_PANIC/INF_PANIC diagnosis path (reference:
+        DefaultOpExecutioner.java:397-437 checkForAny/checkForNaN).
+
+        Under jit there is nothing between ops to hook, so panic-mode
+        LOCALIZATION runs the pruned graph eagerly (one tiny XLA program
+        per op) and raises NumericsException at the first op whose output
+        goes non-finite, naming the op, its inputs and their stats. Slow
+        by design; use after fit() flags a non-finite loss
+        (TrainingConfig.nan_panic)."""
+        import numpy as _np
+        if outputs is None:
+            outputs = self.outputs()
+        out_names = tuple(o.name if isinstance(o, SDVariable) else o
+                          for o in outputs)
+        ph = self._prep_placeholders(placeholders)
+        if key is None:
+            key = jax.random.key(0)
+        env: Dict[str, jax.Array] = {}
+        env.update(self.constants_map())
+        env.update({**self.trainable_params(), **self.state_vars_map()})
+        env.update(ph)
+
+        def _bad(a):
+            a = _np.asarray(a)
+            if not _np.issubdtype(a.dtype, _np.floating):
+                return None
+            if check in ("nan", "nan_inf") and _np.isnan(a).any():
+                return "NaN"
+            if check in ("inf", "nan_inf") and _np.isinf(a).any():
+                return "Inf"
+            return None
+
+        for name, arr in env.items():
+            kind = _bad(arr)
+            if kind:
+                raise NumericsException(f"input/parameter {name!r} already "
+                                        f"contains {kind}")
+        for idx, node in enumerate(self._prune(out_names)):
+            o = registry.get_op(node.op)
+            attrs = dict(node.attrs)
+            if node.random:
+                attrs["key"] = jax.random.fold_in(key, idx)
+            try:
+                args = [env[i] for i in node.inputs]
+            except KeyError as e:
+                raise KeyError(
+                    f"exec_debug: op {node.name!r} needs variable "
+                    f"{e.args[0]!r} — pass it in placeholders=") from None
+            res = o.fn(*args, **attrs)
+            results = list(res) if isinstance(res, (tuple, list)) else [res]
+            for out_name, r in zip(node.outputs, results):
+                env[out_name] = r
+                kind = _bad(r)
+                if kind:
+                    stats = "; ".join(
+                        f"{i}: shape {tuple(_np.shape(env[i]))}, "
+                        f"range [{float(_np.nanmin(_np.asarray(env[i]))):.4g}"
+                        f", {float(_np.nanmax(_np.asarray(env[i]))):.4g}]"
+                        for i in node.inputs)
+                    raise NumericsException(
+                        f"{kind} produced by op {node.op!r} (node "
+                        f"{node.name!r}) in output {out_name!r}; "
+                        f"inputs: {stats}")
+        return {o: NDArray(env[o]) for o in out_names}
+
     def outputs(self) -> List[str]:
         """Graph outputs = ARRAY vars consumed by no op (reference:
         SameDiff.outputs())."""
@@ -678,6 +751,13 @@ class SameDiff:
                 vals = [float(v) for v in
                         np.asarray(jnp.stack([lv for _, lv in pending]))]
                 epoch_losses.extend(vals)
+                if getattr(tc, "nan_panic", False):
+                    for it, v in zip(iters, vals):
+                        if not np.isfinite(v):
+                            raise NumericsException(
+                                f"non-finite loss {v} at iteration {it} "
+                                f"(nan_panic); localize the producing op "
+                                f"with sd.exec_debug(placeholders)")
                 for l in listeners:
                     l.iterations_done(self, epoch, iters, vals)
                 pending.clear()
@@ -713,6 +793,14 @@ class SameDiff:
                 _flush(pending)
                 mean_loss = float(np.mean(epoch_losses)) \
                     if epoch_losses else float("nan")
+            elif getattr(tc, "nan_panic", False):
+                # panic mode: fetch the epoch mean NOW (one sync per epoch)
+                mean_loss = float(jnp.mean(jnp.stack(epoch_losses))) \
+                    if epoch_losses else float("nan")
+                if epoch_losses and not np.isfinite(mean_loss):
+                    raise NumericsException(
+                        f"non-finite epoch-{epoch} mean loss {mean_loss} "
+                        f"(nan_panic); localize with sd.exec_debug()")
             else:
                 # mean on device, fetch deferred to fit end (one transfer)
                 mean_loss = None
@@ -772,10 +860,16 @@ class SameDiff:
         n_steps = next(iter(stacked.values())).shape[0]
         history = History()
         epoch_means = []
+        panic = getattr(tc, "nan_panic", False)
         for _ in range(epochs):
             params, svars, state, it_dev, losses = epoch_step(
                 params, svars, state, it_dev, constants, stacked, base_key)
-            epoch_means.append(jnp.mean(losses))
+            m = jnp.mean(losses)
+            if panic and not np.isfinite(float(m)):
+                raise NumericsException(
+                    f"non-finite mean loss {float(m)} in scanned epoch "
+                    f"(nan_panic); localize with sd.exec_debug()")
+            epoch_means.append(m)
             iteration += n_steps
         # ONE device fetch for all epoch means at fit end
         fetched = np.asarray(jnp.stack(epoch_means))

@@ -73,6 +73,12 @@ class TrainingConfig:
     # unroll factor for the scanned whole-epoch fit path (compile-time
     # cost vs fewer while-loop iterations; runtime-tuning knob, not serde)
     scan_unroll: int = 1
+    # NaN/Inf panic (reference: DefaultOpExecutioner ProfilingMode
+    # NAN_PANIC/INF_PANIC): fit() checks fetched losses and raises
+    # NumericsException naming the iteration; localize the producing op
+    # with sd.exec_debug(). Step-internal per-op checks are impossible
+    # under whole-graph jit, so the check granularity is the loss fetch.
+    nan_panic: bool = False
 
     def clip_gradients(self, grads):
         """Apply elementwise clip + the configured normalization mode to a
@@ -336,3 +342,65 @@ class EarlyStoppingListener(Listener):
             self.stopped_epoch = epoch
             return False
         return None
+
+
+class FailureTestingListener(Listener):
+    """Fault injection for robustness testing (reference:
+    optimize/listeners/FailureTestingListener.java:19 — FailureMode
+    {OOM, SYSTEM_EXIT_1, ILLEGAL_STATE, INFINITE_SLEEP} x CallType
+    trigger points). TPU-native subset: raising and sleeping; process
+    exit/OOM are not simulated in-process (the elastic-restart test
+    kills training with the EXCEPTION mode instead, see
+    parallel/multihost.ElasticTrainer).
+
+    failure_mode: "exception" | "illegal_state" | "sleep"
+    trigger: "epoch_start" | "epoch_end" | "iteration" | "training_start"
+    at: epoch or iteration number that fires the fault (-1 = first call)
+    sleep_seconds: used by the sleep mode
+    """
+
+    class InjectedFailure(RuntimeError):
+        pass
+
+    #: deliver scalars every iteration — a fault at iteration N must fire
+    #: before N+1 trains, not at the next burst flush
+    frequency = 1
+
+    def __init__(self, failure_mode: str = "exception",
+                 trigger: str = "iteration", at: int = -1,
+                 sleep_seconds: float = 0.1):
+        self.failure_mode = failure_mode.lower()
+        self.trigger = trigger.lower()
+        self.at = at
+        self.sleep_seconds = sleep_seconds
+        self.fired = False
+
+    def _fire(self, where: str):
+        self.fired = True
+        if self.failure_mode == "sleep":
+            time.sleep(self.sleep_seconds)
+            return
+        if self.failure_mode == "illegal_state":
+            raise RuntimeError(
+                f"FailureTestingListener: injected illegal state at {where}")
+        raise FailureTestingListener.InjectedFailure(
+            f"FailureTestingListener: injected failure at {where}")
+
+    def _should(self, n: int) -> bool:
+        return not self.fired and (self.at < 0 or n == self.at)
+
+    def on_training_start(self, sd):
+        if self.trigger == "training_start" and self._should(0):
+            self._fire("training start")
+
+    def on_epoch_start(self, sd, epoch):
+        if self.trigger == "epoch_start" and self._should(epoch):
+            self._fire(f"epoch {epoch} start")
+
+    def on_epoch_end(self, sd, epoch, mean_loss):
+        if self.trigger == "epoch_end" and self._should(epoch):
+            self._fire(f"epoch {epoch} end")
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if self.trigger == "iteration" and self._should(iteration):
+            self._fire(f"iteration {iteration}")

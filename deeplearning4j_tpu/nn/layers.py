@@ -159,6 +159,12 @@ class BuildContext:
     labels_var: object = None       # labels placeholder (for loss heads)
     output_var: object = None       # set by the output layer
     loss_var: object = None         # set by the output layer
+    # TBPTT mode: when set, recurrent layers carry their hidden state in
+    # persistent state vars of shape (tbptt_batch, units) instead of
+    # in-graph zeros — the train step's stop_gradient on state-var inputs
+    # IS the truncation (reference: MultiLayerNetwork.doTruncatedBPTT:2083)
+    tbptt_batch: Optional[int] = None
+    rnn_state_vars: list = dataclasses.field(default_factory=list)
     # runtime layout for cnn tensors. InputType dims stay (c, h, w) and the
     # network's EXTERNAL contract stays NCHW (reference convention; users
     # feed/receive NCHW) — but internally the compiled graph runs NHWC:
@@ -180,6 +186,32 @@ class BuildContext:
 
     def state(self, name: str, value):
         return self.sd.state_var(name, np.asarray(value), dtype=self.dtype)
+
+
+def _rnn_initial_states(ctx: BuildContext, lname: str, x, units: int,
+                        names=("h0",)):
+    """Initial recurrent state(s): in-graph zeros normally; persistent
+    zero-initialized state vars in TBPTT mode (reset per sequence batch by
+    fit_tbptt, carried across chunks by the train step)."""
+    outs = []
+    for nm in names:
+        if ctx.tbptt_batch:
+            sv = ctx.state(f"{lname}_{nm}_state",
+                           np.zeros((ctx.tbptt_batch, units)))
+            ctx.rnn_state_vars.append(sv.name)
+            outs.append(sv)
+        else:
+            outs.append(ctx.sd.invoke("rnn_init_state", [x],
+                                      {"units": units}, name=f"{lname}_{nm}"))
+    return outs
+
+
+def _rnn_carry_states(ctx: BuildContext, pairs):
+    """Declare state-var carries (state_var, final_state_var) in TBPTT
+    mode; no-op otherwise."""
+    if ctx.tbptt_batch:
+        for sv, fv in pairs:
+            ctx.sd.update_state(sv, fv)
 
 
 def _maybe_dropout(ctx: BuildContext, x, p: float, lname: str):
@@ -429,14 +461,12 @@ class LSTMLayer(BaseLayer):
         b0 = np.zeros((4 * u,))
         b0[u:2 * u] = self.forget_gate_bias_init  # [i, f, g, o] gate order
         b = ctx.sd.var(f"{lname}_b", value=b0, dtype=ctx.dtype)
-        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_h0")
-        c0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_c0")
+        h0, c0 = _rnn_initial_states(ctx, lname, x, u, ("h0", "c0"))
         out, hT, cT = ctx.sd.invoke(
             "lstm_layer", [x, h0, c0, w_ih, w_hh, b],
             {"time_major": False, "return_sequences": self.return_sequences},
             name=lname, n_outputs=3)
+        _rnn_carry_states(ctx, [(h0, hT), (c0, cT)])
         result = out if self.return_sequences else hT
         return result, self.output_type(itype)
 

@@ -336,14 +336,14 @@ class GravesLSTMLayer(BaseLayer):
         b0 = np.zeros((4 * u,))
         b0[u:2 * u] = self.forget_gate_bias_init
         b = ctx.sd.var(f"{lname}_b", value=b0, dtype=ctx.dtype)
-        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_h0")
-        c0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_c0")
-        out, hT, _ = ctx.sd.invoke(
+        from deeplearning4j_tpu.nn.layers import (_rnn_carry_states,
+                                                  _rnn_initial_states)
+        h0, c0 = _rnn_initial_states(ctx, lname, x, u, ("h0", "c0"))
+        out, hT, cT = ctx.sd.invoke(
             "graves_lstm_layer", [x, h0, c0, w_ih, w_hh, w_p, b],
             {"return_sequences": self.return_sequences}, name=lname,
             n_outputs=3)
+        _rnn_carry_states(ctx, [(h0, hT), (c0, cT)])
         return (out if self.return_sequences else hT), \
             self.output_type(itype)
 
@@ -369,10 +369,12 @@ class GRULayer(BaseLayer):
                           dtype=ctx.dtype)
         b_hh = ctx.sd.var(f"{lname}_bhh", value=np.zeros(3 * u),
                           dtype=ctx.dtype)
-        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_h0")
+        from deeplearning4j_tpu.nn.layers import (_rnn_carry_states,
+                                                  _rnn_initial_states)
+        h0, = _rnn_initial_states(ctx, lname, x, u)
         out, hT = ctx.sd.invoke("gru_layer", [x, h0, w_ih, w_hh, b_ih, b_hh],
                                 {}, name=lname, n_outputs=2)
+        _rnn_carry_states(ctx, [(h0, hT)])
         return (out if self.return_sequences else hT), \
             self.output_type(itype)
 

@@ -66,7 +66,12 @@ def _adapt_itype(itype: InputType, layer: BaseLayer, idx: int) -> InputType:
     nn/conf/preprocessor/{CnnToFeedForward,...}PreProcessor, added
     automatically by setInputType). Used by both graph build and type
     walking so they cannot desynchronize."""
-    accepted = _WANTED_KIND.get(type(layer).__name__)
+    # wrapper layers adapt by their INNER layer's wanted kind
+    probe = layer
+    while type(probe).__name__ == "FrozenLayer" and \
+            getattr(probe, "layer", None) is not None:
+        probe = probe.layer
+    accepted = _WANTED_KIND.get(type(probe).__name__)
     if accepted is None or itype.kind in accepted:
         return itype
     wanted = accepted[0]
@@ -133,12 +138,13 @@ def _to_external_layout(sd, x, itype: InputType, fmt: str, name: str):
     return sd.invoke("permute", [x], {"axes": axes}, name=name)
 
 
-def _build_graph(conf: MultiLayerConfiguration, training: bool):
+def _build_graph(conf: MultiLayerConfiguration, training: bool,
+                 tbptt_batch=None):
     sd = SameDiff()
     rng = np.random.default_rng(conf.seed)
     fmt = getattr(conf, "cnn_data_format", "NHWC")
     ctx = BuildContext(sd=sd, rng=rng, training=training, dtype=conf.dtype,
-                       cnn_format=fmt)
+                       cnn_format=fmt, tbptt_batch=tbptt_batch)
     x = sd.placeholder("input", shape=conf.input_type.placeholder_shape(),
                        dtype=conf.dtype)
     final = _final_output_type(conf)
@@ -206,6 +212,119 @@ class MultiLayerNetwork:
             data = _ArrayIterator(np.asarray(data), np.asarray(labels),
                                   batch_size)
         history = self._sd_train.fit(data, epochs=epochs, listeners=listeners)
+        self._score = history.final_loss()
+        return history
+
+    def fit_tbptt(self, features, labels, tbptt_length: int,
+                  epochs: int = 1, batch_size: int = 32):
+        """Truncated backprop through time (reference:
+        MultiLayerNetwork.doTruncatedBPTT, MultiLayerNetwork.java:2083).
+
+        features (B, T, C) / labels (B, T, C_out) split into
+        ``tbptt_length`` chunks along time. TPU-native design: each
+        recurrent layer's initial state is a persistent STATE VAR carried
+        across chunk steps by the compiled train step (state-var inputs
+        are stop-gradiented there, which IS the truncation); states reset
+        to zero per sequence minibatch. Equivalent to full BPTT when
+        tbptt_length >= T (tested)."""
+        import jax
+        import jax.numpy as jnp
+        self._require_init()
+        X = np.asarray(features)
+        Y = np.asarray(labels)
+        if X.ndim != 3 or Y.ndim != 3:
+            raise ValueError("fit_tbptt needs sequence features (B, T, C) "
+                             "and per-timestep labels (B, T, C_out)")
+        T = X.shape[1]
+        if Y.shape[1] != T:
+            raise ValueError(f"labels T={Y.shape[1]} != features T={T}")
+        # dedicated TBPTT train graph for this batch size (cached)
+        key = ("tbptt", batch_size)
+        cached = getattr(self, "_tbptt_graphs", None) or {}
+        if key not in cached:
+            sd, ctx = _build_graph(self.conf, training=True,
+                                   tbptt_batch=batch_size)
+            sd.training_config = TrainingConfig(
+                updater=self.conf.updater,
+                data_set_feature_mapping=["input"],
+                data_set_label_mapping=["labels"],
+                regularization=self.conf.regularization,
+                grad_clip_value=self.conf.grad_clip_value,
+                mixed_precision=self.conf.mixed_precision,
+                gradient_normalization=self.conf.gradient_normalization,
+                gradient_normalization_threshold=
+                    self.conf.gradient_normalization_threshold)
+            cached[key] = (sd, list(ctx.rnn_state_vars))
+            self._tbptt_graphs = cached
+        sd, rnn_states = cached[key]
+        # current weights in (same names, same init seed)
+        for n, arr in self._sd_train._arrays.items():
+            if n in sd._arrays and \
+                    tuple(sd._arrays[n].shape) == tuple(arr.shape):
+                sd._arrays[n] = arr
+
+        from deeplearning4j_tpu.autodiff.training import History
+        step = sd.make_train_step()
+        tc = sd.training_config
+        params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
+        svars = jax.tree_util.tree_map(jnp.copy, sd.state_vars_map())
+        # persist optimizer state across calls, like fit()
+        if sd._updater_state is not None and \
+                set(sd._updater_state.keys()) == set(params.keys()):
+            state = jax.tree_util.tree_map(jnp.copy, sd._updater_state)
+        else:
+            state = tc.updater.init(params)
+        constants = sd.constants_map()
+        iteration = getattr(tc, "iteration_count", 0)
+        it_dev = jnp.asarray(iteration, jnp.int32)
+        base_key = jax.random.key(sd._seed)
+        sd._seed += 1
+        n = (len(X) // batch_size) * batch_size
+        if n == 0:
+            raise ValueError("dataset smaller than one batch")
+        if n < len(X):
+            import warnings
+            warnings.warn(
+                f"fit_tbptt: dropping {len(X) - n} of {len(X)} sequences "
+                f"that do not fill a full batch of {batch_size} (TBPTT "
+                f"state vars have a fixed batch dimension)")
+        history = History()
+        # host-side zero templates: fresh device arrays per batch (the
+        # step DONATES state buffers, so device zeros can't be reused)
+        zero_np = {nm: np.zeros(svars[nm].shape,
+                                np.asarray(svars[nm]).dtype)
+                   for nm in rnn_states}
+        for epoch in range(epochs):
+            losses = []
+            for i in range(0, n, batch_size):
+                # new sequences: recurrent carries restart at zero
+                svars = {**svars, **{nm: jnp.asarray(z)
+                                     for nm, z in zero_np.items()}}
+                for t0 in range(0, T, tbptt_length):
+                    ph = {"input": jnp.asarray(X[i:i + batch_size,
+                                                 t0:t0 + tbptt_length]),
+                          "labels": jnp.asarray(Y[i:i + batch_size,
+                                                  t0:t0 + tbptt_length])}
+                    params, svars, state, it_dev, loss_val = step(
+                        params, svars, state, it_dev, constants, ph,
+                        base_key)
+                    iteration += 1
+                    losses.append(loss_val)
+            mean = float(jnp.mean(jnp.stack(losses))) if losses else \
+                float("nan")
+            history.add_epoch(epoch, mean)
+        # trained params back into BOTH graphs (by name)
+        for tgt in (sd, self._sd_train):
+            for pn, arr in params.items():
+                if pn in tgt._arrays:
+                    tgt._arrays[pn] = arr
+        for sn, arr in svars.items():
+            if sn in sd._arrays:
+                sd._arrays[sn] = arr
+            if sn in self._sd_train._arrays and sn not in rnn_states:
+                self._sd_train._arrays[sn] = arr   # e.g. BN running stats
+        sd._updater_state = state
+        tc.iteration_count = iteration
         self._score = history.final_loss()
         return history
 

@@ -44,13 +44,15 @@ class SimpleRnnLayer(BaseLayer):
         w = ctx.param(f"{lname}_W", (n_in, u), self.weight_init)
         r = ctx.param(f"{lname}_U", (u, u), self.weight_init)
         b = ctx.sd.var(f"{lname}_b", value=np.zeros((u,)), dtype=ctx.dtype)
-        h0 = ctx.sd.invoke("rnn_init_state", [x], {"units": u},
-                           name=f"{lname}_h0")
+        from deeplearning4j_tpu.nn.layers import (_rnn_carry_states,
+                                                  _rnn_initial_states)
+        h0, = _rnn_initial_states(ctx, lname, x, u)
         from deeplearning4j_tpu.nn.activations import resolve_activation
         out, hT = ctx.sd.invoke(
             "simple_rnn_layer", [x, h0, w, r, b],
             {"activation": resolve_activation(self.activation)},
             name=lname, n_outputs=2)
+        _rnn_carry_states(ctx, [(h0, hT)])
         result = out if self.return_sequences else hT
         return result, self.output_type(itype)
 
@@ -80,7 +82,13 @@ class Bidirectional(BaseLayer):
         x_rev = ctx.sd.invoke("reverse", [x], {"axis": (1,)},
                               name=f"{lname}_xrev")
         ctx.prefix = f"{lname}_bwd"
+        # the BACKWARD direction must NOT carry TBPTT state across chunks:
+        # its "final" state corresponds to the chunk's FIRST timestep, so
+        # carrying it into the next chunk injects past, not future, context
+        saved_tbptt = ctx.tbptt_batch
+        ctx.tbptt_batch = None
         bwd, _ = self.layer.build(ctx, x_rev, itype)
+        ctx.tbptt_batch = saved_tbptt
         ctx.prefix = saved_prefix
         if inner_t.kind == "rnn":
             # re-reverse so backward outputs align with forward time order

@@ -1,0 +1,138 @@
+"""Transfer learning: freeze / replace / fine-tune over built networks.
+
+Reference parity: nn/transferlearning/TransferLearning.java:1 —
+Builder(origModel).fineTuneConfiguration(...).setFeatureExtractor(idx)
+.nOutReplace(idx, nOut).removeOutputLayer().addLayer(...).build(), plus
+FineTuneConfiguration. The graph primitive underneath is the same as the
+reference's FrozenLayer wrapping: frozen layers' parameters become
+CONSTANTS in the compiled train step (convert_to_constant — they are
+baked into the XLA computation and get no gradients), and retained
+weights copy by parameter name.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import BaseLayer
+from deeplearning4j_tpu.nn.layers_ext import FrozenLayer
+
+
+class FineTuneConfiguration:
+    """(reference: transferlearning/FineTuneConfiguration.java) — global
+    overrides applied to the transferred model's training config."""
+
+    def __init__(self, updater=None, seed: Optional[int] = None):
+        self.updater = updater
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"FineTuneConfiguration(updater={self.updater!r}, "
+                f"seed={self.seed!r})")
+
+
+class TransferLearning:
+    """Builder over a trained MultiLayerNetwork."""
+
+    class Builder:
+        def __init__(self, net):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            if not isinstance(net, MultiLayerNetwork):
+                raise TypeError("TransferLearning.Builder takes a "
+                                "MultiLayerNetwork")
+            net._require_init()
+            self._net = net
+            self._layers: List[BaseLayer] = [copy.deepcopy(l)
+                                             for l in net.conf.layers]
+            self._freeze_until: Optional[int] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._kept = len(self._layers)   # layers whose weights copy over
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference:
+            setFeatureExtractor — 'up to and including')."""
+            self._freeze_until = int(layer_idx)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from(len(self._layers) - 1)
+
+        def remove_layers_from(self, layer_idx: int):
+            """Drop layers [layer_idx..end]."""
+            self._layers = self._layers[:layer_idx]
+            self._kept = min(self._kept, layer_idx)
+            return self
+
+        def add_layer(self, layer: BaseLayer):
+            self._layers.append(layer)
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Replace layer_idx's output width; its weights (and every
+            later layer's) re-initialize (reference: nOutReplace)."""
+            l = copy.deepcopy(self._layers[layer_idx])
+            if not hasattr(l, "n_out"):
+                raise ValueError(f"layer {layer_idx} "
+                                 f"({type(l).__name__}) has no n_out")
+            l.n_out = int(n_out)
+            if weight_init is not None and hasattr(l, "weight_init"):
+                l.weight_init = weight_init
+            self._layers[layer_idx] = l
+            self._kept = min(self._kept, layer_idx)
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            old = self._net.conf
+            layers = list(self._layers)
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    if not isinstance(layers[i], FrozenLayer):
+                        layers[i] = FrozenLayer(layer=layers[i])
+            ftc = self._fine_tune
+            conf = MultiLayerConfiguration(
+                layers=layers,
+                input_type=old.input_type,
+                seed=(ftc.seed if ftc and ftc.seed is not None else old.seed),
+                updater=(ftc.updater if ftc and ftc.updater is not None
+                         else old.updater),
+                regularization=old.regularization,
+                dtype=old.dtype,
+                grad_clip_value=old.grad_clip_value,
+                mixed_precision=old.mixed_precision,
+                gradient_normalization=old.gradient_normalization,
+                gradient_normalization_threshold=
+                    old.gradient_normalization_threshold,
+                cnn_data_format=old.cnn_data_format,
+            )
+            new_net = MultiLayerNetwork(conf).init()
+            self._copy_weights(new_net)
+            return new_net
+
+        def _copy_weights(self, new_net):
+            """Copy parameter arrays for retained layers by name; layer
+            indices are positional, so params keep their 'layer{i}_*'
+            names for every kept prefix layer."""
+            import jax.numpy as jnp
+            src = self._net._sd_train
+            kept_prefixes = tuple(f"layer{i}_" for i in range(self._kept))
+            for tgt in (new_net._sd_train, new_net._sd_infer):
+                for name, arr in src._arrays.items():
+                    if not name.startswith(kept_prefixes):
+                        continue
+                    if name in tgt._arrays and \
+                            tuple(tgt._arrays[name].shape) == tuple(arr.shape):
+                        tgt._arrays[name] = jnp.asarray(arr)
+
+    @staticmethod
+    def builder(net) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)

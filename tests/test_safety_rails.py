@@ -1,0 +1,107 @@
+"""Observability + safety rails: NaN panic, per-op localization, fault
+injection (reference: DefaultOpExecutioner.java:397-437 NAN_PANIC,
+FailureTestingListener.java:19)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff.samediff import NumericsException
+from deeplearning4j_tpu.autodiff.training import FailureTestingListener
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+
+
+def _nan_model():
+    """log(x - 2) goes NaN for x < 2 — the 'log' node is the producer."""
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 4))
+    w = sd.var("w", value=np.ones((4, 4), np.float32))
+    h = x.mmul(w, name="h")
+    shifted = h.sub(2.0, name="shifted")
+    bad = sd.invoke("log", [shifted], {}, name="badlog")
+    loss = bad.sum()
+    loss.mark_as_loss()
+    return sd, loss
+
+
+def test_exec_debug_names_the_producing_op():
+    sd, _ = _nan_model()
+    X = np.zeros((2, 4), np.float32)      # h=0 -> shifted=-2 -> log=NaN
+    with pytest.raises(NumericsException) as ei:
+        sd.exec_debug({"x": X})
+    msg = str(ei.value)
+    assert "badlog" in msg and "'log'" in msg
+    assert "range" in msg                  # input stats included
+
+
+def test_exec_debug_clean_graph_matches_output():
+    sd, loss = _nan_model()
+    X = np.full((2, 4), 2.0, np.float32)  # h=8 -> shifted=6 -> fine
+    dbg = sd.exec_debug({"x": X}, outputs=[loss.name])
+    ref = sd.output({"x": X}, [loss.name])
+    np.testing.assert_allclose(np.asarray(dbg[loss.name].data),
+                               np.asarray(ref[loss.name].data), rtol=1e-6)
+
+
+def test_exec_debug_flags_bad_parameter():
+    sd, _ = _nan_model()
+    sd.set_arr_for_var("w", np.full((4, 4), np.nan, np.float32))
+    with pytest.raises(NumericsException, match="parameter 'w'"):
+        sd.exec_debug({"x": np.ones((2, 4), np.float32)})
+
+
+def test_nan_panic_raises_during_fit():
+    sd, _ = _nan_model()
+    sd.training_config = TrainingConfig(
+        updater=Sgd(0.1), data_set_feature_mapping=["x"],
+        data_set_label_mapping=[], nan_panic=True)
+    X = np.zeros((4, 4), np.float32)
+    with pytest.raises(NumericsException, match="non-finite"):
+        sd.fit([{"x": X}] * 3, epochs=2)
+
+
+def test_nan_panic_off_does_not_raise():
+    sd, _ = _nan_model()
+    sd.training_config = TrainingConfig(
+        updater=Sgd(0.1), data_set_feature_mapping=["x"],
+        data_set_label_mapping=[], nan_panic=False)
+    X = np.zeros((4, 4), np.float32)
+    h = sd.fit([{"x": X}] * 3, epochs=1)    # NaN flows, no crash
+    assert np.isnan(h.loss_curve.losses).any()
+
+
+def _clean_fit(listeners):
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 3))
+    y = sd.placeholder("y", shape=(-1, 1))
+    w = sd.var("w", value=np.zeros((3, 1), np.float32))
+    loss = ((x.mmul(w) - y).square()).mean()
+    loss.mark_as_loss()
+    sd.training_config = TrainingConfig(
+        updater=Adam(0.01), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["y"])
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(8, 3).astype(np.float32),
+                rng.randn(8, 1).astype(np.float32)) for _ in range(4)]
+    return sd.fit(batches, epochs=3, listeners=listeners)
+
+
+def test_failure_injection_at_iteration():
+    l = FailureTestingListener(trigger="iteration", at=5)
+    with pytest.raises(FailureTestingListener.InjectedFailure,
+                       match="iteration 5"):
+        _clean_fit([l])
+    assert l.fired
+
+
+def test_failure_injection_epoch_end_illegal_state():
+    l = FailureTestingListener(failure_mode="illegal_state",
+                               trigger="epoch_end", at=1)
+    with pytest.raises(RuntimeError, match="illegal state at epoch 1"):
+        _clean_fit([l])
+
+
+def test_failure_injection_sleep_is_nonfatal():
+    l = FailureTestingListener(failure_mode="sleep", trigger="epoch_start",
+                               at=0, sleep_seconds=0.01)
+    h = _clean_fit([l])
+    assert l.fired and len(h.loss_curve.losses) == 3

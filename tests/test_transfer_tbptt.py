@@ -1,0 +1,124 @@
+"""Transfer learning builder + truncated BPTT.
+
+Reference: TransferLearning.java:1 (freeze/replace/fine-tune),
+MultiLayerNetwork.doTruncatedBPTT (MultiLayerNetwork.java:2083).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn import (
+    ConvolutionLayer, DenseLayer, FineTuneConfiguration, InputType,
+    LSTMLayer, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer, TransferLearning)
+
+
+def _base_cnn(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss_function="MCXENT"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_transfer_freeze_and_replace_head():
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 1, 8, 8).astype(np.float32)
+    Y3 = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    base = _base_cnn()
+    base.fit(X, Y3, epochs=3, batch_size=16)
+    conv_w_name = [n for n in base.samediff._vars
+                   if n.startswith("layer0_") and n.endswith("_W")][0]
+    conv_w = np.asarray(base.samediff.get_arr_for_var(conv_w_name).data)
+
+    # freeze features, swap head for a 5-class task
+    new = (TransferLearning.builder(base)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.05)))
+           .set_feature_extractor(2)          # freeze conv/pool/dense
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, loss_function="MCXENT"))
+           .build())
+    sd = new.samediff
+    # frozen params: present as constants, weights copied from the base
+    got = np.asarray(sd.get_arr_for_var(conv_w_name).data)
+    np.testing.assert_array_equal(got, conv_w)
+    assert conv_w_name not in sd.trainable_params()
+    # new head IS trainable
+    head = [n for n in sd.trainable_params() if n.startswith("layer3_")]
+    assert head
+
+    Y5 = np.eye(5, dtype=np.float32)[rng.randint(0, 5, 32)]
+    h = new.fit(X, Y5, epochs=10, batch_size=16)
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
+    # frozen weights unchanged by fine-tuning
+    after = np.asarray(new.samediff.get_arr_for_var(conv_w_name).data)
+    np.testing.assert_array_equal(after, conv_w)
+    assert np.asarray(new.output(X[:2]).data).shape == (2, 5)
+
+
+def test_transfer_n_out_replace():
+    base = _base_cnn()
+    new = (TransferLearning.builder(base)
+           .n_out_replace(2, 32)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=3, loss_function="MCXENT"))
+           .build())
+    assert new.conf.layers[2].n_out == 32
+    out = new.output(np.zeros((2, 1, 8, 8), np.float32))
+    assert np.asarray(out.data).shape == (2, 3)
+
+
+def _rnn_net(tbptt=False, seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(LSTMLayer(n_out=8))
+            .layer(RnnOutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.recurrent(3, 12))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _seq_data(seed=1, B=16, T=12, C=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(B, T, C).astype(np.float32)
+    y = (np.cumsum(X[:, :, 0], axis=1) > 0).astype(int)
+    Y = np.eye(2, dtype=np.float32)[y]
+    return X, Y
+
+
+def test_tbptt_full_length_equals_bptt():
+    """tbptt_length >= T is exactly full BPTT: same loss trajectory as
+    regular fit from the same seed."""
+    X, Y = _seq_data()
+    net_a = _rnn_net(seed=7)
+    net_b = _rnn_net(seed=7)
+    h_full = net_a.fit(X, Y, epochs=3, batch_size=16)
+    h_tb = net_b.fit_tbptt(X, Y, tbptt_length=12, epochs=3, batch_size=16)
+    np.testing.assert_allclose(h_tb.loss_curve.losses,
+                               h_full.loss_curve.losses, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_tbptt_truncated_converges_and_carries_state():
+    X, Y = _seq_data()
+    net = _rnn_net(seed=3)
+    h = net.fit_tbptt(X, Y, tbptt_length=4, epochs=12, batch_size=16)
+    losses = h.loss_curve.losses
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # truncation changes the gradients: trajectory differs from full BPTT
+    net2 = _rnn_net(seed=3)
+    h2 = net2.fit_tbptt(X, Y, tbptt_length=12, epochs=12, batch_size=16)
+    assert abs(h.loss_curve.losses[-1] - h2.loss_curve.losses[-1]) > 1e-7
+
+
+def test_tbptt_rejects_non_sequence():
+    net = _rnn_net()
+    with pytest.raises(ValueError, match="sequence features"):
+        net.fit_tbptt(np.zeros((4, 3), np.float32),
+                      np.zeros((4, 2), np.float32), tbptt_length=4)
