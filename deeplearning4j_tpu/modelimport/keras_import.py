@@ -359,6 +359,185 @@ def _map_upsampling(cfg, ctx, itype):
     return Upsampling2DLayer(size=_pair(cfg["size"])), None
 
 
+
+
+def _map_gru(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import GRULayer
+    _reject_unsupported(cfg, "GRU", {
+        "activation": "tanh", "recurrent_activation": "sigmoid",
+        "go_backwards": False, "use_bias": True, "reset_after": True})
+    layer = GRULayer(n_out=cfg["units"],
+                     return_sequences=cfg.get("return_sequences", False))
+
+    def reorder(w):
+        # keras gate order [z, r, h] -> gru_cell's [r, z, h]
+        z, r, h = np.split(w, 3, axis=-1)
+        return np.concatenate([r, z, h], axis=-1)
+
+    def setter(sd, stem, weights):
+        _assign(sd, f"{stem}_Wih", reorder(weights[0]))
+        _assign(sd, f"{stem}_Whh", reorder(weights[1]))
+        # reset_after=True: bias (2, 3u) = [input bias; recurrent bias]
+        b = weights[2]
+        _assign(sd, f"{stem}_bih", reorder(b[0]))
+        _assign(sd, f"{stem}_bhh", reorder(b[1]))
+    return layer, setter
+
+
+def _map_layer_norm(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn.attention import LayerNormLayer
+    ax = cfg.get("axis", -1)
+    ax = ax[0] if isinstance(ax, (list, tuple)) else ax
+    if ax not in (-1, len(itype.dims)):
+        raise ValueError(f"Keras LayerNormalization axis={ax} unsupported "
+                         f"(feature-axis only)")
+    layer = LayerNormLayer(eps=cfg.get("epsilon", 1e-3))
+    scale = cfg.get("scale", True)
+    center = cfg.get("center", True)
+
+    def setter(sd, stem, weights):
+        # keras saves only the enabled params, in [gamma, beta] order
+        i = 0
+        if scale:
+            _assign(sd, f"{stem}_g", weights[i]); i += 1
+        if center:
+            _assign(sd, f"{stem}_b", weights[i])
+    setter.allow_empty = not (scale or center)
+    return layer, setter
+
+
+def _map_prelu(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import PReLULayer
+    layer = PReLULayer()
+
+    def setter(sd, stem, weights):
+        name = f"{stem}_alpha"
+        expect = sd._arrays[name].shape
+        _assign(sd, name, np.asarray(weights[0]).reshape(expect))
+    return layer, setter
+
+
+def _map_leaky_relu(cfg, ctx, itype):
+    # PReLU with every alpha fixed to the keras slope (keras default 0.3
+    # vs the framework activation's 0.01 — a plain activation would
+    # silently change the slope)
+    from deeplearning4j_tpu.nn import PReLULayer
+    alpha = cfg.get("alpha", cfg.get("negative_slope", 0.3))
+    layer = PReLULayer()
+
+    def setter(sd, stem, weights):
+        name = f"{stem}_alpha"
+        expect = sd._arrays[name].shape
+        _assign(sd, name, np.full(expect, float(alpha), np.float32))
+        # keras LeakyReLU's slope is a CONSTANT, not a parameter — freeze
+        # it so fine-tuning cannot drift the activation
+        sd.convert_to_constant(sd.get_variable(name))
+    setter.allow_empty = True    # the slope is config, not a keras weight
+    return layer, setter
+
+
+def _map_elu(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ActivationLayer
+    if cfg.get("alpha", 1.0) != 1.0:
+        raise ValueError("Keras ELU alpha != 1.0 unsupported")
+    return ActivationLayer(activation="elu"), None
+
+
+def _map_reshape(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ReshapeLayer
+    return ReshapeLayer(target_shape=tuple(cfg["target_shape"])), None
+
+
+def _map_permute(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import PermuteLayer
+    return PermuteLayer(dims=tuple(cfg["dims"])), None
+
+
+def _map_repeat_vector(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import RepeatVectorLayer
+    return RepeatVectorLayer(n=cfg["n"]), None
+
+
+def _map_time_distributed(cfg, ctx, itype):
+    inner = cfg["layer"]
+    if inner["class_name"] != "Dense":
+        raise ValueError("TimeDistributed import supports Dense only "
+                         f"(got {inner['class_name']})")
+    # DenseLayer broadcasts over (B, T, C) already
+    return _map_dense(inner["config"], ctx, itype)
+
+
+def _map_pool1d(pool_type):
+    def mapper(cfg, ctx, itype):
+        from deeplearning4j_tpu.nn import Subsampling1DLayer
+        ps = cfg["pool_size"]
+        ps = ps[0] if isinstance(ps, (list, tuple)) else ps
+        st = cfg.get("strides") or ps
+        st = st[0] if isinstance(st, (list, tuple)) else st
+        return Subsampling1DLayer(pooling_type=pool_type, kernel_size=ps,
+                                  stride=st,
+                                  convolution_mode=_pad(cfg)), None
+    return mapper
+
+
+def _map_zeropad1d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ZeroPadding1DLayer
+    p = cfg["padding"]
+    pad = (p, p) if isinstance(p, int) else tuple(p)
+    return ZeroPadding1DLayer(padding=pad), None
+
+
+def _map_cropping1d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Cropping1DLayer
+    c = cfg["cropping"]
+    crop = (c, c) if isinstance(c, int) else tuple(c)
+    return Cropping1DLayer(cropping=crop), None
+
+
+def _map_upsampling1d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Upsampling1DLayer
+    return Upsampling1DLayer(size=cfg.get("size", 2)), None
+
+
+def _map_mha(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn.attention import MultiHeadAttentionLayer
+    h = cfg["num_heads"]
+    dk = cfg["key_dim"]
+    if cfg.get("value_dim") not in (None, dk):
+        raise ValueError(f"Keras MultiHeadAttention value_dim="
+                         f"{cfg['value_dim']!r} != key_dim {dk} is not "
+                         f"supported by import")
+    out_shape = cfg.get("output_shape")
+    if isinstance(out_shape, (list, tuple)):
+        if len(out_shape) != 1:
+            raise ValueError(f"Keras MultiHeadAttention output_shape="
+                             f"{out_shape!r} unsupported (rank-1 only)")
+        out_shape = out_shape[0]
+    use_bias = cfg.get("use_bias", True)
+    layer = MultiHeadAttentionLayer(n_heads=h, head_size=dk,
+                                    n_out=out_shape or 0,
+                                    has_bias=use_bias)
+
+    def setter(sd, stem, weights):
+        # keras order with use_bias: q/kernel (d,H,dk), q/bias (H,dk),
+        # k/kernel, k/bias, v/kernel, v/bias, out/kernel (H,dk,d_out),
+        # out/bias (d_out,); without bias the 4 kernels only
+        d = weights[0].shape[0]
+        step = 2 if use_bias else 1
+        _assign(sd, f"{stem}_Wq", weights[0].reshape(d, h * dk))
+        _assign(sd, f"{stem}_Wk", weights[step].reshape(d, h * dk))
+        _assign(sd, f"{stem}_Wv", weights[2 * step].reshape(d, h * dk))
+        wo = weights[3 * step]
+        d_out = wo.shape[-1]
+        _assign(sd, f"{stem}_Wo", wo.reshape(h * dk, d_out))
+        if use_bias:
+            _assign(sd, f"{stem}_bq", weights[1].reshape(h * dk))
+            _assign(sd, f"{stem}_bk", weights[3].reshape(h * dk))
+            _assign(sd, f"{stem}_bv", weights[5].reshape(h * dk))
+            _assign(sd, f"{stem}_bo", weights[7].reshape(d_out))
+    return layer, setter
+
+
 _MAPPERS: Dict[str, Callable] = {
     "Dense": _map_dense,
     "Conv2D": _map_conv2d,
@@ -383,6 +562,21 @@ _MAPPERS: Dict[str, Callable] = {
     "ZeroPadding2D": _map_zeropad,
     "Cropping2D": _map_cropping,
     "UpSampling2D": _map_upsampling,
+    "GRU": _map_gru,
+    "LayerNormalization": _map_layer_norm,
+    "PReLU": _map_prelu,
+    "LeakyReLU": _map_leaky_relu,
+    "ELU": _map_elu,
+    "Reshape": _map_reshape,
+    "Permute": _map_permute,
+    "RepeatVector": _map_repeat_vector,
+    "TimeDistributed": _map_time_distributed,
+    "MaxPooling1D": _map_pool1d("MAX"),
+    "AveragePooling1D": _map_pool1d("AVG"),
+    "ZeroPadding1D": _map_zeropad1d,
+    "Cropping1D": _map_cropping1d,
+    "UpSampling1D": _map_upsampling1d,
+    "MultiHeadAttention": _map_mha,
 }
 
 
@@ -460,7 +654,7 @@ def _copy_weights(net, built, archive: _H5Archive):
         if setter is None:
             continue
         weights = archive.layer_weights(keras_name)
-        if not weights:
+        if not weights and not getattr(setter, "allow_empty", False):
             raise ValueError(f"no weights for Keras layer {keras_name!r}")
         setter(sd, stems[idx], weights)
     net._sync_infer()
@@ -473,6 +667,12 @@ _KIND_STEM = {
     "Deconvolution2DLayer": "deconv", "BatchNormalization": "bn",
     "LSTMLayer": "lstm", "SimpleRnnLayer": "rnn", "Bidirectional": "bidir",
     "EmbeddingSequenceLayer": "embedseq", "EmbeddingLayer": "embedding",
+    "GRULayer": "gru", "LayerNormLayer": "ln", "PReLULayer": "prelu",
+    "MultiHeadAttentionLayer": "mha", "RepeatVectorLayer": "repeat",
+    "PermuteLayer": "permute", "ReshapeLayer": "reshape",
+    "Subsampling1DLayer": "pool1d", "ZeroPadding1DLayer": "zeropad1d",
+    "Cropping1DLayer": "crop1d", "Upsampling1DLayer": "upsample1d",
+    "GravesLSTMLayer": "glstm",
 }
 
 

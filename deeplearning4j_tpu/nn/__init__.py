@@ -29,6 +29,7 @@ from deeplearning4j_tpu.nn.layers_ext import (
     RepeatVectorLayer, RnnLossLayer, SpaceToDepthLayer, Subsampling1DLayer,
     Upsampling1DLayer, Upsampling3DLayer, VariationalAutoencoderLayer,
     Yolo2OutputLayer, ZeroPadding1DLayer, ZeroPadding3DLayer)
+from deeplearning4j_tpu.nn.layers_ext import PermuteLayer, ReshapeLayer
 from deeplearning4j_tpu.nn.transferlearning import (
     FineTuneConfiguration, TransferLearning)
 from deeplearning4j_tpu.nn.weights import init_weights

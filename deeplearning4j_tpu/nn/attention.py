@@ -257,7 +257,75 @@ class TransformerEncoderLayer(BaseLayer):
         return out, itype
 
 
+@dataclasses.dataclass
+class MultiHeadAttentionLayer(BaseLayer):
+    """Self-attention with per-projection biases — the keras
+    MultiHeadAttention-compatible form (separate head_size; output width
+    independent of d_model). Reference analogue:
+    multi_head_dot_product_attention.cpp:34 (which has no biases; this
+    layer adds them for import fidelity)."""
+    n_heads: int = 4
+    head_size: int = 0        # dk; default d_model // n_heads
+    n_out: int = 0            # output width; default d_model
+    has_bias: bool = True
+    weight_init: str = "XAVIER"
+
+    def output_type(self, itype):
+        d = self.n_out or itype.dims[0]
+        return InputType.recurrent(d, itype.dims[1])
+
+    def build(self, ctx, x, itype):
+        lname = ctx.lname("mha")
+        d = itype.dims[0]
+        h = self.n_heads
+        dk = self.head_size or d // h
+        d_out = self.n_out or d
+
+        def proj(nm, w_shape, b_shape, src):
+            w = ctx.param(f"{lname}_W{nm}", w_shape, self.weight_init)
+            z = ctx.sd.invoke("einsum", [src, w],
+                              {"equation": "btc,cd->btd"},
+                              name=f"{lname}_{nm}")
+            if self.has_bias:
+                b = ctx.sd.var(f"{lname}_b{nm}", value=np.zeros(b_shape),
+                               dtype=ctx.dtype)
+                z = z.add(b, name=f"{lname}_{nm}b")
+            return z
+
+        q = proj("q", (d, h * dk), (h * dk,), x)
+        k = proj("k", (d, h * dk), (h * dk,), x)
+        v = proj("v", (d, h * dk), (h * dk,), x)
+
+        t_static = itype.dims[1]
+        if t_static <= 0:
+            raise ValueError("MultiHeadAttentionLayer needs static "
+                             "timesteps in the InputType")
+
+        def heads(t, nm):
+            r = ctx.sd.invoke("reshape", [t],
+                              {"shape": (-1, t_static, h, dk)},
+                              name=f"{lname}_{nm}r")
+            return ctx.sd.invoke("permute", [r], {"axes": (0, 2, 1, 3)},
+                                 name=f"{lname}_{nm}h")
+        qh, kh, vh = heads(q, "q"), heads(k, "k"), heads(v, "v")
+        att = ctx.sd.invoke("dot_product_attention", [qh, kh, vh], {},
+                            name=f"{lname}_att")
+        merged = ctx.sd.invoke("permute", [att], {"axes": (0, 2, 1, 3)},
+                               name=f"{lname}_mrg")
+        merged = ctx.sd.invoke("reshape", [merged],
+                               {"shape": (-1, t_static, h * dk)},
+                               name=f"{lname}_flat")
+        wo = ctx.param(f"{lname}_Wo", (h * dk, d_out), self.weight_init)
+        out = ctx.sd.invoke("einsum", [merged, wo],
+                            {"equation": "btc,cd->btd"}, name=f"{lname}_o")
+        if self.has_bias:
+            bo = ctx.sd.var(f"{lname}_bo", value=np.zeros(d_out),
+                            dtype=ctx.dtype)
+            out = out.add(bo, name=lname)
+        return out, self.output_type(itype)
+
+
 for _cls in [EmbeddingSequenceLayer, PositionalEmbeddingLayer,
              SelfAttentionLayer, LearnedSelfAttentionLayer, LayerNormLayer,
-             TransformerEncoderLayer]:
+             TransformerEncoderLayer, MultiHeadAttentionLayer]:
     LAYER_TYPES[_cls.__name__] = _cls

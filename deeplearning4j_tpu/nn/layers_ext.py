@@ -752,3 +752,67 @@ for _cls in [VariationalAutoencoderLayer, Yolo2OutputLayer,
              DepthToSpaceLayer, CnnLossLayer, RnnLossLayer,
              CenterLossOutputLayer, FrozenLayer]:
     LAYER_TYPES[_cls.__name__] = _cls
+
+
+def _itype_from_channels_last_shape(shape):
+    """Per-sample channels-last shape -> InputType (Keras Reshape/Permute
+    semantics; runtime tensors are channels-last for cnn under NHWC)."""
+    dims = tuple(int(d) for d in shape)
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:          # (T, C)
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:          # (H, W, C)
+        return InputType("cnn", (dims[2], dims[0], dims[1]))
+    raise ValueError(f"unsupported reshape target {shape}")
+
+
+@dataclasses.dataclass
+class ReshapeLayer(BaseLayer):
+    """Per-sample reshape with channels-last semantics (Keras Reshape;
+    reference analogue: ReshapeVertex). target_shape excludes batch."""
+    target_shape: Tuple[int, ...] = ()
+
+    def output_type(self, itype):
+        return _itype_from_channels_last_shape(self.target_shape)
+
+    def build(self, ctx, x, itype):
+        if itype.kind in ("cnn", "cnn3d") and ctx.cnn_format != "NHWC":
+            raise ValueError("ReshapeLayer defines channels-last semantics; "
+                             "build the net with cnn_data_format='NHWC'")
+        out = ctx.sd.invoke("reshape", [x],
+                            {"shape": (-1,) + tuple(self.target_shape)},
+                            name=ctx.lname("reshape"))
+        return out, self.output_type(itype)
+
+
+@dataclasses.dataclass
+class PermuteLayer(BaseLayer):
+    """Permute non-batch axes, 1-based like Keras Permute, over the
+    channels-last view of the tensor."""
+    dims: Tuple[int, ...] = (2, 1)
+
+    def output_type(self, itype):
+        if itype.kind == "rnn":
+            t, c = itype.dims[1], itype.dims[0]
+            cur = (t, c)
+        elif itype.kind == "cnn":
+            c, h, w = itype.dims
+            cur = (h, w, c)
+        else:
+            raise ValueError("PermuteLayer needs rnn or cnn input")
+        new = tuple(cur[d - 1] for d in self.dims)
+        return _itype_from_channels_last_shape(new)
+
+    def build(self, ctx, x, itype):
+        if itype.kind == "cnn" and ctx.cnn_format != "NHWC":
+            raise ValueError("PermuteLayer defines channels-last semantics; "
+                             "build the net with cnn_data_format='NHWC'")
+        axes = (0,) + tuple(self.dims)
+        out = ctx.sd.invoke("permute", [x], {"axes": axes},
+                            name=ctx.lname("permute"))
+        return out, self.output_type(itype)
+
+
+for _cls in [ReshapeLayer, PermuteLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls

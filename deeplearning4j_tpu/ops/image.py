@@ -134,6 +134,8 @@ def crop_and_resize(images, boxes, box_indices, crop_height: int, crop_width: in
                     method: str = "bilinear"):
     """(reference: generic/images/crop_and_resize.cpp) boxes: (n,4) [y1,x1,y2,x2]
     normalized."""
+    images = jnp.asarray(images)   # numpy images + traced idx would fail
+
     def crop_one(box, idx):
         img = images[idx]
         h, w = images.shape[1], images.shape[2]
@@ -185,6 +187,8 @@ def non_max_suppression(boxes, scores, max_output_size: int,
     """(reference: generic/images/nonMaxSuppression.cpp) static-size output:
     returns (indices, valid_count); indices padded with -1."""
     n = boxes.shape[0]
+    boxes = jnp.asarray(boxes)     # traced indices index these below
+    scores = jnp.asarray(scores)
     y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
     area = jnp.abs(y2 - y1) * jnp.abs(x2 - x1)
 

@@ -87,7 +87,11 @@ def random_poisson(shape, lam: float = 1.0, key=None, seed=None):
 
 @op("random_multinomial", _R, n_inputs=1, differentiable=False)
 def random_multinomial(logits, num_samples: int, key=None, seed=None):
-    return jax.random.categorical(_key(key, seed), logits, axis=-1,
+    # batched logits: insert a broadcast dim so the requested shape's
+    # sample axis is compatible with the logits batch dims
+    logits = jnp.asarray(logits)
+    return jax.random.categorical(_key(key, seed), logits[..., None, :],
+                                  axis=-1,
                                   shape=logits.shape[:-1] + (num_samples,))
 
 

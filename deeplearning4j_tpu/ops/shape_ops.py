@@ -381,8 +381,11 @@ def unique(x, size: int = None):
 
 @op("dynamic_partition", _S, n_inputs=2, differentiable=False)
 def dynamic_partition(x, partitions, num_partitions: int):
-    # static-size variant: returns masks-selected, padded partitions
-    return tuple(jnp.where(partitions == i, x, jnp.zeros_like(x))
+    # static-size variant: returns mask-selected, zero-padded partitions;
+    # partitions indexes the leading dim(s), broadcast over the rest
+    mask_shape = partitions.shape + (1,) * (x.ndim - partitions.ndim)
+    p = partitions.reshape(mask_shape)
+    return tuple(jnp.where(p == i, x, jnp.zeros_like(x))
                  for i in range(num_partitions))
 
 

@@ -1,0 +1,196 @@
+"""Keras import breadth: wave-2 layer mappers against numpy references.
+
+TF is unavailable in this environment, so fixtures are constructed as
+real legacy-H5 keras files via h5py (same on-disk format tf.keras
+model.save produces: model_config JSON attr + model_weights groups with
+weight_names) and golden outputs are computed with independent numpy
+implementations of the exact Keras semantics.
+"""
+import json
+import os
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import (
+    import_keras_sequential_model_and_weights)
+
+rng = np.random.RandomState(42)
+
+
+def _write_h5(path, layers, weights):
+    """layers: list of (class_name, config); weights: {layer_name:
+    [(weight_name, array), ...]}."""
+    cfg = {"class_name": "Sequential",
+           "config": {"name": "seq",
+                      "layers": [{"class_name": c, "config": k}
+                                 for c, k in layers]}}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            names = []
+            for wn, arr in ws:
+                full = f"{lname}/{wn}:0"
+                mw.create_dataset(full, data=np.asarray(arr, np.float32))
+                names.append(full.encode())
+            g.attrs["weight_names"] = names
+
+
+def _input(shape, dtype="float32"):
+    return ("InputLayer", {"batch_input_shape": [None] + list(shape),
+                           "dtype": dtype, "name": "input"})
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_gru_import_matches_numpy(tmp_path):
+    T, C, U = 5, 3, 4
+    kernel = rng.randn(C, 3 * U).astype(np.float32) * 0.5   # [z, r, h]
+    rec = rng.randn(U, 3 * U).astype(np.float32) * 0.5
+    bias = rng.randn(2, 3 * U).astype(np.float32) * 0.1      # reset_after
+    path = tmp_path / "gru.h5"
+    _write_h5(path, [
+        _input([T, C]),
+        ("GRU", {"name": "gru", "units": U, "activation": "tanh",
+                 "recurrent_activation": "sigmoid", "use_bias": True,
+                 "reset_after": True, "return_sequences": True,
+                 "go_backwards": False}),
+    ], {"gru": [("kernel", kernel), ("recurrent_kernel", rec),
+                ("bias", bias)]})
+    net = import_keras_sequential_model_and_weights(str(path))
+
+    x = rng.randn(2, T, C).astype(np.float32)
+    got = np.asarray(net.output(x).data)
+
+    # numpy reference: keras GRU v3 (reset_after=True), gates [z, r, h]
+    def ref(x):
+        h = np.zeros((x.shape[0], U), np.float32)
+        outs = []
+        for t in range(T):
+            gi = x[:, t] @ kernel + bias[0]
+            gh = h @ rec + bias[1]
+            z = _sigmoid(gi[:, :U] + gh[:, :U])
+            r = _sigmoid(gi[:, U:2 * U] + gh[:, U:2 * U])
+            hh = np.tanh(gi[:, 2 * U:] + r * gh[:, 2 * U:])
+            h = z * h + (1 - z) * hh
+            outs.append(h)
+        return np.stack(outs, 1)
+
+    np.testing.assert_allclose(got, ref(x), rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_prelu_elu_import(tmp_path):
+    C = 6
+    gamma = (rng.rand(C) + 0.5).astype(np.float32)
+    beta = rng.randn(C).astype(np.float32)
+    alpha = (rng.rand(C) * 0.5).astype(np.float32)
+    path = tmp_path / "ln.h5"
+    _write_h5(path, [
+        _input([C]),
+        ("LayerNormalization", {"name": "ln", "axis": [-1],
+                                "epsilon": 1e-3}),
+        ("PReLU", {"name": "prelu"}),
+        ("ELU", {"name": "elu", "alpha": 1.0}),
+    ], {"ln": [("gamma", gamma), ("beta", beta)],
+        "prelu": [("alpha", alpha)]})
+    net = import_keras_sequential_model_and_weights(str(path))
+    x = rng.randn(4, C).astype(np.float32)
+    got = np.asarray(net.output(x).data)
+
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    h = (x - m) / np.sqrt(v + 1e-3) * gamma + beta
+    h = np.where(h >= 0, h, alpha * h)
+    want = np.where(h >= 0, h, np.exp(h) - 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_leaky_relu_keras_default_slope(tmp_path):
+    path = tmp_path / "leaky.h5"
+    _write_h5(path, [
+        _input([4]),
+        ("LeakyReLU", {"name": "leaky"}),    # keras default alpha=0.3
+    ], {})
+    net = import_keras_sequential_model_and_weights(str(path))
+    x = np.array([[-1.0, -2.0, 1.0, 3.0]], np.float32)
+    got = np.asarray(net.output(x).data)
+    np.testing.assert_allclose(got, [[-0.3, -0.6, 1.0, 3.0]], rtol=1e-5)
+
+
+def test_reshape_permute_repeat_import(tmp_path):
+    path = tmp_path / "shape.h5"
+    _write_h5(path, [
+        _input([6]),
+        ("RepeatVector", {"name": "rv", "n": 4}),        # (B,4,6)
+        ("Permute", {"name": "perm", "dims": [2, 1]}),   # (B,6,4)
+        ("Reshape", {"name": "rs", "target_shape": [24]}),
+    ], {})
+    net = import_keras_sequential_model_and_weights(str(path))
+    x = rng.randn(3, 6).astype(np.float32)
+    got = np.asarray(net.output(x).data)
+    want = np.transpose(np.repeat(x[:, None, :], 4, 1), (0, 2, 1)
+                        ).reshape(3, 24)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_time_distributed_dense_and_pool1d(tmp_path):
+    T, C, U = 6, 4, 3
+    k = rng.randn(C, U).astype(np.float32)
+    b = rng.randn(U).astype(np.float32)
+    path = tmp_path / "td.h5"
+    _write_h5(path, [
+        _input([T, C]),
+        ("TimeDistributed", {"name": "td", "layer": {
+            "class_name": "Dense",
+            "config": {"name": "inner", "units": U, "activation": "relu",
+                       "use_bias": True}}}),
+        ("MaxPooling1D", {"name": "mp", "pool_size": 2, "strides": 2,
+                          "padding": "valid"}),
+    ], {"td": [("kernel", k), ("bias", b)]})
+    net = import_keras_sequential_model_and_weights(str(path))
+    x = rng.randn(2, T, C).astype(np.float32)
+    got = np.asarray(net.output(x).data)
+    h = np.maximum(x @ k + b, 0)                      # (2, 6, 3)
+    want = h.reshape(2, 3, 2, U).max(2)               # pool_size 2
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_head_attention_import(tmp_path):
+    T, D, H, DK = 4, 6, 2, 3
+    wq = rng.randn(D, H, DK).astype(np.float32) * 0.5
+    bq = rng.randn(H, DK).astype(np.float32) * 0.1
+    wk = rng.randn(D, H, DK).astype(np.float32) * 0.5
+    bk = rng.randn(H, DK).astype(np.float32) * 0.1
+    wv = rng.randn(D, H, DK).astype(np.float32) * 0.5
+    bv = rng.randn(H, DK).astype(np.float32) * 0.1
+    wo = rng.randn(H, DK, D).astype(np.float32) * 0.5
+    bo = rng.randn(D).astype(np.float32) * 0.1
+    path = tmp_path / "mha.h5"
+    _write_h5(path, [
+        _input([T, D]),
+        ("MultiHeadAttention", {"name": "mha", "num_heads": H,
+                                "key_dim": DK, "use_bias": True}),
+    ], {"mha": [("query/kernel", wq), ("query/bias", bq),
+                ("key/kernel", wk), ("key/bias", bk),
+                ("value/kernel", wv), ("value/bias", bv),
+                ("attention_output/kernel", wo),
+                ("attention_output/bias", bo)]})
+    net = import_keras_sequential_model_and_weights(str(path))
+    x = rng.randn(2, T, D).astype(np.float32)
+    got = np.asarray(net.output(x).data)
+
+    # numpy reference: keras self-MHA
+    q = np.einsum("btd,dhk->bhtk", x, wq) + bq[None, :, None, :]
+    k = np.einsum("btd,dhk->bhtk", x, wk) + bk[None, :, None, :]
+    v = np.einsum("btd,dhk->bhtk", x, wv) + bv[None, :, None, :]
+    s = np.einsum("bhqk,bhtk->bhqt", q, k) / np.sqrt(DK)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    a = e / e.sum(-1, keepdims=True)
+    ctxv = np.einsum("bhqt,bhtk->bhqk", a, v)
+    want = np.einsum("bhqk,hkd->bqd", ctxv, wo) + bo
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
