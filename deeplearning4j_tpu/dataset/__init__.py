@@ -9,6 +9,9 @@ from deeplearning4j_tpu.dataset.normalizers import (
     NormalizerStandardize)
 from deeplearning4j_tpu.dataset.mnist import (
     MnistDataSetIterator, load_mnist, synthetic_mnist)
+from deeplearning4j_tpu.dataset.vision import (
+    Cifar10DataSetIterator, EmnistDataSetIterator, load_cifar10,
+    load_emnist, synthetic_cifar10)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
@@ -17,5 +20,6 @@ __all__ = [
     "EarlyTerminationIterator", "SamplingDataSetIterator", "Normalizer",
     "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "MnistDataSetIterator", "load_mnist",
-    "synthetic_mnist",
+    "synthetic_mnist", "Cifar10DataSetIterator", "EmnistDataSetIterator",
+    "load_cifar10", "load_emnist", "synthetic_cifar10",
 ]

@@ -1,0 +1,127 @@
+"""CIFAR-10 / EMNIST dataset loading.
+
+Reference parity: deeplearning4j-datasets Cifar10DataSetIterator +
+EmnistDataSetIterator (datasets/iterator/impl/). Same hermetic policy as
+mnist.py: real files when a data directory is present (the exact formats
+the reference downloads — CIFAR-10 python pickle batches, EMNIST idx
+files), deterministic synthetic fallback otherwise.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.dataset.mnist import _find, _read_idx
+
+CIFAR10_LABELS = ["airplane", "automobile", "bird", "cat", "deer", "dog",
+                  "frog", "horse", "ship", "truck"]
+
+# EMNIST splits and class counts (reference: EmnistDataSetIterator.Set)
+EMNIST_SETS = {"balanced": 47, "byclass": 62, "bymerge": 47, "digits": 10,
+               "letters": 26, "mnist": 10}
+
+
+def synthetic_cifar10(n: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Learnable synthetic 32x32 RGB: class-dependent color blocks."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    X = rng.normal(0.35, 0.1, size=(n, 3, 32, 32)).astype(np.float32)
+    for c in range(10):
+        mask = labels == c
+        ch = c % 3
+        r, col = divmod(c, 4)
+        X[mask, ch, 8 * r:8 * r + 8, 8 * col:8 * col + 8] += 0.5
+    return np.clip(X, 0, 1), labels.astype(np.int64)
+
+
+def load_cifar10(train: bool = True, data_dir: Optional[str] = None,
+                 n_synthetic: int = 4096):
+    """(features NCHW float32 in [0,1], int labels). Reads the stock
+    cifar-10-batches-py pickles when present."""
+    data_dir = data_dir or os.environ.get("CIFAR10_DIR",
+                                          "/root/data/cifar10")
+    batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(batch_dir):
+        batch_dir = data_dir
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train \
+        else ["test_batch"]
+    paths = [os.path.join(batch_dir, n) for n in names]
+    if all(os.path.exists(p) for p in paths):
+        xs, ys = [], []
+        for p in paths:
+            with open(p, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.extend(d[b"labels"])
+        X = (np.concatenate(xs).reshape(-1, 3, 32, 32).astype(np.float32)
+             / 255.0)
+        return X, np.asarray(ys, np.int64)
+    return synthetic_cifar10(n_synthetic if train else n_synthetic // 4,
+                             seed=0 if train else 1)
+
+
+def load_emnist(split: str = "balanced", train: bool = True,
+                data_dir: Optional[str] = None, n_synthetic: int = 4096):
+    """(features NCHW float32, int labels) for an EMNIST split; idx files
+    named emnist-<split>-{train,test}-{images-idx3,labels-idx1}-ubyte."""
+    if split not in EMNIST_SETS:
+        raise ValueError(f"unknown EMNIST split {split!r}; "
+                         f"have {sorted(EMNIST_SETS)}")
+    data_dir = data_dir or os.environ.get("EMNIST_DIR", "/root/data/emnist")
+    key = "train" if train else "test"
+    img = lab = None
+    if os.path.isdir(data_dir):
+        img = _find(data_dir, f"emnist-{split}-{key}-images-idx3-ubyte")
+        lab = _find(data_dir, f"emnist-{split}-{key}-labels-idx1-ubyte")
+    if img and lab:
+        X = _read_idx(img).astype(np.float32)[:, None, :, :] / 255.0
+        y = _read_idx(lab).astype(np.int64)
+        # EMNIST 'letters' labels are 1-based in the source files
+        if split == "letters":
+            y = y - 1
+        return X, y
+    n_classes = EMNIST_SETS[split]
+    rng = np.random.default_rng(2 if train else 3)
+    n = n_synthetic if train else n_synthetic // 4
+    labels = rng.integers(0, n_classes, n)
+    X = rng.normal(0.1, 0.05, size=(n, 1, 28, 28)).astype(np.float32)
+    for c in range(n_classes):
+        mask = labels == c
+        r, col = divmod(c % 16, 4)
+        X[mask, 0, 7 * r:7 * r + 6, 7 * col:7 * col + 6] += \
+            0.5 + 0.4 * (c // 16)
+    return np.clip(X, 0, 1), labels.astype(np.int64)
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """Reference: Cifar10DataSetIterator(batch) — (B,3,32,32) + one-hot."""
+
+    def __init__(self, batch_size: int = 128, train: bool = True,
+                 shuffle: bool = True, seed: int = 6,
+                 data_dir: Optional[str] = None, n_synthetic: int = 4096):
+        X, y = load_cifar10(train=train, data_dir=data_dir,
+                            n_synthetic=n_synthetic)
+        Y = np.eye(10, dtype=np.float32)[y]
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+        self.raw_labels = y
+
+
+class EmnistDataSetIterator(ArrayDataSetIterator):
+    """Reference: EmnistDataSetIterator(set, batch, train)."""
+
+    def __init__(self, split: str = "balanced", batch_size: int = 128,
+                 train: bool = True, shuffle: bool = True, seed: int = 6,
+                 data_dir: Optional[str] = None, n_synthetic: int = 4096):
+        X, y = load_emnist(split, train=train, data_dir=data_dir,
+                           n_synthetic=n_synthetic)
+        n_classes = EMNIST_SETS[split]
+        Y = np.eye(n_classes, dtype=np.float32)[y]
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+        self.raw_labels = y
+        self.num_classes = n_classes

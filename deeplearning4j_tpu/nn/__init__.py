@@ -26,10 +26,10 @@ from deeplearning4j_tpu.nn.layers_ext import (
     Cropping1DLayer, DepthToSpaceLayer, DotProductAttentionLayer,
     ElementWiseMultiplicationLayer, FrozenLayer, GravesLSTMLayer, GRULayer,
     PReLULayer, PrimaryCapsulesLayer, RecurrentAttentionLayer,
-    RepeatVectorLayer, RnnLossLayer, SpaceToDepthLayer, Subsampling1DLayer,
-    Upsampling1DLayer, Upsampling3DLayer, VariationalAutoencoderLayer,
-    Yolo2OutputLayer, ZeroPadding1DLayer, ZeroPadding3DLayer)
-from deeplearning4j_tpu.nn.layers_ext import PermuteLayer, ReshapeLayer
+    PermuteLayer, RepeatVectorLayer, ReshapeLayer, RnnLossLayer,
+    SpaceToDepthLayer, Subsampling1DLayer, Upsampling1DLayer,
+    Upsampling3DLayer, VariationalAutoencoderLayer, Yolo2OutputLayer,
+    ZeroPadding1DLayer, ZeroPadding3DLayer)
 from deeplearning4j_tpu.nn.transferlearning import (
     FineTuneConfiguration, TransferLearning)
 from deeplearning4j_tpu.nn.weights import init_weights

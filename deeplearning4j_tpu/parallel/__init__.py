@@ -8,9 +8,10 @@ from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, DeviceMesh)
 from deeplearning4j_tpu.parallel.sharding import (
     ShardingRule, ShardingStrategy, data_and_tensor_parallel, data_parallel,
+    megatron_data_and_tensor_parallel, megatron_tensor_parallel_rules,
     tensor_parallel_rules)
 from deeplearning4j_tpu.parallel.trainer import (
-    ParallelInference, ParallelTrainer)
+    BatchedParallelInference, ParallelInference, ParallelTrainer)
 from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention, ulysses_attention)
 from deeplearning4j_tpu.parallel.pipeline import (
@@ -22,7 +23,9 @@ __all__ = [
     "DeviceMesh", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
     "ShardingRule", "ShardingStrategy", "data_parallel",
     "data_and_tensor_parallel", "tensor_parallel_rules",
-    "ParallelTrainer", "ParallelInference", "ring_attention",
+    "ParallelTrainer", "ParallelInference", "BatchedParallelInference",
+    "megatron_data_and_tensor_parallel", "megatron_tensor_parallel_rules",
+    "ring_attention",
     "ulysses_attention", "collectives", "multihost",
     "pipeline_forward", "pipeline_train_step", "place_stage_params",
     "sequential_forward", "split_microbatches",

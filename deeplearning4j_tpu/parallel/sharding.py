@@ -96,3 +96,54 @@ def data_and_tensor_parallel(mesh: DeviceMesh) -> ShardingStrategy:
     """2D DP×TP: batch over 'data', weights over 'model'."""
     return ShardingStrategy(mesh, param_rules=tensor_parallel_rules(),
                             batch_axes=(DATA_AXIS,))
+
+
+def megatron_tensor_parallel_rules(param_names) -> List[ShardingRule]:
+    """Megatron-style COLUMN→ROW alternation derived from the actual
+    parameter names of a built network (scaling-book MLP recipe): the
+    first dense kernel of each consecutive dense pair shards its OUTPUT
+    dim (column parallel — activations leave sharded on 'model'), the
+    second shards its INPUT dim (row parallel — XLA closes the pair with
+    ONE psum where the contraction meets the sharded dim). Column-layer
+    biases shard with their kernel; row-layer biases replicate (added
+    after the psum).
+
+    Fixes the column-only scheme (round-3 Weak #6): column-only forces an
+    all-gather of every activation between layers; the alternation keeps
+    activations sharded through the pair and halves TP communication.
+    """
+    dense = [n for n in param_names
+             if re.match(r"^(.*?)(?:_dense|_out)_W$", n)]
+    if not dense:
+        import warnings
+        warnings.warn(
+            "megatron_tensor_parallel_rules: no dense/output kernels found "
+            "in the parameter names — tensor parallelism will be OFF "
+            "(custom vertex names need explicit ShardingRules)")
+    rules: List[ShardingRule] = []
+    for i, wname in enumerate(dense):
+        stem = wname[:-1]                       # strip the trailing 'W'
+        if i % 2 == 0:                          # column parallel
+            rules.append(ShardingRule("^" + re.escape(wname) + "$",
+                                      (None, MODEL_AXIS)))
+            rules.append(ShardingRule("^" + re.escape(stem) + "b$",
+                                      (MODEL_AXIS,)))
+        else:                                   # row parallel
+            rules.append(ShardingRule("^" + re.escape(wname) + "$",
+                                      (MODEL_AXIS, None)))
+            rules.append(ShardingRule("^" + re.escape(stem) + "b$",
+                                      (None,)))
+    # everything else follows the generic rules
+    rules.extend(tensor_parallel_rules())
+    return rules
+
+
+def megatron_data_and_tensor_parallel(mesh: DeviceMesh,
+                                      model) -> ShardingStrategy:
+    """DP×TP with column/row alternation derived from ``model``'s actual
+    parameters (SameDiff or layer network)."""
+    sd = getattr(model, "samediff", model)
+    return ShardingStrategy(
+        mesh, param_rules=megatron_tensor_parallel_rules(
+            list(sd.trainable_params())),
+        batch_axes=(DATA_AXIS,))

@@ -8,6 +8,11 @@ ready for fit/output), mirroring ZooModel.init().
 from deeplearning4j_tpu.zoo.models import (
     AlexNet, LeNet, ResNet50, SimpleCNN, TextGenLSTM, TransformerEncoder,
     VGG16)
+from deeplearning4j_tpu.zoo.models_ext import (
+    Darknet19, SqueezeNet, TinyYOLO, UNet, Xception)
+from deeplearning4j_tpu.zoo.bert import BERT_BASE, BERT_TINY, BertConfig, bert_base
 
 __all__ = ["LeNet", "SimpleCNN", "AlexNet", "VGG16", "ResNet50",
-           "TextGenLSTM", "TransformerEncoder"]
+           "TextGenLSTM", "TransformerEncoder", "SqueezeNet", "UNet",
+           "Xception", "Darknet19", "TinyYOLO", "BertConfig", "BERT_BASE",
+           "BERT_TINY", "bert_base"]
