@@ -1,0 +1,751 @@
+"""ONNX ModelProto → SameDiff importer.
+
+Reference parity: nd4j samediff-import-onnx (ImportGraph.kt:218 with the
+onnx OpMappingRegistry; the per-op rule table role of
+ImportClassMapping.java:40). Same TPU-native design as the TF importer
+(tf_import.py): structural tensors const-fold at import time into static
+op attrs so the traced graph is pure dataflow; constant-propagation folds
+all-const subgraphs; ``trainable="auto"`` turns float initializers of
+rank>=1 into trainable VARIABLEs for fine-tuning.
+
+ONNX specifics vs TF: graphs are topologically sorted by spec (kept as a
+fallback check), weights live in graph.initializer, convs/pools are
+NCHW/OIHW (kernels transpose to HWIO at import; conv ops run with
+data_format="NCHW" to preserve graph semantics), and opset>=10 ops pass
+structural args (Slice starts/ends, Pad pads, Clip min/max) as inputs —
+all folded.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.modelimport.onnx_pb import (
+    OnnxModel, onnx_dtype_to_np)
+from deeplearning4j_tpu.modelimport.tf_import import TFImportError, _Val
+from deeplearning4j_tpu.ops import registry
+
+
+class OnnxImportError(TFImportError):
+    pass
+
+
+class OnnxImporter:
+    def __init__(self, model: OnnxModel,
+                 trainable: Union[None, str, Callable] = None,
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None):
+        self.graph = model.graph
+        self.sd = SameDiff()
+        self.input_shapes = dict(input_shapes or {})
+        self._tensors: Dict[str, _Val] = {}
+        if trainable == "auto":
+            self._trainable = lambda name, arr: (
+                np.issubdtype(arr.dtype, np.floating) and arr.ndim >= 1)
+        elif callable(trainable):
+            self._trainable = trainable
+        else:
+            self._trainable = lambda name, arr: False
+        self.placeholder_names: List[str] = []
+        self.variable_names: List[str] = []
+
+    # ------------------------------------------------------------------
+    def run(self) -> SameDiff:
+        g = self.graph
+        for name, arr in g.initializers.items():
+            if self._trainable(name, arr):
+                v = self.sd.var(name, value=arr, dtype=str(arr.dtype))
+                self.variable_names.append(v.name)
+                self._tensors[name] = _Val(var=v)
+            else:
+                self._tensors[name] = _Val(const=arr, name=name)
+        for name, dtype_enum, dims in g.inputs:
+            if name in self._tensors:        # initializer doubles as input
+                continue
+            shape = self.input_shapes.get(name)
+            if shape is None and dims is not None:
+                shape = [(-1 if d < 0 else d) for d in dims]
+            np_dt = onnx_dtype_to_np(dtype_enum) if dtype_enum \
+                else np.dtype(np.float32)
+            ph = self.sd.placeholder(name, shape=shape, dtype=str(np_dt))
+            self.placeholder_names.append(ph.name)
+            self._tensors[name] = _Val(var=ph)
+        for node in g.nodes:
+            try:
+                self._import_node(node)
+            except OnnxImportError:
+                raise
+            except Exception as e:
+                raise OnnxImportError(
+                    f"while importing node {node.op_type} "
+                    f"{node.name!r}: {e}") from e
+        return self.sd
+
+    # ------------------------------------------------------------------
+    def _resolve(self, ref: str) -> _Val:
+        try:
+            return self._tensors[ref]
+        except KeyError:
+            raise OnnxImportError(
+                f"input {ref!r} not produced by any imported node (ONNX "
+                f"graphs must be topologically sorted)") from None
+
+    def _ins(self, node) -> List[_Val]:
+        # optional inputs are empty strings in ONNX
+        return [self._resolve(r) for r in node.inputs if r]
+
+    def _materialize(self, v: _Val):
+        if v.var is None:
+            v.var = self.sd.constant(np.asarray(v.const),
+                                     name=v._name or "onnx_const")
+        return v.var
+
+    def _const_np(self, v: _Val, what: str) -> np.ndarray:
+        if not v.is_const:
+            raise OnnxImportError(
+                f"{what} must be trace-time constant (derived from "
+                f"initializers and static shapes)")
+        return np.asarray(v.const)
+
+    def _ints(self, v, what):
+        return tuple(int(x) for x in self._const_np(v, what).reshape(-1))
+
+    def emit(self, op_name: str, ins: Sequence[_Val], attrs: Dict,
+             name: str, n_outputs: int = 1) -> List[_Val]:
+        if all(v.is_const for v in ins):
+            fn = registry.get_op(op_name).fn
+            res = fn(*[np.asarray(v.const) for v in ins], **attrs)
+            res = res if isinstance(res, (tuple, list)) else [res]
+            return [_Val(const=np.asarray(r), name=name) for r in res]
+        vars_ = [self._materialize(v) for v in ins]
+        out = self.sd.invoke(op_name, vars_, attrs=attrs, name=name,
+                             n_outputs=n_outputs)
+        outs = out if isinstance(out, list) else [out]
+        return [_Val(var=o) for o in outs]
+
+    def _static_shape(self, v: _Val, what: str):
+        if v.is_const:
+            return tuple(np.asarray(v.const).shape)
+        shape = v.var.shape
+        if shape is None or any(d is None or d < 0 for d in shape):
+            raise OnnxImportError(f"{what}: input shape {shape} not static; "
+                                  f"pass input_shapes= with concrete dims")
+        return tuple(shape)
+
+    # ------------------------------------------------------------------
+    def _import_node(self, node):
+        mapper = _MAPPERS.get(node.op_type)
+        if mapper is None:
+            raise OnnxImportError(
+                f"unmapped ONNX op {node.op_type!r} (node {node.name!r}); "
+                f"{len(_MAPPERS)} ops supported")
+        outs = mapper(self, node, self._ins(node))
+        if isinstance(outs, _Val):
+            outs = [outs]
+        for ref, val in zip(node.outputs, outs):
+            if ref:
+                val._name = val._name or ref
+                self._tensors[ref] = val
+                if val.var is not None and self.sd.has_variable(val.var.name) \
+                        and val.var.name != ref and not self.sd.has_variable(ref):
+                    self.sd.rename_variable(val.var.name, ref)
+
+
+# ---------------------------------------------------------------------------
+_MAPPERS: Dict[str, Callable] = {}
+
+
+def _mapper(*names):
+    def deco(fn):
+        for n in names:
+            _MAPPERS[n] = fn
+        return fn
+    return deco
+
+
+def _a_i(node, name, default=0):
+    a = node.attr(name)
+    return a.i if a is not None else default
+
+
+def _a_f(node, name, default=0.0):
+    a = node.attr(name)
+    return a.f if a is not None else default
+
+
+def _a_s(node, name, default=""):
+    a = node.attr(name)
+    return a.s if a is not None else default
+
+
+def _a_ints(node, name, default=()):
+    a = node.attr(name)
+    return list(a.ints) if a is not None else list(default)
+
+
+def _out_name(node):
+    return node.name or node.outputs[0]
+
+
+# --- elementwise -----------------------------------------------------------
+_UNARY = {
+    "Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh", "Exp": "exp",
+    "Log": "log", "Sqrt": "sqrt", "Abs": "abs", "Neg": "neg",
+    "Floor": "floor", "Ceil": "ceil", "Round": "round", "Erf": "erf",
+    "Softplus": "softplus", "Softsign": "softsign", "Sign": "sign",
+    "Reciprocal": "reciprocal", "Sin": "sin", "Cos": "cos", "Tan": "tan",
+    "Asin": "asin", "Acos": "acos", "Atan": "atan", "Sinh": "sinh",
+    "Cosh": "cosh", "Asinh": "asinh", "Acosh": "acosh", "Atanh": "atanh",
+    "Not": "not", "Identity": "identity", "Mish": "mish",
+}
+for _o, _r in _UNARY.items():
+    def _mk(reg):
+        def m(imp, node, ins):
+            return imp.emit(reg, ins, {}, _out_name(node))
+        return m
+    _MAPPERS[_o] = _mk(_r)
+
+_BINARY = {
+    "Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
+    "Pow": "pow_pairwise", "Equal": "equals", "Greater": "greater",
+    "GreaterOrEqual": "greater_equal", "Less": "less",
+    "LessOrEqual": "less_equal", "And": "boolean_and", "Or": "boolean_or",
+    "Xor": "boolean_xor", "Mod": "mod",
+}
+for _o, _r in _BINARY.items():
+    def _mkb(reg):
+        def m(imp, node, ins):
+            return imp.emit(reg, ins, {}, _out_name(node))
+        return m
+    _MAPPERS[_o] = _mkb(_r)
+
+
+@_mapper("Max", "Min", "Sum", "Mean")
+def _m_variadic(imp, node, ins):
+    reg = {"Max": "maximum", "Min": "minimum"}.get(node.op_type)
+    acc = ins[0]
+    for i, v in enumerate(ins[1:]):
+        if reg:
+            acc = imp.emit(reg, [acc, v], {}, f"{_out_name(node)}_{i}")[0]
+        else:
+            acc = imp.emit("add", [acc, v], {}, f"{_out_name(node)}_{i}")[0]
+    if node.op_type == "Mean" and len(ins) > 1:
+        acc = imp.emit("scalar_mul", [acc], {"scalar": 1.0 / len(ins)},
+                       _out_name(node))[0]
+    return acc
+
+
+@_mapper("LeakyRelu")
+def _m_leaky(imp, node, ins):
+    return imp.emit("leaky_relu", ins, {"alpha": _a_f(node, "alpha", 0.01)},
+                    _out_name(node))
+
+
+@_mapper("Elu")
+def _m_elu(imp, node, ins):
+    if abs(_a_f(node, "alpha", 1.0) - 1.0) > 1e-9:
+        raise OnnxImportError("Elu alpha != 1 unsupported")
+    return imp.emit("elu", ins, {}, _out_name(node))
+
+
+@_mapper("Selu")
+def _m_selu(imp, node, ins):
+    return imp.emit("selu", ins, {}, _out_name(node))
+
+
+@_mapper("PRelu")
+def _m_prelu(imp, node, ins):
+    return imp.emit("prelu", ins, {}, _out_name(node))
+
+
+@_mapper("HardSigmoid")
+def _m_hard_sigmoid(imp, node, ins):
+    if abs(_a_f(node, "alpha", 0.2) - 0.2) > 1e-9 or \
+            abs(_a_f(node, "beta", 0.5) - 0.5) > 1e-9:
+        raise OnnxImportError("HardSigmoid alpha/beta != 0.2/0.5 "
+                              "unsupported")
+    return imp.emit("hard_sigmoid", ins, {}, _out_name(node))
+
+
+@_mapper("Softmax")
+def _m_softmax(imp, node, ins):
+    return imp.emit("softmax", ins, {"axis": _a_i(node, "axis", -1)},
+                    _out_name(node))
+
+
+@_mapper("LogSoftmax")
+def _m_log_softmax(imp, node, ins):
+    return imp.emit("log_softmax", ins, {"axis": _a_i(node, "axis", -1)},
+                    _out_name(node))
+
+
+@_mapper("Clip")
+def _m_clip(imp, node, ins):
+    lo = float(imp._const_np(ins[1], "Clip min")) if len(ins) > 1 \
+        else float("-inf")
+    hi = float(imp._const_np(ins[2], "Clip max")) if len(ins) > 2 \
+        else float("inf")
+    return imp.emit("clip_by_value", [ins[0]],
+                    {"clip_min": lo, "clip_max": hi}, _out_name(node))
+
+
+@_mapper("Where")
+def _m_where(imp, node, ins):
+    return imp.emit("where_op", ins, {}, _out_name(node))
+
+
+@_mapper("Cast")
+def _m_cast(imp, node, ins):
+    dt = onnx_dtype_to_np(_a_i(node, "to", 1))
+    return imp.emit("cast", ins, {"dtype": str(dt)}, _out_name(node))
+
+
+@_mapper("Dropout")
+def _m_dropout(imp, node, ins):
+    # inference graphs: identity (mask output unsupported)
+    return imp.emit("identity", [ins[0]], {}, _out_name(node))
+
+
+# --- matmul / gemm ---------------------------------------------------------
+@_mapper("MatMul")
+def _m_matmul(imp, node, ins):
+    a, b = ins
+    return imp.emit("matmul", [a, b], {}, _out_name(node))
+
+
+@_mapper("Gemm")
+def _m_gemm(imp, node, ins):
+    attrs = {"alpha": _a_f(node, "alpha", 1.0),
+             "beta": _a_f(node, "beta", 1.0),
+             "transpose_a": bool(_a_i(node, "transA", 0)),
+             "transpose_b": bool(_a_i(node, "transB", 0))}
+    mm = imp.emit("gemm", ins[:2],
+                  {"alpha": attrs["alpha"],
+                   "transpose_a": attrs["transpose_a"],
+                   "transpose_b": attrs["transpose_b"]},
+                  _out_name(node) + ("_mm" if len(ins) > 2 else ""))
+    if len(ins) > 2:
+        c = ins[2]
+        if attrs["beta"] != 1.0:
+            c = imp.emit("scalar_mul", [c], {"scalar": attrs["beta"]},
+                         _out_name(node) + "_c")[0]
+        return imp.emit("add", [mm[0], c], {}, _out_name(node))
+    return mm
+
+
+@_mapper("Einsum")
+def _m_einsum(imp, node, ins):
+    return imp.emit("einsum", ins, {"equation": _a_s(node, "equation")},
+                    _out_name(node))
+
+
+# --- conv / pool / norm (NCHW / OIHW per ONNX spec) ------------------------
+def _conv_padding(node, spatial_dims=2):
+    auto = _a_s(node, "auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        return "SAME", None
+    pads = _a_ints(node, "pads", [0] * (2 * spatial_dims))
+    if any(pads):
+        return "VALID", pads
+    return "VALID", None
+
+
+@_mapper("Conv")
+def _m_conv(imp, node, ins):
+    x, w = ins[0], ins[1]
+    group = _a_i(node, "group", 1)
+    strides = _a_ints(node, "strides", [1, 1])
+    dil = _a_ints(node, "dilations", [1, 1])
+    padding, pads = _conv_padding(node)
+    name = _out_name(node)
+    if pads:
+        t, l, b, r = (pads + [0, 0, 0, 0])[:4] if len(pads) == 4 \
+            else (pads[0], pads[1], pads[0], pads[1])
+        x = imp.emit("pad", [x],
+                     {"paddings": ((0, 0), (0, 0), (t, b), (l, r))},
+                     f"{name}_pad")[0]
+    # kernel OIHW -> HWIO
+    w = imp.emit("permute", [w], {"axes": (2, 3, 1, 0)}, f"{name}_whwio")[0]
+    if group > 1:
+        c_in = None
+        if ins[1].is_const:
+            c_in = ins[1].const.shape[1] * group
+        if c_in is None or group != c_in:
+            raise OnnxImportError("grouped Conv supported only as full "
+                                  "depthwise (group == C_in)")
+        # depthwise: HWIO (kh, kw, 1, C) -> depthwise layout (kh, kw, C, 1)
+        w = imp.emit("permute", [w], {"axes": (0, 1, 3, 2)},
+                     f"{name}_wdw")[0]
+        conv = imp.emit("depthwise_conv2d", [x, w] + ins[2:3], {
+            "strides": tuple(strides), "padding": padding,
+            "dilation": tuple(dil), "data_format": "NCHW"}, name)
+        return conv
+    return imp.emit("conv2d", [x, w] + ins[2:3], {
+        "strides": tuple(strides), "padding": padding,
+        "dilation": tuple(dil), "data_format": "NCHW"}, name)
+
+
+@_mapper("ConvTranspose")
+def _m_conv_transpose(imp, node, ins):
+    x, w = ins[0], ins[1]
+    strides = _a_ints(node, "strides", [1, 1])
+    auto = _a_s(node, "auto_pad", "NOTSET")
+    pads = _a_ints(node, "pads", [])
+    if pads and any(pads):
+        raise OnnxImportError("ConvTranspose with explicit pads "
+                              "unsupported (use auto_pad)")
+    name = _out_name(node)
+    # ONNX deconv kernel (C_in, C_out/group, kH, kW) -> our (kh, kw, oC, iC)
+    w = imp.emit("permute", [w], {"axes": (2, 3, 1, 0)}, f"{name}_w")[0]
+    return imp.emit("deconv2d", [x, w] + ins[2:3], {
+        "strides": tuple(strides),
+        "padding": "SAME" if auto in ("SAME_UPPER", "SAME_LOWER")
+        else "VALID",
+        "data_format": "NCHW"}, name)
+
+
+def _pool(imp, node, ins, reg):
+    ks = _a_ints(node, "kernel_shape", [2, 2])
+    st = _a_ints(node, "strides", ks)
+    padding, pads = _conv_padding(node)
+    x = ins[0]
+    name = _out_name(node)
+    if pads:
+        t, l, b, r = (pads + [0, 0, 0, 0])[:4] if len(pads) == 4 \
+            else (pads[0], pads[1], pads[0], pads[1])
+        cval = -np.inf if reg == "max_pool2d" else 0.0
+        x = imp.emit("pad", [x],
+                     {"paddings": ((0, 0), (0, 0), (t, b), (l, r)),
+                      "constant": cval}, f"{name}_pad")[0]
+    return imp.emit(reg, [x], {"kernel": tuple(ks), "strides": tuple(st),
+                               "padding": padding, "data_format": "NCHW"},
+                    name)
+
+
+@_mapper("MaxPool")
+def _m_max_pool(imp, node, ins):
+    return _pool(imp, node, ins, "max_pool2d")
+
+
+@_mapper("AveragePool")
+def _m_avg_pool(imp, node, ins):
+    return _pool(imp, node, ins, "avg_pool2d")
+
+
+@_mapper("GlobalAveragePool")
+def _m_gap(imp, node, ins):
+    return imp.emit("global_avg_pool", ins,
+                    {"data_format": "NCHW", "keep_dims": True},
+                    _out_name(node))
+
+
+@_mapper("GlobalMaxPool")
+def _m_gmp(imp, node, ins):
+    return imp.emit("global_max_pool", ins,
+                    {"data_format": "NCHW", "keep_dims": True},
+                    _out_name(node))
+
+
+@_mapper("BatchNormalization")
+def _m_bn(imp, node, ins):
+    x, scale, bias, mean, var = ins[:5]
+    return imp.emit("batchnorm", [x, mean, var, scale, bias],
+                    {"epsilon": _a_f(node, "epsilon", 1e-5), "axis": 1},
+                    _out_name(node))
+
+
+@_mapper("LayerNormalization")
+def _m_ln(imp, node, ins):
+    if _a_i(node, "axis", -1) not in (-1,):
+        raise OnnxImportError("LayerNormalization axis != -1 unsupported")
+    return imp.emit("layer_norm", ins[:3],
+                    {"axis": -1, "epsilon": _a_f(node, "epsilon", 1e-5)},
+                    _out_name(node))
+
+
+@_mapper("InstanceNormalization")
+def _m_inorm(imp, node, ins):
+    x, scale, bias = ins
+    eps = _a_f(node, "epsilon", 1e-5)
+    name = _out_name(node)
+    std = imp.emit("standardize", [x], {"axis": (2, 3), "epsilon": eps},
+                   f"{name}_std")[0]
+    sc = imp.emit("reshape", [scale], {"shape": (1, -1, 1, 1)},
+                  f"{name}_sc")[0]
+    bi = imp.emit("reshape", [bias], {"shape": (1, -1, 1, 1)},
+                  f"{name}_bi")[0]
+    y = imp.emit("multiply", [std, sc], {}, f"{name}_m")[0]
+    return imp.emit("add", [y, bi], {}, name)
+
+
+# --- shape / structure -----------------------------------------------------
+@_mapper("Shape")
+def _m_shape(imp, node, ins):
+    shape = imp._static_shape(ins[0], "Shape")
+    return _Val(const=np.asarray(shape, np.int64), name=_out_name(node))
+
+
+@_mapper("Size")
+def _m_size(imp, node, ins):
+    shape = imp._static_shape(ins[0], "Size")
+    return _Val(const=np.asarray(int(np.prod(shape)), np.int64))
+
+
+@_mapper("Reshape")
+def _m_reshape(imp, node, ins):
+    shape = list(imp._ints(ins[1], "Reshape shape"))
+    if 0 in shape:      # 0 = copy input dim (allowzero=0 default)
+        in_shape = imp._static_shape(ins[0], "Reshape")
+        shape = [in_shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return imp.emit("reshape", [ins[0]], {"shape": tuple(shape)},
+                    _out_name(node))
+
+
+@_mapper("Flatten")
+def _m_flatten(imp, node, ins):
+    ax = _a_i(node, "axis", 1)
+    if ax == 0:
+        return imp.emit("reshape", [ins[0]], {"shape": (1, -1)},
+                        _out_name(node))
+    if ax == 1:
+        # batch dim may be dynamic; only the trailing dims need folding
+        return imp.emit("flatten_2d", [ins[0]], {}, _out_name(node))
+    in_shape = imp._static_shape(ins[0], "Flatten")
+    lead = int(np.prod(in_shape[:ax]))
+    return imp.emit("reshape", [ins[0]], {"shape": (lead, -1)},
+                    _out_name(node))
+
+
+@_mapper("Transpose")
+def _m_transpose(imp, node, ins):
+    perm = _a_ints(node, "perm")
+    if not perm:
+        nd = len(imp._static_shape(ins[0], "Transpose"))
+        perm = list(range(nd))[::-1]
+    return imp.emit("permute", [ins[0]], {"axes": tuple(perm)},
+                    _out_name(node))
+
+
+@_mapper("Squeeze")
+def _m_squeeze(imp, node, ins):
+    axes = _a_ints(node, "axes")
+    if len(ins) > 1:
+        axes = list(imp._ints(ins[1], "Squeeze axes"))
+    return imp.emit("squeeze", [ins[0]],
+                    {"axis": tuple(axes) if axes else None},
+                    _out_name(node))
+
+
+@_mapper("Unsqueeze")
+def _m_unsqueeze(imp, node, ins):
+    axes = _a_ints(node, "axes")
+    if len(ins) > 1:
+        axes = list(imp._ints(ins[1], "Unsqueeze axes"))
+    out = ins[0]
+    for i, ax in enumerate(sorted(axes)):
+        out = imp.emit("expand_dims", [out], {"axis": ax},
+                       f"{_out_name(node)}_{i}" if i < len(axes) - 1
+                       else _out_name(node))[0]
+    return out
+
+
+@_mapper("Concat")
+def _m_concat(imp, node, ins):
+    return imp.emit("concat", ins, {"axis": _a_i(node, "axis", 0)},
+                    _out_name(node))
+
+
+@_mapper("Split")
+def _m_split(imp, node, ins):
+    axis = _a_i(node, "axis", 0)
+    sizes = _a_ints(node, "split")
+    if len(ins) > 1:
+        sizes = list(imp._ints(ins[1], "Split sizes"))
+    n = len(node.outputs)
+    if sizes:
+        return imp.emit("split_v", [ins[0]],
+                        {"sizes": tuple(sizes), "axis": axis},
+                        _out_name(node), n_outputs=len(sizes))
+    return imp.emit("split", [ins[0]], {"num_split": n, "axis": axis},
+                    _out_name(node), n_outputs=n)
+
+
+@_mapper("Slice")
+def _m_slice(imp, node, ins):
+    if len(ins) >= 3:        # opset >= 10: starts/ends[/axes/steps] inputs
+        starts = list(imp._ints(ins[1], "Slice starts"))
+        ends = list(imp._ints(ins[2], "Slice ends"))
+        axes = list(imp._ints(ins[3], "Slice axes")) if len(ins) > 3 \
+            else list(range(len(starts)))
+        steps = list(imp._ints(ins[4], "Slice steps")) if len(ins) > 4 \
+            else [1] * len(starts)
+    else:                    # opset 1: attributes
+        starts = _a_ints(node, "starts")
+        ends = _a_ints(node, "ends")
+        axes = _a_ints(node, "axes") or list(range(len(starts)))
+        steps = [1] * len(starts)
+    nd = len(imp._static_shape(ins[0], "Slice"))
+    big = 2 ** 31 - 1
+    begin = [0] * nd
+    end = [big] * nd
+    strides = [1] * nd
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        begin[a], end[a], strides[a] = s, min(e, big), st
+    return imp.emit("strided_slice", [ins[0]],
+                    {"begin": tuple(begin), "end": tuple(end),
+                     "strides": tuple(strides)}, _out_name(node))
+
+
+@_mapper("Gather")
+def _m_gather(imp, node, ins):
+    return imp.emit("gather", ins[:2], {"axis": _a_i(node, "axis", 0)},
+                    _out_name(node))
+
+
+@_mapper("GatherND")
+def _m_gather_nd(imp, node, ins):
+    if _a_i(node, "batch_dims", 0):
+        raise OnnxImportError("GatherND batch_dims != 0 unsupported")
+    return imp.emit("gather_nd", ins, {}, _out_name(node))
+
+
+@_mapper("OneHot")
+def _m_one_hot(imp, node, ins):
+    depth = int(imp._const_np(ins[1], "OneHot depth"))
+    values = imp._const_np(ins[2], "OneHot values")   # [off, on]
+    return imp.emit("one_hot", [ins[0]],
+                    {"depth": depth, "on_value": float(values[1]),
+                     "off_value": float(values[0]),
+                     "axis": _a_i(node, "axis", -1)}, _out_name(node))
+
+
+@_mapper("Constant")
+def _m_constant(imp, node, ins):
+    a = node.attr("value")
+    if a is None:
+        raise OnnxImportError("Constant without 'value' tensor")
+    return _Val(const=a.t, name=_out_name(node))
+
+
+@_mapper("ConstantOfShape")
+def _m_constant_of_shape(imp, node, ins):
+    shape = imp._ints(ins[0], "ConstantOfShape shape")
+    a = node.attr("value")
+    val = a.t if a is not None else np.zeros(1, np.float32)
+    return _Val(const=np.full(shape, val.reshape(-1)[0], val.dtype),
+                name=_out_name(node))
+
+
+@_mapper("Expand")
+def _m_expand(imp, node, ins):
+    shape = imp._ints(ins[1], "Expand shape")
+    in_shape = imp._static_shape(ins[0], "Expand")
+    # ONNX Expand broadcasts bidirectionally
+    out = tuple(max(a, b) for a, b in
+                zip((1,) * (len(shape) - len(in_shape)) + tuple(in_shape),
+                    shape))
+    return imp.emit("broadcast_to", [ins[0]], {"shape": out},
+                    _out_name(node))
+
+
+@_mapper("Tile")
+def _m_tile(imp, node, ins):
+    return imp.emit("tile", [ins[0]],
+                    {"reps": imp._ints(ins[1], "Tile repeats")},
+                    _out_name(node))
+
+
+@_mapper("Pad")
+def _m_pad(imp, node, ins):
+    mode = _a_s(node, "mode", "constant")
+    if len(ins) > 1:
+        pads = list(imp._ints(ins[1], "Pad pads"))
+    else:
+        pads = _a_ints(node, "pads")
+    nd = len(pads) // 2
+    paddings = [(pads[i], pads[i + nd]) for i in range(nd)]
+    const = 0.0
+    if len(ins) > 2:
+        const = float(imp._const_np(ins[2], "Pad value"))
+    return imp.emit("pad", [ins[0]],
+                    {"paddings": paddings, "mode": mode,
+                     "constant": const}, _out_name(node))
+
+
+@_mapper("Range")
+def _m_range(imp, node, ins):
+    s = imp._const_np(ins[0], "Range start")
+    l = imp._const_np(ins[1], "Range limit")
+    d = imp._const_np(ins[2], "Range delta")
+    return _Val(const=np.arange(s, l, d), name=_out_name(node))
+
+
+@_mapper("CumSum")
+def _m_cumsum(imp, node, ins):
+    axis = int(imp._const_np(ins[1], "CumSum axis"))
+    return imp.emit("cumsum", [ins[0]],
+                    {"axis": axis, "exclusive": bool(_a_i(node, "exclusive")),
+                     "reverse": bool(_a_i(node, "reverse"))},
+                    _out_name(node))
+
+
+# --- reductions ------------------------------------------------------------
+_REDUCE = {"ReduceMean": "reduce_mean", "ReduceSum": "reduce_sum",
+           "ReduceMax": "reduce_max", "ReduceMin": "reduce_min",
+           "ReduceProd": "reduce_prod", "ReduceL2": "reduce_norm2"}
+
+
+def _mk_reduce(reg):
+    def m(imp, node, ins):
+        axes = _a_ints(node, "axes")
+        if len(ins) > 1:                      # opset >= 13/18: axes input
+            axes = list(imp._ints(ins[1], f"{node.op_type} axes"))
+        return imp.emit(reg, [ins[0]],
+                        {"axis": tuple(axes) or None,
+                         "keep_dims": bool(_a_i(node, "keepdims", 1))},
+                        _out_name(node))
+    return m
+
+
+for _o, _r in _REDUCE.items():
+    _MAPPERS[_o] = _mk_reduce(_r)
+
+
+@_mapper("ArgMax")
+def _m_argmax(imp, node, ins):
+    return imp.emit("argmax", ins,
+                    {"axis": _a_i(node, "axis", 0),
+                     "keep_dims": bool(_a_i(node, "keepdims", 1))},
+                    _out_name(node))
+
+
+@_mapper("ArgMin")
+def _m_argmin(imp, node, ins):
+    return imp.emit("argmin", ins,
+                    {"axis": _a_i(node, "axis", 0),
+                     "keep_dims": bool(_a_i(node, "keepdims", 1))},
+                    _out_name(node))
+
+
+# ---------------------------------------------------------------------------
+def import_onnx_model(source: Union[str, bytes, OnnxModel],
+                      trainable: Union[None, str, Callable] = None,
+                      input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                      ) -> SameDiff:
+    """Import an ONNX ModelProto (.onnx path, bytes, or decoded model)
+    into a runnable SameDiff graph. Graph outputs keep their ONNX names.
+
+    Reference: samediff-import-onnx OnnxFrameworkImporter →
+    ImportGraph.kt:218."""
+    if isinstance(source, (str, bytes)):
+        model = OnnxModel.from_file(source) if isinstance(source, str) \
+            else OnnxModel(source)
+    else:
+        model = source
+    return OnnxImporter(model, trainable=trainable,
+                        input_shapes=input_shapes).run()
+
+
+def supported_onnx_ops() -> List[str]:
+    return sorted(set(_MAPPERS) | {"Constant"})

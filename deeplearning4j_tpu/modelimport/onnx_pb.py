@@ -1,0 +1,285 @@
+"""ONNX ModelProto schema views + builder over the protowire codec.
+
+Reference parity: nd4j samediff-import-onnx (Kotlin rule registry over
+generated onnx protobuf bindings; ImportGraph.kt:218). Field numbers are
+the frozen public onnx.proto3 schema — schema constants, not code:
+
+ModelProto:    ir_version=1, opset_import=8, graph=7
+GraphProto:    node=1, name=2, initializer=5, input=11, output=12
+NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
+AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, strings=9,
+               type=20 (FLOAT=1, INT=2, STRING=3, TENSOR=4, FLOATS=6,
+               INTS=7, STRINGS=8)
+TensorProto:   dims=1, data_type=2, float_data=4, int32_data=5,
+               string_data=6, int64_data=7, name=8, raw_data=9,
+               double_data=10, uint64_data=11
+ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1 →
+               {elem_type=1, shape=2 → dim=1 → {dim_value=1, dim_param=2}}
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.protowire import Fields
+from deeplearning4j_tpu.modelimport.tf_builder import (
+    field_bytes, field_string, field_varint)
+
+# onnx TensorProto.DataType enum
+ONNX_DTYPES: Dict[int, Optional[np.dtype]] = {
+    1: np.dtype(np.float32), 2: np.dtype(np.uint8), 3: np.dtype(np.int8),
+    4: np.dtype(np.uint16), 5: np.dtype(np.int16), 6: np.dtype(np.int32),
+    7: np.dtype(np.int64), 9: np.dtype(np.bool_), 10: np.dtype(np.float16),
+    11: np.dtype(np.float64), 12: np.dtype(np.uint32),
+    13: np.dtype(np.uint64),
+}
+NP_TO_ONNX = {v: k for k, v in ONNX_DTYPES.items() if v is not None}
+
+
+def onnx_dtype_to_np(enum: int) -> np.dtype:
+    if enum == 16:
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    dt = ONNX_DTYPES.get(enum)
+    if dt is None:
+        raise ValueError(f"unsupported ONNX dtype enum {enum}")
+    return dt
+
+
+def decode_tensor(t: Fields) -> np.ndarray:
+    dims = t.repeated_varint(1)
+    enum = t.varint(2)
+    np_dtype = onnx_dtype_to_np(enum)
+    raw = t.bytes_(9)
+    if raw:
+        return np.frombuffer(raw, np_dtype).copy().reshape(dims)
+    if enum == 1:
+        vals = np.asarray(t.repeated_f32(4), np.float32)
+    elif enum == 11:
+        vals = np.asarray(t.repeated_f64(10), np.float64)
+    elif enum in (6, 2, 3, 4, 5, 9):
+        vals = np.asarray(t.repeated_svarint(5), np_dtype)
+    elif enum == 7:
+        vals = np.asarray(t.repeated_svarint(7), np.int64)
+    elif enum in (12, 13):
+        vals = np.asarray(t.repeated_varint(11), np_dtype)
+    else:
+        raise ValueError(f"cannot decode ONNX tensor dtype {enum}")
+    return vals.reshape(dims)
+
+
+class Attribute:
+    FLOAT, INT, STRING, TENSOR = 1, 2, 3, 4
+    FLOATS, INTS, STRINGS = 6, 7, 8
+
+    def __init__(self, fields: Fields):
+        self._f = fields
+        self.name = fields.string(1)
+        self.type = fields.varint(20)
+
+    @property
+    def f(self) -> float:
+        return self._f.f32(2)
+
+    @property
+    def i(self) -> int:
+        return self._f.svarint(3)
+
+    @property
+    def s(self) -> str:
+        return self._f.bytes_(4).decode("utf-8", "replace")
+
+    @property
+    def t(self) -> np.ndarray:
+        m = self._f.message(5)
+        if m is None:
+            raise ValueError(f"attribute {self.name!r} has no tensor")
+        return decode_tensor(m)
+
+    @property
+    def floats(self) -> List[float]:
+        return self._f.repeated_f32(7)
+
+    @property
+    def ints(self) -> List[int]:
+        return self._f.repeated_svarint(8)
+
+    @property
+    def strings(self) -> List[str]:
+        return [b.decode("utf-8", "replace")
+                for b in self._f.repeated_bytes(9)]
+
+
+class NodeProto:
+    def __init__(self, fields: Fields):
+        self.inputs = fields.repeated_string(1)
+        self.outputs = fields.repeated_string(2)
+        self.name = fields.string(3)
+        self.op_type = fields.string(4)
+        self.attrs: Dict[str, Attribute] = {}
+        for af in fields.repeated_message(5):
+            a = Attribute(af)
+            self.attrs[a.name] = a
+
+    def attr(self, name: str) -> Optional[Attribute]:
+        return self.attrs.get(name)
+
+    def __repr__(self):
+        return (f"NodeProto({self.op_type} {self.name!r} "
+                f"{self.inputs}->{self.outputs})")
+
+
+def _decode_value_info(f: Fields):
+    """ValueInfoProto -> (name, dtype enum, [dims] with -1 for symbolic)."""
+    name = f.string(1)
+    tp = f.message(2)
+    elem, dims = 0, None
+    if tp is not None:
+        tt = tp.message(1)
+        if tt is not None:
+            elem = tt.varint(1)
+            shp = tt.message(2)
+            if shp is not None:
+                dims = []
+                for d in shp.repeated_message(1):
+                    dims.append(d.svarint(1) if d.has(1) else -1)
+    return name, elem, dims
+
+
+class OnnxGraph:
+    def __init__(self, fields: Fields):
+        self.nodes: List[NodeProto] = [NodeProto(f)
+                                       for f in fields.repeated_message(1)]
+        self.name = fields.string(2)
+        self.initializers: Dict[str, np.ndarray] = {}
+        for tf_ in fields.repeated_message(5):
+            arr = decode_tensor(tf_)
+            self.initializers[tf_.string(8)] = arr
+        self.inputs = [_decode_value_info(f)
+                       for f in fields.repeated_message(11)]
+        self.outputs = [_decode_value_info(f)
+                        for f in fields.repeated_message(12)]
+
+
+class OnnxModel:
+    def __init__(self, data: bytes):
+        fields = Fields(data)
+        g = fields.message(7)
+        if g is None:
+            raise ValueError("not an ONNX ModelProto (no graph field)")
+        self.graph = OnnxGraph(g)
+
+    @staticmethod
+    def from_file(path: str) -> "OnnxModel":
+        with open(path, "rb") as fh:
+            return OnnxModel(fh.read())
+
+
+# ---------------------------------------------------------------------------
+# builder (fixture generation without an onnx install; same role as
+# tf_builder for TF graphs)
+def tensor_proto(arr: np.ndarray, name: str = "") -> bytes:
+    arr = np.asarray(arr, order="C")
+    out = b""
+    for d in arr.shape:
+        out += field_varint(1, d)
+    out += field_varint(2, NP_TO_ONNX[arr.dtype])
+    if name:
+        out += field_string(8, name)
+    out += field_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    import struct
+    out = field_string(1, name)
+    if isinstance(value, float):
+        out += field_varint(20, Attribute.FLOAT)
+        out += b"\x15" + struct.pack("<f", value)     # field 2, fixed32
+    elif isinstance(value, int):
+        out += field_varint(3, value) + field_varint(20, Attribute.INT)
+    elif isinstance(value, str):
+        out += field_string(4, value) + field_varint(20, Attribute.STRING)
+    elif isinstance(value, np.ndarray):
+        out += field_bytes(5, tensor_proto(value))
+        out += field_varint(20, Attribute.TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += b"\x3d" + struct.pack("<f", v)  # field 7, fixed32
+            out += field_varint(20, Attribute.FLOATS)
+        else:
+            for v in value:
+                out += field_varint(8, int(v))
+            out += field_varint(20, Attribute.INTS)
+    else:
+        raise TypeError(f"unsupported attribute {type(value)}")
+    return out
+
+
+def node_proto(op_type: str, inputs, outputs, name: str = "",
+               **attrs) -> bytes:
+    out = b""
+    for i in inputs:
+        out += field_string(1, i)
+    for o in outputs:
+        out += field_string(2, o)
+    out += field_string(3, name or outputs[0])
+    out += field_string(4, op_type)
+    for k, v in attrs.items():
+        out += field_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, dtype_enum: int, dims) -> bytes:
+    dim_bytes = b""
+    for d in dims:
+        dim_bytes += field_bytes(1, field_varint(1, d) if d >= 0 else b"")
+    tt = field_varint(1, dtype_enum) + field_bytes(2, dim_bytes)
+    tp = field_bytes(1, tt)
+    return field_string(1, name) + field_bytes(2, tp)
+
+
+class OnnxModelBuilder:
+    """Builds serialized ModelProto bytes (test fixtures / export)."""
+
+    def __init__(self):
+        self._nodes: List[bytes] = []
+        self._inits: List[bytes] = []
+        self._inputs: List[bytes] = []
+        self._outputs: List[bytes] = []
+
+    def node(self, op_type: str, inputs, outputs, name: str = "", **attrs):
+        self._nodes.append(node_proto(op_type, inputs, outputs, name,
+                                      **attrs))
+        return self
+
+    def initializer(self, name: str, arr) -> "OnnxModelBuilder":
+        self._inits.append(tensor_proto(np.asarray(arr), name))
+        return self
+
+    def input(self, name: str, dims, dtype=np.float32):
+        self._inputs.append(value_info(name, NP_TO_ONNX[np.dtype(dtype)],
+                                       dims))
+        return self
+
+    def output(self, name: str, dims=(), dtype=np.float32):
+        self._outputs.append(value_info(name, NP_TO_ONNX[np.dtype(dtype)],
+                                        dims))
+        return self
+
+    def build(self) -> bytes:
+        g = b""
+        for n in self._nodes:
+            g += field_bytes(1, n)
+        g += field_string(2, "graph")
+        for i in self._inits:
+            g += field_bytes(5, i)
+        for i in self._inputs:
+            g += field_bytes(11, i)
+        for o in self._outputs:
+            g += field_bytes(12, o)
+        out = field_varint(1, 8)                    # ir_version
+        out += field_bytes(7, g)
+        return out

@@ -1,0 +1,174 @@
+"""ONNX import conformance: builder round-trip + numpy golden outputs.
+
+Same methodology as the TF importer tests: real serialized ModelProto
+bytes via the in-tree wire encoder (no onnx install needed), imported and
+compared against independent numpy references.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx_import import (
+    OnnxImportError, import_onnx_model, supported_onnx_ops)
+from deeplearning4j_tpu.modelimport.onnx_pb import OnnxModel, OnnxModelBuilder
+
+rng = np.random.RandomState(0)
+
+
+def _run(model_bytes, feeds, outputs, **kw):
+    sd = import_onnx_model(model_bytes, **kw)
+    res = sd.output(placeholders=feeds, outputs=outputs)
+    return {k: np.asarray(v.data) for k, v in res.items()}
+
+
+def test_wire_roundtrip():
+    b = OnnxModelBuilder()
+    b.input("x", [-1, 4])
+    b.initializer("W", rng.randn(4, 3).astype(np.float32))
+    b.node("MatMul", ["x", "W"], ["y"])
+    b.output("y", [-1, 3])
+    m = OnnxModel(b.build())
+    assert [n.op_type for n in m.graph.nodes] == ["MatMul"]
+    assert list(m.graph.initializers) == ["W"]
+    assert m.graph.inputs[0][0] == "x"
+    assert m.graph.inputs[0][2] == [-1, 4]
+
+
+def test_mlp_gemm_relu_softmax():
+    W1 = rng.randn(4, 8).astype(np.float32)
+    b1 = rng.randn(8).astype(np.float32)
+    W2 = rng.randn(8, 3).astype(np.float32)
+    b2 = rng.randn(3).astype(np.float32)
+    b = OnnxModelBuilder()
+    b.input("x", [-1, 4])
+    b.initializer("W1", W1).initializer("b1", b1)
+    b.initializer("W2", W2).initializer("b2", b2)
+    b.node("Gemm", ["x", "W1", "b1"], ["h"], alpha=1.0, beta=1.0)
+    b.node("Relu", ["h"], ["hr"])
+    b.node("Gemm", ["hr", "W2", "b2"], ["logits"])
+    b.node("Softmax", ["logits"], ["probs"], axis=-1)
+    b.output("probs", [-1, 3])
+
+    x = rng.randn(5, 4).astype(np.float32)
+    got = _run(b.build(), {"x": x}, ["probs"])["probs"]
+    h = np.maximum(x @ W1 + b1, 0)
+    logits = h @ W2 + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    want = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_bn_pool_nchw():
+    from numpy.lib.stride_tricks import sliding_window_view
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    k = rng.randn(4, 3, 3, 3).astype(np.float32)   # OIHW
+    scale = (rng.rand(4) + 0.5).astype(np.float32)
+    bias = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = (rng.rand(4) + 0.5).astype(np.float32)
+
+    b = OnnxModelBuilder()
+    b.input("x", [-1, 3, 8, 8])
+    b.initializer("k", k)
+    for nm, v in (("scale", scale), ("bias", bias), ("mean", mean),
+                  ("var", var)):
+        b.initializer(nm, v)
+    b.node("Conv", ["x", "k"], ["c"], kernel_shape=[3, 3],
+           pads=[1, 1, 1, 1], strides=[1, 1])
+    b.node("BatchNormalization", ["c", "scale", "bias", "mean", "var"],
+           ["bn"], epsilon=1e-5)
+    b.node("MaxPool", ["bn"], ["p"], kernel_shape=[2, 2], strides=[2, 2])
+    b.node("GlobalAveragePool", ["p"], ["g"])
+    b.node("Flatten", ["g"], ["out"], axis=1)
+    b.output("out", [-1, 4])
+
+    got = _run(b.build(), {"x": x}, ["out"])["out"]
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    win = sliding_window_view(xp, (3, 3), axis=(2, 3))   # (2,3,8,8,3,3)
+    conv = np.einsum("bchwij,ocij->bohw", win, k)
+    bn = ((conv - mean[:, None, None]) / np.sqrt(var + 1e-5)[:, None, None]
+          * scale[:, None, None] + bias[:, None, None])
+    pooled = bn.reshape(2, 4, 4, 2, 4, 2).max((3, 5))
+    want = pooled.mean((2, 3))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_shape_ops_and_slicing():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    b = OnnxModelBuilder()
+    b.input("x", [2, 3, 4])
+    b.node("Shape", ["x"], ["sh"])
+    b.initializer("newshape", np.array([2, 12], np.int64))
+    b.node("Reshape", ["x", "newshape"], ["r"])
+    b.initializer("starts", np.array([2], np.int64))
+    b.initializer("ends", np.array([8], np.int64))
+    b.initializer("axes", np.array([1], np.int64))
+    b.node("Slice", ["r", "starts", "ends", "axes"], ["s"])
+    b.node("Transpose", ["s"], ["t"], perm=[1, 0])
+    b.node("Concat", ["t", "t"], ["out"], axis=1)
+    b.output("out", [6, 4])
+    got = _run(b.build(), {"x": x}, ["out"])["out"]
+    want0 = x.reshape(2, 12)[:, 2:8].T
+    want = np.concatenate([want0, want0], 1)
+    np.testing.assert_allclose(got, want)
+
+
+def test_constant_folding_and_fold_ops():
+    b = OnnxModelBuilder()
+    b.input("x", [-1, 3])
+    b.node("Constant", [], ["c"], value=np.full((3,), 2.0, np.float32))
+    b.initializer("sh", np.array([2], np.int64))
+    b.node("ConstantOfShape", ["sh"], ["z"],
+           value=np.array([1.5], np.float32))
+    b.node("Mul", ["x", "c"], ["xm"])
+    b.node("ReduceSum", ["xm"], ["out"], axes=[1], keepdims=0)
+    b.output("out", [-1])
+    x = rng.randn(4, 3).astype(np.float32)
+    got = _run(b.build(), {"x": x}, ["out"])["out"]
+    np.testing.assert_allclose(got, (x * 2.0).sum(1), rtol=1e-6)
+
+
+def test_gru_like_composite_ops():
+    """Gather + Unsqueeze + Expand + Where + Cast chain."""
+    table = rng.randn(10, 4).astype(np.float32)
+    b = OnnxModelBuilder()
+    b.input("ids", [2, 3], dtype=np.int64)
+    b.initializer("table", table)
+    b.node("Gather", ["table", "ids"], ["emb"], axis=0)
+    b.node("ReduceMean", ["emb"], ["m"], axes=[2], keepdims=1)
+    b.node("Greater", ["emb", "m"], ["g"])
+    b.node("Cast", ["g"], ["gf"], to=1)
+    b.node("Mul", ["emb", "gf"], ["out"])
+    b.output("out", [2, 3, 4])
+    ids = np.array([[1, 5, 3], [0, 2, 9]], np.int64)
+    got = _run(b.build(), {"ids": ids}, ["out"])["out"]
+    emb = table[ids]
+    m = emb.mean(-1, keepdims=True)
+    want = emb * (emb > m).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_trainable_auto_and_finetune():
+    W = rng.randn(4, 2).astype(np.float32)
+    b = OnnxModelBuilder()
+    b.input("x", [-1, 4])
+    b.initializer("W", W)
+    b.node("MatMul", ["x", "W"], ["out"])
+    b.output("out", [-1, 2])
+    sd = import_onnx_model(b.build(), trainable="auto")
+    assert "W" in sd.trainable_params()
+    g = sd.calculate_gradients({"x": np.ones((3, 4), np.float32)},
+                               wrt=["W"], loss="out")
+    assert np.abs(np.asarray(g["W"].data)).sum() > 0
+
+
+def test_unmapped_op_reports_cleanly():
+    b = OnnxModelBuilder()
+    b.input("x", [2])
+    b.node("FancyCustomOp", ["x"], ["y"])
+    b.output("y", [2])
+    with pytest.raises(OnnxImportError, match="unmapped ONNX op"):
+        import_onnx_model(b.build())
+
+
+def test_supported_op_count():
+    assert len(supported_onnx_ops()) >= 90, len(supported_onnx_ops())
