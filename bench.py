@@ -172,6 +172,44 @@ def bench_bert_base(batch=16, seq_len=128, steps=4, mixed_precision=True):
             "precision": "bf16_mixed" if mixed_precision else "f32"}
 
 
+def bench_gpt_medium(batch=16, seq_len=512, steps=8, mixed_precision=True):
+    """Compute-dense flagship: GPT-medium-class decoder LM (h=1536, 16
+    layers, ffn 6144, vocab 32k, ~510M params), seq 512, per-layer remat
+    (sd.remat_scope), weight-tied head, sparse CE. This is the config
+    where MXU saturation is actually reachable — matmul-dominated,
+    bf16, one fused attention op per layer."""
+    from deeplearning4j_tpu.autodiff import MixedPrecision, TrainingConfig
+    from deeplearning4j_tpu.dataset import DeviceCachedIterator
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.zoo.gpt import GPT_MEDIUM, build_gpt
+
+    cfg = GPT_MEDIUM
+    sd = build_gpt(cfg, batch=batch, seq_len=seq_len)
+    sd.training_config = TrainingConfig(
+        updater=Adam(1e-4),
+        data_set_feature_mapping=["input_ids"],
+        data_set_label_mapping=["targets"],
+        mixed_precision=MixedPrecision() if mixed_precision else None)
+    rng = np.random.default_rng(0)
+    n = batch * steps
+    ids = rng.integers(0, cfg.vocab_size, (n, seq_len)).astype(np.int32)
+    tgt = rng.integers(0, cfg.vocab_size, (n, seq_len)).astype(np.int32)
+    it = DeviceCachedIterator([ids], [tgt], batch_size=batch)
+    sd.fit(it, epochs=1)                        # warmup/compile
+    sps = _median_rate(lambda: sd.fit(it, epochs=2), 2 * n)
+    h, L, f, S, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                     seq_len, cfg.vocab_size)
+    # fwd matmul FLOPs/token: qkv+proj (8h^2) + mlp (4*h*f) + tied head
+    # (2hV); attention scores+context 4*S*h
+    fwd_flops = S * (L * (8 * h * h + 4 * h * f + 4 * S * h) + 2 * h * V)
+    return {"samples_per_sec": round(sps, 2),
+            "step_time_ms": round(1000.0 * batch / sps, 3),
+            "tokens_per_sec": round(sps * seq_len, 1),
+            "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
+            "batch": batch, "seq_len": seq_len,
+            "precision": "bf16_mixed" if mixed_precision else "f32"}
+
+
 def main():
     import sys
     import traceback
@@ -179,7 +217,8 @@ def main():
     for name, fn in (("lenet_mnist", bench_lenet),
                      ("samediff_mlp", bench_samediff_mlp),
                      ("resnet50", bench_resnet50),
-                     ("bert_base", bench_bert_base)):
+                     ("bert_base", bench_bert_base),
+                     ("gpt_medium", bench_gpt_medium)):
         try:
             configs[name] = fn()
         except Exception:

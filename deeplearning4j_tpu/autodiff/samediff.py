@@ -59,6 +59,7 @@ class OpNode:
     outputs: List[str]        # output variable names
     attrs: Dict[str, Any]     # static attributes (iArgs/tArgs/bArgs analogue)
     random: bool = False      # needs a PRNG key threaded at trace time
+    group: Optional[str] = None  # remat group id (see SameDiff.remat_scope)
 
 
 class SameDiff:
@@ -80,6 +81,8 @@ class SameDiff:
         self._state_updates: Dict[str, str] = {}  # state var -> source output
         self._version = 0                         # bump on any mutation
         self._fn_cache: Dict[Any, Any] = {}
+        self._active_group: Optional[str] = None  # current remat_scope id
+        self._group_counter = 0
         self.training_config = None
         self._updater_state = None
         self._seed = 0
@@ -295,7 +298,8 @@ class SameDiff:
             out_names.append(out_name)
         node = OpNode(name=node_name, op=o.name,
                       inputs=[v.name for v in inputs], outputs=out_names,
-                      attrs=attrs, random=is_random)
+                      attrs=attrs, random=is_random,
+                      group=self._active_group)
         self._ops[node_name] = node
         self._op_order.append(node_name)
         for on in out_names:
@@ -303,6 +307,39 @@ class SameDiff:
         self._mutated()
         outs = [self._vars[n] for n in out_names]
         return outs[0] if n_outputs == 1 else outs
+
+    def remat_scope(self, name: str = "remat"):
+        """Context manager: ops recorded inside form a rematerialized
+        (gradient-checkpointed) group — at trace time the group becomes one
+        ``jax.checkpoint`` call, so its internal activations are NOT saved
+        for the backward pass but recomputed from the group's inputs.
+
+        The TPU-native memory/workspace lever (SURVEY §2.1 memory &
+        workspaces): where the reference manages activation memory with
+        workspaces + MemoryManager, here HBM held-live set is traded for
+        FLOPs at the XLA level. Typical use: one scope per transformer
+        layer, which drops activation memory from O(layers) to
+        O(sqrt-ish) and lets batch/seq grow to MXU-saturating sizes::
+
+            for i in range(num_layers):
+                with sd.remat_scope(f"layer{i}"):
+                    x = block(sd, x, ...)
+
+        Nesting records the innermost scope only (one checkpoint level).
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            prev = self._active_group
+            self._group_counter += 1
+            self._active_group = f"{name}#{self._group_counter}"
+            try:
+                yield
+            finally:
+                self._active_group = prev
+
+        return _scope()
 
     # ------------------------------------------------------------------
     # tracing: graph -> pure jax function
@@ -321,17 +358,26 @@ class SameDiff:
         return [self._ops[n] for n in self._op_order if n in needed_ops]
 
     def _trace_fn(self, outputs: Tuple[str, ...]) -> Callable:
-        """Build fn(params, constants, placeholders, key) -> {name: array}."""
-        order = self._prune(outputs)
-        vars_ = self._vars
+        """Build fn(params, constants, placeholders, key) -> {name: array}.
 
-        def fn(params: Dict[str, jax.Array], constants: Dict[str, jax.Array],
-               placeholders: Dict[str, jax.Array], key) -> Dict[str, jax.Array]:
-            env: Dict[str, jax.Array] = {}
-            env.update(constants)
-            env.update(params)
-            env.update(placeholders)
-            for idx, node in enumerate(order):
+        Consecutive ops sharing a remat group (recorded under
+        ``remat_scope``) execute inside one ``jax.checkpoint`` region:
+        the group's boundary values are the only activations XLA keeps
+        live for the backward pass."""
+        order = self._prune(outputs)
+        out_set = set(outputs)
+
+        # segment the topo order into (group, [(global_idx, node), ...])
+        segments: List[Tuple[Optional[str], List[Tuple[int, OpNode]]]] = []
+        for idx, node in enumerate(order):
+            g = node.group
+            if segments and segments[-1][0] == g and g is not None:
+                segments[-1][1].append((idx, node))
+            else:
+                segments.append((g, [(idx, node)]))
+
+        def _run_nodes(nodes, env, key):
+            for idx, node in nodes:
                 o = registry.get_op(node.op)
                 attrs = dict(node.attrs)
                 if node.random:
@@ -348,6 +394,54 @@ class SameDiff:
                         env[out_name] = r
                 else:
                     env[node.outputs[0]] = res
+
+        # per remat segment: external inputs (read, not produced inside)
+        # and external outputs (produced inside, consumed later/returned)
+        seg_specs = []
+        for si, (g, nodes) in enumerate(segments):
+            if g is None:
+                seg_specs.append((None, nodes, None, None))
+                continue
+            produced = {o for _, n in nodes for o in n.outputs}
+            ext_in, seen = [], set()
+            for _, n in nodes:
+                for i in n.inputs:
+                    if i not in produced and i not in seen:
+                        seen.add(i)
+                        ext_in.append(i)
+            later = set()
+            for _, nodes2 in segments[si + 1:]:
+                for _, n2 in nodes2:
+                    later.update(n2.inputs)
+            ext_out = [o for _, n in nodes for o in n.outputs
+                       if o in later or o in out_set]
+            seg_specs.append((g, nodes, ext_in, ext_out))
+
+        def fn(params: Dict[str, jax.Array], constants: Dict[str, jax.Array],
+               placeholders: Dict[str, jax.Array], key) -> Dict[str, jax.Array]:
+            env: Dict[str, jax.Array] = {}
+            env.update(constants)
+            env.update(params)
+            env.update(placeholders)
+            for g, nodes, ext_in, ext_out in seg_specs:
+                if g is None:
+                    _run_nodes(nodes, env, key)
+                    continue
+
+                def seg_fn(k, *args, _nodes=nodes, _ein=ext_in,
+                           _eout=ext_out):
+                    local = dict(zip(_ein, args))
+                    _run_nodes(_nodes, local, k)
+                    return tuple(local[o] for o in _eout)
+
+                try:
+                    args = [env[i] for i in ext_in]
+                except KeyError as e:
+                    raise KeyError(
+                        f"remat group {g!r} needs variable {e.args[0]!r} — "
+                        f"missing placeholder?") from None
+                res = jax.checkpoint(seg_fn)(key, *args)
+                env.update(zip(ext_out, res))
             missing = [o for o in outputs if o not in env]
             if missing:
                 raise KeyError(f"outputs not computable: {missing}")

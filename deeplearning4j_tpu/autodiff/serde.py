@@ -73,7 +73,8 @@ def save(sd, path, include_updater_state: bool = True) -> None:
         "ops": [
             {"name": n.name, "op": n.op, "inputs": n.inputs,
              "outputs": n.outputs, "attrs": _attrs_to_json(n.attrs),
-             "random": n.random}
+             "random": n.random,
+             **({"group": n.group} if n.group else {})}
             for n in sd.ops()
         ],
         "loss_variables": sd.loss_variables,
@@ -127,11 +128,14 @@ def load(path):
         node = OpNode(name=od["name"], op=od["op"], inputs=list(od["inputs"]),
                       outputs=list(od["outputs"]),
                       attrs=_attrs_from_json(od["attrs"]),
-                      random=od.get("random", False))
+                      random=od.get("random", False),
+                      group=od.get("group"))
         sd._ops[node.name] = node
         sd._op_order.append(node.name)
         for on in node.outputs:
             sd._producer[on] = node.name
+    # keep future remat_scope ids distinct from loaded ones
+    sd._group_counter = sum(1 for od in graph["ops"] if od.get("group"))
     sd.loss_variables = list(graph.get("loss_variables", []))
     sd._state_var_names = set(graph.get("state_vars", []))
     sd._state_updates = dict(graph.get("state_updates", {}))

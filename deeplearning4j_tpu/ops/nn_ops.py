@@ -18,6 +18,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.ops.registry import op
@@ -456,6 +457,35 @@ def dot_product_attention(queries, keys, values, mask=None, scaled: bool = True,
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.matmul(weights, values)
     return (out, weights) if with_weights else out
+
+
+@op("scaled_dot_product_attention", _N, aliases=("sdpa",))
+def scaled_dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                                 scale: float = None):
+    """Fused multi-head attention core, TPU-shaped: q/k/v are
+    (batch, heads, seq, head_dim); score accumulation and softmax run in
+    f32 regardless of input dtype (bf16-safe — the MXU accumulates f32
+    natively so the upcast is free), probabilities are cast back to the
+    value dtype for the PV matmul.
+
+    ``causal=True`` applies the autoregressive mask; ``mask`` (broadcast
+    to [batch, heads, sq, sk], nonzero = attend) composes with it.
+    Reference: multi_head_dot_product_attention.cpp:34 computes the same
+    math head-by-head via mmul/softmax graph ops; here it is one op so
+    XLA sees the whole pattern and its backward as a unit.
+    """
+    d = q.shape[-1]
+    s = (1.0 / np.sqrt(d)) if scale is None else scale
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cm, scores, jnp.float32(-1e30))
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 @op("multi_head_dot_product_attention", _N)

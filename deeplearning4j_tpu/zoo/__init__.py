@@ -11,8 +11,10 @@ from deeplearning4j_tpu.zoo.models import (
 from deeplearning4j_tpu.zoo.models_ext import (
     Darknet19, SqueezeNet, TinyYOLO, UNet, Xception)
 from deeplearning4j_tpu.zoo.bert import BERT_BASE, BERT_TINY, BertConfig, bert_base
+from deeplearning4j_tpu.zoo.gpt import GPT_MEDIUM, GPT_TINY, GPTConfig, build_gpt
 
 __all__ = ["LeNet", "SimpleCNN", "AlexNet", "VGG16", "ResNet50",
            "TextGenLSTM", "TransformerEncoder", "SqueezeNet", "UNet",
            "Xception", "Darknet19", "TinyYOLO", "BertConfig", "BERT_BASE",
-           "BERT_TINY", "bert_base"]
+           "BERT_TINY", "bert_base", "GPTConfig", "GPT_MEDIUM", "GPT_TINY",
+           "build_gpt"]

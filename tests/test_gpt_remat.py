@@ -1,0 +1,194 @@
+"""GPT decoder zoo model + SameDiff remat_scope + fused SDPA op.
+
+Covers the compute-dense flagship path benched as gpt_medium: the fused
+scaled_dot_product_attention op against a numpy reference, remat-scope
+gradient equivalence (checkpointing must change memory, never numerics),
+serde round-trip of the remat group field, and GPT_TINY learning.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import registry
+
+
+def _np_sdpa(q, k, v, causal=False, mask=None):
+    d = q.shape[-1]
+    s = q.astype(np.float64) @ np.swapaxes(k.astype(np.float64), -1, -2)
+    s /= np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        s = np.where(cm, s, -np.inf)
+    if mask is not None:
+        s = np.where(mask.astype(bool), s, -np.inf)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return p @ v.astype(np.float64)
+
+
+class TestSDPA:
+    def setup_method(self):
+        self.rng = np.random.default_rng(7)
+        self.q = self.rng.standard_normal((2, 3, 5, 8)).astype(np.float32)
+        self.k = self.rng.standard_normal((2, 3, 5, 8)).astype(np.float32)
+        self.v = self.rng.standard_normal((2, 3, 5, 8)).astype(np.float32)
+
+    def test_matches_numpy_plain(self):
+        out = registry.exec_op("scaled_dot_product_attention",
+                               self.q, self.k, self.v)
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   _np_sdpa(self.q, self.k, self.v),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_numpy_causal(self):
+        out = registry.exec_op("scaled_dot_product_attention",
+                               self.q, self.k, self.v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out.data),
+            _np_sdpa(self.q, self.k, self.v, causal=True),
+            rtol=1e-5, atol=1e-5)
+
+    def test_causal_first_row_attends_only_self(self):
+        out = np.asarray(registry.exec_op(
+            "scaled_dot_product_attention", self.q, self.k, self.v,
+            causal=True).data)
+        np.testing.assert_allclose(out[..., 0, :], self.v[..., 0, :],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_padding_mask(self):
+        mask = np.ones((2, 1, 1, 5), np.float32)
+        mask[..., 3:] = 0          # keys 3,4 masked out
+        out = registry.exec_op("scaled_dot_product_attention",
+                               self.q, self.k, self.v, mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(out.data),
+            _np_sdpa(self.q, self.k, self.v, mask=mask),
+            rtol=1e-5, atol=1e-5)
+
+    def test_bf16_inputs_finite_and_close(self):
+        import jax.numpy as jnp
+        qb = jnp.asarray(self.q, jnp.bfloat16)
+        kb = jnp.asarray(self.k, jnp.bfloat16)
+        vb = jnp.asarray(self.v, jnp.bfloat16)
+        out = np.asarray(registry.get_op("scaled_dot_product_attention")
+                         (qb, kb, vb, causal=True), np.float32)
+        ref = _np_sdpa(self.q, self.k, self.v, causal=True)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+
+
+class TestRematScope:
+    def _mlp(self, remat):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        rng = np.random.default_rng(3)
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4, 8))
+        cur, n_in = x, 8
+        for i in range(3):
+            ctx = sd.remat_scope(f"blk{i}") if remat else _null()
+            with ctx:
+                w = sd.var(f"w{i}", value=rng.standard_normal(
+                    (n_in, 8)).astype(np.float32) * 0.3)
+                cur = sd.nn.relu(cur.mmul(w), name=f"h{i}")
+        loss = sd.invoke("reduce_sum", [cur.mul(cur)], name="loss")
+        sd.set_loss_variables([loss])
+        return sd
+
+    def test_grads_identical_with_and_without_remat(self):
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+        g_plain = self._mlp(False).calculate_gradients({"x": x})
+        g_remat = self._mlp(True).calculate_gradients({"x": x})
+        assert set(g_plain) == set(g_remat)
+        for n in g_plain:
+            np.testing.assert_allclose(np.asarray(g_plain[n].data),
+                                       np.asarray(g_remat[n].data),
+                                       rtol=1e-6, atol=1e-6,
+                                       err_msg=n)
+
+    def test_forward_identical(self):
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+        o1 = self._mlp(False).output({"x": x}, outputs=["loss"])
+        o2 = self._mlp(True).output({"x": x}, outputs=["loss"])
+        np.testing.assert_allclose(float(o1["loss"].data),
+                                   float(o2["loss"].data), rtol=1e-6)
+
+    def test_group_serde_roundtrip(self, tmp_path):
+        sd = self._mlp(True)
+        groups = [n.group for n in sd.ops()]
+        assert any(g is not None for g in groups)
+        p = tmp_path / "remat.sdz"
+        sd.save(str(p))
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd2 = SameDiff.load(str(p))
+        assert [n.group for n in sd2.ops()] == groups
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            float(sd.output({"x": x}, outputs=["loss"])["loss"].data),
+            float(sd2.output({"x": x}, outputs=["loss"])["loss"].data),
+            rtol=1e-6)
+
+    def test_remat_with_random_op_deterministic_per_trace(self):
+        """Dropout inside a remat scope: forward and recomputed-backward
+        must see the SAME mask (jax.checkpoint replays the fold_in key)."""
+        from deeplearning4j_tpu.autodiff import SameDiff
+        rng = np.random.default_rng(1)
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(32, 16))
+        with sd.remat_scope("blk"):
+            w = sd.var("w", value=rng.standard_normal(
+                (16, 16)).astype(np.float32) * 0.3)
+            h = sd.invoke("dropout", [x.mmul(w)], {"p": 0.5}, name="drop")
+        loss = sd.invoke("reduce_sum", [h.mul(h)], name="loss")
+        sd.set_loss_variables([loss])
+        xv = rng.standard_normal((32, 16)).astype(np.float32)
+        g = sd.calculate_gradients({"x": xv})
+        assert np.isfinite(np.asarray(g["w"].data)).all()
+
+
+class TestGPT:
+    def test_tiny_overfits(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.dataset import DeviceCachedIterator
+        from deeplearning4j_tpu.learning.updaters import Adam
+        from deeplearning4j_tpu.zoo.gpt import GPT_TINY, build_gpt
+
+        sd = build_gpt(GPT_TINY, batch=4, seq_len=16)
+        sd.training_config = TrainingConfig(
+            updater=Adam(1e-3),
+            data_set_feature_mapping=["input_ids"],
+            data_set_label_mapping=["targets"])
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, GPT_TINY.vocab_size, (8, 16)).astype(np.int32)
+        tgt = rng.integers(0, GPT_TINY.vocab_size, (8, 16)).astype(np.int32)
+        it = DeviceCachedIterator([ids], [tgt], batch_size=4)
+        h = sd.fit(it, epochs=120)
+        assert h.loss_curve.losses[-1] < h.loss_curve.losses[0] * 0.2
+
+    def test_logits_shape_and_causality(self):
+        """Changing a future token must not change past logits (the
+        causal-mask end-to-end check)."""
+        from deeplearning4j_tpu.zoo.gpt import GPT_TINY, build_gpt
+        sd = build_gpt(GPT_TINY, batch=2, seq_len=8)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, GPT_TINY.vocab_size, (2, 8)).astype(np.int32)
+        tgt = np.zeros((2, 8), np.int32)
+        base = np.asarray(sd.output({"input_ids": ids, "targets": tgt},
+                                    outputs=["logits"])["logits"].data)
+        ids2 = ids.copy()
+        ids2[:, -1] = (ids2[:, -1] + 1) % GPT_TINY.vocab_size
+        pert = np.asarray(sd.output({"input_ids": ids2, "targets": tgt},
+                                    outputs=["logits"])["logits"].data)
+        np.testing.assert_allclose(base[:, :-1], pert[:, :-1],
+                                   rtol=1e-5, atol=1e-5)
+        assert base.shape == (2, 8, GPT_TINY.vocab_size)
+
+    def test_weight_tying(self):
+        from deeplearning4j_tpu.zoo.gpt import GPT_TINY, build_gpt
+        sd = build_gpt(GPT_TINY, batch=2, seq_len=8)
+        names = [v.name for v in sd.variables()]
+        assert "wte" in names and "lm_head" not in names
+
+
+def _null():
+    import contextlib
+    return contextlib.nullcontext()

@@ -558,6 +558,12 @@ SMOKE = {
     "multi_head_dot_product_attention": lambda f: f(
         A32(2, 4, 8), A32(2, 4, 8), A32(2, 4, 8), A32(8, 8), A32(8, 8),
         A32(8, 8), A32(8, 8), nheads=2).shape == (2, 4, 8),
+    # causal SDPA: row 0 may only attend to position 0 — equals plain
+    # softmax(qk)v restricted to the first key (checked vs full numpy
+    # reference in test_gpt_remat.py)
+    "scaled_dot_product_attention": lambda f: f(
+        A32(2, 2, 4, 8), A32(2, 2, 4, 8), A32(2, 2, 4, 8),
+        causal=True).shape == (2, 2, 4, 8),
     "mean_pairwssqerr_loss": lambda f: float(
         f(A32(3, 4), A32(3, 4))) >= 0,
     "cosine_distance_loss": lambda f: np.isfinite(float(
