@@ -288,7 +288,7 @@ class SameDiff:
         o = registry.get_op(op_name)
         attrs = dict(attrs or {})
         node_name = self._unique_name(name or op_name)
-        is_random = o.category == "random"
+        is_random = o.needs_key    # op() folds category=="random" into it
         out_names = []
         for i in range(n_outputs):
             base = node_name if n_outputs == 1 else f"{node_name}:{i}"
@@ -307,6 +307,87 @@ class SameDiff:
         self._mutated()
         outs = [self._vars[n] for n in out_names]
         return outs[0] if n_outputs == 1 else outs
+
+    # ------------------------------------------------------------------
+    # control flow (reference: AbstractSession.java:46-101 executes
+    # Enter/Exit/Switch/Merge frames host-side; redesigned per ADR 0020's
+    # invokable-subgraph direction, lowered to lax.while_loop/cond/scan —
+    # see ops/control_flow.py for semantics + differentiability)
+    def _record_subgraph(self, fn, arg_vars, arg_shapes=None,
+                         prefix: str = "p"):
+        from deeplearning4j_tpu.ops import control_flow as cf
+        sub = SameDiff()
+        phs = []
+        for i, v in enumerate(arg_vars):
+            shape = (arg_shapes[i] if arg_shapes is not None
+                     else getattr(v, "_shape", None))
+            ph = sub.placeholder(f"{prefix}{i}", shape=shape,
+                                 dtype=getattr(v, "dtype", "float32"))
+            phs.append(ph)
+        res = fn(sub, *phs)
+        if isinstance(res, SDVariable):
+            res = [res]
+        if not res:
+            raise ValueError("control-flow subgraph returned no outputs")
+        return cf.subgraph_to_json(sub, [p.name for p in phs],
+                                   [r.name for r in res])
+
+    def while_loop(self, cond_fn, body_fn, loop_vars, captures=(),
+                   name: str = "while"):
+        """Data-dependent loop: ``cond_fn(sub, *loop_vars, *captures) ->
+        scalar bool var``, ``body_fn(sub, *loop_vars, *captures) -> new
+        loop vars``. Returns the final loop vars. Lowered to
+        ``lax.while_loop`` (forward-only; use scan() for gradients)."""
+        loop_vars, captures = list(loop_vars), list(captures)
+        allv = loop_vars + captures
+        cg = self._record_subgraph(cond_fn, allv)
+        bg = self._record_subgraph(body_fn, allv)
+        if len(bg["outputs"]) != len(loop_vars):
+            raise ValueError(
+                f"while_loop body returned {len(bg['outputs'])} values "
+                f"for {len(loop_vars)} loop vars")
+        return self.invoke("while_loop", allv,
+                           {"cond_graph": cg, "body_graph": bg,
+                            "n_loop": len(loop_vars)},
+                           name=name, n_outputs=len(loop_vars))
+
+    def cond(self, pred, true_fn, false_fn, operands, name: str = "cond"):
+        """Branch: ``true_fn/false_fn(sub, *operands) -> same-shaped
+        outputs``. Lowered to ``lax.cond`` (differentiable)."""
+        operands = list(operands)
+        tg = self._record_subgraph(true_fn, operands)
+        fg = self._record_subgraph(false_fn, operands)
+        if len(tg["outputs"]) != len(fg["outputs"]):
+            raise ValueError("cond branches must return the same arity")
+        return self.invoke("cond_branch", [pred, *operands],
+                           {"true_graph": tg, "false_graph": fg},
+                           name=name, n_outputs=len(tg["outputs"]))
+
+    def scan(self, body_fn, carries, scanned=(), captures=(),
+             length: Optional[int] = None, reverse: bool = False,
+             name: str = "scan"):
+        """Static-trip recurrence: ``body_fn(sub, *carries, *x_slices,
+        *captures) -> (new_carries..., per_step_outputs...)``; scanned
+        vars are consumed along their leading axis. Returns final
+        carries + stacked per-step outputs. Lowered to ``lax.scan`` —
+        fully reverse-mode differentiable (the trainable-RNN path)."""
+        carries, scanned, captures = (list(carries), list(scanned),
+                                      list(captures))
+        shapes = [getattr(v, "_shape", None) for v in carries]
+        for v in scanned:
+            s = getattr(v, "_shape", None)
+            shapes.append(tuple(s[1:]) if s else None)
+        shapes += [getattr(v, "_shape", None) for v in captures]
+        bg = self._record_subgraph(body_fn, carries + scanned + captures,
+                                   arg_shapes=shapes)
+        n_out = len(bg["outputs"])
+        if n_out < len(carries):
+            raise ValueError("scan body must return at least the carries")
+        return self.invoke("scan_loop", carries + scanned + captures,
+                           {"body_graph": bg, "n_carry": len(carries),
+                            "n_scan": len(scanned), "length": length,
+                            "reverse": reverse},
+                           name=name, n_outputs=n_out)
 
     def remat_scope(self, name: str = "remat"):
         """Context manager: ops recorded inside form a rematerialized

@@ -115,6 +115,9 @@ def attr_value(value) -> bytes:
     """
     if isinstance(value, tuple) and len(value) == 2 and value[0] == "dtype":
         return field_varint(6, int(value[1]))
+    if isinstance(value, tuple) and len(value) == 2 and value[0] == "func":
+        # NameAttrList (field 10): name=1 — While/If branch references
+        return field_bytes(10, field_string(1, value[1]))
     if isinstance(value, tuple) and len(value) == 2 and value[0] == "shape":
         return field_bytes(7, tensor_shape_proto(value[1]))
     if isinstance(value, bool):
@@ -158,11 +161,41 @@ def node_def(name: str, op: str, inputs: Sequence[str] = (),
     return out
 
 
+def function_def(name: str, args: Sequence, outputs: Sequence,
+                 body: "GraphDefBuilder") -> bytes:
+    """Encode a FunctionDef (the subgraph a TF2 functional While/If node
+    invokes). ``args``: [(arg_name, np_dtype)]; ``outputs``:
+    [(output_name, body_ref, np_dtype)] where body_ref is the function-
+    internal tensor ref (e.g. "mul:z:0"); ``body``: a GraphDefBuilder
+    holding the body NodeDefs (inputs reference arg names / node refs).
+
+    Wire: FunctionDef signature=1 (OpDef name=1, input_arg=2,
+    output_arg=3; ArgDef name=1 type=3), node_def=3, ret=4 (map)."""
+    sig = field_string(1, name)
+    for an, dt in args:
+        sig += field_bytes(2, field_string(1, an)
+                           + field_varint(3, np_to_tf_dtype(dt)))
+    for on, _ref, dt in outputs:
+        sig += field_bytes(3, field_string(1, on)
+                           + field_varint(3, np_to_tf_dtype(dt)))
+    out = field_bytes(1, sig)
+    for nd in body._nodes:
+        out += field_bytes(3, nd)
+    for on, ref, _dt in outputs:
+        out += field_bytes(4, field_string(1, on) + field_string(2, ref))
+    return out
+
+
 class GraphDefBuilder:
     """Accumulates NodeDefs and serializes a frozen-graph .pb byte string."""
 
     def __init__(self):
         self._nodes: List[bytes] = []
+        self._functions: List[bytes] = []
+
+    def add_function(self, fbytes: bytes) -> None:
+        """Attach an encoded FunctionDef to the graph's library."""
+        self._functions.append(fbytes)
 
     def raw_node(self, name: str, op: str, inputs: Sequence[str] = (),
                  attrs: Optional[Dict[str, object]] = None) -> str:
@@ -188,8 +221,12 @@ class GraphDefBuilder:
         return self.raw_node(name, op, inputs, attrs or None)
 
     def build(self) -> bytes:
-        """GraphDef: node=1 repeated."""
-        return b"".join(field_bytes(1, n) for n in self._nodes)
+        """GraphDef: node=1 repeated, library=2 (function=1 repeated)."""
+        out = b"".join(field_bytes(1, n) for n in self._nodes)
+        if self._functions:
+            lib = b"".join(field_bytes(1, f) for f in self._functions)
+            out += field_bytes(2, lib)
+        return out
 
     def save(self, path: str) -> None:
         with open(path, "wb") as fh:

@@ -21,6 +21,39 @@ Weights come in as CONSTANTs by default (inference import). With
 ``trainable="auto"`` floating-point consts of rank>=1 become VARIABLEs —
 the fine-tuning path (BASELINE config 4's BERT fine-tune step); a predicate
 ``trainable=lambda name, arr: ...`` gives explicit control.
+
+SCOPE — which of tensorflow-op-def.pbtxt's ~1200 op families import
+(the reference's registry: samediff-import-tensorflow/src/main/resources/
+tensorflow-op-def.pbtxt; its own mapper covers a comparable subset):
+
+IN SCOPE (~130 NodeDef ops + functional control flow):
+- math/elementwise/reduction/linalg/nn/conv/pool/image resize families
+  (see supported_tf_ops() for the authoritative list);
+- structural ops (Reshape/StridedSlice/Concat/Pack/Shape/Range/Fill...)
+  with CONST-FOLDABLE arguments — the frozen-inference-graph pattern;
+- TF2 *functional* control flow: StatelessWhile/While, StatelessIf/If
+  with FunctionDef library bodies -> lax.while_loop / lax.cond
+  (data-dependent trip counts run on-device; While output is
+  forward-only for AD — record with SameDiff.scan for trainable
+  recurrence);
+- Placeholder shape handling: shape attrs auto-derive placeholder
+  shapes; shape=None / -1 dims raise an actionable error naming
+  ``input_shapes=`` when shape math needs them.
+
+OUT OF SCOPE (by design — raise TFImportError):
+- v1 control-flow frames (Enter/Exit/Switch/Merge/NextIteration,
+  LoopCond): the pre-TF2 cyclic-graph encoding; freeze with TF2
+  functional ops instead (the reference's ADR 0020 makes the same
+  break);
+- stateful/resource ops (Variable/VarHandleOp/ReadVariableOp/Assign*,
+  queues, iterators, datasets, StackV2/TensorArrayV3): a frozen graph
+  has no mutable state; run the TF freezing tools first;
+- data-dependent *shapes* (Where, NonMaxSuppression's dynamic output,
+  boolean_mask composites, Unique as a data input): XLA requires
+  static shapes; these need host-side execution by construction;
+- string/audio/sparse/ragged families, summary/debug ops, and
+  gradient-helper ops (the importer consumes inference graphs;
+  training graphs re-derive gradients via jax.grad after import).
 """
 from __future__ import annotations
 
@@ -56,10 +89,14 @@ class _Val:
 
 
 def _norm_ref(ref: str) -> Tuple[str, int]:
-    """'node:2' -> ('node', 2); 'node' == 'node:0'."""
-    if ":" in ref:
-        name, idx = ref.rsplit(":", 1)
-        return name, int(idx)
+    """'node:2' -> ('node', 2); 'node' == 'node:0'. FunctionDef bodies
+    use 3-part refs 'node:out_arg:idx' (e.g. 'mul:z:0') — the middle
+    output-arg name collapses onto the positional index."""
+    parts = ref.split(":")
+    if len(parts) == 3:
+        return parts[0], int(parts[2])
+    if len(parts) == 2:
+        return parts[0], (int(parts[1]) if parts[1].isdigit() else 0)
     return ref, 0
 
 
@@ -85,6 +122,10 @@ class TFImporter:
         self.variable_names: List[str] = []
         # PlaceholderWithDefault nodes bound to their constant default
         self.placeholder_defaults: Dict[str, np.ndarray] = {}
+        # placeholders whose pb shape attr is absent/unknown-rank/-1-dim
+        # and that input_shapes= did not pin (shape-math import errors
+        # name these so the fix is one kwarg away)
+        self.underspecified_placeholders: Dict[str, Optional[Sequence[int]]] = {}
 
     # ------------------------------------------------------------------
     def run(self) -> SameDiff:
@@ -130,7 +171,38 @@ class TFImporter:
     # ------------------------------------------------------------------
     # input resolution
     def _resolve(self, ref: str) -> _Val:
-        name, idx = _norm_ref(ref)
+        parts = ref.split(":")
+        if len(parts) == 2 and not parts[1].isdigit():
+            # named-arg shorthand 'node:out_arg' == 'node:out_arg:0'
+            parts = [parts[0], parts[1], "0"]
+        if len(parts) == 3:
+            # FunctionDef-body ref 'node:out_arg:idx' — idx is WITHIN the
+            # named output arg; the flat index needs the producer's
+            # output-arg layout (single-size args before it)
+            name, arg, sub = parts[0], parts[1], int(parts[2])
+            node = self._nodes.get(name)
+            layout = _FUNC_OUT_ARGS.get(node.op) if node is not None else None
+            if layout is not None:
+                if arg not in layout:
+                    raise TFImportError(
+                        f"function-body ref {ref!r}: unknown output arg "
+                        f"{arg!r} of {node.op} (known: {layout})")
+                idx = layout.index(arg) + sub
+            else:
+                # single-output-arg producer (or an arg placeholder):
+                # within-arg index IS the flat index — but refuse to
+                # guess if the producer recorded several outputs and we
+                # have no layout for it
+                idx = sub
+                if sub == 0 and (name, 1) in self._tensors and \
+                        node is not None and arg not in (
+                            "output", "z", "y", "out"):
+                    raise TFImportError(
+                        f"function-body ref {ref!r}: {node.op} has "
+                        f"multiple outputs and no known output-arg "
+                        f"layout; cannot map {arg!r} to a flat index")
+        else:
+            name, idx = _norm_ref(ref)
         try:
             return self._tensors[(name, idx)]
         except KeyError:
@@ -188,9 +260,18 @@ class TFImporter:
             return tuple(np.asarray(v.const).shape)
         shape = v.var.shape
         if shape is None or any(d is None or d < 0 for d in shape):
+            hint = ""
+            if self.underspecified_placeholders:
+                ex = ", ".join(
+                    f"{n!r}: (batch, ...)"
+                    for n in sorted(self.underspecified_placeholders))
+                hint = (f" — this graph's placeholders carry no static "
+                        f"shape in the pb (a normal frozen-graph export "
+                        f"artifact): pass input_shapes={{{ex}}} with "
+                        f"concrete dims")
             raise TFImportError(
                 f"Shape node {node_name!r}: input has non-static shape "
-                f"{shape}; pass input_shapes= with concrete dims")
+                f"{shape}{hint}")
         return tuple(shape)
 
     # ------------------------------------------------------------------
@@ -224,7 +305,12 @@ class TFImporter:
             a = node.attr("shape")
             shape = self.input_shapes.get(node.name)
             if shape is None and a is not None:
-                shape = a.shape
+                shape = a.shape          # auto-derive from the shape attr
+            if shape is None or any(d is None or d < 0 for d in (shape or ())):
+                # real frozen graphs routinely carry shape=None / dim=-1
+                # placeholders (the exporter never pinned them); record it
+                # so shape-dependent failures can name the fix
+                self.underspecified_placeholders[node.name] = shape
             dt = node.attr("dtype")
             np_dt = tf_dtype_to_np(dt.type) if dt else np.dtype(np.float32)
             ph = self.sd.placeholder(node.name, shape=shape, dtype=str(np_dt))
@@ -246,6 +332,19 @@ class TFImporter:
 # ---------------------------------------------------------------------------
 # mapper table (reference: ImportClassMapping.java:40's name->class table)
 _MAPPERS: Dict[str, Callable] = {}
+
+# output-arg layout of mapped multi-output ops whose args are each size 1
+# (FunctionDef refs name the arg: 'topk:indices:0' -> flat index 1).
+# Split/SplitV/While/If expose ONE size-N arg ('output'), where the
+# within-arg index already equals the flat index.
+_FUNC_OUT_ARGS: Dict[str, Tuple[str, ...]] = {
+    "TopKV2": ("values", "indices"),
+    "FusedBatchNorm": ("y", "batch_mean", "batch_variance"),
+    "FusedBatchNormV2": ("y", "batch_mean", "batch_variance"),
+    "FusedBatchNormV3": ("y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2",
+                         "reserve_space_3"),
+}
 
 
 def _mapper(*tf_names):
@@ -774,6 +873,77 @@ def _m_segment_sum(imp, node, ins):
     seg = imp._const_np(ins[1], "SegmentSum segment_ids")
     return imp.emit("segment_sum", ins,
                     {"num_segments": int(seg.max()) + 1}, node.name)
+
+
+# --- TF2 functional control flow (StatelessWhile/While, StatelessIf/If) ----
+class _FuncGraph:
+    """GraphDef-shaped view over one FunctionDef body (shares the outer
+    graph's function library so nested control flow resolves)."""
+
+    def __init__(self, fd, functions):
+        self.nodes = fd.nodes
+        self.functions = functions
+
+
+def _import_function_body(imp: "TFImporter", fname: str) -> Dict:
+    """FunctionDef -> control-flow subgraph dict (ops/control_flow.py
+    wire format): args become placeholders, body nodes run through the
+    SAME mapper table, ret refs become the subgraph outputs.
+
+    Reference: the reference's IR maps function bodies through the same
+    importGraph machinery (ImportGraph.kt:218 importing subgraphs for
+    If/While per ADR 0020)."""
+    from deeplearning4j_tpu.modelimport.tf_pb import tf_dtype_to_np
+    from deeplearning4j_tpu.ops import control_flow as cf
+    fd = imp.graph.functions.get(fname) if hasattr(imp.graph, "functions") \
+        else None
+    if fd is None:
+        raise TFImportError(
+            f"control-flow node references function {fname!r} which is "
+            f"not in the GraphDef library")
+    sub = TFImporter(_FuncGraph(fd, imp.graph.functions))
+    for arg in fd.input_args:
+        np_dt = tf_dtype_to_np(arg.type) if arg.type else np.dtype(np.float32)
+        ph = sub.sd.placeholder(arg.name, shape=None, dtype=str(np_dt))
+        sub.placeholder_names.append(arg.name)
+        sub._set(arg.name, [_Val(var=ph)])
+    sub.run()
+    outs = []
+    for oa in fd.output_args:
+        ref = fd.ret.get(oa.name, oa.name)
+        outs.append(sub._materialize(sub._resolve(ref)).name)
+    return cf.subgraph_to_json(sub.sd, [a.name for a in fd.input_args], outs)
+
+
+@_mapper("StatelessWhile", "While")
+def _m_while(imp, node, ins):
+    cond_g = _import_function_body(imp, node.attr("cond").func)
+    body_g = _import_function_body(imp, node.attr("body").func)
+    vars_ = [imp._materialize(v) for v in ins]
+    outs = imp.sd.invoke("while_loop", vars_,
+                         {"cond_graph": cond_g, "body_graph": body_g,
+                          "n_loop": len(vars_)},
+                         name=node.name, n_outputs=len(vars_))
+    outs = outs if isinstance(outs, list) else [outs]
+    return [_Val(var=o) for o in outs]
+
+
+@_mapper("StatelessIf", "If")
+def _m_if(imp, node, ins):
+    tg = _import_function_body(imp, node.attr("then_branch").func)
+    fg = _import_function_body(imp, node.attr("else_branch").func)
+    if len(tg["outputs"]) != len(fg["outputs"]):
+        raise TFImportError(
+            f"If node {node.name!r}: then_branch returns "
+            f"{len(tg['outputs'])} outputs but else_branch returns "
+            f"{len(fg['outputs'])}")
+    pred = imp._materialize(ins[0])
+    operands = [imp._materialize(v) for v in ins[1:]]
+    outs = imp.sd.invoke("cond_branch", [pred] + operands,
+                         {"true_graph": tg, "false_graph": fg},
+                         name=node.name, n_outputs=len(tg["outputs"]))
+    outs = outs if isinstance(outs, list) else [outs]
+    return [_Val(var=o) for o in outs]
 
 
 # ---------------------------------------------------------------------------

@@ -8,8 +8,13 @@ types}.proto — schema constants, not code.
 
 GraphDef:        node=1, library=2, versions=4
 NodeDef:         name=1, op=2, input=3, device=4, attr=5 (map entry: key=1, value=2)
-AttrValue:       list=1, s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8
+AttrValue:       list=1, s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8, func=10
 AttrValue.ListValue: s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8
+NameAttrList:    name=1, attr=2 (map entry: key=1, value=2)
+FunctionDefLibrary: function=1, gradient=2
+FunctionDef:     signature=1 (OpDef), node_def=3, ret=4 (map), attr=5
+OpDef:           name=1, input_arg=2, output_arg=3 (ArgDef: name=1, type=3,
+                 type_attr=4)
 TensorProto:     dtype=1, tensor_shape=2, tensor_content=4, half_val=13,
                  float_val=5, double_val=6, int_val=7, string_val=8,
                  int64_val=10, bool_val=11, uint32_val=16, uint64_val=17
@@ -145,6 +150,13 @@ class AttrValue:
         return decode_tensor(m)
 
     @property
+    def func(self) -> Optional[str]:
+        """NameAttrList.name — the FunctionDef a While/If node's
+        cond/body/then_branch/else_branch attr points at."""
+        m = self._f.message(10)
+        return m.string(1) if m is not None else None
+
+    @property
     def list(self) -> Dict[str, list]:
         lv = self._f.message(1)
         if lv is None:
@@ -178,10 +190,41 @@ class NodeDef:
         return f"NodeDef({self.op} {self.name!r} inputs={self.inputs})"
 
 
+class ArgDef:
+    def __init__(self, fields: Fields):
+        self.name = fields.string(1)
+        self.type = fields.varint(3)        # DataType enum (0 if type_attr)
+        self.type_attr = fields.string(4)
+
+
+class FunctionDef:
+    """tensorflow.FunctionDef — the subgraph a TF2 functional
+    While/If node invokes."""
+
+    def __init__(self, fields: Fields):
+        sig = fields.message(1)
+        self.name = sig.string(1) if sig else ""
+        self.input_args: List[ArgDef] = (
+            [ArgDef(a) for a in sig.repeated_message(2)] if sig else [])
+        self.output_args: List[ArgDef] = (
+            [ArgDef(a) for a in sig.repeated_message(3)] if sig else [])
+        self.nodes: List[NodeDef] = [NodeDef(f)
+                                     for f in fields.repeated_message(3)]
+        self.ret: Dict[str, str] = {}
+        for entry in fields.repeated_message(4):
+            self.ret[entry.string(1)] = entry.string(2)
+
+
 class GraphDef:
     def __init__(self, data: bytes):
         fields = Fields(data)
         self.nodes: List[NodeDef] = [NodeDef(f) for f in fields.repeated_message(1)]
+        self.functions: Dict[str, FunctionDef] = {}
+        lib = fields.message(2)
+        if lib is not None:
+            for f in lib.repeated_message(1):
+                fd = FunctionDef(f)
+                self.functions[fd.name] = fd
 
     @staticmethod
     def from_file(path: str) -> "GraphDef":

@@ -1,0 +1,207 @@
+"""Graph control flow: While / Cond / Scan as structural ops.
+
+Reference parity: the reference executes TF-v1 control-flow frames
+(Enter/Exit/Switch/Merge/NextIteration) with an interpreter loop that
+re-enqueues frame iterations (AbstractSession.java:46-101) and re-designed
+them around invokable subgraphs in its own ADR ("New Control flow":
+ADRs/0020). The TPU-native answer skips frames entirely: a loop/branch is
+ONE graph node whose attrs embed the cond/body/branch subgraphs
+(define-then-run, like TF2 functional StatelessWhile/If), and at trace
+time the subgraphs compile into `lax.while_loop` / `lax.cond` /
+`lax.scan` — XLA-native control flow with static shapes, no interpreter.
+
+Subgraph wire format (the attr value — pure JSON-able dict, so OpNode
+serde handles it untouched):
+    {"params":   [name, ...],          # formal inputs, positional
+     "outputs":  [var name, ...],      # returned values
+     "variables":[{name, dtype}, ...], # placeholder decls (params)
+     "constants":{name: {"__ndarray__": ..., "dtype": ...}},
+     "ops":      [{name, op, inputs, outputs, attrs, random}, ...]}
+
+Differentiability (documented, matching what JAX provides):
+- `cond`: reverse-mode differentiable (both branches traced).
+- `scan_loop` (static trip count): fully reverse-mode differentiable —
+  use it for trainable recurrence (TBPTT-style).
+- `while_loop` (data-dependent trip count): NOT reverse-mode
+  differentiable (XLA cannot run a dynamic loop backwards without
+  storing an unbounded tape); use scan_loop when gradients are needed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import op
+
+_F = "flow"
+
+
+def _const_to_json(arr: np.ndarray) -> Dict:
+    """base64 raw bytes, not tolist(): imported function bodies can carry
+    weight-sized consts — nested Python floats would cost tens of MB."""
+    import base64
+    return {"__ndarray_b64__": base64.b64encode(arr.tobytes()).decode(),
+            "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def _const_from_json(c: Dict) -> np.ndarray:
+    import base64
+    if "__ndarray_b64__" in c:
+        return np.frombuffer(
+            base64.b64decode(c["__ndarray_b64__"]),
+            dtype=np.dtype(c["dtype"])).reshape(c["shape"]).copy()
+    return np.asarray(c["__ndarray__"], dtype=c["dtype"])   # legacy form
+
+
+def subgraph_to_json(sub_sd, params: List[str], outputs: List[str]) -> Dict:
+    """Encode a recorded sub-SameDiff as the attr dict."""
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    consts = {}
+    for n, v in sub_sd._vars.items():
+        if v.var_type == VariableType.CONSTANT:
+            consts[n] = _const_to_json(np.asarray(sub_sd._arrays[n]))
+        elif v.var_type == VariableType.VARIABLE:
+            raise ValueError(
+                f"subgraph may not own trainable variables ({n!r}); pass "
+                f"outer variables through `captures=` instead")
+    return {
+        "params": list(params),
+        "outputs": list(outputs),
+        "variables": [{"name": n, "dtype": v.dtype}
+                      for n, v in sub_sd._vars.items()
+                      if v.var_type == VariableType.PLACEHOLDER],
+        "constants": consts,
+        "ops": [{"name": nd.name, "op": nd.op, "inputs": list(nd.inputs),
+                 "outputs": list(nd.outputs), "attrs": dict(nd.attrs),
+                 "random": nd.random,
+                 **({"group": nd.group} if nd.group else {})}
+                for nd in sub_sd.ops()],
+    }
+
+
+def subgraph_from_json(g: Dict):
+    """Rebuild a SameDiff from the attr dict."""
+    from deeplearning4j_tpu.autodiff.samediff import OpNode, SameDiff
+    from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
+    sub = SameDiff()
+    for vd in g["variables"]:
+        v = SDVariable(sub, vd["name"], VariableType.PLACEHOLDER, None,
+                       vd["dtype"])
+        sub._vars[v.name] = v
+    for n, c in g["constants"].items():
+        arr = _const_from_json(c)
+        v = SDVariable(sub, n, VariableType.CONSTANT, arr.shape,
+                       str(arr.dtype))
+        sub._vars[n] = v
+        sub._arrays[n] = jnp.asarray(arr)
+    for od in g["ops"]:
+        for on in od["outputs"]:
+            if on not in sub._vars:
+                sub._vars[on] = SDVariable(sub, on, VariableType.ARRAY,
+                                           None, "float32")
+        node = OpNode(name=od["name"], op=od["op"],
+                      inputs=list(od["inputs"]), outputs=list(od["outputs"]),
+                      attrs=dict(od["attrs"]),
+                      random=od.get("random", False),
+                      group=od.get("group"))
+        sub._ops[node.name] = node
+        sub._op_order.append(node.name)
+        for on in node.outputs:
+            sub._producer[on] = node.name
+    sub._mutated()
+    return sub
+
+
+def compile_subgraph(g: Dict):
+    """attr dict -> callable(key, *arrays) -> list of output arrays.
+    The PRNG key seeds any random ops in the body (each trace folds it
+    per-node, so distinct keys give distinct masks)."""
+    sub = subgraph_from_json(g)
+    fn = sub._trace_fn(tuple(g["outputs"]))
+    params = list(g["params"])
+    consts = sub.constants_map()
+
+    def call(key, *arrays):
+        res = fn({}, consts, dict(zip(params, arrays)), key)
+        return [res[o] for o in g["outputs"]]
+
+    return call
+
+
+@op("while_loop", _F, differentiable=False, needs_key=True)
+def while_loop(*args, cond_graph: Dict, body_graph: Dict, n_loop: int,
+               key=None):
+    """Run `body` while `cond` holds. args = loop_vars + captures;
+    captures feed both subgraphs after the loop vars and pass through
+    unchanged. Returns the final loop vars. The key is split every
+    iteration so random ops in the body draw fresh masks per step.
+
+    Lowered to `lax.while_loop`: compiled once, executed on-device with
+    a data-dependent trip count (reference runs this with host-side
+    frame re-enqueueing, AbstractSession.java:46)."""
+    loop_vars, captures = args[:n_loop], args[n_loop:]
+    cond_fn = compile_subgraph(cond_graph)
+    body_fn = compile_subgraph(body_graph)
+    if key is None:
+        key = jax.random.key(0)
+
+    def c(carry):
+        k, lv = carry[0], carry[1:]
+        out = cond_fn(k, *lv, *captures)[0]
+        return out.reshape(()).astype(bool)
+
+    def b(carry):
+        k, lv = carry[0], carry[1:]
+        k_step, k_next = jax.random.split(k)
+        return (k_next, *body_fn(k_step, *lv, *captures))
+
+    res = jax.lax.while_loop(c, b, (key, *loop_vars))[1:]
+    return res if n_loop > 1 else res[0]
+
+
+@op("cond_branch", _F, needs_key=True)
+def cond_branch(pred, *args, true_graph: Dict, false_graph: Dict,
+                key=None):
+    """`lax.cond` over two subgraphs sharing the operand list.
+    Reverse-mode differentiable; both branches must return the same
+    shapes/dtypes (XLA requirement)."""
+    tf_ = compile_subgraph(true_graph)
+    ff_ = compile_subgraph(false_graph)
+    if key is None:
+        key = jax.random.key(0)
+    res = jax.lax.cond(pred.reshape(()).astype(bool),
+                       lambda ops: tuple(tf_(key, *ops)),
+                       lambda ops: tuple(ff_(key, *ops)),
+                       tuple(args))
+    return res if len(res) > 1 else res[0]
+
+
+@op("scan_loop", _F, needs_key=True)
+def scan_loop(*args, body_graph: Dict, n_carry: int, n_scan: int,
+              length: int = None, reverse: bool = False, key=None):
+    """Static-trip-count loop with per-step inputs and stacked per-step
+    outputs — the differentiable recurrence primitive (lowered to
+    `lax.scan`; reverse-mode AD supported, so RNNs/TBPTT train through
+    it). args = carries + scanned (leading axis = time) + captures.
+    body returns new carries + per-step outputs (stacked on return).
+    The key is split per step (fresh dropout masks along time)."""
+    carries = args[:n_carry]
+    xs = args[n_carry:n_carry + n_scan]
+    captures = args[n_carry + n_scan:]
+    body_fn = compile_subgraph(body_graph)
+    if key is None:
+        key = jax.random.key(0)
+
+    def b(carry, x):
+        k, cs = carry[0], carry[1:]
+        k_step, k_next = jax.random.split(k)
+        res = body_fn(k_step, *cs, *x, *captures)
+        return (k_next, *res[:n_carry]), tuple(res[n_carry:])
+
+    (_, *final), stacked = jax.lax.scan(b, (key, *carries), tuple(xs),
+                                        length=length, reverse=reverse)
+    outs = list(final) + list(stacked)
+    return outs if len(outs) > 1 else outs[0]

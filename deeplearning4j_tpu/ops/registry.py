@@ -35,6 +35,10 @@ class Op:
     n_inputs: Optional[int]  # None = variadic
     differentiable: bool = True
     aliases: Tuple[str, ...] = ()
+    # takes a `key=` attr at trace time (all "random" ops do; structural
+    # ops like while/cond/scan also do, to seed random ops in their
+    # subgraph bodies)
+    needs_key: bool = False
 
     def __call__(self, *args, **attrs):
         return self.fn(*args, **attrs)
@@ -44,11 +48,13 @@ _REGISTRY: Dict[str, Op] = {}
 
 
 def op(name: str, category: str, n_inputs: Optional[int] = None,
-       differentiable: bool = True, aliases: Sequence[str] = ()):
+       differentiable: bool = True, aliases: Sequence[str] = (),
+       needs_key: bool = False):
     """Decorator: register a pure jax function as a named op."""
     def deco(fn: Callable) -> Callable:
         o = Op(name=name, fn=fn, category=category, n_inputs=n_inputs,
-               differentiable=differentiable, aliases=tuple(aliases))
+               differentiable=differentiable, aliases=tuple(aliases),
+               needs_key=needs_key or category == "random")
         if name in _REGISTRY:
             raise ValueError(f"duplicate op registration: {name}")
         _REGISTRY[name] = o
@@ -113,6 +119,7 @@ def _ensure_loaded() -> None:
         return
     _LOADED = True
     from deeplearning4j_tpu.ops import (  # noqa: F401
-        elementwise, pairwise, reduce as _reduce, shape_ops, random as _random,
-        linalg, nn_ops, nn_ext, loss, bitwise, image, tf_compat,
+        control_flow, elementwise, pairwise, reduce as _reduce, shape_ops,
+        random as _random, linalg, nn_ops, nn_ext, loss, bitwise, image,
+        tf_compat,
     )

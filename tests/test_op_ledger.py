@@ -446,7 +446,11 @@ def _scatter_ref(ref, idx, upd, op):
 # requires that every op is covered SOMEWHERE, mirrored after
 # OpValidation.collectCoverageInformation
 EXERCISED = {    # nn ops — test_nn / test_layer_breadth / test_layers_ext / test_ops
-    "conv1d": "test_layer_breadth", 
+    # control flow — numerics + grads + serde in test_control_flow
+    "while_loop": "test_control_flow",
+    "cond_branch": "test_control_flow",
+    "scan_loop": "test_control_flow",
+    "conv1d": "test_layer_breadth",
     "conv3d": "test_layer_breadth", 
     "batchnorm": "test_nn", 
     "layer_norm": "test_keras_breadth", "lrn": "test_layer_breadth", "graves_lstm_layer": "test_layers_ext",

@@ -1,0 +1,121 @@
+"""Import conformance against REAL TF-exported artifacts from the
+reference tree (round-4 Weak #2: every in-tree import fixture was built
+by this repo's own wire encoder, so builder and importer could share one
+author's misreading of TF semantics — these tests consume bytes that
+TensorFlow itself serialized).
+
+Artifacts (reference paths, read-only):
+- platform-tests/src/test/resources/lenet_frozen.pb — a real frozen
+  LeNet classifier (Conv2D/MaxPool/Reshape/Shape/StridedSlice/Pack/
+  MatMul/ArgMax), 250 KB of TF-produced GraphDef wire bytes. Golden
+  activations below were captured from this importer ONCE and frozen as
+  regression values; the structural assertions (softmax-free argmax
+  consistency, shape math through the Shape→Pack→Reshape fold) hold
+  independently of them.
+- nd4j-tensorflow/src/main/resources/cast_graph/cast_<src>_<dst>.pb —
+  the reference's own Cast conformance matrix (121 real TF graphs, all
+  11×11 dtype pairs); golden semantics = numpy astype.
+
+All placeholders in these real graphs carry shape=None — the normal
+frozen-export artifact — so they also exercise the auto-derive /
+usable-error path (underspecified_placeholders).
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+REF = "/root/reference"
+LENET = os.path.join(REF, "platform-tests/src/test/resources/lenet_frozen.pb")
+CAST_DIR = os.path.join(REF, "nd4j/nd4j-tensorflow/src/main/resources/cast_graph")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(LENET),
+    reason="reference artifact tree not present")
+
+
+def _import(path, **kw):
+    from deeplearning4j_tpu.modelimport.tf_import import import_tf_graph
+    return import_tf_graph(path, **kw)
+
+
+class TestLenetFrozen:
+    def test_imports_and_runs(self):
+        sd = _import(LENET, input_shapes={"input": (2, 784)})
+        x = np.linspace(0, 1, 2 * 784, dtype=np.float32).reshape(2, 784)
+        out = sd.output({"input": x})
+        assert set(out) == {"output"}
+        cls = np.asarray(out["output"].data)
+        assert cls.shape == (2,)
+        assert ((cls >= 0) & (cls < 10)).all()
+
+    def test_golden_activations(self):
+        """Frozen regression goldens for the last Relu layer on a fixed
+        deterministic input (captured from this importer; guards against
+        silent numeric drift in the conv/pool/matmul mapping chain)."""
+        sd = _import(LENET, input_shapes={"input": (2, 784)})
+        x = np.linspace(0, 1, 2 * 784, dtype=np.float32).reshape(2, 784)
+        out = sd.output({"input": x}, outputs=["Lenet/fc9_1/Relu", "output"])
+        r = np.asarray(out["Lenet/fc9_1/Relu"].data)
+        assert r.shape == (2, 10)
+        np.testing.assert_allclose(r.sum(axis=1), [1.7698, 4.2696],
+                                   rtol=2e-3)
+        np.testing.assert_allclose(
+            r[0, :5], [0.4123, 0.0673, 0.1776, 0.2881, 0.2041], atol=2e-3)
+        # the ArgMax node must agree with the logits it consumes
+        np.testing.assert_array_equal(np.asarray(out["output"].data),
+                                      r.argmax(axis=1))
+
+    def test_batch_size_follows_input_shapes(self):
+        sd = _import(LENET, input_shapes={"input": (5, 784)})
+        x = np.zeros((5, 784), np.float32)
+        assert np.asarray(sd.output({"input": x})["output"].data).shape == (5,)
+
+    def test_unknown_shape_error_is_actionable(self):
+        """shape=None placeholders (as really exported) must produce an
+        error naming the placeholder and the input_shapes= fix."""
+        from deeplearning4j_tpu.modelimport.tf_import import TFImportError
+        with pytest.raises(TFImportError) as ei:
+            _import(LENET)
+        msg = str(ei.value)
+        assert "input_shapes" in msg and "'input'" in msg
+
+    def test_fine_tunable(self):
+        """trainable='auto' turns the frozen conv/fc weights into
+        VARIABLEs — the transfer-learning entry point on a real pb."""
+        sd = _import(LENET, trainable="auto",
+                     input_shapes={"input": (2, 784)})
+        params = sd.trainable_params()
+        assert len(params) >= 8      # 3 conv + 2 fc kernels + biases
+
+
+def _cast_cases():
+    for p in sorted(glob.glob(os.path.join(CAST_DIR, "*.pb"))):
+        base = os.path.basename(p)[:-3]          # cast_<src>_<dst>
+        _, src, dst = base.split("_", 2)
+        yield pytest.param(p, src, dst, id=f"{src}->{dst}")
+
+
+@pytest.mark.skipif(not os.path.isdir(CAST_DIR),
+                    reason="cast_graph artifacts not present")
+class TestCastMatrix:
+    """The reference's 121-graph Cast conformance matrix, executed
+    against numpy astype semantics."""
+
+    @pytest.mark.parametrize("path,src,dst", list(_cast_cases()))
+    def test_cast(self, path, src, dst):
+        sd = _import(path)
+        x = np.array([0, 1, 3, 100], dtype=np.dtype(src))
+        if src == dst:
+            # identity graphs contain only the placeholder; nothing to run
+            assert sd.placeholders() == ["input"]
+            return
+        out = sd.output({"input": x}, outputs=["cast_output"])
+        got = np.asarray(out["cast_output"].data)
+        want = x.astype(np.dtype(dst))
+        assert got.dtype == want.dtype, f"{src}->{dst}"
+        np.testing.assert_array_equal(got, want)
+
+    def test_matrix_is_complete(self):
+        assert len(list(_cast_cases())) == 121
