@@ -313,6 +313,16 @@ class SameDiff:
     # Enter/Exit/Switch/Merge frames host-side; redesigned per ADR 0020's
     # invokable-subgraph direction, lowered to lax.while_loop/cond/scan —
     # see ops/control_flow.py for semantics + differentiability)
+    @staticmethod
+    def _var_shape(v) -> Optional[Tuple[int, ...]]:
+        """Best-effort static shape: the .shape property runs lazy
+        inference for ARRAY vars (derived op outputs), so control-flow
+        bodies see real shapes, not just placeholder declarations."""
+        try:
+            return v.shape
+        except Exception:
+            return None
+
     def _record_subgraph(self, fn, arg_vars, arg_shapes=None,
                          prefix: str = "p"):
         from deeplearning4j_tpu.ops import control_flow as cf
@@ -320,7 +330,7 @@ class SameDiff:
         phs = []
         for i, v in enumerate(arg_vars):
             shape = (arg_shapes[i] if arg_shapes is not None
-                     else getattr(v, "_shape", None))
+                     else self._var_shape(v))
             ph = sub.placeholder(f"{prefix}{i}", shape=shape,
                                  dtype=getattr(v, "dtype", "float32"))
             phs.append(ph)
@@ -373,11 +383,11 @@ class SameDiff:
         fully reverse-mode differentiable (the trainable-RNN path)."""
         carries, scanned, captures = (list(carries), list(scanned),
                                       list(captures))
-        shapes = [getattr(v, "_shape", None) for v in carries]
+        shapes = [self._var_shape(v) for v in carries]
         for v in scanned:
-            s = getattr(v, "_shape", None)
+            s = self._var_shape(v)
             shapes.append(tuple(s[1:]) if s else None)
-        shapes += [getattr(v, "_shape", None) for v in captures]
+        shapes += [self._var_shape(v) for v in captures]
         bg = self._record_subgraph(body_fn, carries + scanned + captures,
                                    arg_shapes=shapes)
         n_out = len(bg["outputs"])

@@ -1,0 +1,17 @@
+"""Model hub: pretrained-weight loading + local artifact cache.
+
+Reference parity: the reference zoo's ZooModel.initPretrained()
+(deeplearning4j-zoo/.../ZooModel.java:1 — downloads checkpoint zips into
+~/.deeplearning4j/models and loads them) and the omnihub module
+(model artifact registry/cache). This environment has zero egress, so
+the hub is download-free by design: artifacts land in the cache via
+``ModelHub.add`` (CI pre-seeding, scp, bind mounts) and loads are pure
+local reads — the same split the reference makes between fetch and
+restore.
+"""
+from deeplearning4j_tpu.hub.cache import KNOWN_ARTIFACTS, ModelHub
+from deeplearning4j_tpu.hub.pretrained import (
+    init_pretrained, load_sequential_weights, read_h5_layer_weights)
+
+__all__ = ["ModelHub", "KNOWN_ARTIFACTS", "init_pretrained",
+           "load_sequential_weights", "read_h5_layer_weights"]

@@ -1,0 +1,86 @@
+"""Local artifact cache (reference: ZooModel's ~/.deeplearning4j/models
+cache dir + omnihub's named-artifact registry).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Dict, List, Optional
+
+# named artifacts the zoo knows how to consume (reference: each ZooModel
+# subclass pins pretrainedUrl + checksum). Stock keras-applications
+# weight files load into the zoo's VGG16/ResNet50 via
+# hub.init_pretrained.
+KNOWN_ARTIFACTS: Dict[str, Dict[str, str]] = {
+    "vgg16_keras": {
+        "filename": "vgg16_weights_tf_dim_ordering_tf_kernels.h5",
+        "consumer": "zoo.VGG16",
+        "note": "stock keras-applications VGG16 ImageNet weights"},
+    "vgg16_keras_notop": {
+        "filename": "vgg16_weights_tf_dim_ordering_tf_kernels_notop.h5",
+        "consumer": "zoo.VGG16 (feature extractor)",
+        "note": "keras-applications VGG16 without the dense head"},
+    "resnet50_keras": {
+        "filename": "resnet50_weights_tf_dim_ordering_tf_kernels.h5",
+        "consumer": "zoo.ResNet50",
+        "note": "stock keras-applications ResNet50 ImageNet weights"},
+}
+
+
+class ModelHub:
+    """Filesystem artifact cache. Resolution order for ``path(name)``:
+    exact file path -> cache entry -> KNOWN_ARTIFACTS filename in cache.
+    Missing artifacts raise with the exact placement instructions
+    (zero-egress environments can't download)."""
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or os.environ.get(
+            "DL4J_TPU_HUB",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "deeplearning4j_tpu", "hub"))
+        os.makedirs(self.cache_dir, exist_ok=True)
+
+    def add(self, name: str, src_path: str) -> str:
+        """Copy an artifact into the cache under ``name``."""
+        dst = os.path.join(self.cache_dir, name)
+        if os.path.abspath(src_path) != os.path.abspath(dst):
+            shutil.copy2(src_path, dst)
+        return dst
+
+    def contains(self, name: str) -> bool:
+        try:
+            self.path(name)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def list(self) -> List[str]:
+        return sorted(os.listdir(self.cache_dir))
+
+    def sha256(self, name: str) -> str:
+        h = hashlib.sha256()
+        with open(self.path(name), "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def path(self, name: str) -> str:
+        if os.path.isfile(name):
+            return name
+        cand = os.path.join(self.cache_dir, name)
+        if os.path.isfile(cand):
+            return cand
+        known = KNOWN_ARTIFACTS.get(name)
+        if known:
+            cand = os.path.join(self.cache_dir, known["filename"])
+            if os.path.isfile(cand):
+                return cand
+            raise FileNotFoundError(
+                f"hub artifact {name!r} ({known['note']}) not cached; "
+                f"place {known['filename']!r} into {self.cache_dir} "
+                f"(this environment has no network egress, so the hub "
+                f"never downloads)")
+        raise FileNotFoundError(
+            f"no hub artifact {name!r} in {self.cache_dir}; "
+            f"known names: {sorted(KNOWN_ARTIFACTS)}, or pass a file path")

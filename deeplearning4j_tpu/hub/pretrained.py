@@ -1,0 +1,155 @@
+"""Pretrained-weight loading: Keras h5 weight files -> zoo networks.
+
+Reference parity: ZooModel.initPretrained() restores a downloaded
+checkpoint into the freshly-built architecture; KerasModelImport's
+weight path does the same from h5. Here the loader is ORDER-based with
+strict shape checks: keras-applications weight files enumerate layers
+in model order (h5 attr ``layer_names``), the zoo nets build the same
+architecture in the same order, and conv kernels are HWIO on both sides
+(the NHWC runtime keeps Keras layout verbatim) — so position+shape is a
+complete, name-independent pairing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _decode(names) -> List[str]:
+    return [n.decode() if isinstance(n, bytes) else str(n) for n in names]
+
+
+def read_h5_layer_weights(path: str) -> List[Tuple[str, List[np.ndarray]]]:
+    """[(layer_name, [arrays in weight_names order])] for BOTH Keras h5
+    layouts: full-model files (root group ``model_weights``) and
+    weights-only files (layers at the root, keras-applications style)."""
+    import h5py
+    out = []
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        layer_names = _decode(root.attrs.get("layer_names", []))
+        if not layer_names:      # fall back to group order
+            layer_names = [k for k in root.keys()
+                           if isinstance(root[k], h5py.Group)]
+        for ln in layer_names:
+            if ln not in root:
+                continue
+            g = root[ln]
+            wnames = _decode(g.attrs.get("weight_names", []))
+            arrs = []
+            for wn in wnames:
+                node = g[wn] if wn in g else (
+                    root[wn] if wn in root else None)
+                if node is None:   # nested one level (layer/layer/kernel)
+                    parts = wn.split("/")
+                    node = g
+                    for p in parts:
+                        if p in node:
+                            node = node[p]
+                    if not hasattr(node, "shape"):
+                        continue
+                arrs.append(np.asarray(node))
+            if not wnames:         # no attr: collect datasets recursively
+                def walk(grp, acc):
+                    for k in grp:
+                        item = grp[k]
+                        if hasattr(item, "shape"):
+                            acc.append(np.asarray(item))
+                        else:
+                            walk(item, acc)
+                walk(g, arrs)
+            if arrs:
+                out.append((ln, arrs))
+    return out
+
+
+def load_sequential_weights(net, source: str, strict: bool = True,
+                            skip_mismatched_head: bool = False) -> int:
+    """Pour h5 layer weights into ``net`` (MultiLayerNetwork) by order
+    with exact shape checks. Returns the number of arrays assigned.
+
+    ``skip_mismatched_head=True`` skips trailing layers whose shapes
+    differ (e.g. notop/1000-class weights into a custom-class head) —
+    the transfer-learning import mode (reference:
+    TransferLearningHelper + ZooModel.initPretrained(num_classes)).
+    """
+    from deeplearning4j_tpu.hub.cache import ModelHub
+    path = ModelHub().path(source)
+    h5_layers = [(ln, arrs) for ln, arrs in read_h5_layer_weights(path)]
+
+    # net params grouped by layer stem, in build order; state vars (BN
+    # running mean/var) merge into their layer's stem group so a Keras
+    # BN layer's [gamma, beta, mean, var] pairs one-to-one
+    sd = net.samediff
+    params = {n: np.asarray(a) for n, a in
+              {**sd.trainable_params(), **sd.state_vars_map()}.items()}
+    stems: List[str] = []
+    by_stem: Dict[str, List[Tuple[str, np.ndarray]]] = {}
+    for name, arr in params.items():
+        stem = name.rsplit("_", 1)[0]
+        if stem not in by_stem:
+            by_stem[stem] = []
+            stems.append(stem)
+        by_stem[stem].append((name, arr))
+
+    n_assigned = 0
+    hi = 0
+    assigned: Dict[str, np.ndarray] = {}
+    for stem in stems:
+        entries = by_stem[stem]
+        if hi >= len(h5_layers):
+            if strict and not skip_mismatched_head:
+                raise ValueError(
+                    f"h5 file exhausted at net layer {stem!r} "
+                    f"({len(h5_layers)} weighted h5 layers, net needs "
+                    f"more)")
+            break
+        ln, arrs = h5_layers[hi]
+        hi += 1
+        if len(arrs) != len(entries):
+            raise ValueError(
+                f"layer pairing mismatch at net {stem!r} <- h5 {ln!r}: "
+                f"{len(entries)} net arrays vs {len(arrs)} h5 arrays")
+        for (pname, cur), new in zip(entries, arrs):
+            if tuple(cur.shape) != tuple(new.shape):
+                if skip_mismatched_head:
+                    break
+                raise ValueError(
+                    f"shape mismatch at {pname} <- h5 {ln!r}: net "
+                    f"{tuple(cur.shape)} vs h5 {tuple(new.shape)} — "
+                    f"pass skip_mismatched_head=True to keep the "
+                    f"random-init head (custom num_classes)")
+            assigned[pname] = np.asarray(new, dtype=np.asarray(cur).dtype)
+        else:
+            continue
+        break        # inner break (mismatched head) stops the walk
+
+    for pname, arr in assigned.items():
+        for sd in (net._sd_train, net._sd_infer):
+            if sd is not None and sd.has_variable(pname):
+                sd.set_arr_for_var(pname, arr)
+        n_assigned += 1
+    if strict and hi < len(h5_layers) and not skip_mismatched_head:
+        raise ValueError(
+            f"{len(h5_layers) - hi} unconsumed weighted h5 layers "
+            f"(starting at {h5_layers[hi][0]!r}) — architecture mismatch")
+    return n_assigned
+
+
+def init_pretrained(zoo_model, source: str,
+                    skip_mismatched_head: Optional[bool] = None):
+    """Build a zoo model and load pretrained weights (the reference's
+    ``ZooModel.initPretrained()`` shape)::
+
+        net = init_pretrained(VGG16(), "vgg16_keras")
+
+    ``skip_mismatched_head`` defaults to True when the model's
+    num_classes differs from the artifact's 1000-way head.
+    """
+    net = zoo_model.build()
+    if skip_mismatched_head is None:
+        skip_mismatched_head = getattr(zoo_model, "num_classes", 1000) != 1000
+    load_sequential_weights(net, source,
+                            skip_mismatched_head=skip_mismatched_head)
+    return net

@@ -88,16 +88,34 @@ class _Val:
         return self.const is not None
 
 
-def _norm_ref(ref: str) -> Tuple[str, int]:
-    """'node:2' -> ('node', 2); 'node' == 'node:0'. FunctionDef bodies
-    use 3-part refs 'node:out_arg:idx' (e.g. 'mul:z:0') — the middle
-    output-arg name collapses onto the positional index."""
+def _split_ref(ref: str) -> Tuple[str, Optional[str], int]:
+    """One parser for every tensor-ref form -> (name, out_arg, idx):
+    'node' -> (node, None, 0); 'node:2' -> (node, None, 2);
+    FunctionDef bodies: 'node:z:1' -> (node, 'z', 1) and the shorthand
+    'node:z' -> (node, 'z', 0). The out_arg index is WITHIN the named
+    arg; _resolve maps it to a flat index via the producer's layout."""
     parts = ref.split(":")
     if len(parts) == 3:
-        return parts[0], int(parts[2])
+        return parts[0], parts[1], int(parts[2])
     if len(parts) == 2:
-        return parts[0], (int(parts[1]) if parts[1].isdigit() else 0)
-    return ref, 0
+        if parts[1].isdigit():
+            return parts[0], None, int(parts[1])
+        return parts[0], parts[1], 0
+    return ref, None, 0
+
+
+def _norm_ref(ref: str) -> Tuple[str, int]:
+    """Plain-GraphDef ref -> (node, flat idx). Named-arg refs (only
+    legal inside FunctionDef bodies) must resolve through _resolve's
+    layout logic — treating them as index 0 here would silently pick
+    the wrong tensor of a multi-output op."""
+    name, arg, sub = _split_ref(ref)
+    if arg is not None:
+        raise TFImportError(
+            f"named output-arg ref {ref!r} needs producer layout "
+            f"resolution (FunctionDef-body form); plain GraphDef refs "
+            f"are 'node' or 'node:<int>'")
+    return name, sub
 
 
 class TFImporter:
@@ -144,7 +162,7 @@ class TFImporter:
         indeg: Dict[str, int] = {}
         consumers: Dict[str, List[str]] = {}
         for n in self.graph.nodes:
-            deps = {_norm_ref(i.lstrip("^"))[0] for i in n.inputs}
+            deps = {_split_ref(i.lstrip("^"))[0] for i in n.inputs}
             deps = {d for d in deps if d in self._nodes and d != n.name}
             indeg[n.name] = len(deps)
             for d in deps:
@@ -171,15 +189,12 @@ class TFImporter:
     # ------------------------------------------------------------------
     # input resolution
     def _resolve(self, ref: str) -> _Val:
-        parts = ref.split(":")
-        if len(parts) == 2 and not parts[1].isdigit():
-            # named-arg shorthand 'node:out_arg' == 'node:out_arg:0'
-            parts = [parts[0], parts[1], "0"]
-        if len(parts) == 3:
-            # FunctionDef-body ref 'node:out_arg:idx' — idx is WITHIN the
-            # named output arg; the flat index needs the producer's
-            # output-arg layout (single-size args before it)
-            name, arg, sub = parts[0], parts[1], int(parts[2])
+        name, arg, sub = _split_ref(ref)
+        if arg is None:
+            idx = sub
+        else:
+            # FunctionDef-body ref: idx is WITHIN the named output arg;
+            # the flat index needs the producer's output-arg layout
             node = self._nodes.get(name)
             layout = _FUNC_OUT_ARGS.get(node.op) if node is not None else None
             if layout is not None:
@@ -191,18 +206,15 @@ class TFImporter:
             else:
                 # single-output-arg producer (or an arg placeholder):
                 # within-arg index IS the flat index — but refuse to
-                # guess if the producer recorded several outputs and we
-                # have no layout for it
+                # guess whenever the producer recorded several outputs
+                # and we have no layout for it
                 idx = sub
-                if sub == 0 and (name, 1) in self._tensors and \
-                        node is not None and arg not in (
-                            "output", "z", "y", "out"):
+                if (name, 1) in self._tensors and node is not None and \
+                        arg not in ("output", "z", "y", "out"):
                     raise TFImportError(
                         f"function-body ref {ref!r}: {node.op} has "
                         f"multiple outputs and no known output-arg "
                         f"layout; cannot map {arg!r} to a flat index")
-        else:
-            name, idx = _norm_ref(ref)
         try:
             return self._tensors[(name, idx)]
         except KeyError:
@@ -908,6 +920,22 @@ def _import_function_body(imp: "TFImporter", fname: str) -> Dict:
         sub.placeholder_names.append(arg.name)
         sub._set(arg.name, [_Val(var=ph)])
     sub.run()
+    # weights living INSIDE a control-flow body become subgraph
+    # constants — they cannot join trainable_params(), so a fine-tune
+    # import (trainable='auto'/predicate) would silently freeze them.
+    # Tell the user instead of training around them quietly.
+    frozen = [n for n, arr in
+              ((n, np.asarray(a)) for n, a in sub.sd.constants_map().items())
+              if imp._trainable(n, arr)]
+    if frozen:
+        import warnings
+        warnings.warn(
+            f"control-flow function {fname!r} contains weight constants "
+            f"{frozen[:3]}{'...' if len(frozen) > 3 else ''} that match "
+            f"the trainable predicate; weights inside While/If bodies "
+            f"import as FROZEN constants (hoist them out of the "
+            f"function, or train outer parameters only)",
+            stacklevel=2)
     outs = []
     for oa in fd.output_args:
         ref = fd.ret.get(oa.name, oa.name)

@@ -1,0 +1,25 @@
+"""NLP subsystem (reference: deeplearning4j-nlp-parent + deeplearning4j-graph).
+
+Word/doc/graph embeddings trained through batched negative-sampling ops
+on-device (ops/nlp_ops.py), plus the tokenization and serialization APIs.
+"""
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.graph_embeddings import (
+    DeepWalk, Graph, Node2Vec, random_walks)
+from deeplearning4j_tpu.nlp.tokenization import (
+    ENGLISH_STOP_WORDS, CommonPreprocessor, DefaultTokenizerFactory,
+    LineSentenceIterator, LowCasePreProcessor, NGramTokenizerFactory,
+    SentenceIterator, Tokenizer, TokenizerFactory, TokenPreProcess)
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import (
+    FastText, ParagraphVectors, SequenceVectors, Word2Vec, WordVectors,
+    WordVectorSerializer)
+
+__all__ = [
+    "Word2Vec", "FastText", "ParagraphVectors", "Glove", "SequenceVectors",
+    "WordVectors", "WordVectorSerializer", "VocabCache", "DeepWalk",
+    "Node2Vec", "Graph", "random_walks", "Tokenizer", "TokenizerFactory",
+    "DefaultTokenizerFactory", "NGramTokenizerFactory", "TokenPreProcess",
+    "CommonPreprocessor", "LowCasePreProcessor", "SentenceIterator",
+    "LineSentenceIterator", "ENGLISH_STOP_WORDS",
+]

@@ -1,0 +1,99 @@
+"""GloVe (reference: deeplearning4j-nlp models/glove/Glove.java:1 — the
+same weighted-least-squares objective over a cooccurrence table,
+trained there per-pair with AdaGrad; here the table is built host-side
+and batches train through the registry's glove_loss op with jax.grad
+and AdaGrad accumulators, one jitted step).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor, DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import WordVectors
+
+
+class Glove(WordVectors):
+    def __init__(self, vector_size: int = 50, window_size: int = 5,
+                 epochs: int = 20, learning_rate: float = 0.05,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 min_word_frequency: int = 1, batch_size: int = 4096,
+                 seed: int = 0):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max, self.alpha = x_max, alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = VocabCache(min_word_frequency)
+        self.vectors = None
+
+    def _cooccurrences(self, seqs):
+        """Symmetric 1/d-weighted window counts (GloVe's counting rule;
+        the reference accumulates the same in CoOccurrences)."""
+        cooc = defaultdict(float)
+        for ids in seqs:
+            for i, wi in enumerate(ids):
+                for j in range(max(0, i - self.window_size), i):
+                    cooc[(int(wi), int(ids[j]))] += 1.0 / (i - j)
+                    cooc[(int(ids[j]), int(wi))] += 1.0 / (i - j)
+        rows = np.array([k[0] for k in cooc], np.int32)
+        cols = np.array([k[1] for k in cooc], np.int32)
+        counts = np.array(list(cooc.values()), np.float32)
+        return rows, cols, counts
+
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        fac = DefaultTokenizerFactory(CommonPreprocessor())
+        tok = [fac.create(s).get_tokens() for s in sentences]
+        self.vocab.fit(tok)
+        seqs = [self.vocab.encode(t) for t in tok]
+        rows, cols, counts = self._cooccurrences(seqs)
+        V, D = self.vocab.num_words(), self.vector_size
+        rng = np.random.default_rng(self.seed)
+
+        from deeplearning4j_tpu.ops import registry
+        loss_op = registry.get_op("glove_loss").fn
+        x_max, alpha = self.x_max, self.alpha
+
+        def loss_fn(params, r, c, x):
+            w, wt, b, bt = params
+            return loss_op(w, wt, b, bt, r, c, x, x_max=x_max, alpha=alpha)
+
+        @jax.jit
+        def step(params, acc, r, c, x, lr):
+            loss, grads = jax.value_and_grad(loss_fn)(params, r, c, x)
+            new_acc = tuple(a + g * g for a, g in zip(acc, grads))
+            new_params = tuple(
+                p - lr * g / jnp.sqrt(a + 1e-8)
+                for p, g, a in zip(params, grads, new_acc))
+            return new_params, new_acc, loss
+
+        init = lambda shape: ((rng.random(shape) - 0.5) / D).astype(np.float32)
+        params = tuple(jnp.asarray(x) for x in
+                       (init((V, D)), init((V, D)),
+                        np.zeros(V, np.float32), np.zeros(V, np.float32)))
+        acc = tuple(jnp.zeros_like(p) for p in params)
+        B = self.batch_size
+        n = len(rows)
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            r, c, x = rows[perm], cols[perm], counts[perm]
+            for bi in range((n + B - 1) // B):
+                sl = slice(bi * B, min(n, (bi + 1) * B))
+                rb, cb, xb = r[sl], c[sl], x[sl]
+                if len(rb) < B:
+                    idx = np.resize(np.arange(len(rb)), B)
+                    rb, cb, xb = rb[idx], cb[idx], xb[idx]
+                params, acc, _ = step(params, acc, rb, cb, xb,
+                                      np.float32(self.learning_rate))
+        # final vectors = w + w̃ (the GloVe paper's recommendation)
+        self.vectors = np.asarray(params[0]) + np.asarray(params[1])
+        self._normed = None
+        return self

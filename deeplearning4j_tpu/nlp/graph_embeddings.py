@@ -1,0 +1,127 @@
+"""Graph vertex embeddings: DeepWalk / node2vec.
+
+Reference parity: deeplearning4j-graph —
+graph/api + graph/graph/Graph.java (adjacency-list graph),
+graph/iterator/RandomWalkIterator.java (uniform walks),
+graph/models/deepwalk/DeepWalk.java:1 (walks -> skipgram; the reference
+trains hierarchical softmax per-pair, here walks feed the SAME batched
+negative-sampling SequenceVectors trainer Word2Vec uses — one shared
+trainer, as the reference shares SequenceVectors).
+node2vec's p/q-biased second-order walks (models/node2vec) are the
+``p``/``q`` parameters; p=q=1 reduces to DeepWalk.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, WordVectors
+
+
+class Graph:
+    """Undirected adjacency-list graph (reference: graph/graph/Graph.java)."""
+
+    def __init__(self, n_vertices: int,
+                 edges: Sequence[Tuple[int, int]] = ()):
+        self.n = n_vertices
+        self.adj: List[List[int]] = [[] for _ in range(n_vertices)]
+        for a, b in edges:
+            self.add_edge(a, b)
+
+    def add_edge(self, a: int, b: int) -> None:
+        self.adj[a].append(b)
+        self.adj[b].append(a)
+
+    def degree(self, v: int) -> int:
+        return len(self.adj[v])
+
+    def neighbors(self, v: int) -> List[int]:
+        return self.adj[v]
+
+
+def random_walks(graph: Graph, walk_length: int, walks_per_vertex: int,
+                 rng: np.random.Generator, p: float = 1.0,
+                 q: float = 1.0) -> List[np.ndarray]:
+    """Uniform (p=q=1) or node2vec-biased second-order walks."""
+    walks = []
+    for _ in range(walks_per_vertex):
+        for start in rng.permutation(graph.n):
+            if not graph.adj[start]:
+                continue
+            walk = [int(start)]
+            prev = None
+            while len(walk) < walk_length:
+                cur = walk[-1]
+                nbrs = graph.adj[cur]
+                if not nbrs:
+                    break
+                if prev is None or (p == 1.0 and q == 1.0):
+                    nxt = nbrs[int(rng.integers(len(nbrs)))]
+                else:
+                    # node2vec: 1/p back, 1 common, 1/q outward
+                    prev_nbrs = set(graph.adj[prev])
+                    w = np.array([1.0 / p if nb == prev
+                                  else (1.0 if nb in prev_nbrs
+                                        else 1.0 / q) for nb in nbrs])
+                    w /= w.sum()
+                    nxt = nbrs[int(rng.choice(len(nbrs), p=w))]
+                prev = cur
+                walk.append(int(nxt))
+            walks.append(np.asarray(walk, np.int32))
+    return walks
+
+
+class DeepWalk(WordVectors):
+    """reference: models/deepwalk/DeepWalk.java:1 (builder:
+    windowSize/vectorSize/learningRate; fit(graph, walkLength))."""
+
+    def __init__(self, vector_size: int = 64, window_size: int = 4,
+                 walk_length: int = 20, walks_per_vertex: int = 10,
+                 negative: int = 5, epochs: int = 3,
+                 learning_rate: float = 0.025, seed: int = 0,
+                 p: float = 1.0, q: float = 1.0,
+                 batch_size: int = 2048):
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.p, self.q = p, q
+        self.trainer = SequenceVectors(
+            vector_size=vector_size, window_size=window_size,
+            negative=negative, epochs=epochs, learning_rate=learning_rate,
+            batch_size=batch_size, seed=seed)
+        self.vectors = None
+        self.vocab: Optional[VocabCache] = None
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        rng = np.random.default_rng(self.trainer.seed)
+        # vertex ids shift by 1: VocabCache reserves index 0 for <unk>
+        walks = [w + 1 for w in random_walks(
+            graph, self.walk_length, self.walks_per_vertex, rng,
+            self.p, self.q)]
+        vc = VocabCache()
+        vc.word2idx = {VocabCache.UNK: 0}
+        vc.idx2word = [VocabCache.UNK]
+        for v in range(graph.n):
+            vc.word2idx[str(v)] = v + 1
+            vc.idx2word.append(str(v))
+            vc.counts[str(v)] = max(1, graph.degree(v))
+        self.vocab = vc
+        self.trainer.fit_sequences(walks, graph.n + 1,
+                                   vc.unigram_table())
+        self.vectors = self.trainer.syn0
+        self._normed = None
+        return self
+
+    def vertex_vector(self, v: int) -> np.ndarray:
+        return self.vectors[v + 1]
+
+    def similarity_vertex(self, a: int, b: int) -> float:
+        return self.similarity(str(a), str(b))
+
+
+class Node2Vec(DeepWalk):
+    """p/q-biased DeepWalk (reference: models/node2vec)."""
+
+    def __init__(self, p: float = 1.0, q: float = 0.5, **kw):
+        super().__init__(p=p, q=q, **kw)

@@ -1,0 +1,133 @@
+"""Tokenization API (reference: deeplearning4j-nlp text/tokenization —
+TokenizerFactory/Tokenizer/TokenPreProcess interfaces:
+tokenization/tokenizerfactory/DefaultTokenizerFactory.java:1,
+tokenization/tokenizer/DefaultTokenizer.java:1,
+preprocessor/CommonPreprocessor.java:1) and the stopwords list
+(text/stopwords/StopWords.java:1).
+
+Same three-interface shape as the reference (factory → tokenizer →
+preprocessor), python-idiomatic: tokenizers are iterables of tokens.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+# reference: stopwords file loaded by StopWords.getStopWords()
+ENGLISH_STOP_WORDS = frozenset("""a an and are as at be but by for if in into
+is it no not of on or such that the their then there these they this to was
+will with""".split())
+
+
+class TokenPreProcess:
+    """reference: TokenPreProcess interface — one string in, one out."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference:
+    CommonPreprocessor.java:1 — same regex class)."""
+
+    _RE = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._RE.sub("", token).lower()
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class Tokenizer:
+    """reference: Tokenizer interface (hasMoreTokens/nextToken/getTokens)."""
+
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+    def get_tokens(self) -> List[str]:
+        out = []
+        for t in self._tokens:
+            if self._pre is not None:
+                t = self._pre.pre_process(t)
+            if t:
+                out.append(t)
+        return out
+
+    def count_tokens(self) -> int:
+        return len(self.get_tokens())
+
+    def __iter__(self):
+        return iter(self.get_tokens())
+
+
+class TokenizerFactory:
+    """reference: TokenizerFactory interface."""
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenizer (reference: DefaultTokenizerFactory wraps
+    java.util.StringTokenizer — whitespace splitting)."""
+
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None):
+        self._pre = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text.split(), self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Word n-grams over a base tokenizer (reference:
+    NGramTokenizerFactory.java:1)."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        self._base = base
+        self.min_n, self.max_n = min_n, max_n
+        self._pre = None
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self._base.create(text).get_tokens()
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(toks) - n + 1):
+                out.append(" ".join(toks[i:i + n]))
+        return Tokenizer(out, self._pre)
+
+
+class SentenceIterator:
+    """reference: sentenceiterator.SentenceIterator — streams sentences;
+    here any iterable of strings qualifies, this class adds reset()."""
+
+    def __init__(self, sentences: Iterable[str],
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        self._sentences = list(sentences)
+        self._pre = preprocessor
+
+    def __iter__(self):
+        for s in self._sentences:
+            yield self._pre(s) if self._pre else s
+
+    def reset(self) -> None:      # list-backed; API parity
+        pass
+
+
+class LineSentenceIterator(SentenceIterator):
+    """reference: LineSentenceIterator — one sentence per file line."""
+
+    def __init__(self, path: str, preprocessor=None):
+        with open(path, "r", encoding="utf-8") as fh:
+            super().__init__([ln.strip() for ln in fh if ln.strip()],
+                             preprocessor)

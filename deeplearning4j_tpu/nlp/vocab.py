@@ -1,0 +1,81 @@
+"""Vocabulary cache (reference: models/word2vec/wordstore/VocabCache +
+AbstractCache — word↔index maps, frequencies, min-frequency pruning) and
+the negative-sampling unigram table (reference builds the same
+count^0.75 table in embeddings/learning/impl/elements/SkipGram.java's
+sampling path; here it is a numpy array sampled in batches).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabCache:
+    """Word <-> index with counts. Index 0 is reserved for <unk>."""
+
+    UNK = "<unk>"
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self.word2idx: Dict[str, int] = {self.UNK: 0}
+        self.idx2word: List[str] = [self.UNK]
+        self.counts: Counter = Counter()
+
+    def fit(self, sequences: Iterable[List[str]]) -> "VocabCache":
+        for seq in sequences:
+            self.counts.update(seq)
+        for w, c in self.counts.most_common():
+            if c >= self.min_word_frequency and w not in self.word2idx:
+                self.word2idx[w] = len(self.idx2word)
+                self.idx2word.append(w)
+        return self
+
+    # reference VocabCache method names
+    def contains_word(self, word: str) -> bool:
+        return word in self.word2idx
+
+    def index_of(self, word: str) -> int:
+        return self.word2idx.get(word, 0)
+
+    def word_at_index(self, idx: int) -> str:
+        return self.idx2word[idx]
+
+    def word_frequency(self, word: str) -> int:
+        return self.counts.get(word, 0)
+
+    def num_words(self) -> int:
+        return len(self.idx2word)
+
+    def words(self) -> List[str]:
+        return list(self.idx2word[1:])
+
+    def encode(self, tokens: List[str], drop_unk: bool = True) -> np.ndarray:
+        ids = [self.word2idx.get(t, 0) for t in tokens]
+        if drop_unk:
+            ids = [i for i in ids if i != 0]
+        return np.asarray(ids, dtype=np.int32)
+
+    def unigram_table(self, power: float = 0.75) -> np.ndarray:
+        """Sampling distribution over word indices ∝ count^power
+        (word2vec's negative-sampling distribution)."""
+        probs = np.zeros(self.num_words(), np.float64)
+        for w, i in self.word2idx.items():
+            if i != 0:
+                probs[i] = float(self.counts[w]) ** power
+        s = probs.sum()
+        return (probs / s) if s > 0 else probs
+
+    def subsample_keep_probs(self, t: float = 1e-3) -> Optional[np.ndarray]:
+        """word2vec frequent-word subsampling keep-probability per index
+        (reference sampling config Word2Vec.Builder.sampling)."""
+        total = sum(self.counts.values()) or 1
+        keep = np.ones(self.num_words(), np.float64)
+        for w, i in self.word2idx.items():
+            if i == 0:
+                continue
+            f = self.counts[w] / total
+            if f > 0:
+                keep[i] = min(1.0, (np.sqrt(f / t) + 1.0) * (t / f))
+        return keep

@@ -120,6 +120,6 @@ def _ensure_loaded() -> None:
     _LOADED = True
     from deeplearning4j_tpu.ops import (  # noqa: F401
         control_flow, elementwise, pairwise, reduce as _reduce, shape_ops,
-        random as _random, linalg, nn_ops, nn_ext, loss, bitwise, image,
-        tf_compat,
+        random as _random, linalg, nlp_ops, nn_ops, nn_ext, loss, bitwise,
+        image, tf_compat,
     )

@@ -71,6 +71,52 @@ def sync_global_devices(tag: str = "barrier") -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+class HostFailureError(RuntimeError):
+    """A peer host failed to reach a barrier within the liveness
+    timeout (SURVEY §5 failure detection — the reference's analogue is
+    Spark-era heartbeating; here liveness is defined as barrier
+    progress, the scaling-book model where a dead host means the
+    collective never completes)."""
+
+
+def barrier_with_timeout(tag: str = "barrier", timeout: float = 60.0,
+                         _sync_fn: Optional[Callable] = None) -> None:
+    """Liveness-checked barrier: raises HostFailureError if the global
+    sync does not complete within ``timeout`` seconds (a hung/dead peer
+    otherwise blocks forever). Single-process: no-op.
+
+    The barrier runs in a worker thread; on timeout the thread is
+    abandoned (the runtime cannot cancel a blocked collective) and the
+    caller should checkpoint-and-exit so the scheduler can relaunch the
+    slice — the elastic recovery path (ElasticTrainer.run resumes).
+    """
+    import threading
+    sync = _sync_fn if _sync_fn is not None else sync_global_devices
+    if _sync_fn is None and jax.process_count() <= 1:
+        return
+    err = []
+    done = threading.Event()
+
+    def _run():
+        try:
+            sync(tag)
+        except Exception as e:      # surface remote failures too
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise HostFailureError(
+            f"barrier {tag!r} did not complete within {timeout}s — a "
+            f"peer process is unreachable; checkpoint and restart the "
+            f"job (ElasticTrainer resumes from the latest checkpoint)")
+    if err:
+        raise HostFailureError(
+            f"barrier {tag!r} failed: {err[0]}") from err[0]
+
+
 class ElasticTrainer:
     """Checkpoint-based elastic training driver.
 
@@ -84,11 +130,12 @@ class ElasticTrainer:
     """
 
     def __init__(self, sd, checkpoint_dir: str, every_n_epochs: int = 1,
-                 keep_last: int = 3):
+                 keep_last: int = 3, barrier_timeout: float = 600.0):
         self.sd = sd
         self.dir = str(checkpoint_dir)
         self.every = max(1, int(every_n_epochs))
         self.keep = keep_last
+        self.barrier_timeout = barrier_timeout
         os.makedirs(self.dir, exist_ok=True)
 
     # -- checkpoint bookkeeping ----------------------------------------
@@ -116,15 +163,35 @@ class ElasticTrainer:
 
     # -- elastic run ----------------------------------------------------
     def run(self, dataset_iterator, epochs: int,
-            fault_hook: Optional[Callable[[int], None]] = None):
+            fault_hook: Optional[Callable[[int], None]] = None,
+            strict_restore: bool = True):
         """Train ``epochs`` total epochs, resuming from the latest
         checkpoint. fault_hook(epoch) (tests/fault injection — reference
         FailureTestingListener.java:19) runs after each epoch and may
-        raise to simulate a crash."""
+        raise to simulate a crash.
+
+        strict_restore: a checkpoint whose array names do not cover the
+        live graph's parameters raises instead of silently training the
+        uncovered parameters from their fresh init (a renamed layer must
+        not resume from initialization without telling anyone)."""
         from deeplearning4j_tpu.autodiff.samediff import SameDiff
         path, done = self.latest()
         if path is not None:
             restored = SameDiff.load(path)
+            if strict_restore:
+                live = set(self.sd.trainable_params()) | \
+                    set(self.sd.state_vars_map())
+                have = set(restored._arrays)
+                missing = sorted(live - have)
+                if missing:
+                    raise ValueError(
+                        f"checkpoint {path} does not cover live "
+                        f"parameters {missing[:5]}{'...' if len(missing) > 5 else ''} "
+                        f"— the graph changed since the checkpoint "
+                        f"(renamed/added layers). Pass "
+                        f"strict_restore=False to resume the matching "
+                        f"subset from the checkpoint and the rest from "
+                        f"fresh init.")
             # adopt restored arrays/updater state into the live graph
             for n, arr in restored._arrays.items():
                 if n in self.sd._arrays:
@@ -139,7 +206,9 @@ class ElasticTrainer:
         for epoch in range(start, epochs):
             h = self.sd.fit(dataset_iterator, epochs=1)
             losses.append(h.final_loss())
-            sync_global_devices(f"epoch_{epoch}")
+            # liveness-checked epoch barrier: a dead peer surfaces as
+            # HostFailureError instead of an indefinite hang
+            barrier_with_timeout(f"epoch_{epoch}", self.barrier_timeout)
             if (epoch + 1) % self.every == 0 or epoch == epochs - 1:
                 self._save(epoch)
             if fault_hook is not None:

@@ -1,0 +1,12 @@
+"""Training UI subsystem (reference: deeplearning4j-ui-parent).
+
+StatsListener collects score/throughput/param-stats/memory into a
+StatsStorage (JSONL); ui.report renders the storage as ONE static,
+self-contained HTML dashboard — the Vertx web server replaced by an
+artifact you can open anywhere (TPU pods rarely allow inbound ports).
+"""
+from deeplearning4j_tpu.ui.report import render_report, write_report
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsStorage
+
+__all__ = ["StatsListener", "StatsStorage", "render_report",
+           "write_report"]

@@ -1,0 +1,139 @@
+"""Static HTML training report from a StatsStorage.
+
+Reference parity: the deeplearning4j-vertx dashboard's Overview and
+Model tabs (VertxUIServer.java:78; TrainModule's score chart, update:
+parameter ratio chart, histograms, system tab) rendered as ONE
+self-contained HTML file: inline SVG, zero external assets, no server.
+"""
+from __future__ import annotations
+
+import html as _html
+from typing import List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _svg_line(points: Sequence[Tuple[float, float]], w=640, h=180,
+              color="#1f77b4", label="", ylog=False) -> str:
+    if not points:
+        return f"<p>(no data for {_html.escape(label)})</p>"
+    import math
+    xs = [p[0] for p in points]
+    ys = [(math.log10(max(p[1], 1e-12)) if ylog else p[1]) for p in points]
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    if y1 - y0 < 1e-12:
+        y0, y1 = y0 - 1, y1 + 1
+    px = lambda x: 45 + (x - x0) / max(x1 - x0, 1e-12) * (w - 55)
+    py = lambda y: (h - 25) - (y - y0) / (y1 - y0) * (h - 35)
+    path = " ".join(f"{'M' if i == 0 else 'L'}{px(x):.1f},{py(y):.1f}"
+                    for i, (x, y) in enumerate(zip(xs, ys)))
+    fmt = (lambda v: f"1e{v:.1f}") if ylog else (lambda v: f"{v:.4g}")
+    return f"""<svg width="{w}" height="{h}" style="background:#fafafa">
+<text x="5" y="14" font-size="12" fill="#444">{_html.escape(label)}</text>
+<text x="5" y="{h-28}" font-size="10" fill="#888">{fmt(y0)}</text>
+<text x="5" y="26" font-size="10" fill="#888">{fmt(y1)}</text>
+<path d="{path}" stroke="{color}" fill="none" stroke-width="1.5"/>
+</svg>"""
+
+
+def _svg_hist(hist: List[int], edges: List[float], w=220, h=90,
+              label="") -> str:
+    if not hist or max(hist) == 0:
+        return ""
+    n = len(hist)
+    bw = (w - 10) / n
+    mx = max(hist)
+    bars = "".join(
+        f'<rect x="{5+i*bw:.1f}" y="{(h-18)*(1-v/mx)+4:.1f}" '
+        f'width="{bw-1:.1f}" height="{(h-18)*v/mx:.1f}" fill="#2ca02c"/>'
+        for i, v in enumerate(hist))
+    return f"""<svg width="{w}" height="{h}" style="background:#fafafa">
+{bars}
+<text x="5" y="{h-4}" font-size="9" fill="#666">{_html.escape(label)}
+ [{edges[0]:.3g}, {edges[1]:.3g}]</text></svg>"""
+
+
+def render_report(storage: StatsStorage, title: str = "Training report"
+                  ) -> str:
+    scores = storage.of_type("score")
+    perf = storage.of_type("perf")
+    params = storage.of_type("params")
+    memory = storage.of_type("memory")
+    end = storage.of_type("end")
+
+    parts = [f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{_html.escape(title)}</title>
+<style>body{{font-family:sans-serif;margin:24px;color:#222}}
+h2{{border-bottom:1px solid #ddd;padding-bottom:4px}}
+.row{{display:flex;flex-wrap:wrap;gap:12px}}
+table{{border-collapse:collapse;font-size:13px}}
+td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
+<h1>{_html.escape(title)}</h1>"""]
+
+    # -- overview: score + throughput ------------------------------------
+    parts.append("<h2>Overview</h2><div class='row'>")
+    parts.append(_svg_line([(r["iter"], r["loss"]) for r in scores],
+                           label="score vs iteration", ylog=True))
+    parts.append(_svg_line(
+        [(r["iter"], r.get("samples_per_sec", r["batches_per_sec"]))
+         for r in perf],
+        label="throughput (samples/sec)" if any(
+            "samples_per_sec" in r for r in perf)
+        else "throughput (batches/sec)", color="#ff7f0e"))
+    parts.append("</div>")
+    if end and end[-1].get("wall_seconds") is not None:
+        parts.append(f"<p>wall time: {end[-1]['wall_seconds']:.2f}s, "
+                     f"{len(scores)} scored iterations</p>")
+
+    # -- model: update:param ratios + histograms -------------------------
+    if params:
+        parts.append("<h2>Update : parameter ratios (log10)</h2>"
+                     "<div class='row'>")
+        names = sorted(params[-1]["params"])
+        for name in names:
+            pts = [(r["epoch"], r["params"][name]["update_ratio"])
+                   for r in params if name in r["params"]
+                   and "update_ratio" in r["params"][name]]
+            if pts:
+                parts.append(_svg_line(pts, w=320, h=120, color="#d62728",
+                                       label=name, ylog=True))
+        parts.append("</div><h2>Parameter histograms (last epoch)</h2>"
+                     "<div class='row'>")
+        last = params[-1]["params"]
+        for name in names:
+            ent = last[name]
+            parts.append(_svg_hist(ent["hist"], ent["edges"], label=name))
+        parts.append("</div><h2>Parameter stats (last epoch)</h2><table>"
+                     "<tr><th>param</th><th>mean</th><th>std</th>"
+                     "<th>norm</th><th>update norm</th></tr>")
+        for name in names:
+            ent = last[name]
+            parts.append(
+                f"<tr><td>{_html.escape(name)}</td>"
+                f"<td>{ent['mean']:.4g}</td><td>{ent['std']:.4g}</td>"
+                f"<td>{ent['norm']:.4g}</td>"
+                f"<td>{ent.get('update_norm', float('nan')):.4g}</td></tr>")
+        parts.append("</table>")
+
+    # -- system: memory --------------------------------------------------
+    if memory:
+        parts.append("<h2>Device memory</h2><div class='row'>")
+        parts.append(_svg_line(
+            [(r["epoch"], r["bytes_in_use"] / 2**20) for r in memory],
+            label="HBM in use (MiB)", color="#9467bd"))
+        parts.append(_svg_line(
+            [(r["epoch"], r["peak_bytes"] / 2**20) for r in memory],
+            label="HBM peak (MiB)", color="#8c564b"))
+        parts.append("</div>")
+
+    parts.append("</body></html>")
+    return "\n".join(parts)
+
+
+def write_report(storage: StatsStorage, path: str,
+                 title: str = "Training report") -> str:
+    html = render_report(storage, title)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(html)
+    return path

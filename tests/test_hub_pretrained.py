@@ -1,0 +1,197 @@
+"""Model hub + pretrained-weight loading.
+
+Reference parity: ZooModel.initPretrained() (download-cache-restore;
+here the cache is seed-only — zero egress) and KerasModelImport's h5
+weight restore. Hermetic fixtures: h5 files in BOTH Keras layouts
+(weights-only keras-applications style and full-model model_weights
+style) synthesized to the zoo architecture's exact shapes.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.hub import (
+    KNOWN_ARTIFACTS, ModelHub, init_pretrained, load_sequential_weights,
+    read_h5_layer_weights)
+
+
+def _write_keras_apps_h5(path, layers, full_model=False):
+    """layers: [(name, [arrays])] in model order, keras-applications
+    attr layout (layer_names / weight_names)."""
+    import h5py
+    with h5py.File(path, "w") as f:
+        root = f.create_group("model_weights") if full_model else f
+        root.attrs["layer_names"] = np.array(
+            [ln.encode() for ln, _ in layers])
+        for ln, arrs in layers:
+            g = root.create_group(ln)
+            wnames = []
+            for i, a in enumerate(arrs):
+                wn = f"{ln}/w_{i}:0"
+                g.create_dataset(wn, data=a)
+                wnames.append(wn.encode())
+            g.attrs["weight_names"] = np.array(wnames)
+
+
+class TestModelHub:
+    def test_add_and_resolve(self, tmp_path):
+        hub = ModelHub(cache_dir=str(tmp_path / "hub"))
+        src = tmp_path / "weights.bin"
+        src.write_bytes(b"abc123")
+        hub.add("my_weights.h5", str(src))
+        assert hub.contains("my_weights.h5")
+        assert "my_weights.h5" in hub.list()
+        assert open(hub.path("my_weights.h5"), "rb").read() == b"abc123"
+
+    def test_known_artifact_missing_is_actionable(self, tmp_path):
+        hub = ModelHub(cache_dir=str(tmp_path / "hub"))
+        with pytest.raises(FileNotFoundError) as ei:
+            hub.path("vgg16_keras")
+        msg = str(ei.value)
+        assert "vgg16_weights_tf_dim_ordering_tf_kernels.h5" in msg
+        assert str(tmp_path / "hub") in msg
+
+    def test_unknown_name_lists_known(self, tmp_path):
+        hub = ModelHub(cache_dir=str(tmp_path / "hub"))
+        with pytest.raises(FileNotFoundError, match="vgg16_keras"):
+            hub.path("nope")
+
+    def test_sha256(self, tmp_path):
+        hub = ModelHub(cache_dir=str(tmp_path / "hub"))
+        (tmp_path / "hub" / "a.bin").write_bytes(b"x")
+        assert hub.sha256("a.bin") == (
+            "2d711642b726b04401627ca9fbac32f5c8530fb1903cc4db02258717921a4881")
+
+
+def _vgg_fixture_layers(net, rng, head_classes=None):
+    """Synthesize h5 layer entries shaped exactly like the net's params
+    (optionally with a different head width, keras-apps 1000-way)."""
+    sd = net.samediff
+    params = {n: np.asarray(a) for n, a in
+              {**sd.trainable_params(), **sd.state_vars_map()}.items()}
+    stems, by_stem = [], {}
+    for n, a in params.items():
+        stem = n.rsplit("_", 1)[0]
+        if stem not in by_stem:
+            by_stem[stem] = []
+            stems.append(stem)
+        by_stem[stem].append(a)
+    layers = []
+    for i, stem in enumerate(stems):
+        arrs = [rng.standard_normal(a.shape).astype(np.float32) * 0.05
+                for a in by_stem[stem]]
+        if head_classes is not None and i == len(stems) - 1:
+            w = by_stem[stem][0]
+            arrs = [rng.standard_normal((w.shape[0], head_classes))
+                    .astype(np.float32) * 0.05,
+                    np.zeros(head_classes, np.float32)]
+        layers.append((f"keras_layer_{i}", arrs))
+    return layers
+
+
+class TestSequentialLoad:
+    @pytest.mark.parametrize("full_model", [False, True])
+    def test_vgg16_weights_land_exactly(self, tmp_path, full_model):
+        from deeplearning4j_tpu.zoo import VGG16
+        net = VGG16(height=32, width=32, num_classes=10).build()
+        rng = np.random.default_rng(0)
+        layers = _vgg_fixture_layers(net, rng)
+        p = str(tmp_path / "w.h5")
+        _write_keras_apps_h5(p, layers, full_model=full_model)
+        n = load_sequential_weights(net, p)
+        assert n == sum(len(a) for _, a in layers)
+        # every param now equals its h5 source array
+        sd = net.samediff
+        flat = [a for _, arrs in layers for a in arrs]
+        got = list({**sd.trainable_params(),
+                    **sd.state_vars_map()}.values())
+        stems_sorted = []    # rebuild pairing as the loader does
+        params = {k: np.asarray(v) for k, v in
+                  {**sd.trainable_params(), **sd.state_vars_map()}.items()}
+        by_stem = {}
+        for k, v in params.items():
+            by_stem.setdefault(k.rsplit("_", 1)[0], []).append(v)
+        pos = 0
+        for stem in by_stem:
+            for v in by_stem[stem]:
+                np.testing.assert_allclose(v, flat[pos], atol=0,
+                                           err_msg=stem)
+                pos += 1
+
+    def test_forward_uses_loaded_weights(self, tmp_path):
+        """End-to-end: load handcrafted weights, check the network's
+        prediction against a numpy forward computation."""
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, InputType, NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.learning.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=5, activation="relu"))
+                .layer(OutputLayer(n_out=3, loss_function="MCXENT"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        w0 = rng.standard_normal((4, 5)).astype(np.float32)
+        b0 = rng.standard_normal(5).astype(np.float32)
+        w1 = rng.standard_normal((5, 3)).astype(np.float32)
+        b1 = np.zeros(3, np.float32)
+        p = str(tmp_path / "w.h5")
+        _write_keras_apps_h5(p, [("dense", [w0, b0]), ("out", [w1, b1])])
+        load_sequential_weights(net, p)
+        x = rng.standard_normal((2, 4)).astype(np.float32)
+        got = net.output(x)
+        h = np.maximum(x @ w0 + b0, 0)
+        logits = h @ w1 + b1
+        want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_skip_mismatched_head(self, tmp_path):
+        """1000-class keras-apps weights into a 10-class net: body
+        loads, head stays at its fresh init (ZooModel.initPretrained
+        with custom num_classes)."""
+        from deeplearning4j_tpu.zoo import VGG16
+        net = VGG16(height=32, width=32, num_classes=10).build()
+        rng = np.random.default_rng(0)
+        layers = _vgg_fixture_layers(net, rng, head_classes=1000)
+        p = str(tmp_path / "w.h5")
+        _write_keras_apps_h5(p, layers)
+        head_before = np.asarray(net.samediff.trainable_params()
+                                 ["layer20_out_W"])
+        net2 = init_pretrained(
+            VGG16(height=32, width=32, num_classes=10), p)
+        sd = net2.samediff
+        # first conv loaded from h5
+        np.testing.assert_allclose(
+            np.asarray(sd.trainable_params()["layer0_conv_W"]),
+            layers[0][1][0])
+        # head kept its own (seeded) init, not the 1000-way h5 head
+        assert np.asarray(sd.trainable_params()["layer20_out_W"]
+                          ).shape == (4096, 10)
+
+    def test_shape_mismatch_is_actionable(self, tmp_path):
+        from deeplearning4j_tpu.zoo import VGG16
+        net = VGG16(height=32, width=32, num_classes=10).build()
+        rng = np.random.default_rng(0)
+        layers = _vgg_fixture_layers(net, rng, head_classes=1000)
+        p = str(tmp_path / "w.h5")
+        _write_keras_apps_h5(p, layers)
+        with pytest.raises(ValueError, match="skip_mismatched_head"):
+            load_sequential_weights(net, p)
+
+    def test_read_both_layouts_agree(self, tmp_path):
+        rng = np.random.default_rng(2)
+        layers = [("a", [rng.standard_normal((3, 3)).astype(np.float32)]),
+                  ("b", [rng.standard_normal(4).astype(np.float32),
+                         rng.standard_normal((4, 2)).astype(np.float32)])]
+        p1, p2 = str(tmp_path / "w1.h5"), str(tmp_path / "w2.h5")
+        _write_keras_apps_h5(p1, layers, full_model=False)
+        _write_keras_apps_h5(p2, layers, full_model=True)
+        r1 = read_h5_layer_weights(p1)
+        r2 = read_h5_layer_weights(p2)
+        assert [ln for ln, _ in r1] == [ln for ln, _ in r2] == ["a", "b"]
+        for (_, a1), (_, a2) in zip(r1, r2):
+            for x, y in zip(a1, a2):
+                np.testing.assert_array_equal(x, y)

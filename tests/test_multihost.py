@@ -92,3 +92,132 @@ def test_elastic_fresh_run_no_checkpoint(tmp_path):
     losses = tr.run(_data(), epochs=2)
     assert len(losses) == 2
     assert np.isfinite(losses).all()
+
+
+def test_strict_restore_mismatch_raises(tmp_path):
+    """Round-4 Weak #6: a checkpoint that doesn't cover the live
+    graph's parameters (renamed layer) must raise, not silently resume
+    the uncovered parameter from fresh init."""
+    import pytest
+
+    ckdir = str(tmp_path / "mismatch")
+    sd = _make_model()
+    tr = multihost.ElasticTrainer(sd, ckdir, every_n_epochs=1)
+    tr.run(_data(), epochs=1)
+
+    sd2 = _make_model()
+    sd2.rename_variable("w", "w_renamed")
+    tr2 = multihost.ElasticTrainer(sd2, ckdir, every_n_epochs=1)
+    with pytest.raises(ValueError, match="w_renamed"):
+        tr2.run(_data(), epochs=2)
+    # explicit opt-out resumes the matching subset
+    losses = tr2.run(_data(), epochs=2, strict_restore=False)
+    assert len(losses) == 1
+
+
+def test_barrier_with_timeout_detects_hang():
+    """Liveness: a barrier that never completes (dead peer) raises
+    HostFailureError instead of blocking forever."""
+    import time
+
+    import pytest
+
+    def hung_sync(tag):
+        time.sleep(30)
+
+    t0 = time.perf_counter()
+    with pytest.raises(multihost.HostFailureError, match="epoch_0"):
+        multihost.barrier_with_timeout("epoch_0", timeout=0.3,
+                                       _sync_fn=hung_sync)
+    assert time.perf_counter() - t0 < 5
+
+
+def test_barrier_with_timeout_propagates_peer_error():
+    import pytest
+
+    def failing_sync(tag):
+        raise RuntimeError("peer went away")
+
+    with pytest.raises(multihost.HostFailureError, match="peer went away"):
+        multihost.barrier_with_timeout("b", timeout=5, _sync_fn=failing_sync)
+
+
+def test_barrier_completes_normally():
+    calls = []
+    multihost.barrier_with_timeout("ok", timeout=5,
+                                   _sync_fn=lambda tag: calls.append(tag))
+    assert calls == ["ok"]
+
+
+_TWO_PROC_WORKER = r"""
+import os, sys, json
+proc_id = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+# initialize() must run before anything touches the XLA backend — the
+# package __init__ builds mesh helpers that do, so initialize first
+# through the same code path, importing only the multihost module
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "mh_standalone",
+    os.path.join(os.environ["PYTHONPATH"],
+                 "deeplearning4j_tpu/parallel/multihost.py"))
+mh = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mh)
+mh.initialize(coordinator_address=f"127.0.0.1:{port}",
+              num_processes=2, process_id=proc_id)
+from deeplearning4j_tpu.parallel import multihost
+assert jax.process_count() == 2
+assert jax.device_count() == 4          # 2 hosts x 2 local devices
+from jax.experimental import multihost_utils
+import numpy as np
+gathered = multihost_utils.process_allgather(
+    np.asarray([multihost.process_index()], np.int32))
+multihost.barrier_with_timeout("handshake", timeout=60)
+assert mh.initialize is not multihost.initialize  # same file, two loads
+with open(out, "w") as fh:
+    json.dump({"pid": proc_id,
+               "is_coord": multihost.is_coordinator(),
+               "gathered": np.asarray(gathered).ravel().tolist()}, fh)
+"""
+
+
+def test_two_process_distributed_cpu(tmp_path):
+    """An ACTUAL 2-process jax.distributed run on CPU (round-4 Weak #6:
+    initialize() had never been exercised with >1 process): both
+    processes join the coordinator, see the global device view
+    (2 hosts x 2 devices), allgather each other's ranks, and pass a
+    liveness-checked barrier."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:       # free port
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "worker.py"
+    script.write_text(_TWO_PROC_WORKER)
+    outs = [str(tmp_path / f"out_{i}.json") for i in range(2)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = os.getcwd()
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), port, outs[i]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, stdout.decode()[-2000:]
+    finally:
+        for p in procs:
+            p.kill()
+    results = [json.load(open(o)) for o in outs]
+    assert results[0]["is_coord"] is True
+    assert results[1]["is_coord"] is False
+    for r in results:
+        assert r["gathered"] == [0, 1]

@@ -450,6 +450,10 @@ EXERCISED = {    # nn ops — test_nn / test_layer_breadth / test_layers_ext / t
     "while_loop": "test_control_flow",
     "cond_branch": "test_control_flow",
     "scan_loop": "test_control_flow",
+    # nlp — numpy-reference checks in test_nlp (TestNlpOpsLedger)
+    "skipgram_ns_loss": "test_nlp",
+    "cbow_ns_loss": "test_nlp",
+    "glove_loss": "test_nlp",
     "conv1d": "test_layer_breadth",
     "conv3d": "test_layer_breadth", 
     "batchnorm": "test_nn", 
