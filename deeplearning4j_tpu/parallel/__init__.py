@@ -9,14 +9,14 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.sharding import (
     ShardingRule, ShardingStrategy, data_and_tensor_parallel, data_parallel,
     megatron_data_and_tensor_parallel, megatron_tensor_parallel_rules,
-    tensor_parallel_rules)
+    tensor_parallel_rules, transformer_tensor_parallel_rules)
 from deeplearning4j_tpu.parallel.trainer import (
     BatchedParallelInference, ParallelInference, ParallelTrainer)
 from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention, ulysses_attention)
 from deeplearning4j_tpu.parallel.pipeline import (
-    pipeline_forward, pipeline_train_step, place_stage_params,
-    sequential_forward, split_microbatches)
+    pipeline_forward, pipeline_model_train_step, pipeline_train_step,
+    place_stage_params, sequential_forward, split_microbatches)
 from deeplearning4j_tpu.parallel import collectives, multihost
 
 __all__ = [
@@ -27,6 +27,7 @@ __all__ = [
     "megatron_data_and_tensor_parallel", "megatron_tensor_parallel_rules",
     "ring_attention",
     "ulysses_attention", "collectives", "multihost",
-    "pipeline_forward", "pipeline_train_step", "place_stage_params",
-    "sequential_forward", "split_microbatches",
+    "pipeline_forward", "pipeline_train_step", "pipeline_model_train_step",
+    "place_stage_params", "sequential_forward", "split_microbatches",
+    "transformer_tensor_parallel_rules",
 ]

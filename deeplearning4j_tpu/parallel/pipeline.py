@@ -50,7 +50,8 @@ def place_stage_params(mesh: DeviceMesh, stage_params):
 
 def pipeline_forward(stage_fn: Callable, mesh: DeviceMesh,
                      microbatch_spec: Optional[PartitionSpec] = None,
-                     extra_specs: Tuple = ()):
+                     extra_specs: Tuple = (),
+                     param_specs=None):
     """Build fn(stage_params, microbatches, *extra) -> outputs running the
     GPipe schedule over the mesh's 'pipe' axis.
 
@@ -62,7 +63,11 @@ def pipeline_forward(stage_fn: Callable, mesh: DeviceMesh,
     Composition: on a (pipe, data, ...) mesh the microbatch dim 1 shards
     over 'data' by default, so each pipe column runs data-parallel
     columns of the same stage; stage_fn may additionally use explicit
-    'model'-axis collectives for in-stage tensor parallelism.
+    'model'-axis collectives for in-stage tensor parallelism —
+    ``param_specs`` (a pytree of PartitionSpecs matching stage_params,
+    each leading with PIPE_AXIS) declares per-leaf Megatron shardings,
+    and stage_fn closes row-parallel contractions with
+    ``lax.psum(..., 'model')``.
     """
     S = mesh.axis_size(PIPE_AXIS)
 
@@ -116,9 +121,10 @@ def pipeline_forward(stage_fn: Callable, mesh: DeviceMesh,
         from jax.experimental.shard_map import shard_map
 
     def fn(stage_params, microbatches, *extra):
-        param_specs = jax.tree_util.tree_map(lambda _: pspec, stage_params)
+        pspecs = (param_specs if param_specs is not None else
+                  jax.tree_util.tree_map(lambda _: pspec, stage_params))
         kw = dict(mesh=mesh.mesh,
-                  in_specs=(param_specs, xspec) + tuple(
+                  in_specs=(pspecs, xspec) + tuple(
                       extra_specs or (xspec,) * len(extra)),
                   out_specs=xspec)
         try:
@@ -128,6 +134,10 @@ def pipeline_forward(stage_fn: Callable, mesh: DeviceMesh,
         return sm(stage_params, microbatches, *extra)
 
     return fn
+
+
+def _default_sgd(p, g):
+    return jax.tree_util.tree_map(lambda a, b: a - 1e-2 * b, p, g)
 
 
 def split_microbatches(x, n_micro: int):
@@ -158,8 +168,7 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     """
     fwd = pipeline_forward(stage_fn, mesh)
     if optimizer_update is None:
-        def optimizer_update(p, g):
-            return jax.tree_util.tree_map(lambda a, b: a - 1e-2 * b, p, g)
+        optimizer_update = _default_sgd
 
     def loss_of(stage_params, head_params, x, labels):
         mb = split_microbatches(x, n_micro)
@@ -173,6 +182,51 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         gs, gh = grads
         return (optimizer_update(stage_params, gs),
                 optimizer_update(head_params, gh), loss)
+
+    return step
+
+
+def pipeline_model_train_step(embed_fn: Callable, stage_fn: Callable,
+                              head_loss_fn: Callable, mesh: DeviceMesh,
+                              n_micro: int,
+                              optimizer_update: Optional[Callable] = None,
+                              stage_param_specs=None):
+    """One jitted train step for the NON-homogeneous model shape
+    embed → homogeneous trunk → head (round-4 Weak #8: only same-shape
+    trunks could be pipelined).
+
+    TPU-native composition: the trunk — the only part with S
+    structurally-identical stages — runs the GPipe schedule over the
+    'pipe' axis; ``embed_fn`` (token/position lookup, arbitrary input
+    shape → trunk shape) and ``head_loss_fn`` (trunk shape → scalar
+    loss, e.g. final LN + tied-vocab logits + CE) run as ordinary SPMD
+    computations around it in the SAME jit, sharded over 'data' (and
+    'model' where their params carry TP specs). Their FLOPs are tiny
+    next to the trunk's, so pinning them to pipe ranks (the GPU
+    runtimes' approach) would only add bubble.
+
+    embed_fn(embed_params, *inputs) -> (B, ...) trunk input
+    stage_fn(stage_params_slice, h) -> h       (homogeneous trunk)
+    head_loss_fn(head_params, h, *labels) -> scalar loss
+    Returns step((embed_p, stage_p, head_p), inputs_tuple, labels_tuple)
+    -> (new_params_triple, loss).
+    """
+    fwd = pipeline_forward(stage_fn, mesh, param_specs=stage_param_specs)
+    if optimizer_update is None:
+        optimizer_update = _default_sgd
+
+    def loss_of(params, inputs, labels):
+        embed_p, stage_p, head_p = params
+        h = embed_fn(embed_p, *inputs)
+        mb = split_microbatches(h, n_micro)
+        y = merge_microbatches(fwd(stage_p, mb))
+        return head_loss_fn(head_p, y, *labels)
+
+    @jax.jit
+    def step(params, inputs, labels):
+        loss, grads = jax.value_and_grad(loss_of)(params, inputs, labels)
+        new = tuple(optimizer_update(p, g) for p, g in zip(params, grads))
+        return new, loss
 
     return step
 

@@ -98,7 +98,8 @@ def data_and_tensor_parallel(mesh: DeviceMesh) -> ShardingStrategy:
                             batch_axes=(DATA_AXIS,))
 
 
-def megatron_tensor_parallel_rules(param_names) -> List[ShardingRule]:
+def megatron_tensor_parallel_rules(param_names,
+                                   warn_empty: bool = True) -> List[ShardingRule]:
     """Megatron-style COLUMN→ROW alternation derived from the actual
     parameter names of a built network (scaling-book MLP recipe): the
     first dense kernel of each consecutive dense pair shards its OUTPUT
@@ -114,7 +115,7 @@ def megatron_tensor_parallel_rules(param_names) -> List[ShardingRule]:
     """
     dense = [n for n in param_names
              if re.match(r"^(.*?)(?:_dense|_out)_W$", n)]
-    if not dense:
+    if not dense and warn_empty:
         import warnings
         warnings.warn(
             "megatron_tensor_parallel_rules: no dense/output kernels found "
@@ -138,12 +139,66 @@ def megatron_tensor_parallel_rules(param_names) -> List[ShardingRule]:
     return rules
 
 
+def transformer_tensor_parallel_rules() -> List[ShardingRule]:
+    """Megatron attention + MLP + embedding rules for the transformer
+    naming schemes in this repo (zoo/gpt: ``h{i}/attn/qkv/kernel``...;
+    nn attention layers: ``..._attn_Wq``...) — the full Megatron-LM
+    layout (round-4 Weak #5: qkv/proj/embeddings fell through to
+    replication):
+
+    - qkv projection: COLUMN parallel (shard the fused 3H output dim —
+      each model rank owns a head subset);
+    - attention output projection: ROW parallel (shard the input dim;
+      one psum closes the attention block);
+    - MLP up/fc: COLUMN; MLP down/proj: ROW (one psum closes the MLP);
+    - token embedding: row-parallel over the VOCAB dim (each rank owns
+      a vocab shard; the gather's psum combines) — position embeddings
+      replicate (small).
+    """
+    return [
+        # zoo/gpt naming
+        ShardingRule(r"attn/qkv/kernel$", (None, MODEL_AXIS)),
+        ShardingRule(r"attn/qkv/bias$", (MODEL_AXIS,)),
+        ShardingRule(r"attn/proj/kernel$", (MODEL_AXIS, None)),
+        ShardingRule(r"attn/proj/bias$", (None,)),
+        ShardingRule(r"mlp/fc/kernel$", (None, MODEL_AXIS)),
+        ShardingRule(r"mlp/fc/bias$", (MODEL_AXIS,)),
+        ShardingRule(r"mlp/proj/kernel$", (MODEL_AXIS, None)),
+        ShardingRule(r"mlp/proj/bias$", (None,)),
+        ShardingRule(r"^wte$", (MODEL_AXIS, None)),
+        ShardingRule(r"^wpe$", (None,)),
+        # nn attention layers (RecurrentAttentionLayer etc.: _Wq/_Wk/_Wv
+        # column, _Wo row)
+        ShardingRule(r"_attn_W[qkv]$", (None, MODEL_AXIS)),
+        ShardingRule(r"_attn_Wo$", (MODEL_AXIS, None)),
+        # BERT-import naming (query/key/value/attention-output denses)
+        ShardingRule(r"attention/self/(query|key|value)/kernel$",
+                     (None, MODEL_AXIS)),
+        ShardingRule(r"attention/self/(query|key|value)/bias$",
+                     (MODEL_AXIS,)),
+        ShardingRule(r"attention/output/dense/kernel$", (MODEL_AXIS, None)),
+        ShardingRule(r"attention/output/dense/bias$", (None,)),
+        ShardingRule(r"intermediate/dense/kernel$", (None, MODEL_AXIS)),
+        ShardingRule(r"intermediate/dense/bias$", (MODEL_AXIS,)),
+        ShardingRule(r"(?<!attention)/output/dense/kernel$",
+                     (MODEL_AXIS, None)),
+        ShardingRule(r"word_embeddings$", (MODEL_AXIS, None)),
+    ]
+
+
 def megatron_data_and_tensor_parallel(mesh: DeviceMesh,
                                       model) -> ShardingStrategy:
-    """DP×TP with column/row alternation derived from ``model``'s actual
-    parameters (SameDiff or layer network)."""
+    """DP×TP with the full Megatron layout: transformer attention/MLP/
+    embedding rules first (name-scheme based), then column→row
+    alternation derived from ``model``'s remaining dense parameters."""
     sd = getattr(model, "samediff", model)
-    return ShardingStrategy(
-        mesh, param_rules=megatron_tensor_parallel_rules(
-            list(sd.trainable_params())),
-        batch_axes=(DATA_AXIS,))
+    names = list(sd.trainable_params())
+    rules = transformer_tensor_parallel_rules()
+    covered = {n for n in names if any(r.matches(n) for r in rules)}
+    remaining = [n for n in names if n not in covered]
+    # the alternation pass warns when it finds no dense kernels — that
+    # is spurious when the transformer rules already cover the model
+    rules += megatron_tensor_parallel_rules(remaining,
+                                            warn_empty=not covered)
+    return ShardingStrategy(mesh, param_rules=rules,
+                            batch_axes=(DATA_AXIS,))

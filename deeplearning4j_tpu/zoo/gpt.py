@@ -104,13 +104,18 @@ def build_gpt(cfg: GPTConfig, batch: int, seq_len: int, seed: int = 0):
         with ctx:
             y = _layer_norm(sd, f"{sc}/ln_1", x, H, cfg.layer_norm_eps)
             qkv = _dense(sd, rng, f"{sc}/attn/qkv", y, H, 3 * H, std)
+            # fused-kernel layout is PER-HEAD blocks [q_a|k_a|v_a] (not
+            # [Q|K|V]): a contiguous shard of the 3H output dim then
+            # holds complete heads, so Megatron column-parallel sharding
+            # (parallel/sharding.py transformer rules) never straddles a
+            # q/k/v boundary — zero resharding inside the block
             qkv = sd.invoke("reshape", [qkv],
-                            {"shape": (batch, seq_len, 3 * A, D)},
+                            {"shape": (batch, seq_len, A, 3 * D)},
                             name=f"{sc}/attn/split_heads")
             qkv = sd.invoke("permute", [qkv], {"axes": (0, 2, 1, 3)},
-                            name=f"{sc}/attn/heads_t")   # [B, 3A, S, D]
+                            name=f"{sc}/attn/heads_t")   # [B, A, S, 3D]
             q, k, v = sd.invoke("split", [qkv],
-                                {"num_split": 3, "axis": 1},
+                                {"num_split": 3, "axis": 3},
                                 name=f"{sc}/attn/qkv_split", n_outputs=3)
             att = sd.invoke("scaled_dot_product_attention", [q, k, v],
                             {"causal": True}, name=f"{sc}/attn/sdpa")

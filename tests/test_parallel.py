@@ -236,3 +236,61 @@ def test_global_pooling_rejects_ff_input():
             .build())
     with pytest.raises(ValueError, match="cnn or rnn"):
         MultiLayerNetwork(conf).init()
+
+
+class TestTransformerTPRules:
+    def test_gpt_naming_covered(self):
+        """Round-4 Weak #5: attention qkv/proj + embeddings must get
+        Megatron specs, not silent replication."""
+        import jax
+        from deeplearning4j_tpu.parallel import (
+            DeviceMesh, megatron_data_and_tensor_parallel)
+        from deeplearning4j_tpu.zoo.gpt import GPT_TINY, build_gpt
+        sd = build_gpt(GPT_TINY, batch=2, seq_len=8)
+        mesh = DeviceMesh.create(devices=jax.devices()[:4], data=2, model=2)
+        st = megatron_data_and_tensor_parallel(mesh, sd)
+        spec = lambda n: tuple(st.param_spec(
+            n, len(np.shape(sd._arrays[n]))))
+        assert spec("h0/attn/qkv/kernel") == (None, "model")
+        assert spec("h0/attn/proj/kernel") == ("model", None)
+        assert spec("h0/mlp/fc/kernel") == (None, "model")
+        assert spec("h0/mlp/proj/kernel") == ("model", None)
+        assert spec("wte") == ("model", None)
+        assert spec("h0/ln_1/gamma") == ()        # replicated
+
+    def test_gpt_tiny_trains_with_megatron_tp(self):
+        """GPT through the GSPMD path with the full Megatron layout:
+        numerics equal to single-device training."""
+        import jax
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.dataset import DeviceCachedIterator
+        from deeplearning4j_tpu.learning.updaters import Sgd
+        from deeplearning4j_tpu.parallel import (
+            DeviceMesh, ParallelTrainer, megatron_data_and_tensor_parallel)
+        from deeplearning4j_tpu.zoo.gpt import GPT_TINY, build_gpt
+
+        def make():
+            sd = build_gpt(GPT_TINY, batch=4, seq_len=8)
+            sd.training_config = TrainingConfig(
+                updater=Sgd(0.05),
+                data_set_feature_mapping=["input_ids"],
+                data_set_label_mapping=["targets"])
+            return sd
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, GPT_TINY.vocab_size, (8, 8)).astype(np.int32)
+        tgt = rng.integers(0, GPT_TINY.vocab_size, (8, 8)).astype(np.int32)
+
+        sd1 = make()
+        it = DeviceCachedIterator([ids], [tgt], batch_size=4)
+        sd1.fit(it, epochs=2)
+        w1 = np.asarray(sd1.get_arr_for_var("wte").data)
+
+        sd2 = make()
+        mesh = DeviceMesh.create(devices=jax.devices()[:4], data=2,
+                                 model=2)
+        tr = ParallelTrainer(sd2, megatron_data_and_tensor_parallel(
+            mesh, sd2))
+        it2 = DeviceCachedIterator([ids], [tgt], batch_size=4)
+        tr.fit(it2, epochs=2)
+        w2 = np.asarray(sd2.get_arr_for_var("wte").data)
+        np.testing.assert_allclose(w1, w2, rtol=2e-4, atol=2e-5)
