@@ -1,0 +1,99 @@
+"""Conditions for boolean indexing (reference:
+nd4j-api indexing/conditions/Conditions.java — the factory the reference
+uses with BooleanIndexing.replaceWhere / INDArray.replaceWhere).
+
+A Condition is a callable array -> bool mask; factories mirror the
+reference names (snake_cased, camelCase aliases kept).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Condition:
+    def __init__(self, fn, desc: str):
+        self._fn = fn
+        self.desc = desc
+
+    def __call__(self, x):
+        return self._fn(x)
+
+    def __repr__(self):
+        return f"Condition({self.desc})"
+
+
+class Conditions:
+    @staticmethod
+    def greater_than(v) -> Condition:
+        return Condition(lambda x: x > v, f"> {v}")
+
+    @staticmethod
+    def less_than(v) -> Condition:
+        return Condition(lambda x: x < v, f"< {v}")
+
+    @staticmethod
+    def greater_than_or_equal(v) -> Condition:
+        return Condition(lambda x: x >= v, f">= {v}")
+
+    @staticmethod
+    def less_than_or_equal(v) -> Condition:
+        return Condition(lambda x: x <= v, f"<= {v}")
+
+    @staticmethod
+    def equals(v) -> Condition:
+        return Condition(lambda x: x == v, f"== {v}")
+
+    @staticmethod
+    def not_equals(v) -> Condition:
+        return Condition(lambda x: x != v, f"!= {v}")
+
+    @staticmethod
+    def epsilon_equals(v, eps: float = 1e-5) -> Condition:
+        return Condition(lambda x: jnp.abs(x - v) < eps, f"~= {v}")
+
+    @staticmethod
+    def is_nan() -> Condition:
+        return Condition(jnp.isnan, "isnan")
+
+    @staticmethod
+    def is_infinite() -> Condition:
+        return Condition(jnp.isinf, "isinf")
+
+    @staticmethod
+    def is_finite() -> Condition:
+        return Condition(jnp.isfinite, "isfinite")
+
+    @staticmethod
+    def not_finite() -> Condition:
+        return Condition(lambda x: ~jnp.isfinite(x), "notfinite")
+
+    @staticmethod
+    def absolute_greater_than(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) > v, f"|x| > {v}")
+
+    @staticmethod
+    def absolute_less_than(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) < v, f"|x| < {v}")
+
+    # reference camelCase aliases
+    greaterThan = greater_than
+    lessThan = less_than
+    greaterThanOrEqual = greater_than_or_equal
+    lessThanOrEqual = less_than_or_equal
+    notEquals = not_equals
+    epsEquals = epsilon_equals
+    isNan = is_nan
+    isInfinite = is_infinite
+    absGreaterThan = absolute_greater_than
+    absLessThan = absolute_less_than
+
+
+def resolve(cond) -> Condition:
+    """Accept a Condition, a callable mask fn, or a boolean array."""
+    if isinstance(cond, Condition):
+        return cond
+    if callable(cond):
+        return Condition(cond, "custom")
+    mask = jnp.asarray(cond)
+    return Condition(lambda x: jnp.broadcast_to(mask.astype(bool), x.shape),
+                     "mask")

@@ -1,0 +1,783 @@
+"""Op-breadth wave: list / segment / scatter-nd / image-tail / cast /
+math-tail families.
+
+Reference parity: the declarable-op families this module completes are
+cited per section (libnd4j/include/ops/declarable/generic/<dir>). Every
+op is a pure jax function; coverage enforced by the ledger gate
+(tests/test_op_ledger.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ---------------------------------------------------------------------------
+# list ops (reference: generic/list/*.cpp — the NDArrayList/TensorArray
+# family). TPU-native representation: a "list" is a stacked array with a
+# leading element axis (XLA has no ragged storage; the reference's list
+# is likewise a vector of same-shape NDArrays for every op below).
+# ---------------------------------------------------------------------------
+_L = "list"
+
+
+@op("create_list", _L, differentiable=False)
+def create_list(template, size: int):
+    """Empty list of ``size`` elements shaped like ``template``
+    (reference: create_list.cpp)."""
+    return jnp.zeros((size,) + tuple(template.shape), template.dtype)
+
+
+@op("write_list", _L, n_inputs=2)
+def write_list(lst, value, index: int):
+    """(reference: write_list.cpp)"""
+    lst = jnp.asarray(lst)
+    return lst.at[index].set(value.astype(lst.dtype))
+
+
+@op("read_list", _L, n_inputs=1)
+def read_list(lst, index: int):
+    """(reference: read_list.cpp)"""
+    return lst[index]
+
+
+@op("gather_list", _L, n_inputs=2)
+def gather_list(lst, indices):
+    """(reference: gather_list.cpp)"""
+    return jnp.take(lst, indices.astype(jnp.int32), axis=0)
+
+
+@op("scatter_list", _L, n_inputs=3)
+def scatter_list(lst, indices, values):
+    """(reference: scatter_list.cpp)"""
+    lst = jnp.asarray(lst)
+    return lst.at[indices.astype(jnp.int32)].set(values.astype(lst.dtype))
+
+
+@op("stack_list", _L, n_inputs=1)
+def stack_list(lst):
+    """List -> single stacked array (reference: stack_list.cpp; the
+    representation already is the stack, so this is identity)."""
+    return lst
+
+
+@op("unstack_list", _L, n_inputs=1)
+def unstack_list(arr):
+    """Array -> list along axis 0 (reference: unstack_list.cpp)."""
+    return arr
+
+
+@op("split_list", _L, n_inputs=1)
+def split_list(arr, sizes):
+    """(reference: split_list.cpp)"""
+    idx, acc = [], 0
+    for s in list(sizes)[:-1]:
+        acc += int(s)
+        idx.append(acc)
+    return tuple(jnp.split(arr, idx, axis=0))
+
+
+@op("size_list", _L, n_inputs=1, differentiable=False)
+def size_list(lst):
+    """(reference: size_list.cpp)"""
+    return jnp.asarray(lst.shape[0], jnp.int32)
+
+
+@op("pick_list", _L, n_inputs=2)
+def pick_list(lst, indices):
+    """Gather + concatenate along the element axis (reference:
+    pick_list.cpp)."""
+    return jnp.concatenate(
+        [lst[i] for i in np.asarray(indices).astype(np.int64).tolist()], 0) \
+        if np.ndim(indices) else lst[int(indices)]
+
+
+@op("clone_list", _L, n_inputs=1)
+def clone_list(lst):
+    """(reference: clone_list.cpp)"""
+    return jnp.array(lst, copy=True)
+
+
+# ---------------------------------------------------------------------------
+# unsorted segment ops (reference: generic/parity_ops/unsorted_segment_*)
+# ---------------------------------------------------------------------------
+_S = "segment"
+
+
+def _seg(reducer, data, segment_ids, num_segments):
+    ids = segment_ids.astype(jnp.int32)
+    return reducer(data, ids, num_segments=int(num_segments))
+
+
+@op("unsorted_segment_sum", _S, n_inputs=2)
+def unsorted_segment_sum(data, segment_ids, num_segments: int):
+    return _seg(jax.ops.segment_sum, data, segment_ids, num_segments)
+
+
+@op("unsorted_segment_mean", _S, n_inputs=2)
+def unsorted_segment_mean(data, segment_ids, num_segments: int):
+    s = _seg(jax.ops.segment_sum, data, segment_ids, num_segments)
+    n = _seg(jax.ops.segment_sum, jnp.ones_like(data), segment_ids,
+             num_segments)
+    return s / jnp.maximum(n, 1)
+
+
+@op("unsorted_segment_min", _S, n_inputs=2)
+def unsorted_segment_min(data, segment_ids, num_segments: int):
+    return _seg(jax.ops.segment_min, data, segment_ids, num_segments)
+
+
+@op("unsorted_segment_max", _S, n_inputs=2)
+def unsorted_segment_max(data, segment_ids, num_segments: int):
+    return _seg(jax.ops.segment_max, data, segment_ids, num_segments)
+
+
+@op("unsorted_segment_prod", _S, n_inputs=2)
+def unsorted_segment_prod(data, segment_ids, num_segments: int):
+    return _seg(jax.ops.segment_prod, data, segment_ids, num_segments)
+
+
+@op("unsorted_segment_sqrt_n", _S, n_inputs=2)
+def unsorted_segment_sqrt_n(data, segment_ids, num_segments: int):
+    s = _seg(jax.ops.segment_sum, data, segment_ids, num_segments)
+    n = _seg(jax.ops.segment_sum, jnp.ones_like(data), segment_ids,
+             num_segments)
+    return s / jnp.sqrt(jnp.maximum(n, 1))
+
+
+# ---------------------------------------------------------------------------
+# scatter-nd updates (reference: generic/parity_ops/scatter_nd_*.cpp)
+# ---------------------------------------------------------------------------
+_SC = "shape"
+
+
+def _nd_idx(indices):
+    ix = indices.astype(jnp.int32)
+    return tuple(jnp.moveaxis(ix, -1, 0))
+
+
+@op("scatter_nd_update", _SC, n_inputs=3, differentiable=False)
+def scatter_nd_update(ref, indices, updates):
+    return ref.at[_nd_idx(indices)].set(updates.astype(ref.dtype))
+
+
+@op("scatter_nd_add", _SC, n_inputs=3)
+def scatter_nd_add(ref, indices, updates):
+    return ref.at[_nd_idx(indices)].add(updates.astype(ref.dtype))
+
+
+@op("scatter_nd_sub", _SC, n_inputs=3)
+def scatter_nd_sub(ref, indices, updates):
+    return ref.at[_nd_idx(indices)].add(-updates.astype(ref.dtype))
+
+
+# ---------------------------------------------------------------------------
+# image tail (reference: generic/images/*.cpp, parity_ops resize family)
+# ---------------------------------------------------------------------------
+_I = "image"
+
+
+@op("resize_area", _I, n_inputs=1)
+def resize_area(images, height: int, width: int):
+    """Area (box) resampling (reference: resize_area.cpp)."""
+    b, h, w, c = images.shape
+    return jax.image.resize(images, (b, height, width, c), method="linear") \
+        if (height > h or width > w) else _box_downsample(images, height, width)
+
+
+def _box_downsample(images, height, width):
+    b, h, w, c = images.shape
+    if h % height == 0 and w % width == 0:
+        fh, fw = h // height, w // width
+        x = images.reshape(b, height, fh, width, fw, c)
+        return x.mean(axis=(2, 4))
+    return jax.image.resize(images, (b, height, width, c), method="linear")
+
+
+@op("mirror_pad", _I, n_inputs=1, aliases=("mirrorPad",))
+def mirror_pad(x, paddings, mode: str = "REFLECT"):
+    """(reference: parity_ops/mirrorPad.cpp)"""
+    pw = [tuple(int(v) for v in p) for p in np.asarray(paddings)]
+    return jnp.pad(x, pw, mode="reflect" if mode.upper() == "REFLECT"
+                   else "symmetric")
+
+
+@op("rgb_to_yiq", _I, n_inputs=1)
+def rgb_to_yiq(images):
+    """(reference: images/rgbToYiq.cpp — NTSC matrix)"""
+    m = jnp.asarray([[0.299, 0.587, 0.114],
+                     [0.5959, -0.2746, -0.3213],
+                     [0.2115, -0.5227, 0.3112]], images.dtype)
+    return jnp.einsum("...c,yc->...y", images, m)
+
+
+@op("yiq_to_rgb", _I, n_inputs=1)
+def yiq_to_rgb(images):
+    """(reference: images/yiqToRgb.cpp)"""
+    m = jnp.asarray([[0.299, 0.587, 0.114],
+                     [0.5959, -0.2746, -0.3213],
+                     [0.2115, -0.5227, 0.3112]], jnp.float64)
+    inv = jnp.linalg.inv(m).astype(images.dtype)
+    return jnp.einsum("...c,yc->...y", images, inv)
+
+
+@op("random_crop", _I, n_inputs=1)
+def random_crop(images, size, key=None, seed: int = 0):
+    """(reference: parity_ops/random_crop.cpp)"""
+    if key is None:
+        key = jax.random.key(seed)
+    size = tuple(int(s) for s in size)
+    starts = []
+    for i, (dim, want) in enumerate(zip(images.shape, size)):
+        k = jax.random.fold_in(key, i)
+        starts.append(
+            jax.random.randint(k, (), 0, dim - want + 1, dtype=jnp.int32)
+            if dim > want else jnp.asarray(0, jnp.int32))
+    return lax.dynamic_slice(images, tuple(starts), size)
+
+
+@op("draw_bounding_boxes", _I, n_inputs=2, differentiable=False)
+def draw_bounding_boxes(images, boxes, colors=None):
+    """(reference: parity_ops/draw_bounding_boxes.cpp) — boxes
+    [B, N, 4] normalized (ymin, xmin, ymax, xmax); 1-pixel outlines."""
+    b, h, w, c = images.shape
+    out = jnp.asarray(images)
+    boxes = np.asarray(boxes)
+    colors = (np.asarray(colors) if colors is not None
+              else np.ones((1, c), np.float32))
+    yy = jnp.arange(h)[:, None]
+    xx = jnp.arange(w)[None, :]
+    for bi in range(boxes.shape[0]):
+        for ni in range(boxes.shape[1]):
+            ymin, xmin, ymax, xmax = boxes[bi, ni]
+            y0, y1 = int(ymin * (h - 1)), int(ymax * (h - 1))
+            x0, x1 = int(xmin * (w - 1)), int(xmax * (w - 1))
+            col = jnp.asarray(colors[ni % len(colors)], images.dtype)
+            on_edge = (((yy == y0) | (yy == y1)) & (xx >= x0) & (xx <= x1)) \
+                | (((xx == x0) | (xx == x1)) & (yy >= y0) & (yy <= y1))
+            out = out.at[bi].set(
+                jnp.where(on_edge[..., None], col, out[bi]))
+    return out
+
+
+@op("dilation2d", _I, n_inputs=2)
+def dilation2d(x, filt, strides=(1, 1), rates=(1, 1), padding: str = "SAME"):
+    """Grayscale morphological dilation (reference:
+    parity_ops/dilation2d.cpp; NHWC, filter [fh, fw, c])."""
+    fh, fw, c = filt.shape
+    sh, sw = (strides if len(strides) == 2 else strides[1:3])
+    rh, rw = (rates if len(rates) == 2 else rates[1:3])
+    patches = _patches(x, fh, fw, sh, sw, rh, rw, padding)  # [b,oh,ow,k,c]
+    return jnp.max(patches + filt.reshape(fh * fw, c), axis=3)
+
+
+def _patches(x, fh, fw, sh, sw, rh, rw, padding):
+    b, h, w, c = x.shape
+    cols = lax.conv_general_dilated_patches(
+        x, (fh, fw), (sh, sw), padding, rhs_dilation=(rh, rw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = cols.shape[1], cols.shape[2]
+    return cols.reshape(b, oh, ow, c, fh * fw).transpose(0, 1, 2, 4, 3)
+
+
+@op("histogram", _I, n_inputs=1, differentiable=False)
+def histogram(x, num_bins: int):
+    """(reference: parity_ops/histogram.cpp)"""
+    lo, hi = jnp.min(x), jnp.max(x)
+    edges = jnp.linspace(lo, hi, num_bins + 1)
+    idx = jnp.clip(jnp.searchsorted(edges[1:-1], x.reshape(-1),
+                                    side="right"), 0, num_bins - 1)
+    return jax.ops.segment_sum(jnp.ones_like(idx, jnp.int64), idx,
+                               num_segments=num_bins)
+
+
+@op("histogram_fixed_width", _I, n_inputs=1, differentiable=False)
+def histogram_fixed_width(x, value_range, num_bins: int = 100):
+    """(reference: parity_ops/histogram_fixed_width.cpp)"""
+    lo, hi = float(value_range[0]), float(value_range[1])
+    scaled = (x.reshape(-1) - lo) / max(hi - lo, 1e-30) * num_bins
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, num_bins - 1)
+    return jax.ops.segment_sum(jnp.ones_like(idx, jnp.int64), idx,
+                               num_segments=num_bins)
+
+
+# ---------------------------------------------------------------------------
+# dtype casts (reference: generic/datatypes/to_*.cpp, bitcast.cpp)
+# ---------------------------------------------------------------------------
+_D = "datatypes"
+
+for _name, _dt in (("to_double", jnp.float64), ("to_float32", jnp.float32),
+                   ("to_float16", jnp.float16), ("to_int32", jnp.int32),
+                   ("to_int64", jnp.int64), ("to_uint32", jnp.uint32),
+                   ("to_uint64", jnp.uint64)):
+    def _mk(dt):
+        def cast(x):
+            return x.astype(dt)
+        cast.__doc__ = f"(reference: generic/datatypes) cast to {dt}"
+        return cast
+    op(_name, _D, n_inputs=1, differentiable=False)(_mk(_dt))
+
+
+@op("bitcast", _D, n_inputs=1, differentiable=False)
+def bitcast(x, dtype: str):
+    """Reinterpret bytes (reference: datatypes/bitcast.cpp)."""
+    return lax.bitcast_convert_type(x, jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# math / transform tail (reference: generic/parity_ops + transforms)
+# ---------------------------------------------------------------------------
+_M = "elementwise"
+
+
+@op("betainc", _M, n_inputs=3)
+def betainc(a, b, x):
+    """(reference: parity_ops/betaInc.cpp)"""
+    return jax.scipy.special.betainc(a, b, x)
+
+
+@op("polygamma", _M, n_inputs=2)
+def polygamma(n, x):
+    """(reference: parity_ops/polygamma.cpp)"""
+    return jax.scipy.special.polygamma(n.astype(jnp.int32), x)
+
+
+@op("zeta", _M, n_inputs=2)
+def zeta(x, q):
+    """Hurwitz zeta (reference: parity_ops/zeta.cpp)."""
+    return jax.scipy.special.zeta(x, q)
+
+
+@op("logaddexp", _M, n_inputs=2)
+def logaddexp(a, b):
+    """(reference: legacy pairwise LogAddExp)"""
+    return jnp.logaddexp(a, b)
+
+
+@op("xlogy", _M, n_inputs=2)
+def xlogy(x, y):
+    """x*log(y) with 0*log(0)=0 (reference: legacy pairwise)."""
+    return jax.scipy.special.xlogy(x, y)
+
+
+@op("sinc", _M, n_inputs=1)
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@op("entr", _M, n_inputs=1)
+def entr(x):
+    """-x*log(x) elementwise entropy (reference: legacy transforms)."""
+    return jax.scipy.special.entr(x)
+
+
+@op("erfinv", _M, n_inputs=1)
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@op("heaviside", _M, n_inputs=2)
+def heaviside(x, h0):
+    return jnp.heaviside(x, h0)
+
+
+@op("nextafter", _M, n_inputs=2, differentiable=False)
+def nextafter(a, b):
+    return jnp.nextafter(a, b)
+
+
+@op("ldexp", _M, n_inputs=2)
+def ldexp(x, e):
+    return jnp.ldexp(x, e.astype(jnp.int32))
+
+
+@op("crelu", _M, n_inputs=1)
+def crelu(x, axis: int = -1):
+    """Concatenated ReLU (reference: transforms/crelu.cpp)."""
+    return jnp.concatenate([jax.nn.relu(x), jax.nn.relu(-x)], axis=axis)
+
+
+@op("realdiv", _M, n_inputs=2)
+def realdiv(a, b):
+    """(reference: broadcastable/realdiv.cpp — always real-valued div)"""
+    af = a.astype(jnp.result_type(a.dtype, jnp.float32))
+    return af / b.astype(af.dtype)
+
+
+@op("reduce_dot", _M, n_inputs=2)
+def reduce_dot(a, b, axes=None, keep_dims: bool = False):
+    """sum(a*b, axes) (reference: reduce/reduce_dot.cpp)."""
+    prod = a * b.astype(a.dtype)
+    ax = tuple(axes) if axes is not None else None
+    return jnp.sum(prod, axis=ax, keepdims=keep_dims)
+
+
+@op("percentile", _M, n_inputs=1, differentiable=False)
+def percentile(x, q: float, axis=None, interpolation: str = "linear"):
+    """(reference: parity_ops/percentile.cpp)"""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.percentile(x, q, axis=ax, method=interpolation)
+
+
+@op("roll", _M, n_inputs=1)
+def roll(x, shift, axis=None):
+    """(reference: parity_ops/roll.cpp)"""
+    sh = tuple(shift) if isinstance(shift, (list, tuple)) else int(shift)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.roll(x, sh, axis=ax)
+
+
+@op("tri_op", _M, differentiable=False, aliases=("tri",))
+def tri_op(n: int, m: int = None, k: int = 0, dtype: str = "float32"):
+    """(reference: parity_ops/tri.cpp)"""
+    return jnp.tri(n, m, k, dtype=jnp.dtype(dtype))
+
+
+@op("triu_op", _M, n_inputs=1, aliases=("triu",))
+def triu_op(x, k: int = 0):
+    """(reference: parity_ops/triu.cpp)"""
+    return jnp.triu(x, k)
+
+
+@op("tril_op", _M, n_inputs=1, aliases=("tril",))
+def tril_op(x, k: int = 0):
+    return jnp.tril(x, k)
+
+
+@op("sqrtm", _M, n_inputs=1, differentiable=False)
+def sqrtm(x):
+    """Matrix square root (reference: parity_ops/sqrtm.cpp)."""
+    return jax.scipy.linalg.sqrtm(x).real.astype(x.dtype)
+
+
+@op("nth_element", _M, n_inputs=1, differentiable=False)
+def nth_element(x, n: int, reverse: bool = False):
+    """(reference: parity_ops/nth_element.cpp) — n-th order statistic
+    along the last axis."""
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@op("sequence_mask", _M, n_inputs=1, differentiable=False)
+def sequence_mask(lengths, maxlen: int = None, dtype: str = "bool"):
+    """(reference: parity_ops/sequence_mask.cpp)"""
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    rng = jnp.arange(ml)
+    return (rng[None, :] < lengths.astype(jnp.int32)[..., None]) \
+        .astype(jnp.dtype(dtype))
+
+
+@op("invert_permutation", _M, n_inputs=1, differentiable=False)
+def invert_permutation(p):
+    """(reference: parity_ops/invertPermutation.cpp)"""
+    p = p.astype(jnp.int32)
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=jnp.int32))
+
+
+@op("is_non_decreasing", _M, n_inputs=1, differentiable=False)
+def is_non_decreasing(x):
+    f = x.reshape(-1)
+    return jnp.all(f[1:] >= f[:-1]) if f.shape[0] > 1 else jnp.asarray(True)
+
+
+@op("is_strictly_increasing", _M, n_inputs=1, differentiable=False)
+def is_strictly_increasing(x):
+    f = x.reshape(-1)
+    return jnp.all(f[1:] > f[:-1]) if f.shape[0] > 1 else jnp.asarray(True)
+
+
+@op("ismax", _M, n_inputs=1, differentiable=False)
+def ismax(x, axis=None):
+    """1 where the (axis-wise) max sits (reference: legacy IsMax)."""
+    if axis is None:
+        return (x == jnp.max(x)).astype(x.dtype)
+    return (x == jnp.max(x, axis=axis, keepdims=True)).astype(x.dtype)
+
+
+@op("listdiff", _M, n_inputs=2, differentiable=False)
+def listdiff(x, y):
+    """Values (and their indices) of x not present in y (reference:
+    parity_ops/listdiff.cpp)."""
+    keep = ~jnp.isin(x, y)
+    idx = jnp.where(keep)[0]
+    return x[idx], idx.astype(jnp.int32)
+
+
+@op("merge_add", _M, aliases=("mergeadd", "accumulate_n"))
+def merge_add(*xs):
+    """(reference: transforms/merge_add.cpp)"""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@op("merge_avg", _M, aliases=("mergeavg",))
+def merge_avg(*xs):
+    return merge_add(*xs) / len(xs)
+
+
+@op("merge_max", _M, aliases=("mergemax",))
+def merge_max(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@op("merge_max_idx", _M, differentiable=False, aliases=("mergemaxindex",))
+def merge_max_idx(*xs):
+    """Index of the input holding the elementwise max (reference:
+    transforms/merge_max_idx.cpp)."""
+    return jnp.argmax(jnp.stack(xs, axis=0), axis=0).astype(jnp.int32)
+
+
+@op("col2im", _M, n_inputs=1)
+def col2im(cols, height: int, width: int, kernel=(2, 2), stride=(1, 1),
+           padding=(0, 0), dilation=(1, 1)):
+    """Inverse of im2col: scatter-add patches back (reference:
+    transforms/col2im.cpp). cols: [b, c, kh, kw, oh, ow]."""
+    b, c, kh, kw, oh, ow = cols.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    img = jnp.zeros((b, c, height + 2 * ph, width + 2 * pw), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            y, x = i * dh, j * dw
+            patch = cols[:, :, i, j]
+            up = jnp.zeros((b, c, (oh - 1) * sh + 1, (ow - 1) * sw + 1),
+                           cols.dtype)
+            up = up.at[:, :, ::sh, ::sw].set(patch)
+            pad_cfg = [(0, 0), (0, 0),
+                       (y, img.shape[2] - y - up.shape[2]),
+                       (x, img.shape[3] - x - up.shape[3])]
+            img = img + jnp.pad(up, pad_cfg)
+    return img[:, :, ph:ph + height, pw:pw + width]
+
+
+@op("maxpool_with_argmax", _M, n_inputs=1)
+def maxpool_with_argmax(x, kernel=(2, 2), stride=None, padding: str = "VALID"):
+    """(reference: nn/pooling/maxpool_with_argmax.cpp; NHWC) — returns
+    (pooled, flat argmax indices per window)."""
+    kh, kw = kernel
+    sh, sw = stride or kernel
+    b, h, w, c = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    # patches channels ordered [c, kh*kw]
+    p = patches.reshape(b, oh, ow, c, kh * kw)
+    pooled = jnp.max(p, axis=-1)
+    arg_in_window = jnp.argmax(p, axis=-1)
+    # flat NHWC index of the argmax element
+    wy = arg_in_window // kw
+    wx = arg_in_window % kw
+    oy = jnp.arange(oh)[None, :, None, None]
+    ox = jnp.arange(ow)[None, None, :, None]
+    iy = oy * sh + wy
+    ix = ox * sw + wx
+    cc = jnp.arange(c)[None, None, None, :]
+    flat = (iy * w + ix) * c + cc
+    return pooled, flat.astype(jnp.int64)
+
+
+@op("batch_to_space_nd", _M, n_inputs=1)
+def batch_to_space_nd(x, block_shape, crops):
+    """(reference: parity_ops/batch_to_space_nd.cpp)"""
+    block = [int(v) for v in np.asarray(block_shape).reshape(-1)]
+    crops = np.asarray(crops).reshape(-1, 2)
+    b = x.shape[0]
+    prod = int(np.prod(block))
+    spatial = x.shape[1:1 + len(block)]
+    rest = x.shape[1 + len(block):]
+    y = x.reshape(tuple(block) + (b // prod,) + spatial + rest)
+    perm = [len(block)]
+    for i in range(len(block)):
+        perm += [len(block) + 1 + i, i]
+    perm += list(range(2 * len(block) + 1, y.ndim))
+    y = y.transpose(perm)
+    new_spatial = tuple(s * bl for s, bl in zip(spatial, block))
+    y = y.reshape((b // prod,) + new_spatial + rest)
+    slices = [slice(None)]
+    for i, (c0, c1) in enumerate(crops):
+        slices.append(slice(int(c0), new_spatial[i] - int(c1)))
+    return y[tuple(slices)]
+
+
+@op("space_to_batch_nd", _M, n_inputs=1)
+def space_to_batch_nd(x, block_shape, paddings):
+    """(reference: parity_ops/space_to_batch_nd.cpp)"""
+    block = [int(v) for v in np.asarray(block_shape).reshape(-1)]
+    pads = np.asarray(paddings).reshape(-1, 2)
+    nb = len(block)
+    pad_cfg = [(0, 0)] + [tuple(int(v) for v in p) for p in pads] \
+        + [(0, 0)] * (x.ndim - 1 - nb)
+    x = jnp.pad(x, pad_cfg)
+    b = x.shape[0]
+    spatial = x.shape[1:1 + nb]
+    rest = x.shape[1 + nb:]
+    shape = (b,)
+    for s, bl in zip(spatial, block):
+        shape += (s // bl, bl)
+    shape += rest
+    y = x.reshape(shape)
+    perm = []
+    for i in range(nb):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(nb):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * nb, y.ndim))
+    y = y.transpose(perm)
+    return y.reshape((b * int(np.prod(block)),)
+                     + tuple(s // bl for s, bl in zip(spatial, block))
+                     + rest)
+
+
+@op("fake_quant_with_min_max_vars", _M, n_inputs=1)
+def fake_quant_with_min_max_vars(x, min_val: float = -6.0,
+                                 max_val: float = 6.0, num_bits: int = 8,
+                                 narrow_range: bool = False):
+    """(reference: parity_ops/fake_quant_with_min_max_vars.cpp)"""
+    qmin = 1 if narrow_range else 0
+    qmax = 2 ** num_bits - 1
+    scale = (max_val - min_val) / (qmax - qmin)
+    zp = qmin - min_val / scale
+    q = jnp.round(jnp.clip(x / scale + zp, qmin, qmax))
+    return (q - zp) * scale
+
+
+@op("fake_quant_with_min_max_vars_per_channel", _M, n_inputs=3)
+def fake_quant_per_channel(x, min_val, max_val, num_bits: int = 8,
+                           narrow_range: bool = False):
+    qmin = 1 if narrow_range else 0
+    qmax = 2 ** num_bits - 1
+    scale = (max_val - min_val) / (qmax - qmin)
+    zp = qmin - min_val / scale
+    q = jnp.round(jnp.clip(x / scale + zp, qmin, qmax))
+    return (q - zp) * scale
+
+
+@op("clip_by_averaged_norm", _M, n_inputs=1)
+def clip_by_averaged_norm(x, clip_norm: float):
+    """(reference: parity_ops/clip_by_averaged_norm.cpp)"""
+    avg_norm = jnp.sqrt(jnp.mean(x * x))
+    factor = jnp.minimum(1.0, clip_norm / jnp.maximum(avg_norm, 1e-30))
+    return x * factor
+
+
+@op("identity_n", _M, differentiable=True)
+def identity_n(*xs):
+    """(reference: parity_ops/identity_n.cpp)"""
+    return tuple(xs) if len(xs) > 1 else xs[0]
+
+
+@op("reshape_as", _M, n_inputs=2)
+def reshape_as(x, template):
+    """(reference: shape/reshape_as.cpp)"""
+    return x.reshape(template.shape)
+
+
+@op("tile_to_shape", _M, n_inputs=1)
+def tile_to_shape(x, shape):
+    """Tile up to ``shape`` — repeats = target/input per dim (reference:
+    shape/tile_to_shape.cpp; broadcast-compatible dims repeat too)."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != x.ndim:
+        x = x.reshape((1,) * (len(shape) - x.ndim) + x.shape)
+    reps = []
+    for want, have in zip(shape, x.shape):
+        if want % have:
+            raise ValueError(
+                f"tile_to_shape: target {shape} not a multiple of input "
+                f"{x.shape}")
+        reps.append(want // have)
+    return jnp.tile(x, reps)
+
+
+@op("relu_layer", _M, n_inputs=2)
+def relu_layer(x, w, b=None):
+    """relu(x@w+b) (reference: nn/relu_layer.cpp)."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return jax.nn.relu(y)
+
+
+@op("upsampling3d", _M, n_inputs=1)
+def upsampling3d(x, factor=(2, 2, 2), data_format: str = "NDHWC"):
+    """(reference: nn/convo/upsampling3d.cpp)"""
+    fd, fh, fw = factor
+    if data_format == "NCDHW":
+        return jnp.repeat(jnp.repeat(jnp.repeat(x, fd, 2), fh, 3), fw, 4)
+    return jnp.repeat(jnp.repeat(jnp.repeat(x, fd, 1), fh, 2), fw, 3)
+
+
+@op("cyclic_shift", "bitwise", n_inputs=2, differentiable=False,
+    aliases=("rotl",))
+def cyclic_shift(x, shift):
+    """Rotate bits left (reference: bitwise/cyclic_shift.cpp)."""
+    bits = x.dtype.itemsize * 8
+    s = shift.astype(x.dtype) % bits
+    ux = x.astype(jnp.uint32 if bits == 32 else jnp.uint64) \
+        if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
+    inv = ((bits - s) % bits).astype(ux.dtype)   # s==0: shift by width is UB
+    out = (ux << s.astype(ux.dtype)) | jnp.where(s == 0, 0, ux >> inv)
+    return out.astype(x.dtype)
+
+
+@op("cyclic_rshift", "bitwise", n_inputs=2, differentiable=False,
+    aliases=("rotr",))
+def cyclic_rshift(x, shift):
+    """Rotate bits right (reference: bitwise/cyclic_rshift.cpp)."""
+    bits = x.dtype.itemsize * 8
+    s = shift.astype(x.dtype) % bits
+    ux = x.astype(jnp.uint32 if bits == 32 else jnp.uint64) \
+        if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
+    inv = ((bits - s) % bits).astype(ux.dtype)   # s==0: shift by width is UB
+    out = (ux >> s.astype(ux.dtype)) | jnp.where(s == 0, 0, ux << inv)
+    return out.astype(x.dtype)
+
+
+@op("multinomial", "random", differentiable=False)
+def multinomial(logits, num_samples: int, key=None, seed: int = 0):
+    """(reference: random/multinomial.cpp)"""
+    if key is None:
+        key = jax.random.key(seed)
+    s = jax.random.categorical(key, logits, axis=-1,
+                               shape=(num_samples,) + logits.shape[:-1])
+    return jnp.moveaxis(s, 0, -1).astype(jnp.int64)
+
+
+@op("log_poisson_loss", "loss", n_inputs=2)
+def log_poisson_loss(log_input, targets, full: bool = False,
+                     reduction: str = "mean"):
+    """(reference: loss/log_poisson_loss.cpp)"""
+    loss = jnp.exp(log_input) - targets * log_input
+    if full:
+        stirling = (targets * jnp.log(jnp.maximum(targets, 1e-30))
+                    - targets + 0.5 * jnp.log(2 * jnp.pi
+                                              * jnp.maximum(targets, 1.0)))
+        loss = loss + jnp.where(targets > 1, stirling, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op("weighted_cross_entropy_with_logits", "loss", n_inputs=3)
+def weighted_cross_entropy_with_logits(targets, logits, weights):
+    """(reference: loss/weighted_cross_entropy_with_logits.cpp)"""
+    log_weight = 1 + (weights - 1) * targets
+    return jnp.mean(
+        (1 - targets) * logits
+        + log_weight * (jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                        + jax.nn.relu(-logits)))

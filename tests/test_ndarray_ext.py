@@ -1,0 +1,163 @@
+"""INDArray surface wave (round-4 Weak #9): boolean-indexing
+conditionals, row/column vector ops, tensors-along-dimension, scalar
+reducers, distances, exporters.
+
+Reference parity: INDArray.java's replaceWhere/getWhere/addRowVector/
+tensorAlongDimension/maxNumber/distance2/toIntVector families +
+indexing/conditions/Conditions.java and BooleanIndexing.java.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+from deeplearning4j_tpu.ndarray.conditions import Conditions
+
+
+def arr(vals):
+    return nd.create(np.asarray(vals, np.float32))
+
+
+class TestConditionals:
+    def test_replace_where_scalar(self):
+        x = arr([[1.0, -2.0], [-3.0, 4.0]])
+        x.replace_where(0.0, Conditions.less_than(0))
+        np.testing.assert_allclose(np.asarray(x), [[1, 0], [0, 4]])
+
+    def test_replace_where_nan(self):
+        x = arr([1.0, np.nan, 3.0])
+        x.replace_where(-1.0, Conditions.is_nan())
+        np.testing.assert_allclose(np.asarray(x), [1, -1, 3])
+
+    def test_put_where(self):
+        x = arr([1.0, 5.0, 2.0])
+        x.put_where(Conditions.greater_than(1.5), arr([10.0, 20.0, 30.0]))
+        np.testing.assert_allclose(np.asarray(x), [1, 20, 30])
+
+    def test_get_where(self):
+        x = arr([1.0, 5.0, 2.0, 7.0])
+        got = x.get_where(None, Conditions.greater_than(2))
+        np.testing.assert_allclose(np.asarray(got), [5, 7])
+
+    def test_match_condition_and_count(self):
+        x = arr([1.0, -1.0, 2.0])
+        mask = x.match_condition(Conditions.greater_than(0))
+        np.testing.assert_array_equal(np.asarray(mask),
+                                      [True, False, True])
+        assert x.condition_count(Conditions.greater_than(0)) == 2
+
+    def test_callable_condition(self):
+        x = arr([1.0, 4.0, 9.0])
+        x.replace_where(0.0, lambda v: v > 5)
+        np.testing.assert_allclose(np.asarray(x), [1, 4, 0])
+
+    def test_camel_aliases(self):
+        x = arr([[1.0, -1.0]])
+        x.replaceWhere(9.0, Conditions.lessThan(0))
+        np.testing.assert_allclose(np.asarray(x), [[1, 9]])
+
+
+class TestRowColumnVectors:
+    def setup_method(self):
+        self.m = arr([[1.0, 2.0], [3.0, 4.0]])
+
+    def test_add_row_vector(self):
+        out = self.m.add_row_vector([10.0, 20.0])
+        np.testing.assert_allclose(np.asarray(out), [[11, 22], [13, 24]])
+        # original untouched (copy semantics, like the reference's add*)
+        np.testing.assert_allclose(np.asarray(self.m), [[1, 2], [3, 4]])
+
+    def test_addi_column_vector_in_place(self):
+        self.m.addi_column_vector([10.0, 20.0])
+        np.testing.assert_allclose(np.asarray(self.m), [[11, 12], [23, 24]])
+
+    def test_mul_div_sub(self):
+        np.testing.assert_allclose(
+            np.asarray(self.m.mul_row_vector([2.0, 3.0])),
+            [[2, 6], [6, 12]])
+        np.testing.assert_allclose(
+            np.asarray(self.m.div_column_vector([1.0, 2.0])),
+            [[1, 2], [1.5, 2]])
+        np.testing.assert_allclose(
+            np.asarray(self.m.sub_row_vector([1.0, 1.0])),
+            [[0, 1], [2, 3]])
+
+
+class TestTensorAlongDimension:
+    def test_tad_matches_reference_semantics(self):
+        x = nd.create(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+        # TADs along dim 2: rows of length 4; there are 6 of them
+        assert x.num_tensors_along_dimension(2) == 6
+        t0 = x.tensor_along_dimension(0, 2)
+        np.testing.assert_allclose(np.asarray(t0), [0, 1, 2, 3])
+        # along dims (1, 2): the 2 matrices
+        assert x.num_tensors_along_dimension(1, 2) == 2
+        np.testing.assert_allclose(
+            np.asarray(x.tensor_along_dimension(1, 1, 2)),
+            np.arange(12, 24).reshape(3, 4))
+
+    def test_slice_and_put_slice(self):
+        x = nd.create(np.zeros((3, 2), np.float32))
+        x.put_slice(1, [5.0, 6.0])
+        np.testing.assert_allclose(np.asarray(x.slice_at(1)), [5, 6])
+        np.testing.assert_allclose(np.asarray(x)[0], [0, 0])
+
+    def test_slice_at_is_view(self):
+        x = nd.create(np.zeros((3, 2), np.float32))
+        x.slice_at(2).addi(7.0)
+        np.testing.assert_allclose(np.asarray(x)[2], [7, 7])
+
+
+class TestScalarReducers:
+    def setup_method(self):
+        self.x = arr([[1.0, -2.0], [3.0, -4.0]])
+
+    def test_numbers(self):
+        assert self.x.max_number() == 3.0
+        assert self.x.min_number() == -4.0
+        assert self.x.sum_number() == -2.0
+        assert self.x.mean_number() == -0.5
+        np.testing.assert_allclose(self.x.norm1_number(), 10.0)
+        np.testing.assert_allclose(self.x.norm2_number(),
+                                   np.sqrt(30.0), rtol=1e-6)
+        np.testing.assert_allclose(self.x.ammean(), 2.5)
+        np.testing.assert_allclose(self.x.median_number(), -0.5)
+        np.testing.assert_allclose(self.x.percentile_number(50), -0.5)
+
+    def test_std_bias_correction(self):
+        v = np.asarray(self.x).reshape(-1)
+        np.testing.assert_allclose(self.x.std_number(True),
+                                   np.std(v, ddof=1), rtol=1e-6)
+        np.testing.assert_allclose(self.x.var_number(False),
+                                   np.var(v), rtol=1e-6)
+
+
+class TestDistances:
+    def test_distance_family(self):
+        a = arr([1.0, 2.0, 3.0])
+        b = arr([2.0, 4.0, 6.0])
+        np.testing.assert_allclose(a.distance1(b), 6.0)
+        np.testing.assert_allclose(a.distance2(b), np.sqrt(14.0),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(a.squared_distance(b), 14.0)
+        np.testing.assert_allclose(a.cosine_similarity(b), 1.0, rtol=1e-6)
+
+
+class TestExportersAndPredicates:
+    def test_exporters(self):
+        x = arr([[1.7, 2.2], [3.0, 4.9]])
+        assert x.to_int_vector() == [1, 2, 3, 4]
+        assert x.to_int_matrix() == [[1, 2], [3, 4]]
+        assert x.to_float_vector() == pytest.approx([1.7, 2.2, 3.0, 4.9],
+                                                    rel=1e-6)
+        assert x.toDoubleMatrix()[1] == pytest.approx([3.0, 4.9])
+
+    def test_predicates(self):
+        assert arr([[1.0, 2.0]]).is_row_vector
+        assert arr([[1.0], [2.0]]).is_column_vector
+        assert arr([[1.0, 2.0], [3.0, 4.0]]).is_square
+        assert not arr([[1.0, 2.0]]).is_square
+
+    def test_repmat_broadcast(self):
+        x = arr([[1.0, 2.0]])
+        assert x.repmat(2, 3).shape == (2, 6)
+        assert x.broadcast(4, 2).shape == (4, 2)

@@ -442,6 +442,149 @@ def _scatter_ref(ref, idx, upd, op):
     return out
 
 
+# --- round-5 breadth wave (ops/breadth.py) ---------------------------------
+SEG_D = R.randn(6, 3)
+SEG_I = np.array([0, 2, 0, 1, 2, 2])
+ND_REF = R.randn(4, 3)
+ND_IX = np.array([[0], [2]])
+ND_UP = R.randn(2, 3)
+U32 = np.array([1, 2, 0x80000001, 7], np.uint32)
+
+
+def _useg(op_):
+    def ref(d, i):
+        out = np.zeros((3, d.shape[1]))
+        cnt = np.zeros((3, d.shape[1]))
+        init = {"max": -np.inf, "min": np.inf, "prod": 1.0}.get(op_, 0.0)
+        out[:] = init
+        for r, seg in enumerate(i):
+            if op_ in ("sum", "mean", "sqrt_n"):
+                out[seg] += d[r]
+            elif op_ == "prod":
+                out[seg] *= d[r]
+            elif op_ == "max":
+                out[seg] = np.maximum(out[seg], d[r])
+            elif op_ == "min":
+                out[seg] = np.minimum(out[seg], d[r])
+            cnt[seg] += 1
+        if op_ == "mean":
+            out = out / np.maximum(cnt, 1)
+        if op_ == "sqrt_n":
+            out = out / np.sqrt(np.maximum(cnt, 1))
+        if op_ in ("max", "min"):
+            out[cnt == 0] = init    # jax fills empty segments w/ identity
+        return out
+    return ref
+
+
+def _wce_ref(t, lo, w):
+    lw = 1 + (w - 1) * t
+    return np.mean((1 - t) * lo
+                   + lw * (np.log1p(np.exp(-np.abs(lo)))
+                           + np.maximum(-lo, 0)))
+
+
+def _fq_ref(x, mn=-6.0, mx=6.0, bits=8):
+    qmax = 2 ** bits - 1
+    scale = (mx - mn) / qmax
+    zp = -mn / scale
+    return (np.round(np.clip(x / scale + zp, 0, qmax)) - zp) * scale
+
+
+LEDGER.update({
+    "logaddexp": spec([A, B_], np.logaddexp, grad=True),
+    "xlogy": spec([U, P], sps.xlogy, grad=True),
+    "sinc": spec([A], np.sinc, grad=True, rtol=1e-4),
+    "entr": spec([U], sps.entr),
+    "erfinv": spec([U], sps.erfinv, grad=True, rtol=1e-4),
+    "heaviside": spec([A, U], np.heaviside),
+    "nextafter": spec([A, B_], np.nextafter),
+    "ldexp": spec([A, I1], lambda a, i: np.ldexp(a, i.astype(int))),
+    "betainc": spec([U * 3 + 0.5, U.T.reshape(3, 4) * 2 + 0.5, U],
+                    sps.betainc, rtol=1e-4),
+    "polygamma": spec([np.abs(I2).astype(np.float64), P + 0.5],
+                      lambda n, x: sps.polygamma(n.astype(int), x),
+                      rtol=1e-3),
+    "zeta": spec([P + 1.5, P + 0.5], sps.zeta, rtol=1e-4),
+    "crelu": spec([A], lambda x: np.concatenate(
+        [np.maximum(x, 0), np.maximum(-x, 0)], -1), grad=True),
+    "realdiv": spec([A, P], lambda a, b: a / b, grad=True),
+    "reduce_dot": spec([A, B_], lambda a, b: np.sum(a * b), grad=True),
+    "percentile": spec([A], lambda x: np.percentile(x, 30.0),
+                       attrs={"q": 30.0}),
+    "roll": spec([A], lambda x: np.roll(x, 2), attrs={"shift": 2}),
+    "triu_op": spec([A], np.triu, grad=True),
+    "tril_op": spec([A], np.tril, grad=True),
+    "nth_element": spec([A], lambda x: np.sort(x, -1)[..., 1],
+                        attrs={"n": 1}),
+    "sequence_mask": spec([np.array([1, 3, 0])],
+                          lambda l: (np.arange(4)[None, :]
+                                     < l[:, None]),
+                          attrs={"maxlen": 4}),
+    "invert_permutation": spec([np.array([2, 0, 1, 3])], np.argsort),
+    "ismax": spec([A], lambda x: (x == x.max()).astype(x.dtype)),
+    "merge_add": spec([A, B_], lambda a, b: a + b, grad=True),
+    "merge_avg": spec([A, B_], lambda a, b: (a + b) / 2, grad=True),
+    "merge_max": spec([A, B_], np.maximum, grad=True),
+    "merge_max_idx": spec([A, B_],
+                          lambda a, b: np.argmax(np.stack([a, b]), 0)),
+    "mirror_pad": spec([A], lambda x: np.pad(x, [(1, 1), (2, 2)],
+                                             mode="reflect"),
+                       attrs={"paddings": np.array([[1, 1], [2, 2]])}),
+    "histogram": spec([A], lambda x: np.histogram(x, bins=5)[0],
+                      attrs={"num_bins": 5}),
+    "histogram_fixed_width": spec(
+        [U], lambda x: np.histogram(x, bins=4, range=(0.0, 1.0))[0],
+        attrs={"value_range": (0.0, 1.0), "num_bins": 4}),
+    "unsorted_segment_sum": spec([SEG_D, SEG_I], _useg("sum"),
+                                 attrs={"num_segments": 3}),
+    "unsorted_segment_mean": spec([SEG_D, SEG_I], _useg("mean"),
+                                  attrs={"num_segments": 3}),
+    "unsorted_segment_min": spec([SEG_D, SEG_I], _useg("min"),
+                                 attrs={"num_segments": 3}),
+    "unsorted_segment_max": spec([SEG_D, SEG_I], _useg("max"),
+                                 attrs={"num_segments": 3}),
+    "unsorted_segment_prod": spec([SEG_D, SEG_I], _useg("prod"),
+                                  attrs={"num_segments": 3}),
+    "unsorted_segment_sqrt_n": spec([SEG_D, SEG_I], _useg("sqrt_n"),
+                                    attrs={"num_segments": 3}),
+    "scatter_nd_update": spec(
+        [ND_REF, ND_IX, ND_UP],
+        lambda r, i, u: _scatter_ref(r, i[:, 0], u, lambda a, b: b)),
+    "scatter_nd_add": spec(
+        [ND_REF, ND_IX, ND_UP],
+        lambda r, i, u: _scatter_ref(r, i[:, 0], u, lambda a, b: a + b)),
+    "scatter_nd_sub": spec(
+        [ND_REF, ND_IX, ND_UP],
+        lambda r, i, u: _scatter_ref(r, i[:, 0], u, lambda a, b: a - b)),
+    "clip_by_averaged_norm": spec(
+        [A], lambda x: x * min(1.0, 0.5 / np.sqrt(np.mean(x * x))),
+        attrs={"clip_norm": 0.5}),
+    "fake_quant_with_min_max_vars": spec([A], _fq_ref),
+    "reshape_as": spec([A, B_.reshape(4, 3)],
+                       lambda x, t: x.reshape(4, 3), grad=True),
+    "tile_to_shape": spec([A[0:1]], lambda x: np.broadcast_to(x, (3, 4)),
+                          attrs={"shape": (3, 4)}),
+    "relu_layer": spec([A, B_.T, np.zeros(3)],
+                       lambda x, w, b: np.maximum(x @ w + b, 0),
+                       grad=True),
+    "upsampling3d": spec(
+        [R.rand(1, 2, 2, 2, 1)],
+        lambda x: x.repeat(2, 1).repeat(2, 2).repeat(2, 3)),
+    "cyclic_shift": spec(
+        [U32, np.array([1, 4, 1, 31], np.uint32)],
+        lambda x, s: ((x << s) | (x >> (32 - s))).astype(np.uint32)),
+    "cyclic_rshift": spec(
+        [U32, np.array([1, 4, 1, 31], np.uint32)],
+        lambda x, s: ((x >> s) | (x << (32 - s))).astype(np.uint32)),
+    "log_poisson_loss": spec(
+        [A, np.abs(B_)],
+        lambda lo, t: np.mean(np.exp(lo) - t * lo), rtol=1e-5),
+    "weighted_cross_entropy_with_logits": spec(
+        [U, A, P], _wce_ref, rtol=1e-5),
+})
+
+
 # ops exercised by dedicated tests elsewhere (file noted); the gate only
 # requires that every op is covered SOMEWHERE, mirrored after
 # OpValidation.collectCoverageInformation
@@ -733,6 +876,113 @@ BM2 = R.rand(2, 4, 5).astype(np.float32)
 def _stat(sample, want_mean, tol):
     return abs(float(jnp.mean(sample)) - want_mean) <= tol * max(
         want_mean, 1.0)
+
+
+def _np32(*shape):
+    return R.randn(*shape).astype(np.float32)
+
+
+_CLONE_IN = _np32(3, 2)
+_RGB_IN = np.abs(_np32(1, 2, 2, 3)) + 0.1
+_YIQ_M = np.array([[0.299, 0.587, 0.114],
+                   [0.5959, -0.2746, -0.3213],
+                   [0.2115, -0.5227, 0.3112]], np.float32)
+_YIQ_IN = np.einsum("...c,yc->...y", _RGB_IN, _YIQ_M)
+_SPD_C = _np32(3, 3)
+_SPD = (_SPD_C @ _SPD_C.T + 3 * np.eye(3)).astype(np.float32)
+_MPX = _np32(1, 4, 4, 2)
+_BC_IN = _np32(2, 2)
+
+
+SMOKE.update({
+    # list family: write/read/scatter/gather round-trips on the stacked
+    # representation (reference generic/list semantics)
+    "create_list": lambda f: f(A32(2, 3), size=4).shape == (4, 2, 3),
+    "write_list": lambda f: np.allclose(
+        f(np.zeros((3, 2)), np.ones(2), index=1)[1], 1.0),
+    "read_list": lambda f: np.allclose(
+        f(np.arange(6).reshape(3, 2), index=2), [4, 5]),
+    "gather_list": lambda f: f(np.arange(6).reshape(3, 2),
+                               np.array([2, 0])).shape == (2, 2),
+    "scatter_list": lambda f: np.allclose(
+        f(np.zeros((3, 2)), np.array([1]), np.ones((1, 2)))[1], 1.0),
+    "stack_list": lambda f: f(np.arange(6).reshape(3, 2)).shape == (3, 2),
+    "unstack_list": lambda f: f(np.arange(6).reshape(3, 2)).shape == (3, 2),
+    "split_list": lambda f: [x.shape[0] for x in
+                             f(np.arange(10).reshape(5, 2),
+                               sizes=(2, 3))] == [2, 3],
+    "size_list": lambda f: int(f(np.zeros((7, 2)))) == 7,
+    "pick_list": lambda f: f(np.arange(6).reshape(3, 2),
+                             np.array([0, 2])).shape == (4,),
+    "clone_list": lambda f: np.allclose(f(_CLONE_IN), _CLONE_IN),
+    # dtype casts
+    "to_double": lambda f: f(A32(2, 2)).dtype == np.float64,
+    "to_float32": lambda f: f(A.astype(np.float64)).dtype == np.float32,
+    "to_float16": lambda f: f(A32(2, 2)).dtype == np.float16,
+    "to_int32": lambda f: f(A32(2, 2)).dtype == np.int32,
+    "to_int64": lambda f: f(A32(2, 2)).dtype == np.int64,
+    "to_uint32": lambda f: f(np.abs(A32(2, 2))).dtype == np.uint32,
+    "to_uint64": lambda f: f(np.abs(A32(2, 2))).dtype == np.uint64,
+    "bitcast": lambda f: np.array_equal(
+        np.asarray(f(f(_BC_IN, dtype="int32"), dtype="float32")), _BC_IN),
+    # math/structural
+    "tri_op": lambda f: np.array_equal(np.asarray(f(n=3, m=3, k=0)),
+                                       np.tri(3, 3)),
+    "sqrtm": lambda f: np.allclose(
+        (lambda s: s @ s)(np.asarray(f(_SPD))), _SPD, atol=1e-3),
+    "is_non_decreasing": lambda f: bool(f(np.array([1.0, 2.0, 2.0]))) and
+    not bool(f(np.array([2.0, 1.0]))),
+    "is_strictly_increasing": lambda f: bool(f(np.array([1.0, 2.0, 3.0])))
+    and not bool(f(np.array([1.0, 1.0]))),
+    "listdiff": lambda f: np.array_equal(
+        np.asarray(f(np.array([1, 2, 3, 4]), np.array([2, 4]))[0]),
+        [1, 3]),
+    "identity_n": lambda f: np.allclose(
+        np.asarray(f(np.ones(2), np.zeros(2))[0]), 1.0),
+    "fake_quant_with_min_max_vars_per_channel": lambda f: np.isfinite(
+        np.asarray(f(A32(2, 3), np.full(3, -6.0, np.float32),
+                     np.full(3, 6.0, np.float32)))).all(),
+    # image tail
+    "resize_area": lambda f: np.allclose(
+        np.asarray(f(np.arange(16, dtype=np.float32)
+                     .reshape(1, 4, 4, 1), height=2, width=2))
+        .reshape(2, 2),
+        np.arange(16, dtype=np.float32).reshape(4, 4)
+        .reshape(2, 2, 2, 2).mean(axis=(1, 3))),
+    "rgb_to_yiq": lambda f: f(A32(2, 4, 4, 3)).shape == (2, 4, 4, 3),
+    "yiq_to_rgb": lambda f: np.allclose(np.asarray(f(_YIQ_IN)), _RGB_IN,
+                                        atol=1e-4),
+    "random_crop": lambda f: f(A32(1, 6, 6, 3),
+                               size=(1, 4, 4, 3)).shape == (1, 4, 4, 3),
+    "draw_bounding_boxes": lambda f: f(
+        np.zeros((1, 8, 8, 3), np.float32),
+        np.array([[[0.1, 0.1, 0.8, 0.8]]], np.float32)).sum() > 0,
+    "dilation2d": lambda f: np.allclose(     # zero filter == max pool
+        np.asarray(f(np.arange(16, dtype=np.float32)
+                     .reshape(1, 4, 4, 1),
+                     np.zeros((2, 2, 1), np.float32),
+                     strides=(2, 2), padding="VALID")).reshape(2, 2),
+        [[5, 7], [13, 15]]),
+    "col2im": lambda f: float(np.asarray(f(
+        np.ones((1, 1, 2, 2, 2, 2), np.float32), height=3, width=3,
+        kernel=(2, 2), stride=(1, 1))).sum()) == 16.0,
+    "maxpool_with_argmax": lambda f: (lambda res: np.allclose(
+        np.asarray(res[0]).ravel(),
+        _MPX.ravel()[np.asarray(res[1]).ravel()]))(
+        f(_MPX, kernel=(2, 2))),
+    "batch_to_space_nd": lambda f: f(
+        np.arange(16, dtype=np.float32).reshape(4, 2, 2, 1),
+        block_shape=np.array([2, 2]),
+        crops=np.array([[0, 0], [0, 0]])).shape == (1, 4, 4, 1),
+    "space_to_batch_nd": lambda f: f(
+        np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1),
+        block_shape=np.array([2, 2]),
+        paddings=np.array([[0, 0], [0, 0]])).shape == (4, 2, 2, 1),
+    "multinomial": lambda f: (lambda s: s.shape == (2, 64)
+                              and int(np.asarray(s).max()) <= 2)(
+        f(np.log(np.full((2, 3), 1 / 3, np.float32)), num_samples=64,
+          seed=0)),
+})
 
 
 @pytest.mark.parametrize("name", sorted(SMOKE))
