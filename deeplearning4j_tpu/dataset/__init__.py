@@ -10,8 +10,9 @@ from deeplearning4j_tpu.dataset.normalizers import (
 from deeplearning4j_tpu.dataset.mnist import (
     MnistDataSetIterator, load_mnist, synthetic_mnist)
 from deeplearning4j_tpu.dataset.vision import (
-    Cifar10DataSetIterator, EmnistDataSetIterator, load_cifar10,
-    load_emnist, synthetic_cifar10)
+    Cifar10DataSetIterator, EmnistDataSetIterator, SvhnDataSetIterator,
+    TinyImageNetDataSetIterator, load_cifar10, load_emnist, load_svhn,
+    load_tiny_imagenet, synthetic_cifar10)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
@@ -22,4 +23,6 @@ __all__ = [
     "ImagePreProcessingScaler", "MnistDataSetIterator", "load_mnist",
     "synthetic_mnist", "Cifar10DataSetIterator", "EmnistDataSetIterator",
     "load_cifar10", "load_emnist", "synthetic_cifar10",
+    "SvhnDataSetIterator", "TinyImageNetDataSetIterator", "load_svhn",
+    "load_tiny_imagenet",
 ]

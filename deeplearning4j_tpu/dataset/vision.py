@@ -125,3 +125,130 @@ class EmnistDataSetIterator(ArrayDataSetIterator):
                          seed=seed)
         self.raw_labels = y
         self.num_classes = n_classes
+
+
+# ---------------------------------------------------------------------------
+# SVHN / Tiny ImageNet (reference: datasets/fetchers/SvhnDataFetcher +
+# TinyImageNetDataSetIterator, deeplearning4j-datasets)
+
+def synthetic_rgb(n: int, size: int, n_classes: int, seed: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Learnable synthetic RGB: class-dependent color patches."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_classes, n)
+    X = rng.normal(0.35, 0.1, size=(n, 3, size, size)).astype(np.float32)
+    q = max(size // 4, 1)
+    for c in range(n_classes):
+        mask = labels == c
+        ch = c % 3
+        r, col = divmod((c // 3) % 16, 4)
+        X[mask, ch, q * (r % 4):q * (r % 4) + q,
+          q * (col % 4):q * (col % 4) + q] += 0.5
+    return np.clip(X, 0, 1), labels.astype(np.int64)
+
+
+def load_svhn(train: bool = True, data_dir: Optional[str] = None,
+              n_synthetic: int = 4096):
+    """Street View House Numbers, cropped-digit format (reference:
+    SvhnDataFetcher — {train,test}_32x32.mat). Returns (NCHW float32 in
+    [0,1], int labels 0-9); label '10' in the source files means digit 0."""
+    data_dir = data_dir or os.environ.get("SVHN_DIR", "/root/data/svhn")
+    name = ("train" if train else "test") + "_32x32.mat"
+    path = os.path.join(data_dir, name)
+    if os.path.exists(path):
+        try:
+            from scipy.io import loadmat
+        except ImportError as e:
+            # never silently substitute synthetic data for present files
+            raise RuntimeError(
+                f"SVHN file {path} exists but scipy is unavailable to "
+                f"decode it") from e
+        d = loadmat(path)
+        X = (d["X"].transpose(3, 2, 0, 1).astype(np.float32) / 255.0)
+        y = d["y"].reshape(-1).astype(np.int64) % 10
+        return X, y
+    return synthetic_rgb(n_synthetic if train else n_synthetic // 4,
+                         32, 10, seed=4 if train else 5)
+
+
+def load_tiny_imagenet(train: bool = True, data_dir: Optional[str] = None,
+                       n_synthetic: int = 2048, n_classes: int = 200):
+    """Tiny ImageNet-200, 64x64 (reference: TinyImageNetDataSetIterator /
+    TinyImageNetFetcher). Directory layout: tiny-imagenet-200/train/<wnid>/
+    images/*.JPEG and val/ with val_annotations.txt."""
+    data_dir = data_dir or os.environ.get("TINY_IMAGENET_DIR",
+                                          "/root/data/tiny-imagenet")
+    root = os.path.join(data_dir, "tiny-imagenet-200")
+    if not os.path.isdir(root):
+        root = data_dir
+    wnids_file = os.path.join(root, "wnids.txt")
+    if os.path.exists(wnids_file):
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise RuntimeError(
+                f"Tiny ImageNet tree at {root} exists but PIL is "
+                f"unavailable to decode it") from e
+        with open(wnids_file) as fh:
+            wnids = [w.strip() for w in fh if w.strip()]
+        table = {w: i for i, w in enumerate(wnids)}
+        # accumulate uint8 (4x smaller than float32); scale once at the end
+        xs, ys = [], []
+        if train:
+            for w in wnids:
+                d = os.path.join(root, "train", w, "images")
+                if not os.path.isdir(d):
+                    continue
+                for f in sorted(os.listdir(d)):
+                    img = Image.open(os.path.join(d, f)).convert("RGB")
+                    xs.append(np.asarray(img, np.uint8))
+                    ys.append(table[w])
+        else:
+            ann = os.path.join(root, "val", "val_annotations.txt")
+            if os.path.exists(ann):
+                with open(ann) as fh:
+                    for line in fh:
+                        parts = line.split("\t")
+                        if len(parts) < 2:
+                            continue
+                        p = os.path.join(root, "val", "images", parts[0])
+                        img = Image.open(p).convert("RGB")
+                        xs.append(np.asarray(img, np.uint8))
+                        ys.append(table[parts[1]])
+        if xs:
+            X = (np.stack(xs).transpose(0, 3, 1, 2).astype(np.float32)
+                 / 255.0)
+            return X, np.asarray(ys, np.int64)
+    return synthetic_rgb(n_synthetic if train else n_synthetic // 4,
+                         64, n_classes, seed=6 if train else 7)
+
+
+class SvhnDataSetIterator(ArrayDataSetIterator):
+    """Reference: SvhnDataFetcher-backed iterator — (B,3,32,32) + one-hot."""
+
+    def __init__(self, batch_size: int = 128, train: bool = True,
+                 shuffle: bool = True, seed: int = 6,
+                 data_dir: Optional[str] = None, n_synthetic: int = 4096):
+        X, y = load_svhn(train=train, data_dir=data_dir,
+                         n_synthetic=n_synthetic)
+        Y = np.eye(10, dtype=np.float32)[y]
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+        self.raw_labels = y
+
+
+class TinyImageNetDataSetIterator(ArrayDataSetIterator):
+    """Reference: TinyImageNetDataSetIterator — (B,3,64,64) + one-hot 200."""
+
+    def __init__(self, batch_size: int = 128, train: bool = True,
+                 shuffle: bool = True, seed: int = 6,
+                 data_dir: Optional[str] = None, n_synthetic: int = 2048,
+                 n_classes: int = 200):
+        X, y = load_tiny_imagenet(train=train, data_dir=data_dir,
+                                  n_synthetic=n_synthetic,
+                                  n_classes=n_classes)
+        Y = np.eye(n_classes, dtype=np.float32)[y]
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+        self.raw_labels = y
+        self.num_classes = n_classes

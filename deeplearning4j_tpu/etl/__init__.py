@@ -16,6 +16,12 @@ from deeplearning4j_tpu.etl.transform import (
     ColumnAnalysis, DataAnalysis, TransformProcess, analyze)
 from deeplearning4j_tpu.etl.iterator import (
     ImageRecordReaderDataSetIterator, RecordReaderDataSetIterator)
+from deeplearning4j_tpu.etl.relational import (
+    FULL_OUTER, INNER, LEFT_OUTER, RIGHT_OUTER, Join, Reducer)
+from deeplearning4j_tpu.etl.sequence import (
+    convert_from_sequence, convert_to_sequence, offset_column,
+    reduce_sequence_by_window, sequences_to_arrays, split_sequence_on_gap,
+    trim_sequence)
 
 __all__ = [
     "Schema", "ColumnMeta", "columnar", "to_rows",
@@ -24,4 +30,8 @@ __all__ = [
     "CollectionRecordReader", "ImageRecordReader",
     "TransformProcess", "analyze", "DataAnalysis", "ColumnAnalysis",
     "RecordReaderDataSetIterator", "ImageRecordReaderDataSetIterator",
+    "Join", "Reducer", "INNER", "LEFT_OUTER", "RIGHT_OUTER", "FULL_OUTER",
+    "convert_to_sequence", "convert_from_sequence", "offset_column",
+    "trim_sequence", "split_sequence_on_gap", "reduce_sequence_by_window",
+    "sequences_to_arrays",
 ]

@@ -1,0 +1,173 @@
+"""Sequence ETL: grouping rows into time series and transforming them.
+
+Reference parity: org.datavec.api.transform.sequence.* —
+ConvertToSequence (group by key, order by time/comparator),
+ConvertFromSequence, offset (SequenceOffsetTransform), moving window
+(ReduceSequenceByWindowTransform / TimeWindowFunction), trim
+(SequenceTrimTransform), split (SequenceSplitTimeSeparation).
+
+TPU-native redesign: a sequence set is ``(schema, [columnar dict per
+sequence])`` and the terminal export is ``sequences_to_arrays`` — a
+padded dense [N, T, F] batch + [N, T] mask, the layout RNN/attention
+training on TPU actually consumes (static shapes for XLA; the reference
+keeps ragged List<List<Writable>> all the way down and pads in the
+RecordReaderMultiDataSetIterator instead).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.etl.relational import _key_ids
+from deeplearning4j_tpu.etl.schema import FLOAT, INTEGER, Schema
+
+SequenceData = List[Dict[str, np.ndarray]]
+
+
+def convert_to_sequence(schema: Schema, cols: Dict[str, np.ndarray],
+                        key_column: str, time_column: Optional[str] = None
+                        ) -> Tuple[List, SequenceData]:
+    """Group rows by key, each group sorted by time (reference:
+    sequence/ConvertToSequence.java). Returns (keys, sequences); groups
+    appear in first-occurrence order."""
+    schema.column(key_column)
+    keys = _key_ids(cols, [key_column])
+    seen: Dict[tuple, int] = {}
+    groups: List[List[int]] = []
+    order: List = []
+    for i, k in enumerate(keys):
+        if k not in seen:
+            seen[k] = len(groups)
+            groups.append([])
+            order.append(k[0])
+        groups[seen[k]].append(i)
+    out: SequenceData = []
+    for rows in groups:
+        idx = np.asarray(rows, np.int64)
+        if time_column is not None:
+            t = cols[time_column][idx]
+            idx = idx[np.argsort(t, kind="stable")]
+        out.append({name: cols[name][idx] for name in schema.names()})
+    return order, out
+
+
+def convert_from_sequence(sequences: SequenceData) -> Dict[str, np.ndarray]:
+    """Flatten sequences back to one columnar table (reference:
+    sequence/ConvertFromSequence)."""
+    if not sequences:
+        return {}
+    return {k: np.concatenate([s[k] for s in sequences])
+            for k in sequences[0]}
+
+
+def offset_column(sequences: SequenceData, column: str, offset: int,
+                  new_name: Optional[str] = None,
+                  trim: bool = True) -> SequenceData:
+    """Shift ``column`` by ``offset`` steps within each sequence
+    (reference: sequence/transform/SequenceOffsetTransform.java). Positive
+    offset makes row t see the value from t-offset (lag); negative is a
+    lead. With trim=True, rows without a shifted value are dropped."""
+    if offset == 0:
+        return sequences
+    name = new_name or f"{column}_offset({offset})"
+    out: SequenceData = []
+    for s in sequences:
+        n = len(s[column])
+        k = abs(offset)
+        if n <= k:
+            if trim:
+                continue
+            k = n
+        shifted = np.roll(s[column], offset)
+        if trim:
+            sl = slice(k, None) if offset > 0 else slice(None, n - k)
+            t = {c: v[sl] for c, v in s.items()}
+            t[name] = shifted[sl]
+        else:
+            t = dict(s)
+            fill = shifted.copy()
+            if offset > 0:
+                fill[:k] = s[column][0]
+            else:
+                fill[n - k:] = s[column][-1]
+            t[name] = fill
+        out.append(t)
+    return out
+
+
+def trim_sequence(sequences: SequenceData, num_steps: int,
+                  from_start: bool = True) -> SequenceData:
+    """(reference: sequence/trim/SequenceTrimTransform.java)"""
+    sl = slice(num_steps, None) if from_start else slice(None, -num_steps)
+    return [{k: v[sl] for k, v in s.items()} for s in sequences
+            if len(next(iter(s.values()))) > num_steps]
+
+
+def split_sequence_on_gap(sequences: SequenceData, time_column: str,
+                          max_gap: int) -> SequenceData:
+    """Split a sequence wherever consecutive time values differ by more
+    than max_gap (reference: sequence/split/SequenceSplitTimeSeparation)."""
+    out: SequenceData = []
+    for s in sequences:
+        t = s[time_column]
+        if len(t) == 0:
+            continue
+        cut = np.nonzero(np.diff(t.astype(np.float64)) > max_gap)[0] + 1
+        for part in np.split(np.arange(len(t)), cut):
+            out.append({k: v[part] for k, v in s.items()})
+    return out
+
+
+def reduce_sequence_by_window(sequences: SequenceData, column: str,
+                              window: int, op: str = "mean",
+                              stride: Optional[int] = None) -> SequenceData:
+    """Tumbling/sliding window reduction over one column (reference:
+    sequence/window + ReduceSequenceByWindowTransform). Other columns take
+    the value at each window's last step."""
+    stride = stride or window
+    fns: Dict[str, Callable] = {"mean": np.mean, "sum": np.sum,
+                                "min": np.min, "max": np.max,
+                                "stdev": lambda v: np.std(v, ddof=1)
+                                if len(v) > 1 else 0.0}
+    if op not in fns:
+        raise ValueError(f"unknown window op {op!r}")
+    out: SequenceData = []
+    for s in sequences:
+        n = len(s[column])
+        starts = list(range(0, max(n - window + 1, 1), stride))
+        ends = [min(st + window, n) for st in starts]
+        t = {k: v[[e - 1 for e in ends]] for k, v in s.items()}
+        t[f"{op}({column},w={window})"] = np.asarray(
+            [fns[op](s[column][st:e].astype(np.float64))
+             for st, e in zip(starts, ends)], np.float32)
+        out.append(t)
+    return out
+
+
+def sequences_to_arrays(sequences: SequenceData,
+                        feature_columns: Sequence[str],
+                        label_column: Optional[str] = None,
+                        max_len: Optional[int] = None
+                        ) -> Tuple[np.ndarray, np.ndarray,
+                                   Optional[np.ndarray]]:
+    """Terminal export: padded [N, T, F] features + [N, T] mask (+ [N, T]
+    labels). This is where ragged sequences become the static-shaped
+    batch XLA requires; the reference does the equivalent padding in
+    RecordReaderMultiDataSetIterator with ALIGN_END/mask arrays."""
+    if not sequences:
+        raise ValueError("no sequences")
+    lens = [len(s[feature_columns[0]]) for s in sequences]
+    t_max = max_len or max(lens)
+    n, f = len(sequences), len(feature_columns)
+    feats = np.zeros((n, t_max, f), np.float32)
+    mask = np.zeros((n, t_max), np.float32)
+    labels = np.zeros((n, t_max), np.float32) if label_column else None
+    for i, s in enumerate(sequences):
+        t = min(lens[i], t_max)
+        for j, c in enumerate(feature_columns):
+            feats[i, :t, j] = s[c][:t].astype(np.float32)
+        mask[i, :t] = 1.0
+        if label_column:
+            labels[i, :t] = s[label_column][:t].astype(np.float32)
+    return feats, mask, labels

@@ -1,7 +1,10 @@
 """Evaluation metrics (reference: org.nd4j.evaluation)."""
+from deeplearning4j_tpu.evaluation.calibration import (
+    EvaluationCalibration, Histogram, ReliabilityDiagram)
 from deeplearning4j_tpu.evaluation.classification import (
     Evaluation, EvaluationBinary, ROC, ROCBinary, ROCMultiClass)
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 
-__all__ = ["Evaluation", "EvaluationBinary", "ROC", "ROCBinary",
+__all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration",
+           "Histogram", "ReliabilityDiagram", "ROC", "ROCBinary",
            "ROCMultiClass", "RegressionEvaluation"]

@@ -380,7 +380,8 @@ def _build_graph(conf: ComputationGraphConfiguration, training: bool):
             itype = types_[node.inputs[0]]
             x, itype = _adapt_input(sd, x, itype, node.op, node.name,
                                     name_stem=f"{node.name}_preproc")
-            if hasattr(node.op, "loss_function"):
+            if hasattr(node.op, "loss_function") or \
+                    getattr(node.op, "consumes_labels", False):
                 # labels placeholder sized from this head's output type
                 otype = node.op.output_type(itype)
                 ln = f"labels_{node.name}"
@@ -470,6 +471,19 @@ class ComputationGraph:
         out_names = [name_map[o] for o in self.conf.outputs]
         res = sd.output(ph, out_names)
         return [res[n] for n in out_names]
+
+    def feed_forward(self, *inputs, training: bool = False
+                     ) -> Dict[str, object]:
+        """Forward pass returning the activation of EVERY named vertex
+        (reference: ComputationGraph.feedForward() -> Map<String,INDArray>).
+        cnn-typed intermediates stay in the internal layout."""
+        sd = self._sd_train if training else self._sd_infer
+        name_map = self._map_train if training else self._map_infer
+        if not training:
+            self._sync_infer()
+        ph = dict(zip(self.conf.inputs, inputs))
+        res = sd.output(ph, list(set(name_map.values())))
+        return {n: res[v] for n, v in name_map.items()}
 
     def score(self) -> float:
         return self._score

@@ -126,6 +126,9 @@ class Yolo2OutputLayer(BaseLayer):
     anchors: Tuple[float, ...] = (1.0, 1.0)    # flat (w,h) pairs
     lambda_coord: float = 5.0
     lambda_noobj: float = 0.5
+    # graph builds create a labels placeholder for this head even though
+    # it exposes no loss_function attribute (labels are the target grid)
+    consumes_labels = True
 
     def output_type(self, itype):
         return itype
@@ -680,6 +683,7 @@ class CenterLossOutputLayer(BaseLayer):
     alpha: float = 0.05         # center update rate
     lambda_: float = 0.5        # center-loss weight
     weight_init: str = "XAVIER"
+    consumes_labels = True      # graph builds need a labels placeholder
 
     def output_type(self, itype):
         return InputType.feed_forward(self.n_out)

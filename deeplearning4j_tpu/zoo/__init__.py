@@ -10,11 +10,14 @@ from deeplearning4j_tpu.zoo.models import (
     VGG16)
 from deeplearning4j_tpu.zoo.models_ext import (
     Darknet19, SqueezeNet, TinyYOLO, UNet, Xception)
+from deeplearning4j_tpu.zoo.models_wave3 import (
+    FaceNet, InceptionResNetV1, NASNet, VGG19, YOLO2)
 from deeplearning4j_tpu.zoo.bert import BERT_BASE, BERT_TINY, BertConfig, bert_base
 from deeplearning4j_tpu.zoo.gpt import GPT_MEDIUM, GPT_TINY, GPTConfig, build_gpt
 
 __all__ = ["LeNet", "SimpleCNN", "AlexNet", "VGG16", "ResNet50",
            "TextGenLSTM", "TransformerEncoder", "SqueezeNet", "UNet",
-           "Xception", "Darknet19", "TinyYOLO", "BertConfig", "BERT_BASE",
+           "Xception", "Darknet19", "TinyYOLO", "VGG19", "InceptionResNetV1",
+           "FaceNet", "NASNet", "YOLO2", "BertConfig", "BERT_BASE",
            "BERT_TINY", "bert_base", "GPTConfig", "GPT_MEDIUM", "GPT_TINY",
            "build_gpt"]

@@ -1,0 +1,143 @@
+"""EvaluationCalibration tests (reference: nd4j EvaluationCalibrationTest +
+EvaluationCalibration.java:53-467)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+
+def test_reliability_diagram_hand_computed():
+    ec = EvaluationCalibration(reliability_bins=5, exclude_empty_bins=False)
+    # 4 examples, 2 classes. Class-1 probs: 0.1, 0.3, 0.7, 0.9;
+    # class-1 labels: 0, 0, 1, 1.
+    p1 = np.array([0.1, 0.3, 0.7, 0.9])
+    preds = np.stack([1 - p1, p1], axis=1)
+    labels = np.eye(2)[[0, 0, 1, 1]]
+    ec.eval(labels, preds)
+    rd = ec.reliability_diagram(1)
+    # bins of width 0.2 -> probs land in bins 0,1,3,4; each one example.
+    assert rd.bin_counts.tolist() == [1, 1, 0, 1, 1]
+    assert rd.mean_predicted_value[0] == pytest.approx(0.1)
+    assert rd.frac_positives[0] == 0.0
+    assert rd.mean_predicted_value[4] == pytest.approx(0.9)
+    assert rd.frac_positives[4] == 1.0
+
+
+def test_reliability_diagram_excludes_empty_bins():
+    ec = EvaluationCalibration(reliability_bins=5)
+    p1 = np.array([0.1, 0.9])
+    ec.eval(np.eye(2)[[0, 1]], np.stack([1 - p1, p1], axis=1))
+    rd = ec.reliability_diagram(1)
+    assert rd.bin_counts.tolist() == [1, 1]
+
+
+def test_label_and_prediction_counts():
+    ec = EvaluationCalibration()
+    labels = np.eye(3)[[0, 0, 1, 2, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 2, 2, 0]] * 0.8 + 0.1 / 3
+    ec.eval(labels, preds)
+    assert ec.label_counts_each_class().tolist() == [2, 1, 3]
+    assert ec.prediction_counts_each_class().tolist() == [2, 2, 2]
+
+
+def test_class_index_labels_accepted():
+    ec = EvaluationCalibration()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8]])
+    ec.eval(np.array([0, 1]), preds)
+    assert ec.label_counts_each_class().tolist() == [1, 1]
+
+
+def test_residual_plot_counts():
+    ec = EvaluationCalibration(histogram_bins=10)
+    preds = np.array([[0.95, 0.05]])   # residuals 0.05, 0.05 -> bin 0
+    ec.eval(np.array([[1.0, 0.0]]), preds)
+    h = ec.residual_plot_all_classes()
+    assert h.bin_counts[0] == 2 and h.bin_counts.sum() == 2
+    h0 = ec.residual_plot(0)
+    assert h0.bin_counts.sum() == 2    # the single row is labeled class 0
+    assert ec.residual_plot(1).bin_counts.sum() == 0
+
+
+def test_probability_histogram():
+    ec = EvaluationCalibration(histogram_bins=4)
+    preds = np.array([[0.1, 0.9], [0.6, 0.4]])
+    ec.eval(np.array([1, 0]), preds)
+    h = ec.probability_histogram_all_classes()
+    # probs 0.1, 0.9, 0.6, 0.4 -> bins 0, 3, 2, 1
+    assert h.bin_counts.tolist() == [1, 1, 1, 1]
+
+
+def test_ece_perfectly_calibrated_is_zero():
+    rng = np.random.default_rng(0)
+    ec = EvaluationCalibration(reliability_bins=1)
+    # With a single bin, conf = mean(p), acc = frac positives; make them
+    # equal exactly: two examples at p=0.5, one positive.
+    preds = np.array([[0.5, 0.5], [0.5, 0.5]])
+    ec.eval(np.array([0, 1]), preds)
+    assert ec.expected_calibration_error(1) == pytest.approx(0.0)
+
+
+def test_ece_overconfident_detected():
+    ec = EvaluationCalibration(reliability_bins=10)
+    # Predict class 1 at 0.95 on 10 examples, only 5 actually positive.
+    preds = np.tile([[0.05, 0.95]], (10, 1))
+    labels = np.array([1, 1, 1, 1, 1, 0, 0, 0, 0, 0])
+    ec.eval(labels, preds)
+    assert ec.expected_calibration_error(1) == pytest.approx(0.45)
+
+
+def test_batched_eval_equals_single_eval():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(64, 4))
+    preds = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    labels = rng.integers(0, 4, 64)
+    a = EvaluationCalibration()
+    a.eval(labels, preds)
+    b = EvaluationCalibration()
+    b.eval(labels[:30], preds[:30])
+    b.eval(labels[30:], preds[30:])
+    for i in range(4):
+        ra, rb = a.reliability_diagram(i), b.reliability_diagram(i)
+        np.testing.assert_array_equal(ra.bin_counts, rb.bin_counts)
+        np.testing.assert_allclose(ra.mean_predicted_value,
+                                   rb.mean_predicted_value)
+    np.testing.assert_array_equal(a.residual_plot_all_classes().bin_counts,
+                                  b.residual_plot_all_classes().bin_counts)
+
+
+def test_merge_and_mask():
+    a = EvaluationCalibration()
+    b = EvaluationCalibration()
+    preds = np.array([[0.9, 0.1], [0.3, 0.7], [0.5, 0.5]])
+    labels = np.array([0, 1, 0])
+    a.eval(labels, preds, mask=np.array([1, 1, 0]))  # drops last row
+    b.eval(labels[2:], preds[2:])
+    a.merge(b)
+    assert a.label_counts_each_class().tolist() == [2, 1]
+    assert "EvaluationCalibration" in a.stats()
+
+
+def test_num_classes_mismatch_raises():
+    ec = EvaluationCalibration()
+    ec.eval(np.array([0]), np.array([[0.6, 0.4]]))
+    with pytest.raises(ValueError):
+        ec.eval(np.array([0]), np.array([[0.5, 0.3, 0.2]]))
+
+
+def test_sequence_index_labels_with_mask():
+    """Regression: [N,T] class-index labels + [N,T] mask (padded RNN
+    batches) must accumulate like the flattened equivalent."""
+    preds = np.array([[[0.9, 0.1], [0.2, 0.8], [0.5, 0.5]],
+                      [[0.3, 0.7], [0.6, 0.4], [0.1, 0.9]]])
+    labels = np.array([[0, 1, 0], [1, 0, 1]])
+    mask = np.array([[1, 1, 0], [1, 1, 0]])
+    a = EvaluationCalibration()
+    a.eval(labels, preds, mask=mask)
+    b = EvaluationCalibration()
+    b.eval(np.array([0, 1, 1, 0]),
+           preds.reshape(-1, 2)[[0, 1, 3, 4]])
+    assert a.label_counts_each_class().tolist() == \
+        b.label_counts_each_class().tolist()
+    np.testing.assert_array_equal(
+        a.residual_plot_all_classes().bin_counts,
+        b.residual_plot_all_classes().bin_counts)

@@ -1,0 +1,240 @@
+"""Join / Reducer / sequence ETL tests (reference: datavec TestJoin,
+TestReduce, TestSequenceTransforms)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.etl import (
+    FULL_OUTER, INNER, LEFT_OUTER, RIGHT_OUTER, Join, Reducer, Schema,
+    columnar, convert_from_sequence, convert_to_sequence, offset_column,
+    reduce_sequence_by_window, sequences_to_arrays, split_sequence_on_gap,
+    trim_sequence)
+
+
+def _customers():
+    s = (Schema.builder().add_column_integer("cid")
+         .add_column_string("name").build())
+    cols = columnar(s, [[0, "alice"], [1, "bob"], [2, "carol"]])
+    return s, cols
+
+
+def _orders():
+    s = (Schema.builder().add_column_integer("cid")
+         .add_column_float("amount").build())
+    cols = columnar(s, [[0, 10.0], [0, 20.0], [2, 5.0], [3, 7.0]])
+    return s, cols
+
+
+# ---- joins ----------------------------------------------------------------
+
+def test_inner_join():
+    ls, lc = _customers()
+    rs, rc = _orders()
+    j = Join(INNER, ["cid"], ls, rs)
+    out = j.execute(lc, rc)
+    assert out["cid"].tolist() == [0, 0, 2]
+    assert out["name"].tolist() == ["alice", "alice", "carol"]
+    assert out["amount"].tolist() == [10.0, 20.0, 5.0]
+    assert j.output_schema().names() == ["cid", "name", "amount"]
+
+
+def test_left_outer_join_fills_nan():
+    ls, lc = _customers()
+    rs, rc = _orders()
+    out = Join(LEFT_OUTER, ["cid"], ls, rs).execute(lc, rc)
+    # bob (cid 1) has no orders -> NaN amount
+    assert out["cid"].tolist() == [0, 0, 1, 2]
+    assert np.isnan(out["amount"][2])
+
+
+def test_right_outer_join_keeps_unmatched_right():
+    ls, lc = _customers()
+    rs, rc = _orders()
+    out = Join(RIGHT_OUTER, ["cid"], ls, rs).execute(lc, rc)
+    # order cid=3 has no customer -> empty name
+    assert out["cid"].tolist() == [0, 0, 2, 3]
+    assert out["name"].tolist() == ["alice", "alice", "carol", ""]
+
+
+def test_full_outer_join():
+    ls, lc = _customers()
+    rs, rc = _orders()
+    out = Join(FULL_OUTER, ["cid"], ls, rs).execute(lc, rc)
+    assert sorted(out["cid"].tolist()) == [0, 0, 1, 2, 3]
+
+
+def test_join_rejects_overlapping_value_columns():
+    s1 = (Schema.builder().add_column_integer("k")
+          .add_column_float("x").build())
+    with pytest.raises(ValueError):
+        Join(INNER, ["k"], s1, s1)
+
+
+# ---- reducer --------------------------------------------------------------
+
+def _sales():
+    s = (Schema.builder().add_column_string("region")
+         .add_column_float("amount").add_column_integer("units").build())
+    cols = columnar(s, [["w", 1.0, 2], ["e", 3.0, 4], ["w", 5.0, 6],
+                        ["e", 7.0, 8], ["w", 9.0, 10]])
+    return s, cols
+
+
+def test_reducer_sum_mean_count():
+    s, cols = _sales()
+    r = (Reducer.builder(s).key_columns("region")
+         .sum_columns("amount").mean_columns("units").build())
+    out = r.execute(cols)
+    assert out["region"].tolist() == ["w", "e"]   # first-appearance order
+    assert out["sum(amount)"].tolist() == [15.0, 10.0]
+    np.testing.assert_allclose(out["mean(units)"], [6.0, 6.0])
+    names = r.output_schema().names()
+    assert names == ["region", "sum(amount)", "mean(units)"]
+
+
+def test_reducer_min_max_range_stdev_first_last():
+    s, cols = _sales()
+    r = Reducer(s, ["region"], {"amount": "stdev", "units": "range"})
+    out = r.execute(cols)
+    np.testing.assert_allclose(out["stdev(amount)"],
+                               [np.std([1, 5, 9], ddof=1),
+                                np.std([3, 7], ddof=1)], rtol=1e-6)
+    assert out["range(units)"].tolist() == [8, 4]
+    r2 = Reducer(s, ["region"], {"amount": "last", "units": "count"})
+    out2 = r2.execute(cols)
+    assert out2["last(amount)"].tolist() == [9.0, 7.0]
+    assert out2["count(units)"].tolist() == [3, 2]
+
+
+def test_reducer_count_unique_and_validation():
+    s, cols = _sales()
+    out = Reducer(s, ["region"], {"amount": "count_unique"}).execute(cols)
+    assert out["count_unique(amount)"].tolist() == [3, 2]
+    with pytest.raises(ValueError):
+        Reducer(s, ["region"], {"region": "sum"})
+    with pytest.raises(ValueError):
+        Reducer(s, ["region"], {"amount": "bogus"})
+
+
+def test_reducer_multi_key():
+    s = (Schema.builder().add_column_string("a")
+         .add_column_integer("b").add_column_float("v").build())
+    cols = columnar(s, [["x", 0, 1.0], ["x", 1, 2.0], ["x", 0, 3.0]])
+    out = Reducer(s, ["a", "b"], {"v": "sum"}).execute(cols)
+    assert out["b"].tolist() == [0, 1]
+    assert out["sum(v)"].tolist() == [4.0, 2.0]
+
+
+# ---- sequences ------------------------------------------------------------
+
+def _series():
+    s = (Schema.builder().add_column_string("id").add_column_time("t")
+         .add_column_float("v").build())
+    rows = [["a", 3, 30.0], ["b", 1, 100.0], ["a", 1, 10.0],
+            ["a", 2, 20.0], ["b", 2, 200.0]]
+    return s, columnar(s, rows)
+
+
+def test_convert_to_sequence_groups_and_sorts():
+    s, cols = _series()
+    keys, seqs = convert_to_sequence(s, cols, "id", time_column="t")
+    assert keys == ["a", "b"]
+    assert seqs[0]["v"].tolist() == [10.0, 20.0, 30.0]
+    assert seqs[1]["t"].tolist() == [1, 2]
+    flat = convert_from_sequence(seqs)
+    assert flat["v"].tolist() == [10.0, 20.0, 30.0, 100.0, 200.0]
+
+
+def test_offset_column_lag_and_trim():
+    s, cols = _series()
+    _, seqs = convert_to_sequence(s, cols, "id", time_column="t")
+    lag = offset_column(seqs, "v", 1)
+    # sequence a: rows for t=2,3 remain; lagged value = previous v
+    assert lag[0]["v"].tolist() == [20.0, 30.0]
+    assert lag[0]["v_offset(1)"].tolist() == [10.0, 20.0]
+    # sequence b had 2 rows -> 1 remains
+    assert lag[1]["v_offset(1)"].tolist() == [100.0]
+
+
+def test_offset_lead_and_no_trim():
+    s, cols = _series()
+    _, seqs = convert_to_sequence(s, cols, "id", time_column="t")
+    lead = offset_column(seqs, "v", -1, new_name="next_v", trim=False)
+    assert lead[0]["next_v"].tolist() == [20.0, 30.0, 30.0]  # edge-filled
+
+
+def test_trim_and_split():
+    s, cols = _series()
+    _, seqs = convert_to_sequence(s, cols, "id", time_column="t")
+    trimmed = trim_sequence(seqs, 1)
+    assert trimmed[0]["t"].tolist() == [2, 3]
+    assert trimmed[1]["t"].tolist() == [2]
+    big_gap = [{"t": np.array([1, 2, 10, 11]),
+                "v": np.array([1.0, 2.0, 3.0, 4.0])}]
+    parts = split_sequence_on_gap(big_gap, "t", max_gap=5)
+    assert len(parts) == 2
+    assert parts[0]["v"].tolist() == [1.0, 2.0]
+    assert parts[1]["v"].tolist() == [3.0, 4.0]
+
+
+def test_window_reduce():
+    seqs = [{"t": np.arange(4), "v": np.array([1.0, 2.0, 3.0, 4.0])}]
+    out = reduce_sequence_by_window(seqs, "v", window=2, op="mean")
+    np.testing.assert_allclose(out[0]["mean(v,w=2)"], [1.5, 3.5])
+    assert out[0]["t"].tolist() == [1, 3]   # last step of each window
+
+
+def test_sequences_to_arrays_padding_and_mask():
+    s, cols = _series()
+    _, seqs = convert_to_sequence(s, cols, "id", time_column="t")
+    feats, mask, labels = sequences_to_arrays(seqs, ["v"], label_column="t")
+    assert feats.shape == (2, 3, 1) and mask.shape == (2, 3)
+    assert mask.tolist() == [[1, 1, 1], [1, 1, 0]]
+    assert feats[1, 2, 0] == 0.0                      # padded
+    assert labels[0].tolist() == [1.0, 2.0, 3.0]
+
+
+def test_sequence_pipeline_feeds_training_shapes():
+    """End-to-end: raw rows -> sequences -> lag feature -> padded arrays."""
+    rng = np.random.default_rng(0)
+    s = (Schema.builder().add_column_integer("sensor")
+         .add_column_time("t").add_column_float("x").build())
+    rows = []
+    for sid in range(3):
+        for t in range(5 + sid):
+            rows.append([sid, t, float(rng.normal())])
+    cols = columnar(s, rows)
+    _, seqs = convert_to_sequence(s, cols, "sensor", time_column="t")
+    seqs = offset_column(seqs, "x", 1, new_name="x_prev")
+    feats, mask, _ = sequences_to_arrays(seqs, ["x", "x_prev"])
+    assert feats.shape == (3, 6, 2)
+    assert mask.sum() == (4 + 5 + 6)
+
+
+def test_outer_join_key_width_and_schema_promotion():
+    """Regression: right-side key strings wider than left must not be
+    truncated; nullable int columns are FLOAT in schema AND data."""
+    ls = (Schema.builder().add_column_string("k")
+          .add_column_integer("lv").build())
+    rs = (Schema.builder().add_column_string("k")
+          .add_column_integer("rv").build())
+    lc = {"k": np.array(["x", "y"]), "lv": np.array([1, 2])}
+    rc = {"k": np.array(["x", "longkey"]), "rv": np.array([7, 8])}
+    j = Join(FULL_OUTER, ["k"], ls, rs)
+    out = j.execute(lc, rc)
+    assert "longkey" in out["k"].tolist()
+    schema = j.output_schema()
+    from deeplearning4j_tpu.etl import FLOAT
+    assert schema.column("lv").ctype == FLOAT
+    assert schema.column("rv").ctype == FLOAT
+    assert out["lv"].dtype.kind == "f" and out["rv"].dtype.kind == "f"
+    # inner joins keep ints in both schema and data
+    ji = Join(INNER, ["k"], ls, rs)
+    assert ji.output_schema().column("rv").ctype == "integer"
+    assert ji.execute(lc, rc)["rv"].dtype.kind == "i"
+
+
+def test_split_on_float_gap():
+    """Regression: float time gaps must not be truncated before diffing."""
+    seqs = [{"t": np.array([1.1, 2.9]), "v": np.array([1.0, 2.0])}]
+    parts = split_sequence_on_gap(seqs, "t", max_gap=1)
+    assert len(parts) == 2

@@ -1,0 +1,116 @@
+"""Zoo wave-3 + SVHN/TinyImageNet tests (reference: deeplearning4j-zoo
+TestInstantiation + dataset iterator tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.dataset import (
+    SvhnDataSetIterator, TinyImageNetDataSetIterator, load_svhn,
+    load_tiny_imagenet)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.zoo import (
+    FaceNet, InceptionResNetV1, NASNet, VGG19, YOLO2)
+
+
+def _overfit(net, X, Y, epochs, msg=""):
+    h = net.fit(X, Y, epochs=epochs, batch_size=len(X))
+    losses = h.loss_curve.losses
+    assert np.isfinite(losses).all(), (msg, losses)
+    assert losses[-1] < losses[0], (msg, losses[0], losses[-1])
+    return h
+
+
+def test_vgg19_conf_and_overfit():
+    conf = VGG19().conf()
+    # 16 conv + 5 pool + 2 dense + 1 output
+    from deeplearning4j_tpu.nn import ConvolutionLayer
+    convs = [l for l in conf.layers if isinstance(l, ConvolutionLayer)]
+    assert len(convs) == 16
+    rng = np.random.RandomState(0)
+    X = rng.rand(4, 3, 32, 32).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]
+    net = VGG19(height=32, width=32, num_classes=2,
+                updater=Adam(1e-3)).build()
+    _overfit(net, X, Y, epochs=6, msg="vgg19")
+
+
+def test_inception_resnet_v1_overfit():
+    rng = np.random.RandomState(1)
+    X = rng.rand(4, 3, 64, 64).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+    net = InceptionResNetV1(height=64, width=64, num_classes=3,
+                            blocks_a=1, blocks_b=1, blocks_c=1,
+                            updater=Adam(3e-3)).build()
+    _overfit(net, X, Y, epochs=8, msg="inception_resnet_v1")
+    out = net.output(X[:2])
+    out = out[0] if isinstance(out, list) else out
+    assert np.asarray(out.data).shape == (2, 3)
+
+
+def test_facenet_embedding_is_l2_normalized():
+    rng = np.random.RandomState(2)
+    X = rng.rand(4, 3, 64, 64).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+    net = FaceNet(height=64, width=64, num_classes=3, embedding_size=16,
+                  blocks_a=1, blocks_b=1, blocks_c=1,
+                  updater=Adam(3e-3)).build()
+    _overfit(net, X, Y, epochs=6, msg="facenet")
+    emb = net.feed_forward(X[:2])["embedding"]
+    emb = np.asarray(emb.data if hasattr(emb, "data") else emb)
+    assert emb.shape == (2, 16)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)
+
+
+def test_nasnet_overfit():
+    rng = np.random.RandomState(3)
+    X = rng.rand(4, 3, 32, 32).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]
+    net = NASNet(height=32, width=32, num_classes=2, cells_per_stack=1,
+                 filters=8, stem_filters=8, updater=Adam(3e-3)).build()
+    _overfit(net, X, Y, epochs=8, msg="nasnet")
+
+
+def test_yolo2_trains_with_passthrough():
+    rng = np.random.RandomState(4)
+    B, C = 2, 2
+    net = YOLO2(height=64, width=64, num_classes=C,
+                anchors=(1.0, 1.0, 2.0, 2.0), updater=Adam(3e-3)).build()
+    X = rng.rand(B, 3, 64, 64).astype(np.float32)
+    labels = np.zeros((B, 4 + C, 2, 2), np.float32)   # 64/32 = 2x2 grid
+    labels[:, 0:4, 1, 1] = np.array([0.5, 0.5, 1.5, 1.5], np.float32)
+    labels[:, 4, 1, 1] = 1.0
+    h = net.fit(X, labels, epochs=10, batch_size=B)
+    losses = h.loss_curve.losses
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+# ---- datasets -------------------------------------------------------------
+
+def test_svhn_loader_and_iterator():
+    X, y = load_svhn(n_synthetic=256)
+    assert X.shape == (256, 3, 32, 32) and y.shape == (256,)
+    assert X.dtype == np.float32 and 0 <= y.min() and y.max() < 10
+    it = SvhnDataSetIterator(batch_size=64, n_synthetic=256)
+    xb, yb = next(iter(it))
+    assert xb.shape == (64, 3, 32, 32) and yb.shape == (64, 10)
+
+
+def test_tiny_imagenet_loader_and_iterator():
+    X, y = load_tiny_imagenet(n_synthetic=128, n_classes=20)
+    assert X.shape == (128, 3, 64, 64)
+    assert y.max() < 20
+    it = TinyImageNetDataSetIterator(batch_size=32, n_synthetic=128,
+                                     n_classes=20)
+    xb, yb = next(iter(it))
+    assert xb.shape == (32, 3, 64, 64) and yb.shape == (32, 20)
+
+
+def test_synthetic_svhn_learnable():
+    """The hermetic fallback must be learnable (class signal present)."""
+    from deeplearning4j_tpu.zoo import SimpleCNN
+    X, y = load_svhn(n_synthetic=128)
+    Y = np.eye(10, dtype=np.float32)[y]
+    net = SimpleCNN(height=32, width=32, channels=3, num_classes=10,
+                    updater=Adam(3e-3)).build()
+    h = net.fit(X, Y, epochs=6, batch_size=64)
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
