@@ -31,5 +31,8 @@ __version__ = "0.1.0"
 from deeplearning4j_tpu.ndarray.dtype import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.environment import Environment, environment
+from deeplearning4j_tpu import memory
 
-__all__ = ["DataType", "NDArray", "nd", "__version__"]
+__all__ = ["DataType", "NDArray", "nd", "Environment", "environment",
+           "memory", "__version__"]

@@ -11,9 +11,26 @@ from deeplearning4j_tpu.autodiff.training import (
     ScoreIterationListener, PerformanceListener, CheckpointListener,
     EarlyStoppingListener,
 )
+from deeplearning4j_tpu.autodiff.listeners_ext import (
+    EvaluativeListener, SleepyListener, TimeIterationListener)
+from deeplearning4j_tpu.autodiff.earlystopping import (
+    BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingResult,
+    EarlyStoppingTrainer, InMemoryModelSaver, InvalidScoreTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreTerminationCondition, MaxTimeTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
 
 __all__ = [
     "SameDiff", "SDVariable", "VariableType", "OpNode", "TrainingConfig",
     "MixedPrecision", "History", "Listener", "ScoreIterationListener",
     "PerformanceListener", "CheckpointListener", "EarlyStoppingListener",
+    "EvaluativeListener", "TimeIterationListener", "SleepyListener",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer",
+    "EarlyStoppingResult", "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition", "MaxTimeTerminationCondition",
+    "MaxScoreTerminationCondition", "InvalidScoreTerminationCondition",
+    "DataSetLossCalculator", "ClassificationScoreCalculator",
+    "InMemoryModelSaver", "LocalFileModelSaver",
 ]

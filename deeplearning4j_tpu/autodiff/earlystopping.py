@@ -1,0 +1,413 @@
+"""Early stopping suite: termination conditions, score calculators,
+model savers, and the trainer that drives them.
+
+Reference parity: org.deeplearning4j.earlystopping —
+EarlyStoppingConfiguration + EarlyStoppingTrainer + EarlyStoppingResult
+(earlystopping/EarlyStoppingTrainer.java, trainer/BaseEarlyStoppingTrainer.java),
+epoch termination conditions {MaxEpochs, ScoreImprovementEpoch,
+BestScoreEpoch}, iteration termination conditions {MaxTime, MaxScore,
+InvalidScore}, score calculators (DataSetLossCalculator,
+ClassificationScoreCalculator), and model savers
+{InMemoryModelSaver, LocalFileModelSaver}.
+
+TPU-native difference: the trainer drives whole epochs through the
+model's compiled fit path (one jitted step, scanned epochs) and computes
+holdout scores from batched device inference — there is no per-iteration
+Java loop to interleave, so iteration conditions are checked between
+epochs on the epoch's mean loss and wall clock.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# termination conditions
+
+class MaxEpochsTerminationCondition:
+    """(reference: termination/MaxEpochsTerminationCondition)"""
+
+    def __init__(self, max_epochs: int):
+        self.max_epochs = int(max_epochs)
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+    def __repr__(self):
+        return f"MaxEpochsTerminationCondition({self.max_epochs})"
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after N epochs without improvement (reference:
+    termination/ScoreImprovementEpochTerminationCondition)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = int(max_epochs_without_improvement)
+        self.min_improvement = min_improvement
+        self._since_best = 0
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        self._since_best = 0 if improved else self._since_best + 1
+        return self._since_best > self.patience
+
+    def __repr__(self):
+        return (f"ScoreImprovementEpochTerminationCondition"
+                f"({self.patience})")
+
+
+class BestScoreEpochTerminationCondition:
+    """Stop once the score is at least as good as a target (reference:
+    termination/BestScoreEpochTerminationCondition)."""
+
+    def __init__(self, best_expected_score: float):
+        self.best_expected_score = best_expected_score
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        return score <= self.best_expected_score
+
+    def __repr__(self):
+        return (f"BestScoreEpochTerminationCondition"
+                f"({self.best_expected_score})")
+
+
+class MaxTimeTerminationCondition:
+    """Wall-clock budget (reference:
+    termination/MaxTimeIterationTerminationCondition)."""
+
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def initialize(self):
+        self._start = time.perf_counter()
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        if self._start is None:
+            self.initialize()
+        return time.perf_counter() - self._start > self.max_seconds
+
+    def __repr__(self):
+        return f"MaxTimeTerminationCondition({self.max_seconds}s)"
+
+
+class MaxScoreTerminationCondition:
+    """Abort when the score explodes above a bound (reference:
+    termination/MaxScoreIterationTerminationCondition)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        return score > self.max_score
+
+    def __repr__(self):
+        return f"MaxScoreTerminationCondition({self.max_score})"
+
+
+class InvalidScoreTerminationCondition:
+    """Abort on NaN/Inf (reference:
+    termination/InvalidScoreIterationTerminationCondition)."""
+
+    def terminate(self, epoch: int, score: float, improved: bool) -> bool:
+        return math.isnan(score) or math.isinf(score)
+
+    def __repr__(self):
+        return "InvalidScoreTerminationCondition()"
+
+
+# ---------------------------------------------------------------------------
+# score calculators
+
+class DataSetLossCalculator:
+    """Mean loss over a holdout iterator (reference:
+    scorecalc/DataSetLossCalculator). Uses the model's inference outputs
+    and recomputes the configured loss on host — the holdout pass never
+    touches training state."""
+
+    def __init__(self, iterator, loss: str = "mcxent", eps: float = 1e-7):
+        self.iterator = iterator
+        self.loss = loss.lower()
+        self.eps = eps
+
+    def _batch_loss(self, preds: np.ndarray, labels: np.ndarray) -> float:
+        p = np.asarray(preds, np.float64)
+        y = np.asarray(labels, np.float64)
+        if self.loss == "mcxent":
+            p = np.clip(p, self.eps, 1.0)
+            return float(-(y * np.log(p)).sum(axis=-1).mean())
+        if self.loss == "mse":
+            return float(((p - y) ** 2).mean())
+        raise ValueError(f"unknown loss {self.loss!r}")
+
+    def calculate_score(self, model) -> float:
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        total, n = 0.0, 0
+        for batch in self.iterator:
+            if hasattr(batch, "features"):
+                feats, labs = batch.features, batch.labels
+            else:
+                feats, labs = batch
+            out = model.output(feats)
+            if isinstance(out, list):
+                out = out[0]
+            out = out.to_numpy() if hasattr(out, "to_numpy") else \
+                np.asarray(getattr(out, "data", out))
+            b = len(out)
+            total += self._batch_loss(out, labs) * b
+            n += b
+        return total / max(n, 1)
+
+
+class ClassificationScoreCalculator:
+    """1 - accuracy on a holdout iterator, so lower is better like a loss
+    (reference: scorecalc/ClassificationScoreCalculator with
+    Evaluation.Metric.ACCURACY)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, model) -> float:
+        from deeplearning4j_tpu.evaluation import Evaluation
+        ev = Evaluation()
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        model.evaluate(self.iterator, evaluation=ev)
+        return 1.0 - ev.accuracy()
+
+
+class TrainingLossCalculator:
+    """Scores with the epoch's own mean training loss — no holdout
+    (the implicit behavior when the reference is configured without a
+    score calculator)."""
+
+    def calculate_score(self, model) -> float:
+        raise RuntimeError("TrainingLossCalculator is resolved by the "
+                           "trainer from the epoch history")
+
+
+# ---------------------------------------------------------------------------
+# model savers
+
+class InMemoryModelSaver:
+    """Keep the best model's arrays in memory (reference:
+    saver/InMemoryModelSaver)."""
+
+    def __init__(self):
+        self.best_params: Optional[Dict[str, np.ndarray]] = None
+        self.best_epoch = -1
+        self.best_score = float("inf")
+        self.latest_params: Optional[Dict[str, np.ndarray]] = None
+        self.latest_epoch = -1
+
+    def save_best(self, model, epoch: int, score: float) -> None:
+        sd = model.samediff if hasattr(model, "samediff") else model
+        self.best_params = {n: np.asarray(a)
+                            for n, a in sd._arrays.items()}
+        self.best_epoch = epoch
+        self.best_score = score
+
+    def save_latest(self, model, epoch: int, score: float) -> None:
+        sd = model.samediff if hasattr(model, "samediff") else model
+        self.latest_params = {n: np.asarray(a)
+                              for n, a in sd._arrays.items()}
+        self.latest_epoch = epoch
+
+    def restore_best(self, model):
+        if self.best_params is None:
+            return model
+        import jax.numpy as jnp
+        sd = model.samediff if hasattr(model, "samediff") else model
+        for n, a in self.best_params.items():
+            if n in sd._arrays:
+                sd._arrays[n] = jnp.asarray(a)
+        if hasattr(model, "_sync_infer"):
+            model._sync_infer()
+        return model
+
+
+class LocalFileModelSaver:
+    """Save the best model as a zip in a directory (reference:
+    saver/LocalFileModelSaver — bestModel.bin)."""
+
+    def __init__(self, directory: str):
+        import os
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.best_path = None
+        self.best_epoch = -1
+        self.best_score = float("inf")
+        self.latest_path = None
+        self.latest_epoch = -1
+
+    def save_best(self, model, epoch: int, score: float) -> None:
+        import os
+        path = os.path.join(self.directory, "bestModel.zip")
+        model.save(path)
+        self.best_path = path
+        self.best_epoch = epoch
+        self.best_score = score
+
+    def save_latest(self, model, epoch: int, score: float) -> None:
+        import os
+        path = os.path.join(self.directory, "latestModel.zip")
+        model.save(path)
+        self.latest_path = path
+        self.latest_epoch = epoch
+
+    def restore_best(self, model):
+        if self.best_path is None:
+            return model
+        return type(model).load(self.best_path)
+
+
+# ---------------------------------------------------------------------------
+
+class EarlyStoppingConfiguration:
+    """(reference: EarlyStoppingConfiguration + .Builder)"""
+
+    def __init__(self, epoch_termination_conditions: Sequence = (),
+                 iteration_termination_conditions: Sequence = (),
+                 score_calculator=None, model_saver=None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.epoch_conditions = list(epoch_termination_conditions)
+        self.iteration_conditions = list(iteration_termination_conditions)
+        self.score_calculator = score_calculator
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.evaluate_every_n_epochs = max(int(evaluate_every_n_epochs), 1)
+        self.save_last_model = save_last_model
+
+    class Builder:
+        def __init__(self):
+            self._kw = dict(epoch_termination_conditions=[],
+                            iteration_termination_conditions=[])
+
+        def epoch_termination_conditions(self, *conds):
+            self._kw["epoch_termination_conditions"] = list(conds)
+            return self
+
+        def iteration_termination_conditions(self, *conds):
+            self._kw["iteration_termination_conditions"] = list(conds)
+            return self
+
+        def score_calculator(self, sc):
+            self._kw["score_calculator"] = sc; return self
+
+        def model_saver(self, saver):
+            self._kw["model_saver"] = saver; return self
+
+        def save_last_model(self, v: bool = True):
+            self._kw["save_last_model"] = v; return self
+
+        def evaluate_every_n_epochs(self, n: int):
+            self._kw["evaluate_every_n_epochs"] = n; return self
+
+        def build(self) -> "EarlyStoppingConfiguration":
+            return EarlyStoppingConfiguration(**self._kw)
+
+    @staticmethod
+    def builder() -> "EarlyStoppingConfiguration.Builder":
+        return EarlyStoppingConfiguration.Builder()
+
+
+class EarlyStoppingResult:
+    """(reference: EarlyStoppingResult — termination reason + details +
+    best epoch/score + the best model)"""
+
+    EPOCH_TERMINATION = "EpochTerminationCondition"
+    ITERATION_TERMINATION = "IterationTerminationCondition"
+    MAX_EPOCHS = "MaxEpochsExceeded"
+
+    def __init__(self, reason, details, best_epoch, best_score,
+                 total_epochs, best_model, score_by_epoch):
+        self.termination_reason = reason
+        self.termination_details = details
+        self.best_model_epoch = best_epoch
+        self.best_model_score = best_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+        self.score_vs_epoch = score_by_epoch
+
+    def __repr__(self):
+        return (f"EarlyStoppingResult(reason={self.termination_reason}, "
+                f"details={self.termination_details}, "
+                f"best_epoch={self.best_model_epoch}, "
+                f"best_score={self.best_model_score:.6f}, "
+                f"epochs={self.total_epochs})")
+
+
+class EarlyStoppingTrainer:
+    """Drives epoch-at-a-time training with score-based termination
+    (reference: trainer/BaseEarlyStoppingTrainer.fit)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_data):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+
+    def fit(self, max_epochs: int = 1000) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.iteration_conditions:
+            if hasattr(c, "initialize"):
+                c.initialize()
+        best_score = float("inf")
+        best_epoch = -1
+        score_by_epoch: Dict[int, float] = {}
+        reason, details = EarlyStoppingResult.MAX_EPOCHS, \
+            f"no termination condition fired in {max_epochs} epochs"
+        epoch = -1
+        for epoch in range(max_epochs):
+            if hasattr(self.train_data, "reset"):
+                self.train_data.reset()
+            history = self.model.fit(self.train_data, epochs=1)
+            train_loss = history.final_loss()
+
+            # iteration-class conditions watch the raw training signal
+            fired = None
+            for c in cfg.iteration_conditions:
+                if c.terminate(epoch, train_loss, False):
+                    fired = c
+                    break
+            if fired is not None:
+                reason = EarlyStoppingResult.ITERATION_TERMINATION
+                details = repr(fired)
+                score_by_epoch[epoch] = train_loss
+                break
+
+            if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None and not isinstance(
+                        cfg.score_calculator, TrainingLossCalculator):
+                    score = cfg.score_calculator.calculate_score(self.model)
+                else:
+                    score = train_loss
+                score_by_epoch[epoch] = score
+                improved = score < best_score
+                if improved:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best(self.model, epoch, score)
+                fired = None
+                for c in cfg.epoch_conditions:
+                    if c.terminate(epoch, score, improved):
+                        fired = c
+                        break
+                if fired is not None:
+                    reason = EarlyStoppingResult.EPOCH_TERMINATION
+                    details = repr(fired)
+                    break
+
+        if cfg.save_last_model and epoch >= 0:
+            # reference: saver.saveLatestModel — persisted BEFORE the
+            # best-model restore overwrites the in-memory final state
+            cfg.model_saver.save_latest(
+                self.model, epoch, score_by_epoch.get(epoch, float("nan")))
+        best_model = cfg.model_saver.restore_best(self.model)
+        return EarlyStoppingResult(reason, details, best_epoch, best_score,
+                                   epoch + 1, best_model, score_by_epoch)

@@ -1,0 +1,154 @@
+"""Listener breadth: EvaluativeListener, TimeIterationListener,
+SleepyListener.
+
+Reference parity: org.deeplearning4j.optimize.listeners —
+EvaluativeListener.java (periodic holdout evaluation during fit),
+TimeIterationListener.java (remaining-time ETA logging), and
+SleepyTrainingListener.java (deliberate throttling at chosen points).
+All hook the same burst-aware listener bus as the core listeners
+(autodiff/training.Listener).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.training import Listener
+
+
+class EvaluativeListener(Listener):
+    """Evaluate on a holdout iterator every N epochs or iterations
+    (reference: EvaluativeListener.java — InvocationType
+    {EPOCH_END, ITERATION_END}).
+
+    ``model`` must expose ``output``/``evaluate`` (MultiLayerNetwork,
+    ComputationGraph); evaluations accumulate into ``results`` and the
+    freshest one is in ``last_evaluation``.
+    """
+
+    def __init__(self, model, iterator, frequency: int = 1,
+                 invocation: str = "epoch_end", evaluation_factory=None,
+                 print_fn: Optional[Callable] = None):
+        if invocation not in ("epoch_end", "iteration_end"):
+            raise ValueError(f"unknown invocation {invocation!r}")
+        from deeplearning4j_tpu.evaluation import Evaluation
+        self.model = model
+        self.iterator = iterator
+        self.invocation = invocation
+        self.eval_every = max(int(frequency), 1)
+        # bus burst size (Listener.frequency) is a DIFFERENT axis than the
+        # eval interval: epoch-end evaluation must not force per-iteration
+        # loss flushes, so it leaves the bus cadence effectively unbounded
+        if invocation == "iteration_end":
+            self.frequency = self.eval_every
+            # mid-epoch evaluation reads model params — fit() syncs them
+            # into the graph at each flush when this is set
+            self.needs_params = True
+        else:
+            self.frequency = 1_000_000_000
+        self.evaluation_factory = evaluation_factory or Evaluation
+        self.print_fn = print_fn
+        self.results = []               # (epoch_or_iter, evaluation)
+        self.last_evaluation = None
+
+    def _evaluate(self, tag: int):
+        ev = self.evaluation_factory()
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        self.model.evaluate(self.iterator, evaluation=ev)
+        self.last_evaluation = ev
+        self.results.append((tag, ev))
+        if self.print_fn is not None:
+            acc = ev.accuracy() if hasattr(ev, "accuracy") else None
+            self.print_fn(f"EvaluativeListener at {self.invocation} {tag}: "
+                          + (f"accuracy={acc:.4f}" if acc is not None
+                             else repr(ev)))
+
+    def on_epoch_end(self, sd, epoch, mean_loss):
+        if self.invocation == "epoch_end" and \
+                (epoch + 1) % self.eval_every == 0:
+            self._evaluate(epoch)
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if self.invocation == "iteration_end" and \
+                iteration % self.eval_every == 0:
+            self._evaluate(iteration)
+
+    def iterations_done(self, sd, epoch, iterations, losses):
+        if self.invocation != "iteration_end":
+            return
+        # bursts may span several eval points; evaluate once per burst if
+        # any iteration in it crossed the interval
+        if any(i % self.eval_every == 0 for i in iterations):
+            self._evaluate(iterations[-1])
+
+
+class TimeIterationListener(Listener):
+    """Log estimated remaining training time (reference:
+    TimeIterationListener.java — linear extrapolation from elapsed time
+    over completed iterations toward ``total_iterations``)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50,
+                 print_fn=print):
+        self.total_iterations = int(total_iterations)
+        self.frequency = max(int(frequency), 1)
+        self.print_fn = print_fn
+        self.start_time = None
+        self._last_print = 0
+        self.remaining_seconds = float("nan")
+
+    def on_training_start(self, sd):
+        self.start_time = time.perf_counter()
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        # prints on elapsed-iteration count, not modulo — burst sizes set
+        # by OTHER listeners must not be able to starve the ETA line
+        if self.start_time is None:
+            self.start_time = time.perf_counter()
+            return
+        done = iteration + 1
+        if done - self._last_print < self.frequency:
+            return
+        self._last_print = done
+        elapsed = time.perf_counter() - self.start_time
+        rate = elapsed / max(done, 1)
+        self.remaining_seconds = rate * max(
+            self.total_iterations - done, 0)
+        mins, secs = divmod(int(self.remaining_seconds), 60)
+        self.print_fn(f"iteration {done}/{self.total_iterations}: "
+                      f"estimated {mins}m{secs:02d}s remaining")
+
+    def iterations_done(self, sd, epoch, iterations, losses):
+        self.iteration_done(sd, epoch, iterations[-1], losses[-1])
+
+
+class SleepyListener(Listener):
+    """Throttle training by sleeping at chosen points (reference:
+    SleepyTrainingListener.java — per-callback sleep durations used to
+    simulate slow hosts / pace device submission in tests)."""
+
+    frequency = 1           # sleeps must fire per-iteration, not per-burst
+
+    def __init__(self, on_iteration_ms: float = 0.0,
+                 on_epoch_start_ms: float = 0.0,
+                 on_epoch_end_ms: float = 0.0):
+        self.on_iteration_ms = on_iteration_ms
+        self.on_epoch_start_ms = on_epoch_start_ms
+        self.on_epoch_end_ms = on_epoch_end_ms
+        self.sleep_count = 0
+
+    def _sleep(self, ms: float):
+        if ms > 0:
+            self.sleep_count += 1
+            time.sleep(ms / 1000.0)
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        self._sleep(self.on_iteration_ms)
+
+    def on_epoch_start(self, sd, epoch):
+        self._sleep(self.on_epoch_start_ms)
+
+    def on_epoch_end(self, sd, epoch, mean_loss):
+        self._sleep(self.on_epoch_end_ms)

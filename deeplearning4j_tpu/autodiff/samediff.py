@@ -834,10 +834,31 @@ class SameDiff:
         cache_key = ("train_step", self._version, loss_names, donate)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
+            self._verbose_log(f"compiling train step (graph v{self._version}, "
+                              f"{len(self._ops)} ops, donate={donate})")
             compiled = jax.jit(step_body,
                                donate_argnums=(0, 1, 2, 3) if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
+
+    @staticmethod
+    def _nan_panic_active(tc) -> bool:
+        """Loss checking is on when the config asks for it OR the runtime
+        Environment is in debug mode — debug set after a TrainingConfig
+        was built must still take effect at fit time."""
+        if getattr(tc, "nan_panic", False):
+            return True
+        from deeplearning4j_tpu.environment import environment
+        return environment().is_debug()
+
+    @staticmethod
+    def _verbose_log(msg: str) -> None:
+        """Environment verbose mode (reference: Environment.h verbose —
+        the runtime narrates compile/dispatch events)."""
+        from deeplearning4j_tpu.environment import environment
+        env = environment()
+        if env.is_verbose() or env.is_debug():
+            print(f"[deeplearning4j_tpu] {msg}")
 
     def make_train_epoch(self, donate: bool = True, unroll: int = 1):
         """Whole-epoch train step: lax.scan of the step body over batches
@@ -924,6 +945,10 @@ class SameDiff:
         # burst instead of per iteration)
         flush_every = min((max(1, int(getattr(l, "frequency", 10)))
                            for l in listeners), default=0)
+        # listeners that evaluate/save mid-epoch need current params in
+        # self._arrays at each flush (params otherwise sync at epoch end)
+        sync_params_on_flush = any(getattr(l, "needs_params", False)
+                                   for l in listeners)
 
         for epoch in range(epochs):
             epoch_losses = []
@@ -936,7 +961,10 @@ class SameDiff:
                 vals = [float(v) for v in
                         np.asarray(jnp.stack([lv for _, lv in pending]))]
                 epoch_losses.extend(vals)
-                if getattr(tc, "nan_panic", False):
+                if sync_params_on_flush:
+                    for n, p in {**params, **svars}.items():
+                        self._arrays[n] = jnp.copy(p)
+                if self._nan_panic_active(tc):
                     for it, v in zip(iters, vals):
                         if not np.isfinite(v):
                             raise NumericsException(
@@ -978,7 +1006,7 @@ class SameDiff:
                 _flush(pending)
                 mean_loss = float(np.mean(epoch_losses)) \
                     if epoch_losses else float("nan")
-            elif getattr(tc, "nan_panic", False):
+            elif self._nan_panic_active(tc):
                 # panic mode: fetch the epoch mean NOW (one sync per epoch)
                 mean_loss = float(jnp.mean(jnp.stack(epoch_losses))) \
                     if epoch_losses else float("nan")
@@ -1045,7 +1073,7 @@ class SameDiff:
         n_steps = next(iter(stacked.values())).shape[0]
         history = History()
         epoch_means = []
-        panic = getattr(tc, "nan_panic", False)
+        panic = self._nan_panic_active(tc)
         for _ in range(epochs):
             params, svars, state, it_dev, losses = epoch_step(
                 params, svars, state, it_dev, constants, stacked, base_key)

@@ -24,6 +24,11 @@ from deeplearning4j_tpu.learning.updaters import IUpdater
 from deeplearning4j_tpu.learning.regularization import Regularization
 
 
+def _env():
+    from deeplearning4j_tpu.environment import environment
+    return environment()
+
+
 @dataclasses.dataclass
 class MixedPrecision:
     """Mixed-precision training policy: compute in ``compute_dtype``
@@ -78,7 +83,10 @@ class TrainingConfig:
     # NumericsException naming the iteration; localize the producing op
     # with sd.exec_debug(). Step-internal per-op checks are impossible
     # under whole-graph jit, so the check granularity is the loss fetch.
-    nan_panic: bool = False
+    # Defaults from the runtime Environment ($DL4J_TPU_NAN_PANIC /
+    # $DL4J_TPU_DEBUG, reference: Environment.h debug mode).
+    nan_panic: bool = dataclasses.field(default_factory=lambda: bool(
+        _env().get("nan_panic") or _env().get("debug")))
 
     def clip_gradients(self, grads):
         """Apply elementwise clip + the configured normalization mode to a

@@ -1,0 +1,182 @@
+"""Runtime environment: the framework's flag/property catalog.
+
+Reference parity: org.nd4j.common.config.ND4JSystemProperties (the
+documented catalog of system properties) and libnd4j
+include/system/Environment.h:41 (the runtime toggle singleton —
+verbose/debug mode, max memory, workspace behavior, blas threads).
+
+TPU-native redesign: properties map to environment variables read once
+at first access and overridable programmatically; device/platform rows
+are live queries against JAX (there is no native env struct to mirror —
+XLA owns execution), and memory caps surface the XLA client options
+instead of workspace byte counts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def _as_bool(v: str) -> bool:
+    return str(v).strip().lower() in _TRUE
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertySpec:
+    key: str                 # environment variable
+    type: Callable
+    default: Any
+    description: str
+
+
+# The documented property catalog (reference: ND4JSystemProperties.java —
+# every toggle is listed with its doc string so `describe()` can print
+# the same kind of reference table).
+PROPERTIES: Dict[str, PropertySpec] = {
+    "verbose": PropertySpec(
+        "DL4J_TPU_VERBOSE", _as_bool, False,
+        "Print per-fit compile/dispatch diagnostics (Environment.h "
+        "verbose mode)."),
+    "debug": PropertySpec(
+        "DL4J_TPU_DEBUG", _as_bool, False,
+        "Debug execution mode: every fit() checks fetched losses for "
+        "NaN/Inf regardless of TrainingConfig.nan_panic, and compile "
+        "logging turns on (Environment.h debug mode; per-op localization "
+        "stays on sd.exec_debug())."),
+    "nan_panic": PropertySpec(
+        "DL4J_TPU_NAN_PANIC", _as_bool, False,
+        "Default TrainingConfig.nan_panic: raise on non-finite loss "
+        "(PerformanceListener/NaN panic rails)."),
+    "default_dtype": PropertySpec(
+        "DL4J_TPU_DTYPE", str, "float32",
+        "Default floating dtype for new networks (ND4JSystemProperties "
+        "dtype property)."),
+    "log_compiles": PropertySpec(
+        "DL4J_TPU_LOG_COMPILES", _as_bool, False,
+        "Ask JAX to log every XLA compilation (jax_log_compiles)."),
+    "mem_fraction": PropertySpec(
+        "XLA_PYTHON_CLIENT_MEM_FRACTION", float, 0.75,
+        "Fraction of device HBM the XLA client may preallocate (the "
+        "workspace-size analogue; read by JAX at process start)."),
+    "preallocate": PropertySpec(
+        "XLA_PYTHON_CLIENT_PREALLOCATE", _as_bool, True,
+        "Whether the XLA client preallocates the memory pool at startup."),
+    "compilation_cache_dir": PropertySpec(
+        "JAX_COMPILATION_CACHE_DIR", str, "",
+        "Persistent XLA compilation cache directory (first-compile "
+        "latency amortization across processes)."),
+    "host_device_count": PropertySpec(
+        "DL4J_TPU_HOST_DEVICES", int, 0,
+        "Virtual CPU device count for mesh testing (0 = leave XLA_FLAGS "
+        "alone); mirrors --xla_force_host_platform_device_count."),
+}
+
+
+class Environment:
+    """Singleton runtime toggles (reference: Environment.getInstance()).
+
+    Values resolve in order: programmatic ``set()`` > environment
+    variable > catalog default.
+    """
+
+    _instance: Optional["Environment"] = None
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    @classmethod
+    def get_instance(cls) -> "Environment":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    # -- generic access ----------------------------------------------------
+    def get(self, name: str):
+        spec = PROPERTIES.get(name)
+        if spec is None:
+            raise KeyError(f"unknown property {name!r}; "
+                           f"have {sorted(PROPERTIES)}")
+        if name in self._overrides:
+            return self._overrides[name]
+        raw = os.environ.get(spec.key)
+        if raw is None or raw == "":
+            return spec.default
+        try:
+            return spec.type(raw)
+        except (TypeError, ValueError):
+            return spec.default
+
+    def set(self, name: str, value) -> "Environment":
+        if name not in PROPERTIES:
+            raise KeyError(f"unknown property {name!r}")
+        self._overrides[name] = PROPERTIES[name].type(value)
+        self._apply_side_effects(name)
+        return self
+
+    def reset(self, name: Optional[str] = None) -> "Environment":
+        if name is None:
+            self._overrides.clear()
+        else:
+            self._overrides.pop(name, None)
+        return self
+
+    def _apply_side_effects(self, name: str) -> None:
+        if name == "log_compiles":
+            import jax
+            jax.config.update("jax_log_compiles", bool(self.get(name)))
+
+    # -- named accessors (Environment.h style) -----------------------------
+    def is_verbose(self) -> bool:
+        return bool(self.get("verbose"))
+
+    def set_verbose(self, v: bool):
+        return self.set("verbose", v)
+
+    def is_debug(self) -> bool:
+        return bool(self.get("debug"))
+
+    def set_debug(self, v: bool):
+        return self.set("debug", v)
+
+    def default_dtype(self) -> str:
+        return str(self.get("default_dtype"))
+
+    # -- live platform rows (reference: Environment.h backend queries) -----
+    def platform(self) -> str:
+        import jax
+        try:
+            return jax.default_backend()
+        except Exception:
+            return "uninitialized"
+
+    def device_count(self) -> int:
+        import jax
+        try:
+            return jax.device_count()
+        except Exception:
+            return 0
+
+    def describe(self) -> str:
+        """Render the property catalog with current values (the
+        ND4JSystemProperties doc table, live)."""
+        lines = [f"deeplearning4j_tpu runtime environment "
+                 f"(platform={self.platform()}, "
+                 f"devices={self.device_count()})"]
+        for name, spec in sorted(PROPERTIES.items()):
+            src = ("set" if name in self._overrides else
+                   "env" if os.environ.get(spec.key) else "default")
+            lines.append(f"  {name} = {self.get(name)!r} [{src}; "
+                         f"${spec.key}]")
+            lines.append(f"      {spec.description}")
+        return "\n".join(lines)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: self.get(name) for name in PROPERTIES}
+
+
+def environment() -> Environment:
+    """Module-level accessor (reference: Nd4j.getEnvironment())."""
+    return Environment.get_instance()

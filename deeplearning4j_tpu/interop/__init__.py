@@ -1,0 +1,13 @@
+"""Interop runtimes: run foreign models in-process (reference:
+nd4j-tensorflow GraphRunner / nd4j-onnxruntime OnnxRuntimeRunner —
+SURVEY.md §2.2 interop row).
+
+The environment ships torch-cpu, so the concrete runner executes
+torch/TorchScript modules with zero-copy tensor exchange; the ONNX
+Runtime runner has the same surface and activates when onnxruntime is
+installed.
+"""
+from deeplearning4j_tpu.interop.torch_runner import (
+    OnnxRuntimeRunner, TorchRunner)
+
+__all__ = ["TorchRunner", "OnnxRuntimeRunner"]

@@ -1,0 +1,159 @@
+"""In-process foreign-model execution with zero-copy tensor exchange.
+
+Reference parity: org.nd4j.tensorflow.conversion.graphrunner.GraphRunner
+(GraphRunner.java:52 — load a foreign graph once, keep a persistent
+session, feed Map<String, NDArray>, fetch Map<String, NDArray>, with
+zero-copy tensor conversion via TensorflowConversion) and
+nd4j-onnxruntime's OnnxRuntimeRunner.
+
+TPU-native redesign: the foreign runtime available in this stack is
+torch (CPU). TorchRunner keeps a loaded ``torch.nn.Module`` /
+TorchScript program as the persistent "session"; conversion crosses the
+host boundary zero-copy where the buffer protocols allow it —
+numpy → torch via ``torch.from_numpy`` (shared memory), CPU jax arrays
+via DLPack, and torch outputs back to numpy via the shared-memory
+``.numpy()`` view. TPU-resident jax arrays are device-transferred to
+host first (the same D2H the reference pays feeding libnd4j buffers into
+TF CPU sessions).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _to_torch(value, torch):
+    """Framework array → torch tensor, zero-copy when host-resident."""
+    if isinstance(value, torch.Tensor):
+        return value
+    if isinstance(value, np.ndarray):
+        if not value.flags["C_CONTIGUOUS"]:
+            value = np.ascontiguousarray(value)
+        return torch.from_numpy(value)               # shared memory
+    # NDArray (this framework's imperative array)
+    data = getattr(value, "data", None)
+    if data is not None:
+        value = data
+    # jax array: DLPack zero-copy on CPU; TPU arrays go through host
+    try:
+        import jax
+        if isinstance(value, jax.Array):
+            platform = list(value.devices())[0].platform
+            if platform == "cpu":
+                try:
+                    return torch.from_dlpack(value)
+                except Exception:
+                    pass
+            return torch.from_numpy(np.asarray(value))
+    except ImportError:
+        pass
+    return torch.as_tensor(np.asarray(value))
+
+
+class TorchRunner:
+    """Persistent in-process runner for a torch module (the GraphRunner
+    role: construct once, ``run()`` many times).
+
+    model: a ``torch.nn.Module``, a TorchScript file path (``.pt`` saved
+    with ``torch.jit.save``), or a callable over torch tensors.
+    input_order: feed-dict keys in positional-argument order (defaults
+    to sorted feed keys, or the single key for 1-input models).
+    output_names: names for the fetched outputs (defaults to
+    ``output_0..n``; a dict-returning module uses its own keys).
+    """
+
+    def __init__(self, model, input_order: Optional[Sequence[str]] = None,
+                 output_names: Optional[Sequence[str]] = None):
+        try:
+            import torch
+        except ImportError as e:                     # pragma: no cover
+            raise RuntimeError(
+                "TorchRunner needs torch installed (the reference's "
+                "GraphRunner equally needs the TF runtime present)") from e
+        self._torch = torch
+        if isinstance(model, str):
+            model = torch.jit.load(model, map_location="cpu")
+        if hasattr(model, "eval"):
+            model.eval()
+        self.model = model
+        self.input_order = list(input_order) if input_order else None
+        self.output_names = list(output_names) if output_names else None
+        self._closed = False
+
+    # -- GraphRunner.run(Map<String,INDArray>) ----------------------------
+    def run(self, feed: Dict[str, object]) -> Dict[str, np.ndarray]:
+        if self._closed:
+            raise RuntimeError("TorchRunner is closed")
+        torch = self._torch
+        order = self.input_order or (
+            list(feed) if len(feed) == 1 else sorted(feed))
+        missing = [n for n in order if n not in feed]
+        if missing:
+            raise KeyError(f"feed missing inputs {missing}; got "
+                           f"{sorted(feed)}")
+        args = [_to_torch(feed[n], torch) for n in order]
+        with torch.no_grad():
+            out = self.model(*args)
+        return self._name_outputs(out)
+
+    def _name_outputs(self, out) -> Dict[str, np.ndarray]:
+        torch = self._torch
+        if isinstance(out, dict):
+            return {k: v.detach().numpy() for k, v in out.items()}
+        if isinstance(out, (list, tuple)):
+            outs = list(out)
+        else:
+            outs = [out]
+        names = self.output_names or [f"output_{i}"
+                                      for i in range(len(outs))]
+        if len(names) != len(outs):
+            raise ValueError(f"model returned {len(outs)} outputs, "
+                             f"output_names has {len(names)}")
+        res = {}
+        for n, t in zip(names, outs):
+            res[n] = t.detach().numpy() if isinstance(t, torch.Tensor) \
+                else np.asarray(t)
+        return res
+
+    def run_to_device(self, feed: Dict[str, object]) -> Dict[str, object]:
+        """run() + put outputs on the default JAX device — the fetch-side
+        equivalent of the reference's zero-copy back into nd4j."""
+        import jax.numpy as jnp
+        return {k: jnp.asarray(v) for k, v in self.run(feed).items()}
+
+    # -- lifecycle (GraphRunner implements Closeable) ----------------------
+    def close(self) -> None:
+        self._closed = True
+        self.model = None
+
+    def __enter__(self) -> "TorchRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class OnnxRuntimeRunner:
+    """ONNX Runtime in-process runner (reference: nd4j-onnxruntime
+    OnnxRuntimeRunner). Same surface as TorchRunner; requires the
+    optional onnxruntime package."""
+
+    def __init__(self, model_path: str,
+                 output_names: Optional[Sequence[str]] = None):
+        try:
+            import onnxruntime
+        except ImportError as e:
+            raise RuntimeError(
+                "OnnxRuntimeRunner needs the onnxruntime package, which "
+                "is not installed in this environment; import ONNX models "
+                "natively with modelimport.onnx_import instead") from e
+        self._session = onnxruntime.InferenceSession(model_path)
+        self.output_names = list(output_names) if output_names else None
+
+    def run(self, feed: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        names = self.output_names or [o.name
+                                      for o in self._session.get_outputs()]
+        vals = self._session.run(names, feed)
+        return dict(zip(names, vals))

@@ -1,0 +1,182 @@
+"""Memory observability: allocation tracking and HBM watermarks.
+
+Reference parity: org.nd4j.linalg.api.memory.AllocationsTracker (live
+per-device allocation accounting) and the workspace debug listeners.
+
+TPU-native redesign: XLA owns allocation, so tracking reads the PJRT
+client's per-device counters (``device.memory_stats()``: bytes_in_use,
+peak_bytes_in_use, num_allocs, largest_alloc_size) plus the Python-side
+live-buffer view (``jax.live_arrays()``). The watermark context manager
+is the per-fit HBM accounting the reference gets from
+AllocationsTracker.getInstance() around training calls. On backends
+whose PJRT client exposes no stats (CPU), live-array accounting is the
+fallback so the API stays total.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DeviceMemoryState:
+    """One device's counters at a point in time."""
+    device: str
+    bytes_in_use: int = 0
+    peak_bytes: int = 0
+    num_allocs: int = 0
+    largest_alloc: int = 0
+    bytes_limit: int = 0
+    source: str = "pjrt"        # "pjrt" | "live_arrays"
+
+
+def _live_array_bytes_by_device() -> Dict[str, int]:
+    import jax
+    by_dev: Dict[str, int] = {}
+    for a in jax.live_arrays():
+        try:
+            for shard in a.addressable_shards:
+                d = str(shard.device)
+                by_dev[d] = by_dev.get(d, 0) + int(shard.data.nbytes)
+        except Exception:
+            pass
+    return by_dev
+
+
+def snapshot() -> List[DeviceMemoryState]:
+    """Per-device memory counters (reference:
+    AllocationsTracker.getInstance() device reports)."""
+    import jax
+    out: List[DeviceMemoryState] = []
+    live = None
+    for dev in jax.local_devices():
+        ms = None
+        try:
+            ms = dev.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out.append(DeviceMemoryState(
+                device=str(dev),
+                bytes_in_use=int(ms.get("bytes_in_use", 0)),
+                peak_bytes=int(ms.get("peak_bytes_in_use", 0)),
+                num_allocs=int(ms.get("num_allocs", 0)),
+                largest_alloc=int(ms.get("largest_alloc_size", 0)),
+                bytes_limit=int(ms.get("bytes_limit", 0)),
+                source="pjrt"))
+        else:
+            if live is None:
+                live = _live_array_bytes_by_device()
+            out.append(DeviceMemoryState(
+                device=str(dev),
+                bytes_in_use=live.get(str(dev), 0),
+                source="live_arrays"))
+    return out
+
+
+def total_bytes_in_use() -> int:
+    return sum(s.bytes_in_use for s in snapshot())
+
+
+def live_array_count() -> int:
+    import jax
+    return len(jax.live_arrays())
+
+
+def device_memory_report() -> str:
+    """Human-readable per-device table (reference: AllocationsTracker
+    + Nd4j memory info dumps)."""
+    lines = ["device memory report"]
+    for s in snapshot():
+        mb = s.bytes_in_use / 2**20
+        line = f"  {s.device}: {mb:.1f} MiB in use"
+        if s.source == "pjrt":
+            line += (f", peak {s.peak_bytes / 2**20:.1f} MiB, "
+                     f"{s.num_allocs} allocs, largest "
+                     f"{s.largest_alloc / 2**20:.1f} MiB")
+            if s.bytes_limit:
+                line += f", limit {s.bytes_limit / 2**20:.1f} MiB"
+        else:
+            line += " (live-array accounting; PJRT stats unavailable)"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+class MemoryWatermark:
+    """Context manager recording the HBM watermark across a block —
+    the per-fit accounting the reference gets from AllocationsTracker
+    around training runs.
+
+    with MemoryWatermark() as wm:
+        net.fit(...)
+    wm.peak_bytes / wm.delta_bytes / wm.report()
+    """
+
+    def __init__(self):
+        self.before: List[DeviceMemoryState] = []
+        self.after: List[DeviceMemoryState] = []
+
+    def __enter__(self) -> "MemoryWatermark":
+        self.before = snapshot()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.after = snapshot()
+
+    @property
+    def peak_bytes(self) -> int:
+        """Max peak across devices during/after the block (PJRT peaks are
+        process-lifetime; delta vs `before` isolates this block only when
+        the block's peak exceeded the prior peak)."""
+        if not self.after:
+            self.after = snapshot()
+        return max((s.peak_bytes or s.bytes_in_use) for s in self.after)
+
+    @property
+    def delta_bytes(self) -> int:
+        if not self.after:
+            self.after = snapshot()
+        b = {s.device: s.bytes_in_use for s in self.before}
+        return sum(s.bytes_in_use - b.get(s.device, 0) for s in self.after)
+
+    def report(self) -> str:
+        return (f"memory watermark: peak {self.peak_bytes / 2**20:.1f} "
+                f"MiB, net delta {self.delta_bytes / 2**20:+.1f} MiB\n"
+                + device_memory_report())
+
+
+class AllocationsTracker:
+    """Counting tracker for explicit instrumentation points (reference:
+    AllocationsTracker.allocate/release accounting API). The framework's
+    own allocations go through XLA, so this tracks what callers tag."""
+
+    _instance: Optional["AllocationsTracker"] = None
+
+    def __init__(self):
+        self._tracked: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}
+
+    @classmethod
+    def get_instance(cls) -> "AllocationsTracker":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def allocate(self, tag: str, nbytes: int) -> None:
+        self._tracked[tag] = self._tracked.get(tag, 0) + int(nbytes)
+        self._counts[tag] = self._counts.get(tag, 0) + 1
+
+    def release(self, tag: str, nbytes: int) -> None:
+        self._tracked[tag] = self._tracked.get(tag, 0) - int(nbytes)
+
+    def bytes_tracked(self, tag: str) -> int:
+        return self._tracked.get(tag, 0)
+
+    def totals(self) -> Dict[str, int]:
+        return dict(self._tracked)
+
+    def reset(self) -> None:
+        self._tracked.clear()
+        self._counts.clear()

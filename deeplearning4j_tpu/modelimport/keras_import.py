@@ -68,6 +68,8 @@ def _input_type_from_shape(shape):
         return InputType.recurrent(dims[1], dims[0])
     if len(dims) == 3:          # (H, W, C) image
         return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 4:          # (D|T, H, W, C) volume / image sequence
+        return InputType.convolutional3d(dims[0], dims[1], dims[2], dims[3])
     raise ValueError(f"unsupported Keras input shape {shape}")
 
 
@@ -94,6 +96,10 @@ def _pad(cfg) -> str:
 
 def _pair(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
 
 
 class _Ctx:
@@ -246,6 +252,64 @@ def _map_global_pool(pool_type):
         from deeplearning4j_tpu.nn import GlobalPoolingLayer
         return GlobalPoolingLayer(pooling_type=pool_type), None
     return mapper
+
+
+def _map_conv3d(cfg, ctx, itype):
+    _reject_unsupported(cfg, "Conv3D", {"data_format": "channels_last",
+                                        "groups": 1})
+    from deeplearning4j_tpu.nn import Convolution3DLayer
+    layer = Convolution3DLayer(
+        n_out=cfg["filters"], kernel_size=_triple(cfg["kernel_size"]),
+        stride=_triple(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        dilation=_triple(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg["activation"]),
+        has_bias=cfg.get("use_bias", True))
+    # keras conv3d kernel (kd, kh, kw, cin, cout) == this layout exactly
+    return layer, _set_simple({"W": 0, "b": 1})
+
+
+def _map_pool3d(pool_type):
+    def mapper(cfg, ctx, itype):
+        from deeplearning4j_tpu.nn import Subsampling3DLayer
+        layer = Subsampling3DLayer(
+            pooling_type=pool_type, kernel_size=_triple(cfg["pool_size"]),
+            stride=_triple(cfg.get("strides") or cfg["pool_size"]),
+            convolution_mode=_pad(cfg))
+        return layer, None
+    return mapper
+
+
+def _map_upsampling3d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Upsampling3DLayer
+    return Upsampling3DLayer(size=_triple(cfg.get("size", 2))), None
+
+
+def _map_zeropad3d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ZeroPadding3DLayer
+    p = cfg["padding"]
+    if isinstance(p, int):
+        p = ((p, p), (p, p), (p, p))
+    flat = []
+    for q in p:
+        a, b = (q, q) if isinstance(q, int) else q
+        flat += [a, b]
+    return ZeroPadding3DLayer(padding=tuple(flat)), None
+
+
+def _map_conv_lstm2d(cfg, ctx, itype):
+    _reject_unsupported(cfg, "ConvLSTM2D", {
+        "data_format": "channels_last", "activation": "tanh",
+        "recurrent_activation": "sigmoid", "go_backwards": False,
+        "use_bias": True,
+        "dilation_rate": (1, [1, 1], (1, 1), [1], (1,))})
+    from deeplearning4j_tpu.nn.recurrent_layers import ConvLSTM2DLayer
+    layer = ConvLSTM2DLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolution_mode=_pad(cfg),
+        return_sequences=cfg.get("return_sequences", False))
+    # keras: [kernel (kh,kw,cin,4F), recurrent_kernel (kh,kw,F,4F),
+    # bias (4F,)]; gate order i,f,c,o == conv_lstm2d's i,f,g,o
+    return layer, _set_simple({"Wih": 0, "Whh": 1, "b": 2})
 
 
 def _map_batchnorm(cfg, ctx, itype):
@@ -577,6 +641,12 @@ _MAPPERS: Dict[str, Callable] = {
     "Cropping1D": _map_cropping1d,
     "UpSampling1D": _map_upsampling1d,
     "MultiHeadAttention": _map_mha,
+    "Conv3D": _map_conv3d,
+    "MaxPooling3D": _map_pool3d("MAX"),
+    "AveragePooling3D": _map_pool3d("AVG"),
+    "UpSampling3D": _map_upsampling3d,
+    "ZeroPadding3D": _map_zeropad3d,
+    "ConvLSTM2D": _map_conv_lstm2d,
 }
 
 
@@ -673,6 +743,9 @@ _KIND_STEM = {
     "Subsampling1DLayer": "pool1d", "ZeroPadding1DLayer": "zeropad1d",
     "Cropping1DLayer": "crop1d", "Upsampling1DLayer": "upsample1d",
     "GravesLSTMLayer": "glstm",
+    "Convolution3DLayer": "conv3d", "Subsampling3DLayer": "pool3d",
+    "Upsampling3DLayer": "upsample3d", "ZeroPadding3DLayer": "zeropad3d",
+    "ConvLSTM2DLayer": "convlstm",
 }
 
 
@@ -715,49 +788,61 @@ def import_keras_model_and_weights(path):
         archive.close()
 
 
+def _vname(name: str, call_idx: int) -> str:
+    """Graph vertex name for a Keras layer call site. Shared layers
+    (called k>1 times) expand into k vertices."""
+    return name if call_idx == 0 else f"{name}__call{call_idx}"
+
+
 def _import_functional(model_cfg: dict, archive: _H5Archive):
     """Functional API → ComputationGraph. Supports the merge vertices the
-    graph API has (Add/Average/Maximum/Multiply/Subtract/Concatenate)."""
+    graph API has (Add/Average/Maximum/Multiply/Subtract/Concatenate).
+
+    Shared layers (one Keras layer called at multiple graph positions)
+    expand into one vertex PER CALL SITE; every call site receives the
+    same imported weights. Note the expansion un-ties the copies for
+    subsequent fine-tuning — gradient updates are per-call-site (the
+    reference rejects shared-layer graphs outright:
+    KerasLayer.getInboundLayerNames handles a single inbound node).
+    """
     from deeplearning4j_tpu.nn import (ComputationGraph, ElementWiseVertex,
                                        MergeVertex, NeuralNetConfiguration)
     cfg = model_cfg["config"]
     layers_cfg = {lc["config"]["name"]: lc for lc in cfg["layers"]}
     order = [lc["config"]["name"] for lc in cfg["layers"]]
 
-    def inbound(lc) -> List[str]:
-        nodes = lc.get("inbound_nodes", [])
-        if not nodes:
-            return []
-        if len(nodes) > 1:
-            raise ValueError(
-                f"Keras layer {lc['config']['name']!r} is called "
-                f"{len(nodes)} times (shared layer) — import supports one "
-                f"call site per layer")
-        node = nodes[0]
-        if isinstance(node, dict):       # keras 3 style
-            args = node.get("args", [])
-            names = []
+    def inbound(lc) -> List[List[Tuple[str, int]]]:
+        """Per call site: [(source layer name, source call index), ...]."""
+        sites = []
+        for node in lc.get("inbound_nodes", []):
+            if isinstance(node, dict):   # keras 3 style
+                args = node.get("args", [])
+                names: List[Tuple[str, int]] = []
 
-            def walk(a):
-                if isinstance(a, dict) and "config" in a and \
-                        "keras_history" in a["config"]:
-                    names.append(a["config"]["keras_history"][0])
-                elif isinstance(a, (list, tuple)):
-                    for x in a:
-                        walk(x)
-            walk(args)
-            return names
-        return [n[0] for n in node]      # keras 2 style [[name, 0, 0, {}]]
+                def walk(a):
+                    if isinstance(a, dict) and "config" in a and \
+                            "keras_history" in a["config"]:
+                        hist = a["config"]["keras_history"]
+                        names.append((hist[0], int(hist[1])))
+                    elif isinstance(a, (list, tuple)):
+                        for x in a:
+                            walk(x)
+                walk(args)
+                sites.append(names)
+            else:                        # keras 2 style [[name, n, t, {}]]
+                sites.append([(n[0], int(n[1])) for n in node])
+        return sites
 
-    def _names(spec) -> List[str]:
-        # keras 2: [["name", 0, 0], ...]; keras 3 single: ["name", 0, 0]
+    def _names(spec) -> List[Tuple[str, int]]:
+        # keras 2: [["name", node, tensor], ...]; keras 3: ["name", n, t]
         if isinstance(spec, list) and spec and isinstance(spec[0], str):
-            return [spec[0]]
-        return [n[0] if isinstance(n, list) else n for n in spec]
+            return [(spec[0], int(spec[1]) if len(spec) > 1 else 0)]
+        return [(n[0], int(n[1]) if len(n) > 1 else 0)
+                if isinstance(n, list) else (n, 0) for n in spec]
 
     g = NeuralNetConfiguration.builder().seed(0).graph_builder()
-    inputs = _names(cfg["input_layers"])
-    outputs = _names(cfg["output_layers"])
+    inputs = [n for n, _ in _names(cfg["input_layers"])]
+    outputs = [_vname(n, i) for n, i in _names(cfg["output_layers"])]
     g = g.add_inputs(*inputs)
     itypes = {}
     ctx = _Ctx()
@@ -779,59 +864,61 @@ def _import_functional(model_cfg: dict, archive: _H5Archive):
         cls = lc["class_name"]
         if cls == "InputLayer":
             continue
-        srcs = inbound(lc)
-        src_itype = itypes[srcs[0]]
-        if cls in _MERGE:
-            kind, op = _MERGE[cls]
-            in_types = [itypes[s] for s in srcs]
-            # A spatial Flatten feeding a merge cannot be rewired to its
-            # source: channel-concat of 4D maps is a different element
-            # order than concat of HWC-flattened vectors, and the
-            # downstream Dense kernel permutation is per-branch. Reject
-            # loudly; no-op flattens (already-flat input) resolve fine.
-            for s in srcs:
-                if s in flat_hwc:
-                    raise ValueError(
-                        f"Keras {cls} {name!r} consumes Flatten {s!r} of "
-                        f"a spatial tensor — Flatten-before-merge "
-                        f"topologies are not supported by import")
-            if kind == "ew":
-                vertex = ElementWiseVertex(op=op)
-            else:
-                vertex = MergeVertex()
-            g = g.add_vertex(name, vertex,
-                             *[_resolve_alias(built, s) for s in srcs])
-            itypes[name] = vertex.output_type(in_types)
-            continue
-        if cls not in _MAPPERS:
-            raise ValueError(f"Keras layer {cls} not supported by import")
-        # per-branch Flatten permutation: a Dense consuming a flatten alias
-        # permutes with THAT branch's spatial dims
-        ctx.flatten_hwc = flat_hwc.get(srcs[0])
-        layer, setter = _MAPPERS[cls](lc["config"], ctx, src_itype)
-        ctx.flatten_hwc = None
-        if layer is None:                # Flatten: alias to its source
-            itypes[name] = _flatten_itype(src_itype)
-            if src_itype.kind == "cnn":
-                c, h, w = src_itype.dims
-                flat_hwc[name] = (h, w, c)
-            built[name] = ("alias", srcs[0], None)
-            continue
-        g = g.add_layer(name, layer, *[_resolve_alias(built, s)
-                                       for s in srcs])
-        itypes[name] = layer.output_type(_adapt(src_itype, layer))
-        built[name] = ("layer", layer, setter)
+        for ci, site in enumerate(inbound(lc)):
+            vname = _vname(name, ci)
+            srcs = [_vname(s, si) for s, si in site]
+            src_itype = itypes[srcs[0]]
+            if cls in _MERGE:
+                kind, op = _MERGE[cls]
+                in_types = [itypes[s] for s in srcs]
+                # A spatial Flatten feeding a merge cannot be rewired to
+                # its source: channel-concat of 4D maps is a different
+                # element order than concat of HWC-flattened vectors, and
+                # the downstream Dense kernel permutation is per-branch.
+                # Reject loudly; no-op flattens resolve fine.
+                for s in srcs:
+                    if s in flat_hwc:
+                        raise ValueError(
+                            f"Keras {cls} {name!r} consumes Flatten {s!r} "
+                            f"of a spatial tensor — Flatten-before-merge "
+                            f"topologies are not supported by import")
+                vertex = (ElementWiseVertex(op=op) if kind == "ew"
+                          else MergeVertex())
+                g = g.add_vertex(vname, vertex,
+                                 *[_resolve_alias(built, s) for s in srcs])
+                itypes[vname] = vertex.output_type(in_types)
+                continue
+            if cls not in _MAPPERS:
+                raise ValueError(f"Keras layer {cls} not supported by "
+                                 f"import")
+            # per-branch Flatten permutation: a Dense consuming a flatten
+            # alias permutes with THAT branch's spatial dims
+            ctx.flatten_hwc = flat_hwc.get(srcs[0])
+            layer, setter = _MAPPERS[cls](lc["config"], ctx, src_itype)
+            ctx.flatten_hwc = None
+            if layer is None:            # Flatten: alias to its source
+                itypes[vname] = _flatten_itype(src_itype)
+                if src_itype.kind == "cnn":
+                    c, h, w = src_itype.dims
+                    flat_hwc[vname] = (h, w, c)
+                built[vname] = ("alias", srcs[0], None, name)
+                continue
+            g = g.add_layer(vname, layer, *[_resolve_alias(built, s)
+                                            for s in srcs])
+            itypes[vname] = layer.output_type(_adapt(src_itype, layer))
+            built[vname] = ("layer", layer, setter, name)
     g = g.set_outputs(*[_resolve_alias(built, o) for o in outputs])
     gconf = g.build()
     ctx.cnn_format = gconf.cnn_data_format
     net = ComputationGraph(gconf).init()
     sd = net._sd_train
-    for name, entry in built.items():
+    for vname, entry in built.items():
         if entry[0] == "layer" and entry[2] is not None:
-            weights = archive.layer_weights(name)
+            weights = archive.layer_weights(entry[3])
             if not weights:
-                raise ValueError(f"no weights for Keras layer {name!r}")
-            entry[2](sd, name, weights)   # graph builds: stem = vertex name
+                raise ValueError(f"no weights for Keras layer "
+                                 f"{entry[3]!r}")
+            entry[2](sd, vname, weights)  # graph builds: stem = vertex name
     net._sync_infer()
     return net
 

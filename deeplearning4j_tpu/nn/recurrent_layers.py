@@ -173,3 +173,74 @@ class RnnOutputLayer(BaseLayer):
 for _cls in [SimpleRnnLayer, Bidirectional, LastTimeStepLayer,
              RnnOutputLayer]:
     LAYER_TYPES[_cls.__name__] = _cls
+
+
+@dataclasses.dataclass
+class ConvLSTM2DLayer(BaseLayer):
+    """Convolutional LSTM over image sequences (Shi et al. 2015; the
+    layer Keras calls ConvLSTM2D — reference mapper:
+    modelimport/keras/layers/convolutional/KerasConvLSTM2D.java).
+
+    Input: cnn3d (C, T, H, W) with time as the depth axis; output cnn3d
+    (F, T, H', W') when return_sequences else cnn (F, H', W'). The
+    recurrence is the conv_lstm2d op — one lax.scan, two convs per step.
+    """
+    n_out: int = 0
+    kernel_size: tuple = (3, 3)
+    stride: tuple = (1, 1)
+    convolution_mode: str = "SAME"
+    weight_init: str = "XAVIER"
+    forget_gate_bias_init: float = 1.0
+    return_sequences: bool = True
+    dropout: float = 0.0
+
+    def _spatial_out(self, itype):
+        from deeplearning4j_tpu.nn.layers import _as_pair, _conv_out
+        c, t, h, w = itype.dims
+        kh, kw = _as_pair(self.kernel_size)
+        sh, sw = _as_pair(self.stride)
+        return (_conv_out(h, kh, sh, self.convolution_mode),
+                _conv_out(w, kw, sw, self.convolution_mode))
+
+    def output_type(self, itype):
+        c, t, h, w = itype.dims
+        ho, wo = self._spatial_out(itype)
+        if self.return_sequences:
+            return InputType("cnn3d", (self.n_out, t, ho, wo))
+        return InputType("cnn", (self.n_out, ho, wo))
+
+    def build(self, ctx, x, itype):
+        from deeplearning4j_tpu.nn.layers import _as_pair, _pad_mode
+        if not ctx.cnn_format.endswith("C"):
+            raise ValueError("ConvLSTM2DLayer requires channels-last "
+                             "runtime layout (cnn_format NHWC)")
+        lname = ctx.lname("convlstm")
+        c_in = itype.dims[0]
+        u = self.n_out
+        kh, kw = _as_pair(self.kernel_size)
+        x = _maybe_dropout(ctx, x, self.dropout, lname)
+        w_ih = ctx.param(f"{lname}_Wih", (kh, kw, c_in, 4 * u),
+                         self.weight_init)
+        w_hh = ctx.param(f"{lname}_Whh", (kh, kw, u, 4 * u),
+                         self.weight_init)
+        b0 = np.zeros((4 * u,))
+        b0[u:2 * u] = self.forget_gate_bias_init   # [i, f, g, o]
+        b = ctx.sd.var(f"{lname}_b", value=b0, dtype=ctx.dtype)
+        ho, wo = self._spatial_out(itype)
+        h0 = ctx.sd.invoke("conv_lstm2d_init_state", [x],
+                           {"units": u, "height": ho, "width": wo},
+                           name=f"{lname}_h0")
+        c0 = ctx.sd.invoke("conv_lstm2d_init_state", [x],
+                           {"units": u, "height": ho, "width": wo},
+                           name=f"{lname}_c0")
+        out, hT, cT = ctx.sd.invoke(
+            "conv_lstm2d", [x, h0, c0, w_ih, w_hh, b],
+            {"strides": tuple(_as_pair(self.stride)),
+             "padding": _pad_mode(self.convolution_mode),
+             "return_sequences": self.return_sequences},
+            name=lname, n_outputs=3)
+        result = out if self.return_sequences else hT
+        return result, self.output_type(itype)
+
+
+LAYER_TYPES[ConvLSTM2DLayer.__name__] = ConvLSTM2DLayer

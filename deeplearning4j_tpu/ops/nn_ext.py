@@ -161,3 +161,54 @@ def yolo2_loss(pred, labels, anchors=(), lambda_coord: float = 5.0,
     n = jnp.maximum(jnp.sum(obj_mask), 1.0)
     return (lambda_coord * loss_coord + loss_obj
             + lambda_noobj * loss_noobj + loss_cls) / n
+
+
+# ---------------------------------------------------------------------------
+@op("conv_lstm2d", _N)
+def conv_lstm2d(x, h0, c0, w_ih, w_hh, b, strides=(1, 1),
+                padding: str = "SAME", return_sequences: bool = True):
+    """Convolutional LSTM over an image sequence (reference: the Keras
+    ConvLSTM2D layer the modelimport module maps —
+    keras/layers/convolutional/KerasConvLSTM2D.java; recurrence per
+    Shi et al. 2015). One lax.scan over time; each step computes all four
+    gates with two convolutions (input + recurrent), so the whole layer
+    compiles to a single fused XLA While loop.
+
+    x: (B, T, H, W, Cin) channels-last; h0/c0: (B, H', W', F);
+    w_ih: (kh, kw, Cin, 4F); w_hh: (kh, kw, F, 4F); b: (4F,).
+    Gate order [i, f, g, o] (Keras's i, f, c, o).
+    """
+    xs = jnp.swapaxes(x, 0, 1)                     # (T, B, H, W, C)
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def conv(inp, w, stride, pad):
+        return lax.conv_general_dilated(
+            inp, w, window_strides=tuple(stride), padding=pad,
+            dimension_numbers=dn)
+
+    def step(carry, xt):
+        h, c = carry
+        # the recurrent conv is ALWAYS stride-1 SAME (Keras semantics):
+        # h must keep the spatial shape the input conv produced, under
+        # any input padding/stride
+        z = (conv(xt, w_ih, strides, padding)
+             + conv(h, w_hh, (1, 1), "SAME") + b)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), hs = lax.scan(step, (h0, c0), xs)
+    if return_sequences:
+        return jnp.swapaxes(hs, 0, 1), hT, cT      # (B, T, H', W', F)
+    return hT, hT, cT
+
+
+@op("conv_lstm2d_init_state", _N, n_inputs=1, differentiable=False)
+def conv_lstm2d_init_state(x, units: int, height: int, width: int):
+    """Zero initial state (B, H', W', F) from the (B, T, H, W, C) input."""
+    return jnp.zeros((x.shape[0], height, width, units), x.dtype)

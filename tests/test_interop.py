@@ -1,0 +1,118 @@
+"""Interop runtime tests (reference: nd4j-tensorflow GraphRunnerTest —
+load a foreign graph, feed/fetch by name, persistent session reuse)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.interop import OnnxRuntimeRunner, TorchRunner
+
+
+class _TwoHead(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.lin = torch.nn.Linear(4, 3)
+
+    def forward(self, x):
+        h = self.lin(x)
+        return h, torch.softmax(h, dim=-1)
+
+
+def test_run_module_named_outputs():
+    m = _TwoHead()
+    runner = TorchRunner(m, output_names=["logits", "probs"])
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    out = runner.run({"x": x})
+    assert set(out) == {"logits", "probs"}
+    np.testing.assert_allclose(out["probs"].sum(axis=1), 1.0, rtol=1e-5)
+    # persistent session: second run, same model object
+    out2 = runner.run({"x": x})
+    np.testing.assert_array_equal(out["logits"], out2["logits"])
+
+
+def test_zero_copy_numpy_feed():
+    """numpy feeds share memory with the torch tensor (the zero-copy
+    contract GraphRunner gets from TensorflowConversion)."""
+    from deeplearning4j_tpu.interop.torch_runner import _to_torch
+    a = np.ones((3, 3), np.float32)
+    t = _to_torch(a, torch)
+    t[0, 0] = 42.0
+    assert a[0, 0] == 42.0            # same buffer
+
+
+def test_multi_input_order_and_missing_key():
+    class Add(torch.nn.Module):
+        def forward(self, a, b):
+            return a + 2 * b
+    r = TorchRunner(Add(), input_order=["a", "b"])
+    a = np.full((2, 2), 1.0, np.float32)
+    b = np.full((2, 2), 3.0, np.float32)
+    out = r.run({"a": a, "b": b})
+    np.testing.assert_array_equal(out["output_0"], a + 2 * b)
+    with pytest.raises(KeyError, match="missing"):
+        r.run({"a": a})
+
+
+def test_torchscript_file_roundtrip(tmp_path):
+    m = torch.jit.script(torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2)))
+    p = str(tmp_path / "model.pt")
+    torch.jit.save(m, p)
+    runner = TorchRunner(p)
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    out = runner.run({"x": x})
+    want = m(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(out["output_0"], want, atol=1e-6)
+
+
+def test_jax_array_feed_and_device_fetch():
+    class Neg(torch.nn.Module):
+        def forward(self, x):
+            return -x
+    import jax.numpy as jnp
+    r = TorchRunner(Neg())
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    out = r.run_to_device({"x": x})
+    import jax
+    assert isinstance(out["output_0"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out["output_0"]),
+                                  -np.asarray(x))
+
+
+def test_framework_pipeline_through_foreign_model():
+    """The GraphRunner use case: a foreign torch feature extractor inside
+    a framework training pipeline."""
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        OutputLayer)
+    torch.manual_seed(0)
+    extractor = TorchRunner(torch.nn.Sequential(
+        torch.nn.Linear(8, 6), torch.nn.Tanh()))
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    feats = extractor.run({"x": X})["output_0"]
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.5))
+            .list().layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    h = net.fit(feats, np.eye(2, dtype=np.float32)[y], epochs=10,
+                batch_size=32)
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
+
+
+def test_closed_runner_rejects_and_onnx_gated():
+    r = TorchRunner(torch.nn.Identity())
+    r.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        r.run({"x": np.zeros((1,), np.float32)})
+    try:
+        import onnxruntime  # noqa: F401
+        have_ort = True
+    except ImportError:
+        have_ort = False
+    if not have_ort:
+        with pytest.raises(RuntimeError, match="onnxruntime"):
+            OnnxRuntimeRunner("/nonexistent.onnx")

@@ -1,0 +1,249 @@
+"""Keras import: Conv3D / pooling3D / ConvLSTM2D mappers and shared-layer
+functional graphs, against independent numpy implementations of the Keras
+semantics (fixtures written as legacy-H5 via h5py; TF unavailable here,
+same policy as test_keras_breadth.py)."""
+import json
+import os
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import (
+    import_keras_model_and_weights, import_keras_sequential_model_and_weights)
+
+rng = np.random.RandomState(7)
+
+
+def _write_seq_h5(path, layers, weights):
+    cfg = {"class_name": "Sequential",
+           "config": {"name": "seq",
+                      "layers": [{"class_name": c, "config": k}
+                                 for c, k in layers]}}
+    _write(path, cfg, weights)
+
+
+def _write_func_h5(path, layers, inputs, outputs, weights):
+    """layers: (class_name, config, inbound_nodes) with keras-2 style
+    inbound [[name, node_idx, 0, {}], ...]."""
+    cfg = {"class_name": "Functional",
+           "config": {"name": "func",
+                      "layers": [{"class_name": c, "config": k,
+                                  "name": k["name"], "inbound_nodes": ib}
+                                 for c, k, ib in layers],
+                      "input_layers": [[n, 0, 0] for n in inputs],
+                      "output_layers": [[n, i, 0] for n, i in outputs]}}
+    _write(path, cfg, weights)
+
+
+def _write(path, cfg, weights):
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            names = []
+            for wn, arr in ws:
+                full = f"{lname}/{wn}:0"
+                mw.create_dataset(full, data=np.asarray(arr, np.float32))
+                names.append(full.encode())
+            g.attrs["weight_names"] = names
+
+
+def _np_conv3d_valid(x, w, b):
+    """x (B,D,H,W,Ci), w (kd,kh,kw,Ci,Co) — VALID, stride 1."""
+    B, D, H, W, Ci = x.shape
+    kd, kh, kw, _, Co = w.shape
+    out = np.zeros((B, D - kd + 1, H - kh + 1, W - kw + 1, Co))
+    for d in range(out.shape[1]):
+        for i in range(out.shape[2]):
+            for j in range(out.shape[3]):
+                patch = x[:, d:d + kd, i:i + kh, j:j + kw, :]
+                out[:, d, i, j, :] = np.tensordot(
+                    patch, w, axes=([1, 2, 3, 4], [0, 1, 2, 3]))
+    return out + b
+
+
+def _np_conv2d_same(x, w):
+    """x (B,H,W,Ci), w (kh,kw,Ci,Co) — SAME, stride 1, odd kernels."""
+    kh, kw = w.shape[:2]
+    ph, pw = kh // 2, kw // 2
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    B, H, W, Ci = x.shape
+    out = np.zeros((B, H, W, w.shape[3]))
+    for i in range(H):
+        for j in range(W):
+            patch = xp[:, i:i + kh, j:j + kw, :]
+            out[:, i, j, :] = np.tensordot(
+                patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_conv3d_import_matches_numpy(tmp_path):
+    p = str(tmp_path / "c3d.h5")
+    w = rng.normal(size=(2, 2, 2, 2, 3)).astype(np.float32) * 0.3
+    b = rng.normal(size=(3,)).astype(np.float32) * 0.1
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 3, 5, 5, 2],
+                        "dtype": "float32", "name": "input"}),
+        ("Conv3D", {"name": "c3", "filters": 3, "kernel_size": [2, 2, 2],
+                    "strides": [1, 1, 1], "padding": "valid",
+                    "activation": "linear", "use_bias": True,
+                    "data_format": "channels_last"}),
+    ], {"c3": [("kernel", w), ("bias", b)]})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(2, 3, 5, 5, 2)).astype(np.float32)
+    want = _np_conv3d_valid(x, w, b)
+    got = net.output(x.transpose(0, 4, 1, 2, 3)).to_numpy()   # NCDHW in
+    np.testing.assert_allclose(got, want.transpose(0, 4, 1, 2, 3),
+                               atol=1e-4)
+
+
+def test_pool3d_and_upsampling3d_import(tmp_path):
+    p = str(tmp_path / "p3d.h5")
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 4, 4, 4, 1],
+                        "dtype": "float32", "name": "input"}),
+        ("MaxPooling3D", {"name": "mp", "pool_size": [2, 2, 2],
+                          "strides": [2, 2, 2], "padding": "valid"}),
+        ("UpSampling3D", {"name": "up", "size": [2, 2, 2]}),
+    ], {})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(2, 4, 4, 4, 1)).astype(np.float32)
+    got = net.output(x.transpose(0, 4, 1, 2, 3)).to_numpy()
+    # maxpool 2x2x2 then nearest upsample: every 2-cube holds its max
+    blocks = x.reshape(2, 2, 2, 2, 2, 2, 2, 1).max(axis=(2, 4, 6))
+    want = np.repeat(np.repeat(np.repeat(
+        blocks, 2, axis=1), 2, axis=2), 2, axis=3)
+    np.testing.assert_allclose(got, want.transpose(0, 4, 1, 2, 3),
+                               atol=1e-5)
+
+
+def test_conv_lstm2d_import_matches_numpy(tmp_path):
+    B, T, H, W, Ci, F = 2, 3, 4, 4, 2, 3
+    p = str(tmp_path / "clstm.h5")
+    k = rng.normal(size=(3, 3, Ci, 4 * F)).astype(np.float32) * 0.3
+    rk = rng.normal(size=(3, 3, F, 4 * F)).astype(np.float32) * 0.3
+    b = rng.normal(size=(4 * F,)).astype(np.float32) * 0.1
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, T, H, W, Ci],
+                        "dtype": "float32", "name": "input"}),
+        ("ConvLSTM2D", {"name": "cl", "filters": F,
+                        "kernel_size": [3, 3], "strides": [1, 1],
+                        "padding": "same", "activation": "tanh",
+                        "recurrent_activation": "sigmoid",
+                        "return_sequences": True, "use_bias": True,
+                        "data_format": "channels_last"}),
+    ], {"cl": [("kernel", k), ("recurrent_kernel", rk), ("bias", b)]})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(B, T, H, W, Ci)).astype(np.float32)
+
+    # independent numpy ConvLSTM (keras gate order i, f, c, o)
+    h = np.zeros((B, H, W, F))
+    c = np.zeros((B, H, W, F))
+    outs = []
+    for t in range(T):
+        z = _np_conv2d_same(x[:, t], k) + _np_conv2d_same(h, rk) + b
+        i, f, g, o = np.split(z, 4, axis=-1)
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        h = _sigmoid(o) * np.tanh(c)
+        outs.append(h)
+    want = np.stack(outs, axis=1)                     # (B,T,H,W,F)
+
+    got = net.output(x.transpose(0, 4, 1, 2, 3)).to_numpy()  # NCDHW
+    np.testing.assert_allclose(got, want.transpose(0, 4, 1, 2, 3),
+                               atol=1e-4)
+
+
+def test_shared_layer_functional_import(tmp_path):
+    """One Dense called twice: h1 = d(x); h2 = d(h1); out = h1 + h2.
+    Both call sites must carry the same imported weights."""
+    p = str(tmp_path / "shared.h5")
+    W = rng.normal(size=(6, 6)).astype(np.float32) * 0.4
+    b = rng.normal(size=(6,)).astype(np.float32) * 0.1
+    _write_func_h5(
+        p,
+        [("InputLayer", {"batch_input_shape": [None, 6],
+                         "dtype": "float32", "name": "input"}, []),
+         ("Dense", {"name": "shared", "units": 6, "activation": "relu",
+                    "use_bias": True},
+          [[["input", 0, 0, {}]], [["shared", 0, 0, {}]]]),
+         ("Add", {"name": "add"},
+          [[["shared", 0, 0, {}], ["shared", 1, 0, {}]]])],
+        inputs=["input"], outputs=[("add", 0)],
+        weights={"shared": [("kernel", W), ("bias", b)]})
+    net = import_keras_model_and_weights(p)
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    h1 = np.maximum(x @ W + b, 0)
+    h2 = np.maximum(h1 @ W + b, 0)
+    got = net.output(x)[0].to_numpy()
+    np.testing.assert_allclose(got, h1 + h2, atol=1e-5)
+
+
+def test_shared_layer_into_two_heads(tmp_path):
+    """Shared embedding trunk feeding two inputs (siamese pattern):
+    out = d(x1) - d(x2) via Subtract."""
+    p = str(tmp_path / "siamese.h5")
+    W = rng.normal(size=(5, 4)).astype(np.float32) * 0.4
+    b = np.zeros(4, np.float32)
+    _write_func_h5(
+        p,
+        [("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_a"}, []),
+         ("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_b"}, []),
+         ("Dense", {"name": "emb", "units": 4, "activation": "linear",
+                    "use_bias": True},
+          [[["in_a", 0, 0, {}]], [["in_b", 0, 0, {}]]]),
+         ("Subtract", {"name": "diff"},
+          [[["emb", 0, 0, {}], ["emb", 1, 0, {}]]])],
+        inputs=["in_a", "in_b"], outputs=[("diff", 0)],
+        weights={"emb": [("kernel", W), ("bias", b)]})
+    net = import_keras_model_and_weights(p)
+    xa = rng.normal(size=(3, 5)).astype(np.float32)
+    xb = rng.normal(size=(3, 5)).astype(np.float32)
+    got = net.output(xa, xb)[0].to_numpy()
+    np.testing.assert_allclose(got, xa @ W - xb @ W, atol=1e-5)
+
+
+def test_conv_lstm2d_valid_padding_recurrent_same(tmp_path):
+    """Regression: input conv VALID must not shrink the hidden state —
+    the recurrent conv is always stride-1 SAME."""
+    B, T, H, W, Ci, F = 1, 2, 5, 5, 1, 2
+    p = str(tmp_path / "clstm_valid.h5")
+    k = rng.normal(size=(3, 3, Ci, 4 * F)).astype(np.float32) * 0.3
+    rk = rng.normal(size=(3, 3, F, 4 * F)).astype(np.float32) * 0.3
+    b = np.zeros(4 * F, np.float32)
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, T, H, W, Ci],
+                        "dtype": "float32", "name": "input"}),
+        ("ConvLSTM2D", {"name": "cl", "filters": F,
+                        "kernel_size": [3, 3], "strides": [1, 1],
+                        "padding": "valid", "activation": "tanh",
+                        "recurrent_activation": "sigmoid",
+                        "return_sequences": True, "use_bias": True,
+                        "data_format": "channels_last"}),
+    ], {"cl": [("kernel", k), ("recurrent_kernel", rk), ("bias", b)]})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(B, T, H, W, Ci)).astype(np.float32)
+    got = net.output(x.transpose(0, 4, 1, 2, 3)).to_numpy()
+    assert got.shape == (B, F, T, H - 2, W - 2)
+
+
+def test_conv_lstm2d_rejects_dilation(tmp_path):
+    p = str(tmp_path / "clstm_dil.h5")
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 2, 4, 4, 1],
+                        "dtype": "float32", "name": "input"}),
+        ("ConvLSTM2D", {"name": "cl", "filters": 2, "kernel_size": [3, 3],
+                        "padding": "same", "activation": "tanh",
+                        "recurrent_activation": "sigmoid",
+                        "dilation_rate": [2, 2], "use_bias": True,
+                        "data_format": "channels_last"}),
+    ], {"cl": []})
+    with pytest.raises(ValueError, match="dilation_rate"):
+        import_keras_sequential_model_and_weights(p)
