@@ -42,17 +42,31 @@ class MaxEpochsTerminationCondition:
 
 
 class ScoreImprovementEpochTerminationCondition:
-    """Stop after N epochs without improvement (reference:
-    termination/ScoreImprovementEpochTerminationCondition)."""
+    """Stop after N epochs without improvement of at least
+    ``min_improvement`` (reference:
+    termination/ScoreImprovementEpochTerminationCondition — improvement
+    counts only when best - score >= minImprovement)."""
 
     def __init__(self, max_epochs_without_improvement: int,
                  min_improvement: float = 0.0):
         self.patience = int(max_epochs_without_improvement)
         self.min_improvement = min_improvement
+        self.initialize()
+
+    def initialize(self):
         self._since_best = 0
+        self._best = None
 
     def terminate(self, epoch: int, score: float, improved: bool) -> bool:
-        self._since_best = 0 if improved else self._since_best + 1
+        # strict >: an unchanged score is NOT improvement (reference
+        # ScoreImprovementEpochTerminationCondition.java:62-64)
+        if self._best is None or \
+                self._best - score > self.min_improvement:
+            self._best = score if self._best is None \
+                else min(self._best, score)
+            self._since_best = 0
+        else:
+            self._since_best += 1
         return self._since_best > self.patience
 
     def __repr__(self):
@@ -354,11 +368,12 @@ class EarlyStoppingTrainer:
 
     def fit(self, max_epochs: int = 1000) -> EarlyStoppingResult:
         cfg = self.config
-        for c in cfg.iteration_conditions:
+        for c in list(cfg.iteration_conditions) + list(cfg.epoch_conditions):
             if hasattr(c, "initialize"):
                 c.initialize()
         best_score = float("inf")
         best_epoch = -1
+        last_score = None
         score_by_epoch: Dict[int, float] = {}
         reason, details = EarlyStoppingResult.MAX_EPOCHS, \
             f"no termination condition fired in {max_epochs} epochs"
@@ -381,7 +396,14 @@ class EarlyStoppingTrainer:
                 score_by_epoch[epoch] = train_loss
                 break
 
-            if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+            # scoring + best-model tracking on the evaluation cadence;
+            # epoch conditions are checked EVERY epoch (a MaxEpochs limit
+            # must not overshoot because evaluation is sparse) with the
+            # most recent score. Score-improvement counting only advances
+            # on epochs that produced a fresh score.
+            scored = (epoch + 1) % cfg.evaluate_every_n_epochs == 0
+            improved = False
+            if scored:
                 if cfg.score_calculator is not None and not isinstance(
                         cfg.score_calculator, TrainingLossCalculator):
                     score = cfg.score_calculator.calculate_score(self.model)
@@ -393,15 +415,20 @@ class EarlyStoppingTrainer:
                     best_score = score
                     best_epoch = epoch
                     cfg.model_saver.save_best(self.model, epoch, score)
-                fired = None
-                for c in cfg.epoch_conditions:
-                    if c.terminate(epoch, score, improved):
-                        fired = c
-                        break
-                if fired is not None:
-                    reason = EarlyStoppingResult.EPOCH_TERMINATION
-                    details = repr(fired)
+                last_score = score
+            score = last_score if last_score is not None else train_loss
+            fired = None
+            for c in cfg.epoch_conditions:
+                if isinstance(c, ScoreImprovementEpochTerminationCondition) \
+                        and not scored:
+                    continue
+                if c.terminate(epoch, score, improved):
+                    fired = c
                     break
+            if fired is not None:
+                reason = EarlyStoppingResult.EPOCH_TERMINATION
+                details = repr(fired)
+                break
 
         if cfg.save_last_model and epoch >= 0:
             # reference: saver.saveLatestModel — persisted BEFORE the

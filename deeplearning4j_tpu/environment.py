@@ -30,6 +30,9 @@ class PropertySpec:
     type: Callable
     default: Any
     description: str
+    # read once by JAX/XLA at backend initialization; a set() after that
+    # point cannot affect the running process
+    startup_only: bool = False
 
 
 # The documented property catalog (reference: ND4JSystemProperties.java —
@@ -60,18 +63,21 @@ PROPERTIES: Dict[str, PropertySpec] = {
     "mem_fraction": PropertySpec(
         "XLA_PYTHON_CLIENT_MEM_FRACTION", float, 0.75,
         "Fraction of device HBM the XLA client may preallocate (the "
-        "workspace-size analogue; read by JAX at process start)."),
+        "workspace-size analogue; read by JAX at process start).",
+        startup_only=True),
     "preallocate": PropertySpec(
         "XLA_PYTHON_CLIENT_PREALLOCATE", _as_bool, True,
-        "Whether the XLA client preallocates the memory pool at startup."),
+        "Whether the XLA client preallocates the memory pool at startup.",
+        startup_only=True),
     "compilation_cache_dir": PropertySpec(
         "JAX_COMPILATION_CACHE_DIR", str, "",
         "Persistent XLA compilation cache directory (first-compile "
-        "latency amortization across processes)."),
+        "latency amortization across processes).", startup_only=True),
     "host_device_count": PropertySpec(
         "DL4J_TPU_HOST_DEVICES", int, 0,
         "Virtual CPU device count for mesh testing (0 = leave XLA_FLAGS "
-        "alone); mirrors --xla_force_host_platform_device_count."),
+        "alone); mirrors --xla_force_host_platform_device_count.",
+        startup_only=True),
 }
 
 
@@ -112,7 +118,26 @@ class Environment:
     def set(self, name: str, value) -> "Environment":
         if name not in PROPERTIES:
             raise KeyError(f"unknown property {name!r}")
-        self._overrides[name] = PROPERTIES[name].type(value)
+        spec = PROPERTIES[name]
+        if spec.startup_only:
+            # startup-only properties are read by JAX/XLA at backend init:
+            # write the env var (effective before init and for child
+            # processes), and refuse to pretend it changed a live backend
+            os.environ[spec.key] = str(value)
+            try:
+                import jax._src.xla_bridge as _xb
+                backend_up = bool(getattr(_xb, "_backends", None))
+            except Exception:
+                backend_up = True      # unknown -> assume live, warn
+            if backend_up:
+                import warnings
+                warnings.warn(
+                    f"property {name!r} (${spec.key}) is read at backend "
+                    f"initialization; the running process keeps its "
+                    f"current value — the setting applies to child "
+                    f"processes / the next start", stacklevel=2)
+            return self
+        self._overrides[name] = spec.type(value)
         self._apply_side_effects(name)
         return self
 

@@ -79,6 +79,14 @@ def has_op(name: str) -> bool:
     return name in _REGISTRY
 
 
+def add_alias(alias: str, target: str) -> None:
+    """Register an additional reference/TF name for an existing op
+    (the reference declares several ops under legacy + new names)."""
+    if alias in _REGISTRY:
+        raise ValueError(f"duplicate op alias: {alias}")
+    _REGISTRY[alias] = _REGISTRY[target]
+
+
 def op_names() -> List[str]:
     _ensure_loaded()
     return sorted({o.name for o in _REGISTRY.values()})
@@ -123,3 +131,6 @@ def _ensure_loaded() -> None:
         shape_ops, random as _random, linalg, nlp_ops, nn_ops, nn_ext, loss,
         bitwise, image, tf_compat,
     )
+    # breadth2 last: its reference-name aliases point at ops the modules
+    # above register
+    from deeplearning4j_tpu.ops import breadth2  # noqa: F401

@@ -221,9 +221,14 @@ def ones_like(x):
 
 
 @op("eye_op", _S, differentiable=False)
-def eye_op(rows: int, cols: int = None, dtype: str = "float32"):
+def eye_op(rows: int, cols: int = None, dtype: str = "float32",
+           batch_shape=()):
+    """(reference: parity_ops/eye.cpp — optional leading batch dims)"""
     from deeplearning4j_tpu.ndarray.dtype import DataType
-    return jnp.eye(rows, cols, dtype=DataType.from_any(dtype).jnp)
+    m = jnp.eye(rows, cols, dtype=DataType.from_any(dtype).jnp)
+    if batch_shape:
+        m = jnp.broadcast_to(m, tuple(batch_shape) + m.shape)
+    return m
 
 
 @op("range_op", _S, differentiable=False, aliases=("arange",))
@@ -236,8 +241,10 @@ def range_op(start, limit=None, delta=1, dtype: str = None):
 
 
 @op("linspace_op", _S, differentiable=False)
-def linspace_op(start, stop, num: int):
-    return jnp.linspace(start, stop, num)
+def linspace_op(start, stop, num: int, dtype: str = None):
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    dt = DataType.from_any(dtype).jnp if dtype else None
+    return jnp.linspace(start, stop, int(num), dtype=dt)
 
 
 @op("meshgrid", _S)
@@ -251,8 +258,18 @@ def broadcast_to(x, shape):
 
 
 @op("where_op", _S, aliases=("select",))
-def where_op(cond, x, y):
-    return jnp.where(cond, x, y)
+def where_op(cond, x=None, y=None):
+    """3-input form = select; 1-input form returns coordinates of true
+    elements (reference: parity_ops/where.cpp / where_np.cpp) — a
+    data-dependent shape, so that form executes eagerly like `unique`."""
+    if x is not None:
+        return jnp.where(cond, x, y)
+    if isinstance(cond, jax.core.Tracer):
+        raise ValueError(
+            "where(condition) has a data-dependent output shape and "
+            "cannot run under jit; use where(cond, x, y) or run eagerly")
+    import numpy as _np
+    return jnp.asarray(_np.argwhere(_np.asarray(cond)))
 
 
 @op("one_hot", _S, n_inputs=1, differentiable=False, aliases=("onehot",))

@@ -247,3 +247,22 @@ def test_conv_lstm2d_rejects_dilation(tmp_path):
     ], {"cl": []})
     with pytest.raises(ValueError, match="dilation_rate"):
         import_keras_sequential_model_and_weights(p)
+
+
+def test_conv_lstm2d_op_direct():
+    """Direct op-level exercise of conv_lstm2d + conv_lstm2d_init_state
+    (the golden numerics above go through the layer; this pins the op
+    names the ledger's EXERCISED pointers reference)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import registry
+    clstm = registry.get_op("conv_lstm2d").fn
+    init = registry.get_op("conv_lstm2d_init_state").fn
+    x = jnp.asarray(rng.normal(size=(2, 3, 4, 4, 1)), jnp.float32)
+    h0 = init(x, units=2, height=4, width=4)
+    assert h0.shape == (2, 4, 4, 2)
+    out, hT, cT = clstm(x, h0, h0,
+                        jnp.ones((3, 3, 1, 8), jnp.float32) * 0.1,
+                        jnp.ones((3, 3, 2, 8), jnp.float32) * 0.1,
+                        jnp.zeros(8, jnp.float32))
+    assert out.shape == (2, 3, 4, 4, 2) and hT.shape == (2, 4, 4, 2)
+    np.testing.assert_allclose(np.asarray(out[:, -1]), np.asarray(hT))

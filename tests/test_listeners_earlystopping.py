@@ -11,6 +11,8 @@ from deeplearning4j_tpu.autodiff import (
     MaxEpochsTerminationCondition, MaxScoreTerminationCondition,
     MaxTimeTerminationCondition, ScoreImprovementEpochTerminationCondition,
     SleepyListener, TimeIterationListener)
+import jax
+jax.config.update("jax_platforms", "cpu")
 from deeplearning4j_tpu.dataset import ArrayDataSetIterator
 from deeplearning4j_tpu.learning.updaters import Adam, Sgd
 from deeplearning4j_tpu.nn import (
@@ -242,3 +244,52 @@ def test_environment_debug_enables_nan_check_at_fit_time():
             net.fit(X, Y, epochs=30, batch_size=96)
     finally:
         environment().reset("debug")
+
+
+def test_max_epochs_fires_despite_sparse_evaluation():
+    """Regression: epoch conditions are checked every epoch, not only on
+    the evaluate_every_n_epochs cadence."""
+    net = _toy_net()
+    X, Y = _toy_data()
+    it = ArrayDataSetIterator(X, Y, batch_size=32)
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .evaluate_every_n_epochs(5).build())
+    res = EarlyStoppingTrainer(cfg, net, it).fit(max_epochs=50)
+    assert res.total_epochs == 3
+
+
+def test_score_improvement_min_threshold_and_reuse():
+    """Regression: min_improvement gates what counts as improvement, and
+    the condition resets between fit() calls."""
+    cond = ScoreImprovementEpochTerminationCondition(2, min_improvement=0.1)
+    cond.initialize()
+    assert cond.terminate(0, 1.00, True) is False   # first score = best
+    assert cond.terminate(1, 0.99, True) is False   # +1 (not >0.1 better)
+    assert cond.terminate(2, 0.98, True) is False   # +2
+    assert cond.terminate(3, 0.97, True) is True    # patience exceeded
+    cond.initialize()                               # fresh fit
+    assert cond.terminate(0, 5.0, True) is False    # streak reset
+    # a REAL improvement (>0.1) resets the streak
+    assert cond.terminate(1, 4.99, True) is False   # +1
+    assert cond.terminate(2, 4.0, True) is False    # resets (1.0 > 0.1)
+    assert cond.terminate(3, 3.99, True) is False   # +1 again
+
+
+def test_startup_only_env_property_warns_and_sets_envvar():
+    import os
+    import warnings
+    from deeplearning4j_tpu import environment
+    env = environment()
+    saved = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            env.set("mem_fraction", 0.5)     # backend already initialized
+        assert any("backend initialization" in str(x.message) for x in w)
+        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+    finally:
+        if saved is None:
+            os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+        else:
+            os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = saved

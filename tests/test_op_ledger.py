@@ -628,8 +628,164 @@ EXERCISED = {    # nn ops — test_nn / test_layer_breadth / test_layers_ext / t
     "tf_reshape": "test_registry_coverage", 
     "tf_reduce": "test_registry_coverage",
     "tf_gather": "test_registry_coverage",
+    # conv_lstm2d: golden numerics vs independent numpy ConvLSTM in
+    # test_keras_3d_shared; init_state is its shape helper
+    "conv_lstm2d": "test_keras_3d_shared",
+    "conv_lstm2d_init_state": "test_keras_3d_shared",
 }
 
+
+def _np_sru(x, c0, w, b):
+    """Numpy SRU reference (Lei et al. 2018) for the ledger."""
+    d = x.shape[-1]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    c = c0.copy()
+    outs = []
+    for t in range(x.shape[1]):
+        z = x[:, t] @ w
+        xt, zf, zr = z[:, :d], z[:, d:2 * d], z[:, 2 * d:]
+        f = sig(zf + b[:d])
+        r = sig(zr + b[d:])
+        c = f * c + (1 - f) * xt
+        outs.append(r * np.tanh(c) + (1 - r) * x[:, t])
+    return np.stack(outs, 1), c
+
+
+def _np_rnn(x, h0, w, u, b):
+    h = h0.copy()
+    outs = []
+    for t in range(x.shape[1]):
+        h = np.tanh(x[:, t] @ w + h @ u + b)
+        outs.append(h)
+    return np.stack(outs, 1), h
+
+
+_SEQ = R.randn(2, 3, 4).astype(np.float64) * 0.5
+_C0 = np.zeros((2, 4))
+_WSRU = R.randn(4, 12).astype(np.float64) * 0.4
+_BSRU = R.randn(8).astype(np.float64) * 0.1
+_WR = R.randn(4, 4) * 0.4
+_UR = R.randn(4, 4) * 0.4
+_BR = R.randn(4) * 0.1
+_LOGITS = R.randn(3, 5) * 2.0
+_ONEHOT = np.eye(5)[R.randint(0, 5, 3)]
+_CLS = R.randint(0, 5, 3).astype(np.int64)
+_GEMM_A = R.randn(2, 3, 4) * 0.5
+_GEMM_B = R.randn(2, 4, 5) * 0.5
+_GEMM_C = R.randn(2, 3, 5) * 0.5
+_LSQ_A = R.randn(5, 3) + np.eye(5, 3) * 3.0   # well-conditioned
+_LSQ_B = R.randn(5, 2)
+_BITS = R.randn(2, 16)
+
+
+def _np_softmax_xent(logits, labels):
+    m = logits - logits.max(-1, keepdims=True)
+    logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+    return -(labels * logp).sum(-1)
+
+
+LEDGER.update({
+    # --- breadth2: creation / shape tail ---------------------------------
+    "eye": spec([], lambda: np.eye(3, 5), attrs={"rows": 3, "cols": 5}),
+    "range": spec([], lambda: np.arange(2, 10, 2),
+                  attrs={"start": 2, "limit": 10, "delta": 2}),
+    "lin_space": spec([], lambda: np.linspace(0.0, 1.0, 5,
+                                              dtype=np.float32),
+                      attrs={"start": 0.0, "stop": 1.0, "num": 5}),
+    "create": spec([], lambda: np.zeros((2, 3), np.float32),
+                   attrs={"shape": (2, 3)}),
+    "ones_as": spec([A], np.ones_like),
+    "zeros_as": spec([A], np.zeros_like),
+    "fill_as": spec([A], lambda x: np.full_like(x, 2.5),
+                    attrs={"value": 2.5}),
+    "reshapeas": spec([A, A.reshape(4, 3)],
+                      lambda x, y: x.reshape(4, 3)),
+    "assign": spec([A, B_], lambda x, y: y, grad=False),
+    "size_at": spec([A], lambda x: np.int64(4), attrs={"dim": 1}),
+    "shapes_of": spec([A], lambda x: np.asarray([3, 4], np.int64)),
+    "set_shape": spec([A], lambda x: x.reshape(2, 6),
+                      attrs={"shape": (2, 6)}),
+    "broadcast_dynamic_shape": spec(
+        [np.asarray([3, 1]), np.asarray([1, 4])],
+        lambda a, b: np.asarray([3, 4], np.int64)),
+    "noop": spec([A], lambda x: np.int32(0)),
+    "expose": spec([A], lambda x: x, grad=True),
+    "where": spec([BOOL, A, B_], lambda c, x, y: np.where(c, x, y)),
+    "unique_with_counts": spec(
+        [I1.ravel()],
+        lambda x: np.unique(x, return_inverse=True, return_counts=True)),
+    # --- breadth2: scalar comparisons ------------------------------------
+    "eq_scalar": spec([I1], lambda x: x == 2, attrs={"scalar": 2}),
+    "neq_scalar": spec([I1], lambda x: x != 2, attrs={"scalar": 2}),
+    "gt_scalar": spec([A], lambda x: x > 0.1, attrs={"scalar": 0.1}),
+    "gte_scalar": spec([I1], lambda x: x >= 2, attrs={"scalar": 2}),
+    "lt_scalar": spec([A], lambda x: x < 0.1, attrs={"scalar": 0.1}),
+    "lte_scalar": spec([I1], lambda x: x <= 2, attrs={"scalar": 2}),
+    # --- breadth2: math tail ---------------------------------------------
+    "reversemod": spec([I2, I1], lambda x, y: np.mod(y, x)),
+    "compare_and_bitpack": spec(
+        [_BITS], lambda x: np.packbits((x > 0.0), axis=-1)),
+    "clipbyavgnorm": spec(
+        [A], lambda x: x * min(1.0, 0.05 / (np.linalg.norm(x) / x.size)),
+        attrs={"clip_norm": 0.05}, grad=True),
+    "check_numerics": spec([A], lambda x: x, grad=True),
+    "is_numeric_tensor": spec([A], lambda x: np.bool_(True)),
+    # --- breadth2: recurrent ---------------------------------------------
+    "sru_cell": spec(
+        [_SEQ[:, 0], _C0, _WSRU, _BSRU],
+        lambda x, c, w, b: tuple(
+            a[:, 0] if a.ndim == 3 else a
+            for a in _np_sru(x[:, None], c, w, b)), rtol=1e-6),
+    "sru": spec([_SEQ, _C0, _WSRU, _BSRU], _np_sru, rtol=1e-6),
+    "sru_bi": spec(
+        [_SEQ, _C0, _C0, _WSRU, _BSRU, _WSRU, _BSRU],
+        lambda x, cf, cb, wf, bf, wb, bb: (
+            np.concatenate([_np_sru(x, cf, wf, bf)[0],
+                            _np_sru(x[:, ::-1], cb, wb, bb)[0][:, ::-1]],
+                           axis=-1),
+            _np_sru(x, cf, wf, bf)[1],
+            _np_sru(x[:, ::-1], cb, wb, bb)[1]), rtol=1e-6),
+    "static_rnn": spec([_SEQ, _C0, _WR, _UR, _BR], _np_rnn, rtol=1e-6),
+    "dynamic_rnn": spec(
+        [_SEQ, _C0, _WR, _UR, _BR, np.asarray([2, 3])],
+        lambda x, h, w, u, b, sl: (
+            _np_rnn(x, h, w, u, b)[0]
+            * (np.arange(3)[None, :] < sl[:, None])[..., None],
+            np.stack([_np_rnn(x, h, w, u, b)[0][i, sl[i] - 1]
+                      for i in range(2)])), rtol=1e-6),
+    "static_bidirectional_rnn": spec(
+        [_SEQ, _C0, _C0, _WR, _UR, _BR, _WR, _UR, _BR],
+        lambda x, hf, hb, wf, uf, bf, wb, ub, bb: (
+            np.concatenate([_np_rnn(x, hf, wf, uf, bf)[0],
+                            _np_rnn(x[:, ::-1], hb, wb, ub, bb)[0][:, ::-1]],
+                           axis=-1),
+            _np_rnn(x, hf, wf, uf, bf)[1],
+            _np_rnn(x[:, ::-1], hb, wb, ub, bb)[1]), rtol=1e-6),
+    # full-length case: equals static; the masked path is covered by the
+    # dynamic_rnn entry above (same masking code path)
+    "dynamic_bidirectional_rnn": spec(
+        [_SEQ, _C0, _C0, _WR, _UR, _BR, _WR, _UR, _BR],
+        lambda x, hf, hb, wf, uf, bf, wb, ub, bb: (
+            np.concatenate([_np_rnn(x, hf, wf, uf, bf)[0],
+                            _np_rnn(x[:, ::-1], hb, wb, ub, bb)[0][:, ::-1]],
+                           axis=-1),
+            _np_rnn(x, hf, wf, uf, bf)[1],
+            _np_rnn(x[:, ::-1], hb, wb, ub, bb)[1]), rtol=1e-6),
+    # --- breadth2: losses -------------------------------------------------
+    "softmax_cross_entropy_loss_with_logits": spec(
+        [_LOGITS, _ONEHOT], _np_softmax_xent, grad=True, rtol=1e-6),
+    "sparse_softmax_cross_entropy_loss_with_logits": spec(
+        [_CLS, _LOGITS],
+        lambda y, lg: _np_softmax_xent(lg, np.eye(5)[y]), rtol=1e-6),
+    # --- breadth2: linalg -------------------------------------------------
+    "batched_gemm": spec(
+        [_GEMM_A, _GEMM_B, _GEMM_C],
+        lambda a, b, c: 2.0 * np.matmul(a, b) + 0.5 * c,
+        attrs={"alpha": 2.0, "beta": 0.5}, rtol=1e-6),
+    "solve_ls": spec(
+        [_LSQ_A, _LSQ_B],
+        lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], rtol=1e-4),
+})
 
 
 # ops exercised HERE with invariant/shape checks (conv/rnn/random/structural
@@ -856,6 +1012,37 @@ SMOKE = {
         np.isfinite(np.asarray(o)).all()
         for o in f(IMG_N, np.ones(3, np.float32), np.zeros(3, np.float32),
                    np.zeros(3, np.float32), np.ones(3, np.float32))),
+    # --- breadth2 nn/image tail ------------------------------------------
+    "pointwise_conv2d": lambda f: np.allclose(
+        np.asarray(f(IMG_N, np.ones((1, 1, 3, 2), np.float32))),
+        IMG_N.sum(-1, keepdims=True).repeat(2, -1)),
+    "sep_conv2d": lambda f: f(
+        IMG_N, np.ones((3, 3, 3, 2), np.float32),
+        np.ones((1, 1, 6, 4), np.float32)).shape == (2, 5, 5, 4),
+    "deconv3d": lambda f: f(
+        np.ones((1, 2, 2, 2, 3), np.float32),
+        np.ones((2, 2, 2, 4, 3), np.float32),
+        strides=(2, 2, 2)).shape == (1, 4, 4, 4, 4),
+    "max_pool_with_argmax": lambda f: (
+        np.asarray(f(np.arange(16.0).reshape(1, 4, 4, 1))[0]).ravel()
+        .tolist() == [5.0, 7.0, 13.0, 15.0]
+        and np.asarray(f(np.arange(16.0).reshape(1, 4, 4, 1))[1]).ravel()
+        .tolist() == [5, 7, 13, 15]),
+    "pnormpool2d": lambda f: np.allclose(
+        np.asarray(f(np.ones((1, 4, 4, 1), np.float32), p=2.0)),
+        2.0),     # sqrt(4 ones) per 2x2 window
+    "fused_batch_norm": lambda f: (
+        np.allclose(np.asarray(f(IMG_N, np.ones(3, np.float32),
+                                 np.zeros(3, np.float32))[1]),
+                    IMG_N.mean((0, 1, 2)), rtol=1e-5)
+        and np.allclose(np.asarray(f(IMG_N, np.ones(3, np.float32),
+                                     np.zeros(3, np.float32))[0])
+                        .mean((0, 1, 2)), 0.0, atol=1e-5)),
+    "non_max_suppression_overlaps": lambda f: (
+        np.asarray(f(np.eye(3), np.asarray([0.9, 0.8, 0.7]), 3,
+                     overlap_threshold=0.5)[0]).tolist() == [0, 1, 2]),
+    "print_variable": lambda f: np.allclose(
+        np.asarray(f(np.ones(3, np.float32))), 1.0),
 }
 
 
