@@ -31,6 +31,8 @@ import numpy as np
 class MaxEpochsTerminationCondition:
     """(reference: termination/MaxEpochsTerminationCondition)"""
 
+    uses_score = False       # epoch-count only; safe on any cadence
+
     def __init__(self, max_epochs: int):
         self.max_epochs = int(max_epochs)
 
@@ -42,10 +44,15 @@ class MaxEpochsTerminationCondition:
 
 
 class ScoreImprovementEpochTerminationCondition:
-    """Stop after N epochs without improvement of at least
+    """Stop after N epochs without improvement of MORE than
     ``min_improvement`` (reference:
-    termination/ScoreImprovementEpochTerminationCondition — improvement
-    counts only when best - score >= minImprovement)."""
+    termination/ScoreImprovementEpochTerminationCondition.java:62-64 —
+    improvement counts only when best - score is strictly greater than
+    minImprovement; an unchanged score is not improvement)."""
+
+    #: the internal streak counter must only advance on epochs that
+    #: produced a fresh score — the trainer skips it otherwise
+    requires_fresh_score = True
 
     def __init__(self, max_epochs_without_improvement: int,
                  min_improvement: float = 0.0):
@@ -417,11 +424,18 @@ class EarlyStoppingTrainer:
                     cfg.model_saver.save_best(self.model, epoch, score)
                 last_score = score
             score = last_score if last_score is not None else train_loss
+            # is `score` the configured metric, or a train-loss stand-in
+            # because the calculator hasn't run yet?
+            score_is_real = (scored or last_score is not None
+                             or cfg.score_calculator is None
+                             or isinstance(cfg.score_calculator,
+                                           TrainingLossCalculator))
             fired = None
             for c in cfg.epoch_conditions:
-                if isinstance(c, ScoreImprovementEpochTerminationCondition) \
-                        and not scored:
-                    continue
+                if getattr(c, "requires_fresh_score", False) and not scored:
+                    continue           # streak counters only see new scores
+                if getattr(c, "uses_score", True) and not score_is_real:
+                    continue           # never judge thresholds on stand-ins
                 if c.terminate(epoch, score, improved):
                     fired = c
                     break

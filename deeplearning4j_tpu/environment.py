@@ -92,6 +92,9 @@ class Environment:
 
     def __init__(self):
         self._overrides: Dict[str, Any] = {}
+        # original env-var values before startup_only set()s, so reset()
+        # can restore the documented 'set > env > default' resolution
+        self._env_saved: Dict[str, Optional[str]] = {}
 
     @classmethod
     def get_instance(cls) -> "Environment":
@@ -122,8 +125,11 @@ class Environment:
         if spec.startup_only:
             # startup-only properties are read by JAX/XLA at backend init:
             # write the env var (effective before init and for child
-            # processes), and refuse to pretend it changed a live backend
-            os.environ[spec.key] = str(value)
+            # processes), and refuse to pretend it changed a live backend.
+            # Validate/coerce through spec.type like every other property.
+            if spec.key not in self._env_saved:
+                self._env_saved[spec.key] = os.environ.get(spec.key)
+            os.environ[spec.key] = str(spec.type(value))
             try:
                 import jax._src.xla_bridge as _xb
                 backend_up = bool(getattr(_xb, "_backends", None))
@@ -142,10 +148,22 @@ class Environment:
         return self
 
     def reset(self, name: Optional[str] = None) -> "Environment":
+        def _restore_env(key):
+            if key in self._env_saved:
+                old = self._env_saved.pop(key)
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
+
         if name is None:
             self._overrides.clear()
+            for key in list(self._env_saved):
+                _restore_env(key)
         else:
             self._overrides.pop(name, None)
+            if name in PROPERTIES:
+                _restore_env(PROPERTIES[name].key)
         return self
 
     def _apply_side_effects(self, name: str) -> None:

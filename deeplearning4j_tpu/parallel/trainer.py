@@ -240,20 +240,24 @@ class BatchedParallelInference:
                 rows += nxt[0].shape[0]
             try:
                 X = np.concatenate([b[0] for b in batch], axis=0)
-                # pad to the FIXED max_batch_size (divisible by any mesh
-                # batch axes): every dispatch shares one compiled shape —
-                # per-row-count shapes would recompile on the serving hot
-                # path
+                # EVERY dispatch is exactly max_batch_size rows: requests
+                # larger than the cap (or coalescing overshoot) are sliced
+                # into max-sized dispatches, and the tail pads up — so the
+                # serving hot path only ever sees ONE compiled shape
+                # (per-row-count or multiple-of-max shapes would recompile)
                 n_real = X.shape[0]
-                target = -(-n_real // self.max_batch_size) \
-                    * self.max_batch_size
-                if n_real < target:
-                    X = np.concatenate(
-                        [X, np.repeat(X[-1:], target - n_real, 0)], 0)
-                out = self._inner.output(X)
-                out = out[0] if isinstance(out, list) else out
-                arr = np.asarray(out.data)[:n_real]
-                self.batches_dispatched += 1
+                m = self.max_batch_size
+                outs = []
+                for start in range(0, n_real, m):
+                    sl = X[start:start + m]
+                    if sl.shape[0] < m:
+                        sl = np.concatenate(
+                            [sl, np.repeat(sl[-1:], m - sl.shape[0], 0)], 0)
+                    out = self._inner.output(sl)
+                    out = out[0] if isinstance(out, list) else out
+                    outs.append(np.asarray(out.data))
+                    self.batches_dispatched += 1
+                arr = np.concatenate(outs, axis=0)[:n_real]
                 off = 0
                 for feats, fut in batch:
                     n = feats.shape[0]

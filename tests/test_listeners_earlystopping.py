@@ -11,8 +11,6 @@ from deeplearning4j_tpu.autodiff import (
     MaxEpochsTerminationCondition, MaxScoreTerminationCondition,
     MaxTimeTerminationCondition, ScoreImprovementEpochTerminationCondition,
     SleepyListener, TimeIterationListener)
-import jax
-jax.config.update("jax_platforms", "cpu")
 from deeplearning4j_tpu.dataset import ArrayDataSetIterator
 from deeplearning4j_tpu.learning.updaters import Adam, Sgd
 from deeplearning4j_tpu.nn import (
@@ -288,6 +286,49 @@ def test_startup_only_env_property_warns_and_sets_envvar():
             env.set("mem_fraction", 0.5)     # backend already initialized
         assert any("backend initialization" in str(x.message) for x in w)
         assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+    finally:
+        if saved is None:
+            os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+        else:
+            os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = saved
+
+
+def test_best_score_condition_never_judges_trainloss_standin():
+    """Regression: before the first score-calculator run, threshold
+    conditions must not fire on the train-loss stand-in."""
+    net = _toy_net(lr=0.3)
+    X, Y = _toy_data()
+    train = ArrayDataSetIterator(X, Y, batch_size=32)
+    hold = ArrayDataSetIterator(X[:32], Y[:32], batch_size=32,
+                                shuffle=False)
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(
+               MaxEpochsTerminationCondition(6),
+               # target below any plausible loss: would fire instantly if
+               # judged against the train-loss stand-in at epochs 0-3
+               BestScoreEpochTerminationCondition(-1.0))
+           .score_calculator(DataSetLossCalculator(hold))
+           .evaluate_every_n_epochs(5).build())
+    res = EarlyStoppingTrainer(cfg, net, train).fit(max_epochs=6)
+    # MaxEpochs(6) terminates; BestScore(-1.0) never fires
+    assert res.total_epochs == 6
+    assert "MaxEpochs" in res.termination_details
+
+
+def test_environment_reset_restores_startup_only_envvar():
+    import os
+    import warnings
+    from deeplearning4j_tpu import environment
+    env = environment()
+    saved = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            env.set("mem_fraction", 0.5)
+            with pytest.raises(ValueError):
+                env.set("mem_fraction", "abc")   # validated like others
+        env.reset("mem_fraction")
+        assert os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION") == saved
     finally:
         if saved is None:
             os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
