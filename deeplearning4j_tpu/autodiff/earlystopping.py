@@ -100,6 +100,8 @@ class MaxTimeTerminationCondition:
     """Wall-clock budget (reference:
     termination/MaxTimeIterationTerminationCondition)."""
 
+    uses_score = False       # wall-clock only; judged on every epoch
+
     def __init__(self, max_seconds: float):
         self.max_seconds = max_seconds
         self._start = None

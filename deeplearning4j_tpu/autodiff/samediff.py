@@ -895,18 +895,33 @@ class SameDiff:
     def fit(self, dataset_iterator, epochs: int = 1, listeners=()):
         """Train (reference: SameDiff.fit(DataSetIterator, epochs),
         SameDiff.java:1833). ``dataset_iterator`` yields objects with
-        ``features``/``labels`` (DataSet) or (features, labels) tuples."""
+        ``features``/``labels`` (DataSet) or (features, labels) tuples.
+
+        TWO execution tiers (this is a documented contract, not an
+        internal detail):
+
+        - **scanned fast path** — zero listeners AND an iterator exposing
+          ``stacked_batches`` (``DeviceCachedIterator``): the whole epoch
+          compiles to ONE lax.scan dispatch. Use this for benchmarking
+          and small models, where per-step dispatch latency dominates.
+        - **per-step path** — any listeners, or a host-streaming
+          iterator: one dispatch per step with burst loss delivery.
+          Expect ~ms-scale extra latency per step on a tunneled chip.
+
+        Environment verbose mode announces which tier each fit() took.
+        """
         from deeplearning4j_tpu.autodiff.training import History, LossCurve
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
-        # scan fast path: when no listeners need per-iteration scalars and
-        # the iterator exposes device-stacked batches, run the WHOLE epoch
-        # as one compiled lax.scan — one dispatch per epoch instead of one
-        # per step (the per-step dispatch latency dominates small models
-        # on a tunneled chip)
         if not listeners and hasattr(dataset_iterator, "stacked_batches"):
+            self._verbose_log("fit: scanned whole-epoch path "
+                              "(one dispatch per epoch)")
             return self._fit_scanned(dataset_iterator, epochs)
+        why = ("listeners need per-iteration scalars" if listeners
+               else "iterator has no stacked_batches (use "
+                    "DeviceCachedIterator for the scanned path)")
+        self._verbose_log(f"fit: per-step path — {why}")
         step = self.make_train_step()
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training

@@ -76,6 +76,18 @@ class CSVRecordReader(RecordReader):
     def num_records(self):
         return sum(1 for _ in self)
 
+    def as_matrix(self) -> np.ndarray:
+        """All-numeric fast path: the whole file as a float32 (rows,
+        cols) matrix, parsed by the native C++ kernel when available
+        (datavec keeps this hot loop native too; see
+        deeplearning4j_tpu/native). File-backed readers only."""
+        if self._path is None:
+            rows = [[float(c) for c in r] for r in self]
+            return np.asarray(rows, np.float32).reshape(len(rows), -1)
+        from deeplearning4j_tpu.native import read_csv_f32
+        return read_csv_f32(self._path, delimiter=self._delim,
+                            skip_num_lines=self._skip)
+
 
 class LineRecordReader(RecordReader):
     """One record per line (reference: impl/LineRecordReader.java)."""

@@ -1,0 +1,14 @@
+"""Native runtime components (C++ + ctypes).
+
+Reference parity: the reference keeps IO/parse hot loops native (datavec
+readers over JVM IO, libnd4j for everything numeric). Here the numeric
+compute path is XLA; the native pieces are the host-side runtime — this
+package builds small C++ kernels with the system toolchain on first use
+and binds them with ctypes (no pybind11 in the environment). Every
+native path has a pure-Python fallback, so the framework works without
+a compiler.
+"""
+from deeplearning4j_tpu.native.build import native_available
+from deeplearning4j_tpu.native.fastcsv import read_csv_f32
+
+__all__ = ["native_available", "read_csv_f32"]

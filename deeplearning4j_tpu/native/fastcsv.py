@@ -1,0 +1,62 @@
+"""ctypes binding for the native numeric-CSV loader (fastcsv.cpp),
+with a numpy fallback when no toolchain is present."""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.native.build import load
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.csv_probe.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int64),
+                              ctypes.POINTER(ctypes.c_int64)]
+    lib.csv_probe.restype = ctypes.c_int
+    lib.csv_parse_f32.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                  ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_int64, ctypes.c_int64]
+    lib.csv_parse_f32.restype = ctypes.c_int
+    lib._bound = True
+
+
+def read_csv_f32(path: str, delimiter: str = ",",
+                 skip_num_lines: int = 0) -> np.ndarray:
+    """All-numeric CSV file -> float32 (rows, cols) matrix.
+
+    Native single-pass parse when the C++ kernel is available; numpy
+    text loading otherwise. Raises ValueError on ragged or non-numeric
+    input in both paths.
+    """
+    lib = load("fastcsv")
+    if lib is not None:
+        if not getattr(lib, "_bound", False):
+            _bind(lib)
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        rc = lib.csv_probe(path.encode(), delimiter.encode(),
+                           skip_num_lines, ctypes.byref(rows),
+                           ctypes.byref(cols))
+        if rc == -2:
+            raise ValueError(f"{path}: ragged CSV (unequal column counts)")
+        if rc != 0:
+            raise ValueError(f"{path}: cannot read")
+        out = np.empty((rows.value, cols.value), np.float32)
+        rc = lib.csv_parse_f32(
+            path.encode(), delimiter.encode(), skip_num_lines,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.value, cols.value)
+        if rc != 0:
+            raise ValueError(f"{path}: non-numeric cell at data row "
+                             f"{-rc - 1}")
+        return out
+    # fallback: pure numpy
+    try:
+        arr = np.loadtxt(path, delimiter=delimiter, dtype=np.float32,
+                         skiprows=skip_num_lines, ndmin=2)
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from None
+    return arr

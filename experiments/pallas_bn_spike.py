@@ -74,6 +74,12 @@ def bn_relu_bwd_pallas(x2d, dy2d, gamma, beta, mean, inv, eps=1e-5,
     g = gamma*inv, c2 = inv^2 * E[dz*xhat-ish] ... expanded below.
     """
     R, C = x2d.shape
+    # tile must divide R exactly — a floor division would silently drop
+    # tail rows from the reductions and leave dx's tail uninitialized
+    while R % row_tile and row_tile > 8:
+        row_tile //= 2
+    if R % row_tile:
+        raise ValueError(f"R={R} has no power-of-two row tile >= 8")
     n_tiles = R // row_tile
     a = (gamma * inv).astype(jnp.float32)[None, :]
     b = (beta - gamma * inv * mean).astype(jnp.float32)[None, :]
