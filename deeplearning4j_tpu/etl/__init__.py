@@ -13,11 +13,16 @@ from deeplearning4j_tpu.etl.records import (
     CollectionRecordReader, CSVRecordReader, ImageRecordReader,
     LineRecordReader, RecordReader)
 from deeplearning4j_tpu.etl.transform import (
-    ColumnAnalysis, DataAnalysis, TransformProcess, analyze)
+    ColumnAnalysis, ColumnQuality, DataAnalysis, DataQualityAnalysis,
+    TransformProcess, analyze, analyze_quality)
 from deeplearning4j_tpu.etl.iterator import (
     ImageRecordReaderDataSetIterator, RecordReaderDataSetIterator)
 from deeplearning4j_tpu.etl.relational import (
     FULL_OUTER, INNER, LEFT_OUTER, RIGHT_OUTER, Join, Reducer)
+from deeplearning4j_tpu.etl.image_transform import (
+    BoxImageTransform, CropImageTransform, FlipImageTransform,
+    ImageTransform, PipelineImageTransform, RandomCropTransform,
+    ResizeImageTransform, RotateImageTransform, ScaleImageTransform)
 from deeplearning4j_tpu.etl.sequence import (
     convert_from_sequence, convert_to_sequence, offset_column,
     reduce_sequence_by_window, sequences_to_arrays, split_sequence_on_gap,
@@ -29,9 +34,13 @@ __all__ = [
     "RecordReader", "CSVRecordReader", "LineRecordReader",
     "CollectionRecordReader", "ImageRecordReader",
     "TransformProcess", "analyze", "DataAnalysis", "ColumnAnalysis",
+    "analyze_quality", "DataQualityAnalysis", "ColumnQuality",
     "RecordReaderDataSetIterator", "ImageRecordReaderDataSetIterator",
     "Join", "Reducer", "INNER", "LEFT_OUTER", "RIGHT_OUTER", "FULL_OUTER",
     "convert_to_sequence", "convert_from_sequence", "offset_column",
     "trim_sequence", "split_sequence_on_gap", "reduce_sequence_by_window",
     "sequences_to_arrays",
+    "ImageTransform", "FlipImageTransform", "RotateImageTransform",
+    "CropImageTransform", "RandomCropTransform", "ResizeImageTransform",
+    "ScaleImageTransform", "BoxImageTransform", "PipelineImageTransform",
 ]

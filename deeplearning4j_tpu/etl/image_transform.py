@@ -1,0 +1,185 @@
+"""Image transform pipeline: augmentation over HWC float32 arrays.
+
+Reference parity: org.datavec.image.transform — ImageTransform
+implementations (FlipImageTransform, RotateImageTransform,
+CropImageTransform / RandomCropTransform, ResizeImageTransform,
+ScaleImageTransform, WarpImageTransform's role, ColorConversion's
+brightness/contrast role, BoxImageTransform's pad role) composed by
+PipelineImageTransform with per-transform probabilities.
+
+TPU-native notes: transforms run on host numpy over HWC float32 (the
+decode format) — augmentation belongs in the input pipeline, not the
+compiled graph; everything is vectorized whole-image numpy (no per-pixel
+loops, no OpenCV binding layer)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ImageTransform:
+    """One augmentation step (reference: transform/ImageTransform)."""
+
+    def transform(self, img: np.ndarray,
+                  rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, img, rng=None):
+        return self.transform(np.asarray(img, np.float32),
+                              rng or np.random.default_rng())
+
+
+class FlipImageTransform(ImageTransform):
+    """(reference: transform/FlipImageTransform — mode: 0 vertical,
+    1 horizontal, -1 both; None = random horizontal)."""
+
+    def __init__(self, mode: Optional[int] = 1):
+        self.mode = mode
+
+    def transform(self, img, rng):
+        mode = self.mode
+        if mode is None:
+            if rng.random() < 0.5:
+                return img
+            mode = 1
+        if mode == 1:
+            return img[:, ::-1]
+        if mode == 0:
+            return img[::-1]
+        return img[::-1, ::-1]
+
+
+class RotateImageTransform(ImageTransform):
+    """Right-angle rotation in degrees; random multiple of 90 when angle
+    is None (reference: transform/RotateImageTransform — arbitrary-angle
+    warps collapse to the right-angle family without an OpenCV layer)."""
+
+    def __init__(self, angle: Optional[int] = 90):
+        if angle is not None and angle % 90:
+            raise ValueError("host rotation supports multiples of 90°")
+        self.angle = angle
+
+    def transform(self, img, rng):
+        k = (int(rng.integers(0, 4)) if self.angle is None
+             else (self.angle // 90) % 4)
+        return np.rot90(img, k=k, axes=(0, 1)).copy()
+
+
+class CropImageTransform(ImageTransform):
+    """Fixed margin crop (reference: transform/CropImageTransform)."""
+
+    def __init__(self, top: int, left: int = None, bottom: int = None,
+                 right: int = None):
+        self.top = top
+        self.left = top if left is None else left
+        self.bottom = top if bottom is None else bottom
+        self.right = top if right is None else right
+
+    def transform(self, img, rng):
+        h, w = img.shape[:2]
+        if self.top + self.bottom >= h or self.left + self.right >= w:
+            raise ValueError(
+                f"crop margins ({self.top},{self.left},{self.bottom},"
+                f"{self.right}) consume the whole {h}x{w} image")
+        return img[self.top:h - self.bottom, self.left:w - self.right]
+
+
+class RandomCropTransform(ImageTransform):
+    """Crop to (height, width) at a random position (reference:
+    transform/RandomCropTransform)."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, img, rng):
+        h, w = img.shape[:2]
+        if h < self.height or w < self.width:
+            raise ValueError(f"image {h}x{w} smaller than crop "
+                             f"{self.height}x{self.width}")
+        i = int(rng.integers(0, h - self.height + 1))
+        j = int(rng.integers(0, w - self.width + 1))
+        return img[i:i + self.height, j:j + self.width]
+
+
+class ResizeImageTransform(ImageTransform):
+    """Resize to (height, width) (reference:
+    transform/ResizeImageTransform) — bilinear via vectorized numpy."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, img, rng):
+        h, w = img.shape[:2]
+        if (h, w) == (self.height, self.width):
+            return img
+        ys = np.linspace(0, h - 1, self.height)
+        xs = np.linspace(0, w - 1, self.width)
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        # one gather per corner (np.ix_), no full-width intermediates
+        a = img[np.ix_(y0, x0)]
+        b = img[np.ix_(y0, x1)]
+        c = img[np.ix_(y1, x0)]
+        d = img[np.ix_(y1, x1)]
+        return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+                + c * wy * (1 - wx) + d * wy * wx).astype(np.float32)
+
+
+class ScaleImageTransform(ImageTransform):
+    """Pixel-value scale/shift (the brightness/contrast role of the
+    reference's color transforms)."""
+
+    def __init__(self, scale: float = 1.0, shift: float = 0.0,
+                 clip: Optional[Tuple[float, float]] = (0.0, 255.0)):
+        self.scale, self.shift, self.clip = scale, shift, clip
+
+    def transform(self, img, rng):
+        out = img * self.scale + self.shift
+        if self.clip is not None:
+            out = np.clip(out, *self.clip)
+        return out.astype(np.float32)
+
+
+class BoxImageTransform(ImageTransform):
+    """Pad/crop to a centered (height, width) box (reference:
+    transform/BoxImageTransform)."""
+
+    def __init__(self, height: int, width: int, fill: float = 0.0):
+        self.height, self.width, self.fill = height, width, fill
+
+    def transform(self, img, rng):
+        h, w, c = img.shape
+        out = np.full((self.height, self.width, c), self.fill, np.float32)
+        ti = max((self.height - h) // 2, 0)
+        tj = max((self.width - w) // 2, 0)
+        si = max((h - self.height) // 2, 0)
+        sj = max((w - self.width) // 2, 0)
+        ch = min(h, self.height)
+        cw = min(w, self.width)
+        out[ti:ti + ch, tj:tj + cw] = img[si:si + ch, sj:sj + cw]
+        return out
+
+
+class PipelineImageTransform(ImageTransform):
+    """Sequential pipeline with per-step probabilities (reference:
+    transform/PipelineImageTransform — shuffle=False path)."""
+
+    def __init__(self, *steps, seed: Optional[int] = None):
+        self.steps: List[Tuple[ImageTransform, float]] = [
+            s if isinstance(s, tuple) else (s, 1.0) for s in steps]
+        self._rng = np.random.default_rng(seed)
+
+    def transform(self, img, rng=None):
+        rng = rng or self._rng
+        for t, p in self.steps:
+            if p >= 1.0 or rng.random() < p:
+                img = t.transform(img, rng)
+        return img
+
+    def __call__(self, img, rng=None):
+        return self.transform(np.asarray(img, np.float32), rng)

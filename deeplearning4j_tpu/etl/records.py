@@ -119,8 +119,13 @@ class ImageRecordReader(RecordReader):
     .npy files load directly (shape (H, W, C) or (H, W))."""
 
     def __init__(self, height: int, width: int, channels: int = 3,
-                 root: Optional[str] = None):
+                 root: Optional[str] = None, transform=None,
+                 seed: int = 0):
         self.height, self.width, self.channels = height, width, channels
+        # augmentation pipeline applied per image at read time
+        # (reference: ImageRecordReader(h, w, c, labelGen, imageTransform))
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
         self._files: List[Tuple[str, str]] = []
         self.labels: List[str] = []
         if root is not None:
@@ -163,8 +168,29 @@ class ImageRecordReader(RecordReader):
         return arr
 
     def __iter__(self):
+        # transforms may legally change the decode size (crop/resize),
+        # but every record in one pass must agree — enforce here with the
+        # transform named, not as a cryptic stack/graph error downstream
+        out_shape = None
         for path, label in self._files:
-            yield [self._load(path), label]
+            img = self._load(path)
+            if self.transform is not None:
+                img = np.asarray(
+                    self.transform.transform(img, self._rng), np.float32)
+                if img.ndim != 3:
+                    raise ValueError(
+                        f"transform {type(self.transform).__name__} "
+                        f"returned rank-{img.ndim} output for {path}")
+                if out_shape is None:
+                    out_shape = img.shape
+                elif img.shape != out_shape:
+                    raise ValueError(
+                        f"transform {type(self.transform).__name__} "
+                        f"produced {img.shape} for {path} but "
+                        f"{out_shape} earlier in the pass — randomized "
+                        f"size-changing transforms must fix an output "
+                        f"size (RandomCrop/Resize), not vary it")
+            yield [img, label]
 
     def num_records(self):
         return len(self._files)

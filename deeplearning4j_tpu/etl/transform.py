@@ -20,7 +20,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.etl.schema import (
-    CATEGORICAL, FLOAT, INTEGER, ColumnMeta, Schema, columnar)
+    CATEGORICAL, FLOAT, INTEGER, TIME, ColumnMeta, Schema, columnar)
 
 
 # ---------------------------------------------------------------------------
@@ -298,3 +298,87 @@ class TransformProcess:
     @staticmethod
     def builder(schema: Schema) -> "TransformProcess.Builder":
         return TransformProcess.Builder(schema)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ColumnQuality:
+    """Per-column quality counts (reference:
+    transform/quality/columns/*Quality — countValid/countInvalid/
+    countMissing/countTotal, plus NaN/Inf for numeric columns)."""
+    count_total: int = 0
+    count_valid: int = 0
+    count_invalid: int = 0
+    count_missing: int = 0
+    count_nan: int = 0
+    count_infinite: int = 0
+
+
+class DataQualityAnalysis:
+    """(reference: transform/quality/DataQualityAnalysis)"""
+
+    def __init__(self, schema: Schema, by_column: Dict[str, ColumnQuality]):
+        self.schema = schema
+        self.by_column = by_column
+
+    def column(self, name: str) -> ColumnQuality:
+        return self.by_column[name]
+
+    def report(self) -> str:
+        lines = ["data quality analysis"]
+        for name, q in self.by_column.items():
+            lines.append(
+                f"  {name}: total={q.count_total} valid={q.count_valid} "
+                f"invalid={q.count_invalid} missing={q.count_missing}"
+                + (f" nan={q.count_nan} inf={q.count_infinite}"
+                   if q.count_nan or q.count_infinite else ""))
+        return "\n".join(lines)
+
+
+def analyze_quality(schema: Schema, reader) -> DataQualityAnalysis:
+    """One pass over raw records counting per-column validity (reference:
+    AnalyzeLocal.analyzeQuality). Runs BEFORE columnar() so malformed
+    cells are countable rather than fatal; a cell is missing when empty/
+    None, invalid when it cannot take the column's type, and NaN/Inf are
+    tracked for numeric columns."""
+    out = {c.name: ColumnQuality() for c in schema.columns}
+    for row in reader:
+        for ci, meta in enumerate(schema.columns):
+            # short (ragged) rows: the absent trailing cells are exactly
+            # the malformed input this pass exists to count — missing,
+            # never silently skipped
+            val = row[ci] if ci < len(row) else None
+            q = out[meta.name]
+            q.count_total += 1
+            sval = "" if val is None else str(val).strip()
+            if sval == "":
+                q.count_missing += 1
+                continue
+            if meta.ctype in (INTEGER, TIME):
+                try:
+                    int(sval)
+                    q.count_valid += 1
+                except ValueError:
+                    q.count_invalid += 1
+            elif meta.ctype == FLOAT:
+                try:
+                    f = float(sval)
+                except ValueError:
+                    q.count_invalid += 1
+                    continue
+                if np.isnan(f):
+                    q.count_nan += 1
+                    q.count_invalid += 1
+                elif np.isinf(f):
+                    q.count_infinite += 1
+                    q.count_invalid += 1
+                else:
+                    q.count_valid += 1
+            elif meta.ctype == CATEGORICAL and meta.categories:
+                if sval in meta.categories:
+                    q.count_valid += 1
+                else:
+                    q.count_invalid += 1
+            else:
+                q.count_valid += 1
+    return DataQualityAnalysis(schema, out)

@@ -89,9 +89,20 @@ def _as_pair(v):
 
 def _conv_out(size: int, k: int, s: int, mode: str, d: int = 1) -> int:
     if mode.upper() == "SAME":
-        return -(-size // s)
-    k_eff = (k - 1) * d + 1
-    return (size - k_eff) // s + 1
+        out = -(-size // s)
+    else:
+        k_eff = (k - 1) * d + 1
+        out = (size - k_eff) // s + 1
+    if out < 1:
+        # config-time validation (reference: InputTypeUtil.getOutputType*
+        # throwing DL4JInvalidConfigException): a collapsed spatial dim
+        # must fail HERE with layer math, not as a cryptic zero-dim
+        # reshape inside the compiled graph
+        raise ValueError(
+            f"layer output spatial size {out} < 1 (input {size}, kernel "
+            f"{k}, stride {s}, dilation {d}, mode {mode}): the network is "
+            f"deeper/stride-ier than the input size supports")
+    return out
 
 
 def _pad_mode(mode: str) -> str:

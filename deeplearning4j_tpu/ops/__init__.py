@@ -1,5 +1,10 @@
 from deeplearning4j_tpu.ops.registry import (
-    Op, exec_op, get_op, has_op, op, op_names, ops_by_category,
+    Op, OpTraceEntry, exec_op, get_op, has_op, list_op_traces, op, op_names,
+    ops_by_category, print_op_trace, purge_op_trace,
+    replay_op_trace_as_graph, toggle_op_trace,
 )
 
-__all__ = ["Op", "exec_op", "get_op", "has_op", "op", "op_names", "ops_by_category"]
+__all__ = ["Op", "OpTraceEntry", "exec_op", "get_op", "has_op", "op",
+           "op_names", "ops_by_category", "toggle_op_trace",
+           "list_op_traces", "purge_op_trace", "print_op_trace",
+           "replay_op_trace_as_graph"]

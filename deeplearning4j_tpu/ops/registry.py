@@ -111,10 +111,92 @@ def exec_op(name: str, *args, **attrs):
     o = get_op(name)
     jargs = [_as_jax(a) if isinstance(a, (NDArray, jax.Array, _np.ndarray)) else a
              for a in args]
+    if _trace_enabled:
+        # every positional arg is recorded: arrays by signature, scalar
+        # literals by value — a trace missing literals could not replay
+        inputs = tuple(
+            ("array", tuple(a.shape), str(a.dtype))
+            if hasattr(a, "shape") and hasattr(a, "dtype")
+            else ("literal", a) for a in jargs)
+        _op_trace.append(OpTraceEntry(
+            op=o.name,
+            input_shapes=tuple(i[1] for i in inputs if i[0] == "array"),
+            input_dtypes=tuple(i[2] for i in inputs if i[0] == "array"),
+            attrs={k: v for k, v in attrs.items()},
+            inputs=inputs))
     result = o.fn(*jargs, **attrs)
     if isinstance(result, (tuple, list)):
         return [NDArray(r) for r in result]
     return NDArray(result)
+
+
+# ---------------------------------------------------------------------------
+# Op tracing (reference: the C ABI's toggleOpTrace/listOpTraces/
+# printOpTrace, NativeOps.h:56-121 + ADR "0024 - Execution Tracing":
+# record each dispatched op's shapes/args, replayable as a graph).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OpTraceEntry:
+    op: str
+    input_shapes: tuple     # array inputs only (summary view)
+    input_dtypes: tuple
+    attrs: dict
+    # full positional record: ("array", shape, dtype) | ("literal", value)
+    inputs: tuple = ()
+
+
+_trace_enabled = False
+_op_trace: List["OpTraceEntry"] = []
+
+
+def toggle_op_trace(enabled: bool) -> None:
+    """(reference: NativeOps.toggleOpTrace)"""
+    global _trace_enabled
+    _trace_enabled = bool(enabled)
+
+
+def list_op_traces() -> List["OpTraceEntry"]:
+    """(reference: NativeOps.listOpTraces)"""
+    return list(_op_trace)
+
+
+def purge_op_trace() -> None:
+    """(reference: NativeOps.purgeOpTrace)"""
+    _op_trace.clear()
+
+
+def print_op_trace(print_fn=print) -> None:
+    """(reference: NativeOps.printOpTrace)"""
+    for i, e in enumerate(_op_trace):
+        print_fn(f"[{i}] {e.op} shapes={list(e.input_shapes)} "
+                 f"dtypes={list(e.input_dtypes)} attrs={e.attrs}")
+
+
+def replay_op_trace_as_graph(trace=None):
+    """Rebuild the traced dispatch sequence as a SameDiff graph with
+    placeholders for each op's array inputs (ADR 0024's 'replayable as a
+    SameDiff graph'). Linear traces only: each entry's arrays become
+    fresh placeholders (the eager path does not record producer/consumer
+    identity)."""
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff()
+    outs = []
+    for i, e in enumerate(trace if trace is not None else _op_trace):
+        ins = []
+        spec = e.inputs or tuple(("array", s, dt) for s, dt in
+                                 zip(e.input_shapes, e.input_dtypes))
+        j = 0
+        for entry in spec:
+            if entry[0] == "array":
+                ins.append(sd.placeholder(f"t{i}_in{j}", shape=entry[1],
+                                          dtype=entry[2]))
+                j += 1
+            else:
+                ins.append(sd.constant(entry[1], f"t{i}_lit{len(ins)}"))
+        outs.append(sd.invoke(e.op, ins, dict(e.attrs),
+                              name=f"t{i}_{e.op}"))
+    return sd, outs
 
 
 _LOADED = False

@@ -186,3 +186,100 @@ def test_image_folder_to_cnn_e2e(tmp_path):
     preds = np.asarray(net.output(X).data)
     acc = (preds.argmax(1) == Y.argmax(1)).mean()
     assert acc >= 0.9, acc
+
+
+# ---- image transforms ------------------------------------------------------
+
+def test_image_transforms_shapes_and_values():
+    """(reference: datavec transform/* — flip/rotate/crop/resize/box)"""
+    import numpy as np
+    from deeplearning4j_tpu.etl import (
+        BoxImageTransform, CropImageTransform, FlipImageTransform,
+        PipelineImageTransform, RandomCropTransform, ResizeImageTransform,
+        RotateImageTransform, ScaleImageTransform)
+    rng = np.random.default_rng(0)
+    img = np.arange(6 * 8 * 3, dtype=np.float32).reshape(6, 8, 3)
+    np.testing.assert_array_equal(
+        FlipImageTransform(1).transform(img, rng), img[:, ::-1])
+    np.testing.assert_array_equal(
+        FlipImageTransform(0).transform(img, rng), img[::-1])
+    rot = RotateImageTransform(90).transform(img, rng)
+    assert rot.shape == (8, 6, 3)
+    crop = CropImageTransform(1).transform(img, rng)
+    assert crop.shape == (4, 6, 3)
+    rc = RandomCropTransform(4, 4).transform(img, rng)
+    assert rc.shape == (4, 4, 3)
+    rs = ResizeImageTransform(12, 16).transform(img, rng)
+    assert rs.shape == (12, 16, 3)
+    # bilinear resize preserves corners
+    np.testing.assert_allclose(rs[0, 0], img[0, 0])
+    np.testing.assert_allclose(rs[-1, -1], img[-1, -1])
+    sc = ScaleImageTransform(scale=2.0, shift=1.0, clip=None)
+    np.testing.assert_allclose(sc.transform(img, rng), img * 2 + 1)
+    box = BoxImageTransform(10, 10, fill=-1.0).transform(img, rng)
+    assert box.shape == (10, 10, 3) and box[0, 0, 0] == -1.0
+    pipe = PipelineImageTransform(FlipImageTransform(1),
+                                  (ScaleImageTransform(0.5, clip=None), 1.0))
+    np.testing.assert_allclose(pipe(img, rng), img[:, ::-1] * 0.5)
+
+
+def test_image_reader_applies_transform(tmp_path):
+    import numpy as np
+    from deeplearning4j_tpu.etl import (FlipImageTransform,
+                                        ImageRecordReader)
+    d = tmp_path / "cats"
+    d.mkdir()
+    img = np.arange(4 * 4, dtype=np.float32).reshape(4, 4)
+    np.save(str(d / "a.npy"), img)
+    rr = ImageRecordReader(4, 4, channels=1, root=str(tmp_path),
+                           transform=FlipImageTransform(1))
+    arr, label = next(iter(rr))
+    assert label == "cats"
+    np.testing.assert_array_equal(arr[:, :, 0], img[:, ::-1])
+
+
+def test_quality_counts_ragged_rows_as_missing():
+    """Regression: short rows count their absent cells as missing."""
+    from deeplearning4j_tpu.etl import (CollectionRecordReader, Schema,
+                                        analyze_quality)
+    s = (Schema.builder().add_column_integer("a").add_column_float("b")
+         .add_column_categorical("c", "x").build())
+    qa = analyze_quality(s, CollectionRecordReader(
+        [[1, 2.0, "x"], [1, 2.0]]))
+    q = qa.column("c")
+    assert (q.count_total, q.count_missing) == (2, 1)
+
+
+def test_size_varying_transform_rejected_by_reader(tmp_path):
+    """Regression: per-image varying output shapes raise a clear error
+    naming the transform."""
+    import numpy as np
+    from deeplearning4j_tpu.etl import ImageRecordReader, RotateImageTransform
+    d = tmp_path / "x"
+    d.mkdir()
+    np.save(str(d / "a.npy"), np.zeros((4, 6), np.float32))
+    np.save(str(d / "b.npy"), np.zeros((4, 6), np.float32))
+
+    class AlternatingRotate(RotateImageTransform):
+        def __init__(self):
+            super().__init__(None)
+            self._n = 0
+
+        def transform(self, img, rng):
+            self._n += 1
+            return np.rot90(img, k=self._n % 2, axes=(0, 1)).copy()
+
+    rr = ImageRecordReader(4, 6, channels=1, root=str(tmp_path),
+                           transform=AlternatingRotate())
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="AlternatingRotate"):
+        list(rr)
+
+
+def test_crop_margins_validated():
+    import numpy as np
+    import pytest as _pytest
+    from deeplearning4j_tpu.etl import CropImageTransform
+    img = np.zeros((6, 8, 3), np.float32)
+    with _pytest.raises(ValueError, match="consume"):
+        CropImageTransform(4).transform(img, np.random.default_rng(0))

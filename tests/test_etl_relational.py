@@ -238,3 +238,19 @@ def test_split_on_float_gap():
     seqs = [{"t": np.array([1.1, 2.9]), "v": np.array([1.0, 2.0])}]
     parts = split_sequence_on_gap(seqs, "t", max_gap=1)
     assert len(parts) == 2
+
+
+def test_quality_analysis_counts():
+    """(reference: datavec AnalyzeLocal.analyzeQuality)"""
+    from deeplearning4j_tpu.etl import (CSVRecordReader, analyze_quality)
+    s = (Schema.builder().add_column_integer("a").add_column_float("b")
+         .add_column_categorical("c", "x", "y").build())
+    text = "1,2.0,x\n,nan,z\nbad,inf,y\n2,,x\n"
+    qa = analyze_quality(s, CSVRecordReader(text=text))
+    a, b, c = qa.column("a"), qa.column("b"), qa.column("c")
+    assert (a.count_total, a.count_valid, a.count_invalid,
+            a.count_missing) == (4, 2, 1, 1)
+    assert (b.count_valid, b.count_nan, b.count_infinite,
+            b.count_missing) == (1, 1, 1, 1)
+    assert (c.count_valid, c.count_invalid) == (3, 1)
+    assert "data quality" in qa.report()

@@ -315,3 +315,13 @@ def test_infer_shape_through_state_vars():
             .build())
     net = MultiLayerNetwork(conf).init()
     assert net._sd_train.get_variable("output").shape is not None
+
+
+def test_collapsed_spatial_dim_raises_at_config_time():
+    """Regression: a net whose pools collapse the input below 1 pixel
+    must fail with layer math at build time (reference:
+    DL4JInvalidConfigException from InputTypeUtil), not a zero-dim
+    reshape error inside the compiled step."""
+    from deeplearning4j_tpu.zoo import SimpleCNN
+    with pytest.raises(ValueError, match="spatial size"):
+        SimpleCNN(height=8, width=8, channels=1, num_classes=2).build()

@@ -78,3 +78,54 @@ def test_attrvalue_empty_list_has_all_keys():
     av = AttrValue(Fields(b""))
     lst = av.list
     assert set(lst.keys()) >= {"s", "i", "f", "b", "type", "shape"}
+
+
+def test_op_trace_toggle_list_print_replay():
+    """(reference: NativeOps toggleOpTrace/listOpTraces/printOpTrace +
+    ADR 0024 'replayable as a SameDiff graph')"""
+    import numpy as np
+    from deeplearning4j_tpu.ops import (
+        exec_op, list_op_traces, print_op_trace, purge_op_trace,
+        replay_op_trace_as_graph, toggle_op_trace)
+    purge_op_trace()
+    toggle_op_trace(True)
+    try:
+        a = np.ones((2, 3), np.float32)
+        exec_op("add", a, a)
+        exec_op("reduce_sum", a, axis=(1,))
+    finally:
+        toggle_op_trace(False)
+    traces = list_op_traces()
+    assert [t.op for t in traces] == ["add", "reduce_sum"]
+    assert traces[0].input_shapes == ((2, 3), (2, 3))
+    lines = []
+    print_op_trace(print_fn=lines.append)
+    assert len(lines) == 2 and "add" in lines[0]
+    # replay as a graph and execute it
+    sd, outs = replay_op_trace_as_graph()
+    res = sd.output({"t0_in0": a, "t0_in1": a, "t1_in0": a},
+                    [outs[0].name, outs[1].name])
+    np.testing.assert_allclose(np.asarray(res[outs[0].name]), 2.0)
+    purge_op_trace()
+    # disabled -> nothing recorded
+    exec_op("add", a, a)
+    assert list_op_traces() == []
+
+
+def test_op_trace_scalar_literals_replay():
+    """Regression: scalar positional args are recorded as literals and
+    survive replay."""
+    import numpy as np
+    from deeplearning4j_tpu.ops import (
+        exec_op, purge_op_trace, replay_op_trace_as_graph, toggle_op_trace)
+    purge_op_trace()
+    toggle_op_trace(True)
+    try:
+        exec_op("add", np.ones((2, 3), np.float32), 2.0)
+    finally:
+        toggle_op_trace(False)
+    sd, outs = replay_op_trace_as_graph()
+    res = sd.output({"t0_in0": np.ones((2, 3), np.float32)},
+                    [outs[0].name])
+    np.testing.assert_allclose(np.asarray(res[outs[0].name]), 3.0)
+    purge_op_trace()
