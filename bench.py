@@ -137,7 +137,11 @@ def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
             "precision": "bf16_mixed" if mixed_precision else "f32"}
 
 
-def bench_bert_base(batch=16, seq_len=128, steps=4, mixed_precision=True):
+def bench_bert_base(batch=16, seq_len=128, steps=16, mixed_precision=True):
+    # steps=16 (was 4): with ~40-80 ms steps, 4-step epochs measure the
+    # tunnel's dispatch jitter more than the model (observed 199-409
+    # samples/sec across runs of the identical binary); 16 steps per
+    # epoch amortizes it
     """BASELINE config 4: BERT-base imported from a frozen TF GraphDef,
     fine-tune step (pooled-output classifier, softmax-CE, Adam)."""
     from deeplearning4j_tpu.autodiff import MixedPrecision, TrainingConfig

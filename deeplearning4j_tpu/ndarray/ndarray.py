@@ -413,8 +413,38 @@ class NDArray:
     def cumprod(self, axis=None): return NDArray(jnp.cumprod(self.data, axis=axis))
 
     def entropy(self, *dims):
-        p = self.data
-        return self._reduce(lambda d, axis, keepdims: -jnp.sum(d * jnp.log(d), axis=axis, keepdims=keepdims), dims)
+        # zero-probability entries contribute 0 (0*log(0) -> 0), matching
+        # shannon_entropy's clamp — not NaN
+        return self._reduce(
+            lambda d, axis, keepdims: -jnp.sum(
+                d * jnp.log(jnp.maximum(d, 1e-30)), axis=axis,
+                keepdims=keepdims), dims)
+
+    def shannon_entropy(self, *dims):
+        """-sum(p * log2(p)) (reference: INDArray.shannonEntropy)."""
+        return self._reduce(
+            lambda d, axis, keepdims: -jnp.sum(
+                d * jnp.log2(jnp.maximum(d, 1e-30)), axis=axis,
+                keepdims=keepdims), dims)
+
+    def log_entropy(self, *dims):
+        """log(entropy) (reference: INDArray.logEntropy)."""
+        e = self.entropy(*dims)
+        return NDArray(jnp.log(_as_jax(e)))
+
+    def prod_number(self) -> float:
+        return float(jnp.prod(self.data))
+
+    def eps(self, other, eps: float = 1e-5) -> "NDArray":
+        """Elementwise |a-b| < eps (reference: INDArray.eps — the Eps
+        pairwise bool op)."""
+        return NDArray(jnp.abs(self.data - _as_jax(other)) < eps)
+
+    def take(self, indices, axis: int = 0) -> "NDArray":
+        """Gather along an axis (reference: Nd4j.pullRows / the gather
+        op surface on INDArray)."""
+        idx = _as_jax(indices).astype(jnp.int32)
+        return NDArray(jnp.take(self.data, idx, axis=axis))
 
     def scan_all(self) -> dict:
         """Summary stats (reference: SummaryStats ops family)."""
@@ -736,6 +766,8 @@ _ALIASES = {
     "toFloatVector": "to_float_vector", "toDoubleVector": "to_double_vector",
     "toIntMatrix": "to_int_matrix", "toFloatMatrix": "to_float_matrix",
     "toDoubleMatrix": "to_double_matrix",
+    "shannonEntropy": "shannon_entropy", "logEntropy": "log_entropy",
+    "prodNumber": "prod_number",
 }
 for _camel, _snake in _ALIASES.items():
     setattr(NDArray, _camel, getattr(NDArray, _snake))

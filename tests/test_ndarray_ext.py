@@ -161,3 +161,45 @@ class TestExportersAndPredicates:
         x = arr([[1.0, 2.0]])
         assert x.repmat(2, 3).shape == (2, 6)
         assert x.broadcast(4, 2).shape == (4, 2)
+
+
+# ---- round-5 tail: entropy family, eps, take, where family ----------------
+
+def test_entropy_family_and_prod():
+    from deeplearning4j_tpu import nd
+    p = nd.create([0.5, 0.25, 0.25])
+    assert p.shannon_entropy().item() == pytest.approx(1.5)
+    assert p.log_entropy().item() == pytest.approx(
+        np.log(-(0.5 * np.log(0.5) + 0.5 * np.log(0.25))))
+    assert nd.create([2.0, 3.0, 4.0]).prod_number() == pytest.approx(24.0)
+
+
+def test_eps_take_where_family():
+    from deeplearning4j_tpu import nd
+    from deeplearning4j_tpu.ndarray.conditions import Conditions
+    a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.eps(nd.create([[1.0, 2.000001], [3.1, 4.0]])
+                 ).to_numpy().tolist() == [[True, True], [False, True]]
+    np.testing.assert_array_equal(
+        a.take([1, 0]).to_numpy(), [[3.0, 4.0], [1.0, 2.0]])
+    np.testing.assert_array_equal(
+        a.take([1], axis=1).to_numpy(), [[2.0], [4.0]])
+    got = a.get_where(None, Conditions.greater_than(2.5))
+    np.testing.assert_array_equal(np.sort(got.to_numpy()), [3.0, 4.0])
+    rep = a.dup().replace_where(0.0, Conditions.greater_than(2.5))
+    np.testing.assert_array_equal(rep.to_numpy(), [[1.0, 2.0], [0.0, 0.0]])
+
+
+def test_entropy_zero_probability_and_camel_aliases():
+    """Regression: zero-probability entries contribute 0 to both entropy
+    variants (no NaN), and the new methods have camelCase aliases."""
+    from deeplearning4j_tpu import nd
+    p = nd.create([1.0, 0.0])
+    assert p.entropy().item() == pytest.approx(0.0)
+    assert p.shannon_entropy().item() == pytest.approx(0.0)
+    assert np.isfinite(p.log_entropy().item()) or \
+        p.log_entropy().item() == -np.inf    # log(0) of zero entropy
+    q = nd.create([0.5, 0.25, 0.25])
+    assert q.shannonEntropy().item() == pytest.approx(1.5)
+    assert q.prodNumber() == pytest.approx(0.03125)
+    assert np.isfinite(q.logEntropy().item())
