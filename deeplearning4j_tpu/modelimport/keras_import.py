@@ -102,6 +102,18 @@ def _triple(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
 
 
+def _flat_pairs3d(v):
+    """Keras 3D pad/crop spec (int | 3 ints | 3 pairs) -> flat 6-tuple
+    (d0, d1, h0, h1, w0, w1)."""
+    if isinstance(v, int):
+        v = ((v, v),) * 3
+    flat = []
+    for q in v:
+        a, b = (q, q) if isinstance(q, int) else q
+        flat += [int(a), int(b)]
+    return tuple(flat)
+
+
 class _Ctx:
     """Carries cross-layer import state (pending Flatten permutation)."""
 
@@ -286,14 +298,7 @@ def _map_upsampling3d(cfg, ctx, itype):
 
 def _map_zeropad3d(cfg, ctx, itype):
     from deeplearning4j_tpu.nn import ZeroPadding3DLayer
-    p = cfg["padding"]
-    if isinstance(p, int):
-        p = ((p, p), (p, p), (p, p))
-    flat = []
-    for q in p:
-        a, b = (q, q) if isinstance(q, int) else q
-        flat += [a, b]
-    return ZeroPadding3DLayer(padding=tuple(flat)), None
+    return ZeroPadding3DLayer(padding=_flat_pairs3d(cfg["padding"])), None
 
 
 def _map_conv_lstm2d(cfg, ctx, itype):
@@ -310,6 +315,52 @@ def _map_conv_lstm2d(cfg, ctx, itype):
     # keras: [kernel (kh,kw,cin,4F), recurrent_kernel (kh,kw,F,4F),
     # bias (4F,)]; gate order i,f,c,o == conv_lstm2d's i,f,g,o
     return layer, _set_simple({"Wih": 0, "Whh": 1, "b": 2})
+
+
+def _map_gaussian_noise(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import GaussianNoiseLayer
+    return GaussianNoiseLayer(stddev=cfg.get("stddev", 0.1)), None
+
+
+def _map_gaussian_dropout(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import GaussianDropoutLayer
+    return GaussianDropoutLayer(rate=cfg.get("rate", 0.1)), None
+
+
+def _map_alpha_dropout(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import AlphaDropoutLayer
+    # keras rate = DROP probability; the layer takes retain probability
+    return AlphaDropoutLayer(dropout=1.0 - cfg.get("rate", 0.05)), None
+
+
+def _map_spatial_dropout(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import SpatialDropoutLayer
+    return SpatialDropoutLayer(dropout=1.0 - cfg.get("rate", 0.1)), None
+
+
+def _map_softmax_layer(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ActivationLayer
+    axis = cfg.get("axis", -1)
+    if axis not in (-1, len(getattr(itype, "dims", (0,)))):
+        raise ValueError(f"Keras Softmax axis={axis} is not the feature "
+                         f"axis; unsupported by import")
+    return ActivationLayer(activation="softmax"), None
+
+
+def _map_thresholded_relu(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import ActivationLayer
+    # the activation resolver carries no theta attr; only the op default
+    # (theta=1.0) imports — reject anything else loudly
+    theta = cfg.get("theta", 1.0)
+    if theta != 1.0:
+        raise ValueError("Keras ThresholdedReLU theta != 1.0 is not "
+                         "supported by import")
+    return ActivationLayer(activation="thresholdedrelu"), None
+
+
+def _map_cropping3d(cfg, ctx, itype):
+    from deeplearning4j_tpu.nn import Cropping3DLayer
+    return Cropping3DLayer(cropping=_flat_pairs3d(cfg["cropping"])), None
 
 
 def _map_batchnorm(cfg, ctx, itype):
@@ -647,6 +698,15 @@ _MAPPERS: Dict[str, Callable] = {
     "UpSampling3D": _map_upsampling3d,
     "ZeroPadding3D": _map_zeropad3d,
     "ConvLSTM2D": _map_conv_lstm2d,
+    "GaussianNoise": _map_gaussian_noise,
+    "GaussianDropout": _map_gaussian_dropout,
+    "AlphaDropout": _map_alpha_dropout,
+    "SpatialDropout1D": _map_spatial_dropout,
+    "SpatialDropout2D": _map_spatial_dropout,
+    "SpatialDropout3D": _map_spatial_dropout,
+    "Softmax": _map_softmax_layer,
+    "ThresholdedReLU": _map_thresholded_relu,
+    "Cropping3D": _map_cropping3d,
 }
 
 

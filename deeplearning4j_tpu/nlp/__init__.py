@@ -7,9 +7,11 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.graph_embeddings import (
     DeepWalk, Graph, Node2Vec, random_walks)
 from deeplearning4j_tpu.nlp.tokenization import (
-    ENGLISH_STOP_WORDS, CommonPreprocessor, DefaultTokenizerFactory,
-    LineSentenceIterator, LowCasePreProcessor, NGramTokenizerFactory,
-    SentenceIterator, Tokenizer, TokenizerFactory, TokenPreProcess)
+    ENGLISH_STOP_WORDS, BertWordPieceTokenizer,
+    BertWordPieceTokenizerFactory, CommonPreprocessor,
+    DefaultTokenizerFactory, LineSentenceIterator, LowCasePreProcessor,
+    NGramTokenizerFactory, SentenceIterator, Tokenizer, TokenizerFactory,
+    TokenPreProcess)
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 from deeplearning4j_tpu.nlp.word2vec import (
     FastText, ParagraphVectors, SequenceVectors, Word2Vec, WordVectors,
@@ -22,4 +24,5 @@ __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory", "TokenPreProcess",
     "CommonPreprocessor", "LowCasePreProcessor", "SentenceIterator",
     "LineSentenceIterator", "ENGLISH_STOP_WORDS",
+    "BertWordPieceTokenizer", "BertWordPieceTokenizerFactory",
 ]

@@ -131,3 +131,123 @@ class LineSentenceIterator(SentenceIterator):
         with open(path, "r", encoding="utf-8") as fh:
             super().__init__([ln.strip() for ln in fh if ln.strip()],
                              preprocessor)
+
+
+# ---------------------------------------------------------------------------
+# BERT WordPiece (reference: deeplearning4j-nlp
+# tokenization.tokenizer.BertWordPieceTokenizer +
+# tokenizerfactory.BertWordPieceTokenizerFactory — greedy longest-match
+# wordpiece over a BERT vocab.txt, '##' continuation prefix, [UNK]
+# fallback, optional lower-casing basic tokenization first)
+# ---------------------------------------------------------------------------
+
+class BertWordPieceTokenizer(Tokenizer):
+    """Tokenize one string into wordpieces (reference:
+    BertWordPieceTokenizer.java)."""
+
+    def __init__(self, text: str, vocab: dict, lower_case: bool = True,
+                 unk_token: str = "[UNK]",
+                 max_chars_per_word: int = 100):
+        pieces = []
+        for word in _basic_tokenize(text, lower_case):
+            pieces.extend(_wordpiece(word, vocab, unk_token,
+                                     max_chars_per_word))
+        super().__init__(pieces)
+
+
+def _basic_tokenize(text: str, lower_case: bool) -> List[str]:
+    """Whitespace + punctuation splitting (reference: the
+    BasicTokenizer step inside BertWordPieceTokenizer)."""
+    if lower_case:
+        text = text.lower()
+    out = []
+    word = []
+    for ch in text:
+        if ch.isspace():
+            if word:
+                out.append("".join(word))
+                word = []
+        elif not ch.isalnum():
+            # every punctuation char splits and stands alone, matching
+            # BERT's BasicTokenizer (contractions become don ' t)
+            if word:
+                out.append("".join(word))
+                word = []
+            out.append(ch)
+        else:
+            word.append(ch)
+    if word:
+        out.append("".join(word))
+    return out
+
+
+def _wordpiece(word: str, vocab: dict, unk: str,
+               max_chars: int) -> List[str]:
+    """Greedy longest-match-first subword split."""
+    if len(word) > max_chars:
+        return [unk]
+    pieces = []
+    start = 0
+    while start < len(word):
+        end = len(word)
+        cur = None
+        while start < end:
+            sub = word[start:end]
+            if start > 0:
+                sub = "##" + sub
+            if sub in vocab:
+                cur = sub
+                break
+            end -= 1
+        if cur is None:
+            return [unk]
+        pieces.append(cur)
+        start = end
+    return pieces
+
+
+class BertWordPieceTokenizerFactory(TokenizerFactory):
+    """(reference: BertWordPieceTokenizerFactory.java — built from a
+    BERT vocab.txt; exposes the vocab and encodes to ids)."""
+
+    def __init__(self, vocab=None, vocab_path: str = None,
+                 lower_case: bool = True, unk_token: str = "[UNK]"):
+        if (vocab is None) == (vocab_path is None):
+            raise ValueError("pass exactly one of vocab= or vocab_path=")
+        if vocab_path is not None:
+            with open(vocab_path, encoding="utf-8") as fh:
+                tokens = [ln.rstrip("\n") for ln in fh]
+            vocab = {t: i for i, t in enumerate(tokens) if t}
+        elif not isinstance(vocab, dict):
+            vocab = {t: i for i, t in enumerate(vocab)}
+        self.vocab = vocab
+        self.lower_case = lower_case
+        self.unk_token = unk_token
+        self._pre = None
+
+    def create(self, text: str) -> BertWordPieceTokenizer:
+        t = BertWordPieceTokenizer(text, self.vocab, self.lower_case,
+                                   self.unk_token)
+        if self._pre is not None:
+            t.set_token_pre_processor(self._pre)
+        return t
+
+    def encode(self, text: str, add_special_tokens: bool = True,
+               max_len: int = None):
+        """Token ids, BERT-style: [CLS] ... [SEP] when the specials are
+        in the vocab; pads with [PAD] to max_len when given."""
+        toks = self.create(text).get_tokens()
+        ids = [self.vocab.get(t, self.vocab.get(self.unk_token, 0))
+               for t in toks]
+        specials = add_special_tokens and "[CLS]" in self.vocab
+        if max_len is not None and specials:
+            # truncate BEFORE the specials so [SEP] survives over-length
+            # inputs (BERT sequence structure must stay intact)
+            ids = ids[:max(max_len - 2, 0)]
+        if specials:
+            ids = [self.vocab["[CLS]"]] + ids + [self.vocab.get("[SEP]",
+                                                                0)]
+        if max_len is not None:
+            pad = self.vocab.get("[PAD]", 0)
+            ids = ids[:max_len] + [pad] * max(max_len - len(ids), 0)
+        return ids

@@ -12,7 +12,8 @@ from deeplearning4j_tpu.nn.layers import (
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
-    GraphVertex, L2NormalizeVertex, MergeVertex, ScaleVertex, ShiftVertex,
+    DotProductVertex, GraphVertex, L2NormalizeVertex, MergeVertex,
+    ScaleVertex, ShiftVertex,
     SubsetVertex)
 from deeplearning4j_tpu.nn.conv_layers import (
     Convolution1DLayer, Convolution3DLayer, Cropping2DLayer,
@@ -29,7 +30,10 @@ from deeplearning4j_tpu.nn.layers_ext import (
     PermuteLayer, RepeatVectorLayer, ReshapeLayer, RnnLossLayer,
     SpaceToDepthLayer, Subsampling1DLayer, Upsampling1DLayer,
     Upsampling3DLayer, VariationalAutoencoderLayer, Yolo2OutputLayer,
-    ZeroPadding1DLayer, ZeroPadding3DLayer)
+    ZeroPadding1DLayer, ZeroPadding3DLayer, Cropping3DLayer)
+from deeplearning4j_tpu.nn.noise_layers import (
+    AlphaDropoutLayer, GaussianDropoutLayer, GaussianNoiseLayer,
+    SpatialDropoutLayer)
 from deeplearning4j_tpu.nn.transferlearning import (
     FineTuneConfiguration, TransferLearning)
 from deeplearning4j_tpu.nn.weights import init_weights
@@ -37,9 +41,11 @@ from deeplearning4j_tpu.nn.activations import resolve_activation
 
 __all__ = [
     "NeuralNetConfiguration", "MultiLayerConfiguration", "MultiLayerNetwork",
+    "GaussianNoiseLayer", "GaussianDropoutLayer", "AlphaDropoutLayer",
+    "SpatialDropoutLayer", "Cropping3DLayer",
     "ComputationGraph", "ComputationGraphConfiguration", "MergeVertex",
     "ElementWiseVertex", "SubsetVertex", "ScaleVertex", "ShiftVertex",
-    "L2NormalizeVertex", "GraphVertex",
+    "L2NormalizeVertex", "GraphVertex", "DotProductVertex",
     "InputType", "DenseLayer", "ConvolutionLayer", "SubsamplingLayer",
     "BatchNormalization", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
     "LSTMLayer", "GlobalPoolingLayer", "OutputLayer", "LossLayer",

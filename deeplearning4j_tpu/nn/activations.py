@@ -27,6 +27,10 @@ _ALIASES = {
     "swish": "swish",
     "mish": "mish",
     "cube": "cube",
+    "thresholdedrelu": "thresholdedrelu",
+    "thresholded_relu": "thresholdedrelu",
+    "rationaltanh": "rationaltanh",
+    "rectifiedtanh": "rectifiedtanh",
 }
 
 

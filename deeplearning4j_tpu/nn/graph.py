@@ -186,6 +186,42 @@ class ShiftVertex(GraphVertex):
 
 
 @dataclasses.dataclass
+class DotProductVertex(GraphVertex):
+    """Batch dot product of two inputs over the FEATURE axis, with
+    optional L2 normalization first (imports Keras's Dot merge layer;
+    the cosine-similarity head of siamese nets).
+
+    The runtime feature axis depends on input kind and layout (see
+    SubsetVertex): ff → axis 1 yielding (B, 1); rnn (B, T, C) → axis 2
+    yielding a per-timestep scalar sequence (B, T, 1)."""
+    normalize: bool = False
+
+    def output_type(self, itypes):
+        from deeplearning4j_tpu.nn.layers import InputType
+        t = itypes[0]
+        if t.kind == "ff":
+            return InputType.feed_forward(1)
+        if t.kind == "rnn":
+            return InputType.recurrent(1, t.dims[1])
+        raise ValueError(
+            f"DotProductVertex supports ff/rnn inputs, not {t.kind!r}")
+
+    def build(self, ctx, xs, itypes):
+        name = ctx.lname("dot")
+        a, b = xs[0], xs[1]
+        t = itypes[0]
+        axis = 1 if t.kind == "ff" else 2        # runtime feature axis
+        if self.normalize:
+            eps = ctx.sd.constant(1e-12, f"{name}_eps")
+            a = a.div(a.square().sum(dims=(axis,), keep_dims=True)
+                      .sqrt().add(eps), name=f"{name}_na")
+            b = b.div(b.square().sum(dims=(axis,), keep_dims=True)
+                      .sqrt().add(eps), name=f"{name}_nb")
+        out = a.mul(b).sum(dims=(axis,), keep_dims=True, name=name)
+        return out, self.output_type(itypes)
+
+
+@dataclasses.dataclass
 class L2NormalizeVertex(GraphVertex):
     """Normalizes over all non-batch dimensions by default, matching the
     reference L2NormalizeVertex (nn/conf/graph/L2NormalizeVertex.java);
@@ -211,7 +247,7 @@ class L2NormalizeVertex(GraphVertex):
 
 VERTEX_TYPES: Dict[str, type] = {c.__name__: c for c in [
     MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex, ShiftVertex,
-    L2NormalizeVertex,
+    L2NormalizeVertex, DotProductVertex,
 ]}
 
 

@@ -820,3 +820,35 @@ class PermuteLayer(BaseLayer):
 
 for _cls in [ReshapeLayer, PermuteLayer]:
     LAYER_TYPES[_cls.__name__] = _cls
+
+
+@dataclasses.dataclass
+class Cropping3DLayer(BaseLayer):
+    """(reference: convolutional/Cropping3D.java)
+    cropping = (d0, d1, h0, h1, w0, w1)."""
+    cropping: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def output_type(self, itype):
+        c, d, h, w = itype.dims
+        cr = self.cropping
+        return InputType("cnn3d", (c, d - cr[0] - cr[1],
+                                   h - cr[2] - cr[3], w - cr[4] - cr[5]))
+
+    def build(self, ctx, x, itype):
+        c, d, h, w = itype.dims
+        cr = self.cropping
+        big = 2**31 - 1
+        if ctx.cnn_format == "NHWC":         # runtime NDHWC
+            begin = (0, cr[0], cr[2], cr[4], 0)
+            end = (big, d - cr[1], h - cr[3], w - cr[5], big)
+        else:
+            begin = (0, 0, cr[0], cr[2], cr[4])
+            end = (big, big, d - cr[1], h - cr[3], w - cr[5])
+        out = ctx.sd.invoke(
+            "strided_slice", [x],
+            {"begin": begin, "end": end, "strides": (1,) * 5},
+            name=ctx.lname("crop3d"))
+        return out, self.output_type(itype)
+
+
+LAYER_TYPES[Cropping3DLayer.__name__] = Cropping3DLayer

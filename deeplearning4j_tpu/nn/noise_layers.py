@@ -1,0 +1,92 @@
+"""Noise/regularization layers: Gaussian noise & dropout variants.
+
+Reference parity: the reference models these as IDropout implementations
+applied inside layers (nn/conf/dropout/{GaussianNoise, GaussianDropout,
+AlphaDropout, SpatialDropout}.java) and Keras imports them as standalone
+layers; here they are standalone layers on the same random ops, active
+only in the training graph (inference build is the identity).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.layers import BaseLayer, LAYER_TYPES
+
+
+def _passthrough_type(self, itype):
+    return itype
+
+
+@dataclasses.dataclass
+class GaussianNoiseLayer(BaseLayer):
+    """Additive N(0, stddev) noise at train time (reference:
+    nn/conf/dropout/GaussianNoise.java)."""
+    stddev: float = 0.1
+
+    output_type = _passthrough_type
+
+    def build(self, ctx, x, itype):
+        if not ctx.training or self.stddev <= 0:
+            return x, itype
+        out = ctx.sd.invoke("gaussian_noise", [x],
+                            {"stddev": self.stddev},
+                            name=ctx.lname("gnoise"))
+        return out, itype
+
+
+@dataclasses.dataclass
+class GaussianDropoutLayer(BaseLayer):
+    """Multiplicative N(1, rate/(1-rate)) noise (reference:
+    nn/conf/dropout/GaussianDropout.java)."""
+    rate: float = 0.1
+
+    output_type = _passthrough_type
+
+    def build(self, ctx, x, itype):
+        if not ctx.training or self.rate <= 0:
+            return x, itype
+        out = ctx.sd.invoke("gaussian_dropout", [x], {"rate": self.rate},
+                            name=ctx.lname("gdrop"))
+        return out, itype
+
+
+@dataclasses.dataclass
+class AlphaDropoutLayer(BaseLayer):
+    """SELU-compatible dropout (reference: nn/conf/dropout/
+    AlphaDropout.java; dropout = RETAIN probability)."""
+    dropout: float = 0.95
+
+    output_type = _passthrough_type
+
+    def build(self, ctx, x, itype):
+        if not ctx.training or self.dropout >= 1.0:
+            return x, itype
+        out = ctx.sd.invoke("alpha_dropout", [x], {"p": self.dropout},
+                            name=ctx.lname("adrop"))
+        return out, itype
+
+
+@dataclasses.dataclass
+class SpatialDropoutLayer(BaseLayer):
+    """Whole-channel dropout for cnn/rnn tensors (reference:
+    nn/conf/dropout/SpatialDropout.java; dropout = RETAIN prob)."""
+    dropout: float = 0.9
+
+    output_type = _passthrough_type
+
+    def build(self, ctx, x, itype):
+        if not ctx.training or self.dropout >= 1.0:
+            return x, itype
+        if itype.kind in ("cnn", "cnn3d"):
+            axis = -1 if ctx.cnn_format.endswith("C") else 1
+        else:
+            axis = -1                       # (B, T, C) sequences
+        out = ctx.sd.invoke("spatial_dropout", [x],
+                            {"p": self.dropout, "channel_axis": axis},
+                            name=ctx.lname("sdrop"))
+        return out, itype
+
+
+for _cls in [GaussianNoiseLayer, GaussianDropoutLayer, AlphaDropoutLayer,
+             SpatialDropoutLayer]:
+    LAYER_TYPES[_cls.__name__] = _cls

@@ -140,3 +140,18 @@ def gaussian_noise(x, stddev: float, key=None, seed=None, training: bool = True)
     if not training:
         return x
     return x + stddev * jax.random.normal(_key(key, seed), x.shape, dtype=x.dtype)
+
+
+@op("spatial_dropout", _R, n_inputs=1)
+def spatial_dropout(x, p: float, key=None, seed=None, training: bool = True,
+                    channel_axis: int = -1):
+    """Channel-wise dropout: one Bernoulli per (batch, channel), the
+    whole feature map drops together (reference:
+    nn/conf/dropout/SpatialDropout.java; p = retain probability)."""
+    if not training or p >= 1.0:
+        return x
+    axis = channel_axis % x.ndim
+    mask_shape = tuple(x.shape[d] if d in (0, axis) else 1
+                       for d in range(x.ndim))
+    mask = jax.random.bernoulli(_key(key, seed), p, mask_shape)
+    return jnp.where(mask, x / p, 0.0).astype(x.dtype)

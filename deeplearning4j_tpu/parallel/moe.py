@@ -60,36 +60,60 @@ def switch_gating(x, gate_w, capacity: int):
 def moe_ffn(x, gate_w, w_in, w_out, b_in=None, b_out=None,
             capacity_factor: float = 1.25,
             activation: Callable = jax.nn.gelu,
-            expert_sharded: bool = False):
+            expert_sharded: bool = False, n_groups: int = 1):
     """Switch-routed expert FFN over flattened tokens.
 
     x: (N, d); gate_w: (d, E); w_in: (E, d, f); w_out: (E, f, d).
     Returns (y (N, d), aux_loss). With ``expert_sharded`` the
     expert-major intermediates and weights get a sharding constraint on
     EXPERT_AXIS (call under a Mesh; GSPMD does the token all-to-alls).
+
+    ``n_groups``: GShard-style token grouping. The dense dispatch tensor
+    is (S, E, C) PER GROUP with S = N/G and C ≈ cf·S/E, so its size is
+    N·E·cf·N/(G²·E) = cf·N²/G² — pick G ~ sqrt(N)/16 at large N to keep
+    it linear-ish; G=1 recovers plain Switch routing. Routing (and
+    capacity, and overflow drops) become per-group.
     """
     n, d = x.shape
     e = gate_w.shape[1]
-    capacity = max(int(capacity_factor * n / e), 1)
-    dispatch, combine, aux = switch_gating(x, gate_w, capacity)
+    if n % n_groups:
+        raise ValueError(f"tokens {n} not divisible by n_groups "
+                         f"{n_groups}")
+    s = n // n_groups
+    capacity = max(int(capacity_factor * s / e), 1)
 
-    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    def route(xg):
+        return switch_gating(xg, gate_w, capacity)
+
+    if n_groups == 1:
+        dispatch, combine, aux = route(x)
+        dispatch = dispatch[None]
+        combine = combine[None]
+        xg = x[None]
+    else:
+        xg = x.reshape(n_groups, s, d)
+        dispatch, combine, aux = jax.vmap(route)(xg)
+        aux = jnp.mean(aux)
+
+    expert_inputs = jnp.einsum("gsec,gsd->gecd",
+                               dispatch.astype(x.dtype), xg)
     if expert_sharded:
-        spec_ecd = P(EXPERT_AXIS, None, None)
-        expert_inputs = with_sharding_constraint(expert_inputs, spec_ecd)
-        w_in = with_sharding_constraint(w_in, spec_ecd)
-        w_out = with_sharding_constraint(w_out, spec_ecd)
-    h = jnp.einsum("ecd,edf->ecf", expert_inputs, w_in.astype(x.dtype))
+        spec = P(None, EXPERT_AXIS, None, None)
+        expert_inputs = with_sharding_constraint(expert_inputs, spec)
+        w_in = with_sharding_constraint(w_in, P(EXPERT_AXIS, None, None))
+        w_out = with_sharding_constraint(w_out, P(EXPERT_AXIS, None, None))
+    h = jnp.einsum("gecd,edf->gecf", expert_inputs, w_in.astype(x.dtype))
     if b_in is not None:
-        h = h + b_in.astype(x.dtype)[:, None, :]
+        h = h + b_in.astype(x.dtype)[None, :, None, :]
     h = activation(h)
-    out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(x.dtype))
+    out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(x.dtype))
     if b_out is not None:
-        out = out + b_out.astype(x.dtype)[:, None, :]
+        out = out + b_out.astype(x.dtype)[None, :, None, :]
     if expert_sharded:
-        out = with_sharding_constraint(out, P(EXPERT_AXIS, None, None))
-    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
-    return y, aux.astype(jnp.float32)
+        out = with_sharding_constraint(out, P(None, EXPERT_AXIS, None,
+                                              None))
+    y = jnp.einsum("gsec,gecd->gsd", combine.astype(x.dtype), out)
+    return y.reshape(n, d), jnp.asarray(aux, jnp.float32)
 
 
 def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,

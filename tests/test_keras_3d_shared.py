@@ -266,3 +266,84 @@ def test_conv_lstm2d_op_direct():
                         jnp.zeros(8, jnp.float32))
     assert out.shape == (2, 3, 4, 4, 2) and hT.shape == (2, 4, 4, 2)
     np.testing.assert_allclose(np.asarray(out[:, -1]), np.asarray(hT))
+
+
+def test_noise_layers_import_identity_at_inference(tmp_path):
+    """GaussianNoise/GaussianDropout/AlphaDropout/SpatialDropout2D/
+    Softmax import; inference output = softmax(x) exactly (noise layers
+    are train-only)."""
+    p = str(tmp_path / "noise.h5")
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 6],
+                        "dtype": "float32", "name": "input"}),
+        ("GaussianNoise", {"name": "gn", "stddev": 0.5}),
+        ("GaussianDropout", {"name": "gd", "rate": 0.3}),
+        ("AlphaDropout", {"name": "ad", "rate": 0.1}),
+        ("Softmax", {"name": "sm", "axis": -1}),
+    ], {})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    got = net.output(x).to_numpy()
+    e = np.exp(x - x.max(1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(1, keepdims=True), atol=1e-5)
+
+
+def test_spatial_dropout_and_cropping3d_import(tmp_path):
+    p = str(tmp_path / "sd3.h5")
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 4, 6, 6, 1],
+                        "dtype": "float32", "name": "input"}),
+        ("SpatialDropout3D", {"name": "sd", "rate": 0.2}),
+        ("Cropping3D", {"name": "cr", "cropping": [[1, 1], [2, 0],
+                                                   [0, 2]]}),
+    ], {})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(2, 4, 6, 6, 1)).astype(np.float32)
+    got = net.output(x.transpose(0, 4, 1, 2, 3)).to_numpy()   # NCDHW
+    want = x[:, 1:3, 2:, :4, :].transpose(0, 4, 1, 2, 3)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_thresholded_relu_import_and_theta_reject(tmp_path):
+    p = str(tmp_path / "tr.h5")
+    _write_seq_h5(p, [
+        ("InputLayer", {"batch_input_shape": [None, 4],
+                        "dtype": "float32", "name": "input"}),
+        ("ThresholdedReLU", {"name": "tr", "theta": 1.0}),
+    ], {})
+    net = import_keras_sequential_model_and_weights(p)
+    x = np.array([[0.5, 1.5, -2.0, 3.0]], np.float32)
+    np.testing.assert_allclose(net.output(x).to_numpy(),
+                               [[0.0, 1.5, 0.0, 3.0]], atol=1e-6)
+    p2 = str(tmp_path / "tr2.h5")
+    _write_seq_h5(p2, [
+        ("InputLayer", {"batch_input_shape": [None, 4],
+                        "dtype": "float32", "name": "input"}),
+        ("ThresholdedReLU", {"name": "tr", "theta": 0.5}),
+    ], {})
+    with pytest.raises(ValueError, match="theta"):
+        import_keras_sequential_model_and_weights(p2)
+
+
+def test_noise_layers_active_in_training():
+    """Train-time noise actually perturbs activations (train graph),
+    while the inference graph passes through."""
+    import jax
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.nn import (
+        GaussianNoiseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.0))
+            .list()
+            .layer(GaussianNoiseLayer(stddev=1.0))
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    X = np.zeros((8, 4), np.float32)
+    Y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+    h1 = net.fit(X, Y, epochs=1, batch_size=8)
+    h2 = net.fit(X, Y, epochs=1, batch_size=8)
+    # lr=0: only the injected noise moves the loss between epochs
+    assert h1.loss_curve.losses[0] != h2.loss_curve.losses[0]
+    out = net.output(X[:2]).to_numpy()
+    np.testing.assert_allclose(out, np.full((2, 2), 0.5), atol=1e-6)

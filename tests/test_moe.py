@@ -131,3 +131,25 @@ def test_moe_training_learns_and_shards():
         for i in range(5):
             p2, l2 = step(p2, xs, ts)
     assert float(l2) == pytest.approx(losses[-1], rel=1e-4)
+
+
+def test_grouped_dispatch_matches_ungrouped_at_ample_capacity():
+    """GShard-style grouping: with capacity ample enough that no group
+    drops tokens, G>1 equals G=1 for a single expert, and runs with the
+    (G,S,E,C) dispatch for many experts."""
+    rng = np.random.default_rng(6)
+    d, f = 8, 16
+    p = _params(rng, d, f, e=1)
+    x = jnp.asarray(rng.normal(size=(32, d)), jnp.float32)
+    y1, _ = moe_ffn(x, p["gate_w"], p["w_in"], p["w_out"],
+                    capacity_factor=2.0, n_groups=1)
+    y4, _ = moe_ffn(x, p["gate_w"], p["w_in"], p["w_out"],
+                    capacity_factor=2.0, n_groups=4)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(y1),
+                               rtol=1e-5, atol=1e-6)
+    p8 = _params(rng, d, f, e=4)
+    y8, aux = moe_ffn(x, p8["gate_w"], p8["w_in"], p8["w_out"],
+                      capacity_factor=4.0, n_groups=4)
+    assert y8.shape == (32, d) and np.isfinite(float(aux))
+    with pytest.raises(ValueError, match="divisible"):
+        moe_ffn(x, p["gate_w"], p["w_in"], p["w_out"], n_groups=5)

@@ -234,3 +234,50 @@ class TestNlpOpsLedger:
         loss = float(registry.exec_op("glove_loss", w, w, b, b,
                                       rows, cols, counts).data)
         np.testing.assert_allclose(loss, 0.0, atol=1e-10)
+
+
+# ---- BERT WordPiece -------------------------------------------------------
+
+def test_wordpiece_greedy_longest_match():
+    from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "un", "##aff", "##able",
+             "##ed", "run", "##ning", "!", "the"]
+    f = BertWordPieceTokenizerFactory(vocab=vocab)
+    assert f.create("unaffable").get_tokens() == ["un", "##aff", "##able"]
+    assert f.create("running").get_tokens() == ["run", "##ning"]
+    assert f.create("The running!").get_tokens() == \
+        ["the", "run", "##ning", "!"]
+    # unknown word falls back whole to [UNK]
+    assert f.create("xyzzy").get_tokens() == ["[UNK]"]
+
+
+def test_wordpiece_encode_with_specials_and_padding():
+    from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "run", "##ning"])}
+    f = BertWordPieceTokenizerFactory(vocab=vocab)
+    ids = f.encode("running", max_len=8)
+    assert ids == [2, 4, 5, 3, 0, 0, 0, 0]   # CLS run ##ning SEP PAD...
+    assert f.encode("running", add_special_tokens=False) == [4, 5]
+
+
+def test_wordpiece_vocab_file(tmp_path):
+    from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(["[PAD]", "[UNK]", "hello", "##!"]))
+    f = BertWordPieceTokenizerFactory(vocab_path=str(p))
+    assert f.create("hello").get_tokens() == ["hello"]
+    assert f.vocab["hello"] == 2
+
+
+def test_wordpiece_contractions_and_sep_truncation():
+    """Regression: punctuation (incl. apostrophes) splits like BERT's
+    BasicTokenizer, and max_len truncation preserves [SEP]."""
+    from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "don", "'", "t", "go"])}
+    f = BertWordPieceTokenizerFactory(vocab=vocab)
+    assert f.create("don't go").get_tokens() == ["don", "'", "t", "go"]
+    ids = f.encode("don't go", max_len=4)
+    assert ids[0] == vocab["[CLS]"] and ids[-1] == vocab["[SEP]"]
+    assert len(ids) == 4
