@@ -917,7 +917,8 @@ def _import_functional(model_cfg: dict, archive: _H5Archive):
 
     _MERGE = {"Add": ("ew", "Add"), "Subtract": ("ew", "Subtract"),
               "Multiply": ("ew", "Product"), "Average": ("ew", "Average"),
-              "Maximum": ("ew", "Max"), "Concatenate": ("merge", None)}
+              "Maximum": ("ew", "Max"), "Concatenate": ("merge", None),
+              "Dot": ("dot", None)}
     flat_hwc = {}            # flatten vertex name -> (h, w, c) permutation
     for name in order:
         lc = layers_cfg[name]
@@ -942,8 +943,22 @@ def _import_functional(model_cfg: dict, archive: _H5Archive):
                             f"Keras {cls} {name!r} consumes Flatten {s!r} "
                             f"of a spatial tensor — Flatten-before-merge "
                             f"topologies are not supported by import")
-                vertex = (ElementWiseVertex(op=op) if kind == "ew"
-                          else MergeVertex())
+                if kind == "dot":
+                    from deeplearning4j_tpu.nn import DotProductVertex
+                    axes = lc["config"].get("axes", -1)
+                    ax_list = axes if isinstance(axes, (list, tuple)) \
+                        else [axes, axes]
+                    if any(a not in (-1, len(in_types[0].dims))
+                           for a in ax_list):
+                        raise ValueError(
+                            f"Keras Dot axes={axes!r}: only the feature "
+                            f"axis is supported by import")
+                    vertex = DotProductVertex(
+                        normalize=lc["config"].get("normalize", False))
+                elif kind == "ew":
+                    vertex = ElementWiseVertex(op=op)
+                else:
+                    vertex = MergeVertex()
                 g = g.add_vertex(vname, vertex,
                                  *[_resolve_alias(built, s) for s in srcs])
                 itypes[vname] = vertex.output_type(in_types)

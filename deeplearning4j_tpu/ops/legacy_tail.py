@@ -1,0 +1,288 @@
+"""Legacy opNum tail: the remaining reference legacy-op families.
+
+Reference parity: libnd4j/include/loops/legacy_ops.h — the enumerated
+elementwise/reduce/index-reduce/boolean families the earlier waves left
+out: absolute-value reductions (AMax/AMin/AMean/ASum), entropy reduces
+(Entropy/LogEntropy/ShannonEntropy), index reduces (FirstIndex/
+LastIndex/IndexAbsoluteMax/Min), logical ops, conditional set/replace
+(CompareAndSet/CompareAndReplace/MatchCondition), and the elementwise
+tail (Affine, SetRange, ScaledTanh, TimesOneMinus, SafeDivide,
+RelativeError family, Stabilize, LstmClip, SquaredNorm/NormP).
+Derivative entries (…Derivative) are n/a by design — jax.grad owns
+gradients.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import add_alias, op
+# legacy logical negation is the registered 'not' kernel under another
+# name; elementwise.py loads before this module in _ensure_loaded
+from deeplearning4j_tpu.ops import elementwise as _elementwise  # noqa: F401
+
+_E = "elementwise"
+_P = "pairwise"
+_R = "reduce"
+
+
+def _axes(dims, ndim):
+    if dims is None or dims == ():
+        return None
+    return tuple(d % ndim for d in (dims if isinstance(dims, (tuple, list))
+                                    else (dims,)))
+
+
+# -- absolute-value reductions (legacy AMax/AMin/AMean/ASum) ---------------
+
+@op("amax", _R, n_inputs=1)
+def amax(x, dims=None, keep_dims: bool = False):
+    return jnp.max(jnp.abs(x), axis=_axes(dims, x.ndim),
+                   keepdims=keep_dims)
+
+
+@op("amin", _R, n_inputs=1)
+def amin(x, dims=None, keep_dims: bool = False):
+    return jnp.min(jnp.abs(x), axis=_axes(dims, x.ndim),
+                   keepdims=keep_dims)
+
+
+@op("amean", _R, n_inputs=1)
+def amean(x, dims=None, keep_dims: bool = False):
+    return jnp.mean(jnp.abs(x), axis=_axes(dims, x.ndim),
+                    keepdims=keep_dims)
+
+
+@op("asum", _R, n_inputs=1)
+def asum(x, dims=None, keep_dims: bool = False):
+    return jnp.sum(jnp.abs(x), axis=_axes(dims, x.ndim),
+                   keepdims=keep_dims)
+
+
+@op("squared_norm", _R, n_inputs=1)
+def squared_norm(x, dims=None, keep_dims: bool = False):
+    """(legacy SquaredNorm; reduce_sqnorm is the axis=/keepdims= form
+    already in ops/reduce.py)"""
+    return jnp.sum(x * x, axis=_axes(dims, x.ndim), keepdims=keep_dims)
+
+
+@op("norm_p", _R, n_inputs=1)
+def norm_p(x, p: float = 2.0, dims=None, keep_dims: bool = False):
+    return jnp.sum(jnp.abs(x) ** p, axis=_axes(dims, x.ndim),
+                   keepdims=keep_dims) ** (1.0 / p)
+
+
+# -- entropy reduces (legacy Entropy/LogEntropy/ShannonEntropy) ------------
+
+@op("entropy", _R, n_inputs=1)
+def entropy(x, dims=None, keep_dims: bool = False):
+    """-sum(p * log(p)); zero-probability entries contribute 0."""
+    p = jnp.asarray(x)
+    t = p * jnp.log(jnp.maximum(p, 1e-30))
+    return -jnp.sum(t, axis=_axes(dims, p.ndim), keepdims=keep_dims)
+
+
+@op("shannon_entropy", _R, n_inputs=1)
+def shannon_entropy(x, dims=None, keep_dims: bool = False):
+    p = jnp.asarray(x)
+    t = p * jnp.log2(jnp.maximum(p, 1e-30))
+    return -jnp.sum(t, axis=_axes(dims, p.ndim), keepdims=keep_dims)
+
+
+@op("log_entropy", _R, n_inputs=1)
+def log_entropy(x, dims=None, keep_dims: bool = False):
+    return jnp.log(entropy(x, dims, keep_dims))
+
+
+# -- index reduces (legacy FirstIndex/LastIndex/IndexAbsoluteMax/Min) ------
+
+_CONDS = {
+    "gt": lambda x, v: x > v, "lt": lambda x, v: x < v,
+    "gte": lambda x, v: x >= v, "lte": lambda x, v: x <= v,
+    "eq": lambda x, v: x == v, "neq": lambda x, v: x != v,
+    "abs_gt": lambda x, v: jnp.abs(x) > v,
+    "abs_lt": lambda x, v: jnp.abs(x) < v,
+}
+
+
+@op("first_index", _R, n_inputs=1, differentiable=False)
+def first_index(x, condition: str = "gt", value: float = 0.0,
+                dims=None):
+    """Index of the first element matching the condition (-1 when none
+    matches). No dims = scalar index into the flattened array, matching
+    the sibling index-reduces (iamax/match_condition) and the
+    reference's BooleanIndexing.firstIndex scalar form; dims = per-slice
+    indices along that axis."""
+    mask = _CONDS[condition](jnp.asarray(x), value)
+    if dims is None:
+        mask = mask.reshape(-1)
+        axis = 0
+    else:
+        axis = dims[0] if isinstance(dims, (tuple, list)) else dims
+    idx = jnp.argmax(mask, axis=axis)
+    any_ = jnp.any(mask, axis=axis)
+    return jnp.where(any_, idx, -1)
+
+
+@op("last_index", _R, n_inputs=1, differentiable=False)
+def last_index(x, condition: str = "gt", value: float = 0.0, dims=None):
+    """Global scalar with no dims (see first_index); per-slice with."""
+    mask = _CONDS[condition](jnp.asarray(x), value)
+    if dims is None:
+        mask = mask.reshape(-1)
+        axis = 0
+    else:
+        axis = dims[0] if isinstance(dims, (tuple, list)) else dims
+    n = mask.shape[axis]
+    rev = jnp.flip(mask, axis=axis)
+    idx = n - 1 - jnp.argmax(rev, axis=axis)
+    any_ = jnp.any(mask, axis=axis)
+    return jnp.where(any_, idx, -1)
+
+
+@op("iamax", _R, n_inputs=1, differentiable=False)
+def iamax(x, dims=None):
+    """argmax(|x|) (legacy IndexAbsoluteMax / BLAS iamax)."""
+    axis = None if dims is None else (dims[0] if isinstance(
+        dims, (tuple, list)) else dims)
+    return jnp.argmax(jnp.abs(x), axis=axis)
+
+
+@op("iamin", _R, n_inputs=1, differentiable=False)
+def iamin(x, dims=None):
+    axis = None if dims is None else (dims[0] if isinstance(
+        dims, (tuple, list)) else dims)
+    return jnp.argmin(jnp.abs(x), axis=axis)
+
+
+@op("match_condition", _R, n_inputs=1, differentiable=False)
+def match_condition(x, condition: str = "gt", value: float = 0.0,
+                    dims=None):
+    """Count of elements matching the condition (reference:
+    MatchCondition reduce; INDArray.matchCondition pairs with the
+    boolean form)."""
+    mask = _CONDS[condition](jnp.asarray(x), value)
+    return jnp.sum(mask, axis=_axes(dims, mask.ndim)).astype(jnp.int64)
+
+
+# -- logical ops (legacy LogicalAnd/Or/Not/Xor — boolean semantics,
+#    distinct from the bitwise int family) --------------------------------
+
+@op("logical_and", _P, n_inputs=2, differentiable=False)
+def logical_and(x, y):
+    return jnp.logical_and(jnp.asarray(x) != 0, jnp.asarray(y) != 0)
+
+
+@op("logical_or", _P, n_inputs=2, differentiable=False)
+def logical_or(x, y):
+    return jnp.logical_or(jnp.asarray(x) != 0, jnp.asarray(y) != 0)
+
+
+@op("logical_xor", _P, n_inputs=2, differentiable=False)
+def logical_xor(x, y):
+    return jnp.logical_xor(jnp.asarray(x) != 0, jnp.asarray(y) != 0)
+
+
+add_alias("logical_not", "not")
+
+
+# -- conditional set/replace (legacy CompareAndSet/CompareAndReplace) ------
+
+@op("compare_and_set", _E, n_inputs=1)
+def compare_and_set(x, compare: float = 0.0, set_value: float = 0.0,
+                    condition: str = "eq", eps: float = 1e-7):
+    """x[i] = set_value where cond(x[i], compare) (reference:
+    CompareAndSet; eq uses epsilon equality like the reference)."""
+    x = jnp.asarray(x)
+    if condition == "eq":
+        mask = jnp.abs(x - compare) < eps
+    else:
+        mask = _CONDS[condition](x, compare)
+    return jnp.where(mask, jnp.asarray(set_value, x.dtype), x)
+
+
+@op("compare_and_replace", _P, n_inputs=2)
+def compare_and_replace(x, y, compare: float = 0.0,
+                        condition: str = "lt"):
+    """x[i] = y[i] where cond(x[i], compare) (reference:
+    CompareAndReplace — replacement values come from the second
+    tensor)."""
+    x = jnp.asarray(x)
+    mask = _CONDS[condition](x, compare)
+    return jnp.where(mask, jnp.asarray(y, x.dtype), x)
+
+
+# -- elementwise tail ------------------------------------------------------
+
+@op("affine", _E, n_inputs=1)
+def affine(x, a: float = 1.0, b: float = 0.0):
+    """a*x + b (legacy Affine)."""
+    return a * jnp.asarray(x) + b
+
+
+@op("set_range", _E, n_inputs=1)
+def set_range(x, min: float = 0.0, max: float = 1.0):
+    """Clip into [min, max] (legacy SetRange)."""
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+@op("scaled_tanh", _E, n_inputs=1)
+def scaled_tanh(x, a: float = 1.7159, b: float = 2.0 / 3.0):
+    """a * tanh(b * x) (legacy ScaledTanh; LeCun's constants)."""
+    return a * jnp.tanh(b * jnp.asarray(x))
+
+
+@op("times_one_minus", _E, n_inputs=1)
+def times_one_minus(x):
+    """x * (1 - x) — the sigmoid-derivative form (legacy TimesOneMinus)."""
+    x = jnp.asarray(x)
+    return x * (1.0 - x)
+
+
+@op("safe_divide", _P, n_inputs=2)
+def safe_divide(x, y):
+    """x / y with 0 where y == 0 (legacy SafeDivide)."""
+    y = jnp.asarray(y)
+    return jnp.where(y == 0, jnp.zeros_like(jnp.asarray(x) * y),
+                     jnp.asarray(x) / jnp.where(y == 0, 1, y))
+
+
+@op("relative_error", _P, n_inputs=2)
+def relative_error(x, y):
+    """|x - y| / max(|x|, |y|), 0 where both are 0 (legacy
+    RelativeError / BinaryRelativeError)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    den = jnp.maximum(jnp.abs(x), jnp.abs(y))
+    return jnp.where(den == 0, 0.0, jnp.abs(x - y)
+                     / jnp.where(den == 0, 1, den))
+
+
+@op("stabilize", _E, n_inputs=1)
+def stabilize(x, k: float = 1.0, cutoff: float = -100.0):
+    """Clamp k*x away from exp-underflow range (legacy Stabilize —
+    the reference uses it to keep logits in a numerically safe band)."""
+    x = jnp.asarray(x) * k
+    return jnp.clip(x, cutoff, -cutoff)
+
+
+@op("lstm_clip", _E, n_inputs=1)
+def lstm_clip(x, clip: float = 1.0):
+    """Cell-state clipping (legacy LstmClip)."""
+    return jnp.clip(jnp.asarray(x), -clip, clip)
+
+
+@op("is_negative", _E, n_inputs=1, differentiable=False)
+def is_negative(x):
+    return jnp.asarray(x) < 0
+
+
+@op("is_positive", _E, n_inputs=1, differentiable=False)
+def is_positive(x):
+    return jnp.asarray(x) > 0
+
+
+@op("is_inf_or_nan", _E, n_inputs=1, differentiable=False)
+def is_inf_or_nan(x):
+    x = jnp.asarray(x)
+    return jnp.logical_or(jnp.isinf(x), jnp.isnan(x))

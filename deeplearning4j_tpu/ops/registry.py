@@ -209,9 +209,9 @@ def _ensure_loaded() -> None:
         return
     _LOADED = True
     from deeplearning4j_tpu.ops import (  # noqa: F401
-        breadth, control_flow, elementwise, pairwise, reduce as _reduce,
-        shape_ops, random as _random, linalg, nlp_ops, nn_ops, nn_ext, loss,
-        bitwise, image, tf_compat,
+        breadth, control_flow, elementwise, legacy_tail, pairwise,
+        reduce as _reduce, shape_ops, random as _random, linalg, nlp_ops,
+        nn_ops, nn_ext, loss, bitwise, image, tf_compat,
     )
     # breadth2 last: its reference-name aliases point at ops the modules
     # above register

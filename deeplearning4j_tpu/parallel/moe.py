@@ -68,11 +68,12 @@ def moe_ffn(x, gate_w, w_in, w_out, b_in=None, b_out=None,
     expert-major intermediates and weights get a sharding constraint on
     EXPERT_AXIS (call under a Mesh; GSPMD does the token all-to-alls).
 
-    ``n_groups``: GShard-style token grouping. The dense dispatch tensor
-    is (S, E, C) PER GROUP with S = N/G and C ≈ cf·S/E, so its size is
-    N·E·cf·N/(G²·E) = cf·N²/G² — pick G ~ sqrt(N)/16 at large N to keep
-    it linear-ish; G=1 recovers plain Switch routing. Routing (and
-    capacity, and overflow drops) become per-group.
+    ``n_groups``: GShard-style token grouping. The materialized dispatch
+    tensor is (G, S, E, C) with S = N/G and C ≈ cf·S/E, i.e. TOTAL size
+    G·S·E·C = cf·N²/G — memory falls linearly in G (per-group it is
+    cf·N²/G²). At large N pick G so that cf·N²/G fits the budget (e.g.
+    G = N/1024 caps it at cf·N·1024); G=1 recovers plain Switch
+    routing. Routing, capacity, and overflow drops become per-group.
     """
     n, d = x.shape
     e = gate_w.shape[1]

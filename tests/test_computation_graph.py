@@ -303,3 +303,34 @@ def test_l2_normalize_vertex_cnn_all_nonbatch_dims():
     out = net.output(x)[0].to_numpy()
     norm = np.sqrt((x ** 2).sum(axis=(1, 2, 3), keepdims=True))
     np.testing.assert_allclose(out, x / norm, rtol=1e-5)
+
+
+def test_dot_product_vertex_ff_and_rnn():
+    """DotProductVertex: ff feature-axis dot -> (B,1); rnn per-timestep
+    dot -> (B,T,1); normalize gives cosine similarity."""
+    import numpy as np
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.nn import (
+        ComputationGraph, DenseLayer, DotProductVertex, InputType,
+        NeuralNetConfiguration, OutputLayer)
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+         .graph_builder().add_inputs("a", "b")
+         .set_input_types(InputType.feed_forward(6),
+                          InputType.feed_forward(6)))
+    g.add_vertex("cos", DotProductVertex(normalize=True), "a", "b")
+    g.add_layer("out", OutputLayer(n_out=2, loss_function="MCXENT"), "cos")
+    net = ComputationGraph(g.set_outputs("out").build()).init()
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(4, 6)).astype(np.float32)
+    xb = rng.normal(size=(4, 6)).astype(np.float32)
+    ff = net.feed_forward(xa, xb)
+    cos = np.asarray(ff["cos"].data)
+    want = (np.sum(xa * xb, 1)
+            / (np.linalg.norm(xa, axis=1) * np.linalg.norm(xb, axis=1)))
+    np.testing.assert_allclose(cos.ravel(), want, atol=1e-5)
+    # rnn kind: per-timestep scalar sequence
+    from deeplearning4j_tpu.nn import GraphVertex
+    v = DotProductVertex()
+    t = InputType.recurrent(5, 7)
+    ot = v.output_type([t, t])
+    assert ot.kind == "rnn" and ot.dims == (1, 7)

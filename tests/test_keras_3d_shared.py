@@ -347,3 +347,63 @@ def test_noise_layers_active_in_training():
     assert h1.loss_curve.losses[0] != h2.loss_curve.losses[0]
     out = net.output(X[:2]).to_numpy()
     np.testing.assert_allclose(out, np.full((2, 2), 0.5), atol=1e-6)
+
+
+def test_spatial_dropout_op_drops_whole_channels():
+    """Direct numeric coverage for the spatial_dropout op (the ledger's
+    EXERCISED pointer): whole channels drop together, kept channels
+    rescale by 1/p, and training=False is the identity."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import registry
+    fn = registry.get_op("spatial_dropout").fn
+    x = jnp.ones((2, 4, 4, 8), jnp.float32)
+    y = np.asarray(fn(x, p=0.5, seed=0, channel_axis=-1))
+    per_channel = y.reshape(2, 16, 8)
+    for b in range(2):
+        for c in range(8):
+            vals = np.unique(per_channel[b, :, c])
+            assert len(vals) == 1 and vals[0] in (0.0, 2.0), vals
+    assert float(np.asarray(
+        fn(x, p=0.5, seed=0, training=False)).sum()) == x.size
+
+
+def test_dot_merge_import_cosine_similarity(tmp_path):
+    """Keras Dot merge (normalize=True -> cosine similarity) imports to
+    DotProductVertex and matches numpy."""
+    p = str(tmp_path / "dot.h5")
+    W = rng.normal(size=(5, 4)).astype(np.float32) * 0.5
+    b = np.zeros(4, np.float32)
+    _write_func_h5(
+        p,
+        [("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_a"}, []),
+         ("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_b"}, []),
+         ("Dense", {"name": "emb", "units": 4, "activation": "linear",
+                    "use_bias": True},
+          [[["in_a", 0, 0, {}]], [["in_b", 0, 0, {}]]]),
+         ("Dot", {"name": "cos", "axes": -1, "normalize": True},
+          [[["emb", 0, 0, {}], ["emb", 1, 0, {}]]])],
+        inputs=["in_a", "in_b"], outputs=[("cos", 0)],
+        weights={"emb": [("kernel", W), ("bias", b)]})
+    net = import_keras_model_and_weights(p)
+    xa = rng.normal(size=(3, 5)).astype(np.float32)
+    xb = rng.normal(size=(3, 5)).astype(np.float32)
+    ea, eb = xa @ W, xb @ W
+    want = (np.sum(ea * eb, axis=1)
+            / (np.linalg.norm(ea, axis=1) * np.linalg.norm(eb, axis=1)))
+    got = net.output(xa, xb)[0].to_numpy()
+    np.testing.assert_allclose(got.ravel(), want, atol=1e-5)
+    # unsupported axes rejected loudly
+    p2 = str(tmp_path / "dot2.h5")
+    _write_func_h5(
+        p2,
+        [("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_a"}, []),
+         ("InputLayer", {"batch_input_shape": [None, 5],
+                         "dtype": "float32", "name": "in_b"}, []),
+         ("Dot", {"name": "d", "axes": 0},
+          [[["in_a", 0, 0, {}], ["in_b", 0, 0, {}]]])],
+        inputs=["in_a", "in_b"], outputs=[("d", 0)], weights={})
+    with pytest.raises(ValueError, match="axes"):
+        import_keras_model_and_weights(p2)

@@ -632,6 +632,9 @@ EXERCISED = {    # nn ops — test_nn / test_layer_breadth / test_layers_ext / t
     # test_keras_3d_shared; init_state is its shape helper
     "conv_lstm2d": "test_keras_3d_shared",
     "conv_lstm2d_init_state": "test_keras_3d_shared",
+    # channel-wise dropout: behavior pinned by the SpatialDropout layer
+    # import + training tests
+    "spatial_dropout": "test_keras_3d_shared",
 }
 
 
@@ -785,6 +788,67 @@ LEDGER.update({
     "solve_ls": spec(
         [_LSQ_A, _LSQ_B],
         lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], rtol=1e-4),
+    # --- legacy opNum tail (legacy_ops.h families) ------------------------
+    "amax": spec([A], lambda x: np.max(np.abs(x))),
+    "amin": spec([A], lambda x: np.min(np.abs(x))),
+    "amean": spec([A], lambda x: np.mean(np.abs(x)), grad=True),
+    "asum": spec([A], lambda x: np.sum(np.abs(x))),
+    "squared_norm": spec([A], lambda x: np.sum(x * x), grad=True),
+    "norm_p": spec([A], lambda x: np.sum(np.abs(x) ** 3) ** (1 / 3),
+                   attrs={"p": 3.0}, rtol=1e-6),
+    "entropy": spec([U], lambda x: -np.sum(x * np.log(x)), grad=True,
+                    rtol=1e-6),
+    "shannon_entropy": spec([U], lambda x: -np.sum(x * np.log2(x)),
+                            rtol=1e-6),
+    "log_entropy": spec([U], lambda x: np.log(-np.sum(x * np.log(x))),
+                        rtol=1e-6),
+    # per-axis form; the no-dims form reduces the FLATTENED array to one
+    # scalar like the sibling index reduces (checked by the second pair)
+    "first_index": spec([np.asarray([[0.0, 2.0, 3.0], [0.0, 0.0, 0.0]])],
+                        lambda x: np.asarray([1, -1]),
+                        attrs={"condition": "gt", "value": 1.0,
+                               "dims": 1}),
+    "last_index": spec([np.asarray([[0.0, 2.0, 3.0], [0.0, 0.0, 0.0]])],
+                       lambda x: np.asarray([2, -1]),
+                       attrs={"condition": "gt", "value": 1.0,
+                              "dims": 1}),
+    "iamax": spec([np.asarray([1.0, -5.0, 3.0])], lambda x: np.int64(1)),
+    "iamin": spec([np.asarray([1.0, -5.0, 3.0])], lambda x: np.int64(0)),
+    "match_condition": spec([A], lambda x: np.sum(x > 0.1),
+                            attrs={"condition": "gt", "value": 0.1}),
+    "logical_and": spec([I1, I2], lambda x, y: (x != 0) & (y != 0)),
+    "logical_or": spec([I1, I2], lambda x, y: (x != 0) | (y != 0)),
+    "logical_xor": spec([I1, I2], lambda x, y: (x != 0) ^ (y != 0)),
+    "logical_not": spec([I1], lambda x: x == 0),
+    "compare_and_set": spec(
+        [np.asarray([1.0, 2.0, 3.0])], lambda x: np.asarray([1.0, 9.0, 3.0]),
+        attrs={"compare": 2.0, "set_value": 9.0, "condition": "eq"}),
+    "compare_and_replace": spec(
+        [A, B_], lambda x, y: np.where(x < 0.0, y, x),
+        attrs={"compare": 0.0, "condition": "lt"}),
+    "affine": spec([A], lambda x: 2.0 * x + 1.0,
+                   attrs={"a": 2.0, "b": 1.0}, grad=True),
+    "set_range": spec([A], lambda x: np.clip(x, -0.5, 0.5),
+                      attrs={"min": -0.5, "max": 0.5}),
+    "scaled_tanh": spec([A], lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x),
+                        grad=True, rtol=1e-6),
+    "times_one_minus": spec([U], lambda x: x * (1 - x), grad=True),
+    "safe_divide": spec(
+        [A, np.asarray(I1, np.float64)],
+        lambda x, y: np.where(y == 0, 0.0, x / np.where(y == 0, 1, y))),
+    "relative_error": spec(
+        [A, B_], lambda x, y: np.where(
+            np.maximum(np.abs(x), np.abs(y)) == 0, 0.0,
+            np.abs(x - y) / np.maximum(np.abs(x), np.abs(y))), rtol=1e-6),
+    "stabilize": spec([A * 100], lambda x: np.clip(x * 2.0, -100, 100),
+                      attrs={"k": 2.0, "cutoff": -100.0}),
+    "lstm_clip": spec([A * 3], lambda x: np.clip(x, -1.5, 1.5),
+                      attrs={"clip": 1.5}),
+    "is_negative": spec([A], lambda x: x < 0),
+    "is_positive": spec([A], lambda x: x > 0),
+    "is_inf_or_nan": spec(
+        [np.asarray([1.0, np.inf, np.nan, -np.inf])],
+        lambda x: np.asarray([False, True, True, True])),
 })
 
 
@@ -1242,3 +1306,15 @@ def test_exercised_pointers_are_real():
         assert op_name in path.read_text(), (
             f"EXERCISED claims {op_name!r} is covered by {f}.py but the op "
             f"name does not appear there")
+
+
+def test_first_last_index_global_scalar_form():
+    """No dims: one scalar index into the flattened array (-1 when no
+    element matches), matching BooleanIndexing.firstIndex."""
+    import jax.numpy as jnp
+    fi = registry.get_op("first_index").fn
+    li = registry.get_op("last_index").fn
+    x = jnp.asarray([[0.0, 2.0], [3.0, 0.0]])
+    assert int(fi(x, condition="gt", value=1.0)) == 1
+    assert int(li(x, condition="gt", value=1.0)) == 2
+    assert int(fi(x, condition="gt", value=99.0)) == -1
