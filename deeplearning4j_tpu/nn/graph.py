@@ -533,6 +533,18 @@ class ComputationGraph:
         return sum(int(np.prod(a.shape))
                    for a in self._sd_train.trainable_params().values())
 
+    def summary(self) -> str:
+        """Vertex table (reference: ComputationGraph.summary())."""
+        lines = [f"ComputationGraph: {len(self.conf.nodes)} vertices, "
+                 f"inputs {list(self.conf.inputs)}, outputs "
+                 f"{list(self.conf.outputs)}, "
+                 f"{self.num_params() if self._sd_train else '?'} params"]
+        for node in self.conf.nodes:
+            kind = type(node.op).__name__
+            lines.append(f"  {node.name:<24} {kind:<28} "
+                         f"<- {', '.join(node.inputs)}")
+        return "\n".join(lines)
+
     def evaluate(self, iterator, evaluation=None):
         from deeplearning4j_tpu.evaluation import Evaluation
         ev = evaluation or Evaluation()

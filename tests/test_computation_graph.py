@@ -334,3 +334,22 @@ def test_dot_product_vertex_ff_and_rnn():
     t = InputType.recurrent(5, 7)
     ot = v.output_type([t, t])
     assert ot.kind == "rnn" and ot.dims == (1, 7)
+
+
+def test_graph_summary():
+    """(reference: ComputationGraph.summary())"""
+    from deeplearning4j_tpu.learning.updaters import Sgd
+    from deeplearning4j_tpu.nn import (
+        ComputationGraph, DenseLayer, ElementWiseVertex, InputType,
+        NeuralNetConfiguration, OutputLayer)
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+         .graph_builder().add_inputs("x")
+         .set_input_types(InputType.feed_forward(4)))
+    g.add_layer("d1", DenseLayer(n_out=8), "x")
+    g.add_layer("d2", DenseLayer(n_out=8), "x")
+    g.add_vertex("add", ElementWiseVertex(op="Add"), "d1", "d2")
+    g.add_layer("out", OutputLayer(n_out=2, loss_function="MCXENT"), "add")
+    net = ComputationGraph(g.set_outputs("out").build()).init()
+    s = net.summary()
+    assert "ComputationGraph" in s and "ElementWiseVertex" in s
+    assert "<- d1, d2" in s and str(net.num_params()) in s
