@@ -1,0 +1,49 @@
+"""Early stopping with a holdout score calculator and best-model restore."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import (DataSetLossCalculator,
+                                         EarlyStoppingConfiguration,
+                                         EarlyStoppingTrainer,
+                                         MaxEpochsTerminationCondition,
+                                         ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.dataset import ArrayDataSetIterator
+from deeplearning4j_tpu.learning.updaters import Sgd
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    Y = np.eye(2, dtype=np.float32)[y]
+    train = ArrayDataSetIterator(X[:384], Y[:384], batch_size=64)
+    holdout = ArrayDataSetIterator(X[384:], Y[384:], batch_size=64,
+                                   shuffle=False)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.2))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    es = (EarlyStoppingConfiguration.builder()
+          .epoch_termination_conditions(
+              MaxEpochsTerminationCondition(30),
+              ScoreImprovementEpochTerminationCondition(4))
+          .score_calculator(DataSetLossCalculator(holdout))
+          .build())
+    result = EarlyStoppingTrainer(es, net, train).fit(max_epochs=30)
+    print(result)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Keras .h5 import -> run -> transfer-learning fine-tune.
+
+Builds a tiny Keras-format h5 with h5py (stand-in for a real exported
+model), imports it, and replaces the head for a new task.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+import json
+import tempfile
+
+import h5py
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.modelimport import (
+    import_keras_sequential_model_and_weights)
+from deeplearning4j_tpu.nn import (FineTuneConfiguration, OutputLayer,
+                                   TransferLearning)
+
+
+def write_fixture(path):
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(8, 16).astype(np.float32) * 0.3
+    b1 = np.zeros(16, np.float32)
+    w2 = rng.randn(16, 4).astype(np.float32) * 0.3
+    b2 = np.zeros(4, np.float32)
+    cfg = {"class_name": "Sequential", "config": {"name": "m", "layers": [
+        {"class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 8], "dtype": "float32",
+                    "name": "input"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 16, "activation": "relu",
+                    "use_bias": True}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "units": 4, "activation": "softmax",
+                    "use_bias": True}}]}}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        for name, ws in (("d1", (w1, b1)), ("d2", (w2, b2))):
+            g = mw.create_group(name)
+            names = []
+            for suffix, arr in zip(("kernel", "bias"), ws):
+                full = f"{name}/{suffix}:0"
+                mw.create_dataset(full, data=arr)
+                names.append(full.encode())
+            g.attrs["weight_names"] = names
+
+
+def main():
+    path = tempfile.mktemp(suffix=".h5")
+    write_fixture(path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    print("imported model output:", net.output(x).to_numpy().shape)
+
+    # freeze the trunk, replace the 4-class head with a 2-class one
+    tuned = (TransferLearning.builder(net)
+             .fine_tune_configuration(FineTuneConfiguration(
+                 updater=Adam(1e-2)))
+             .set_feature_extractor(0)          # freeze layer 0
+             .remove_output_layer()
+             .add_layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+             .build())
+    y = (x[:, 0] > 0).astype(int)
+    hist = tuned.fit(x, np.eye(2, dtype=np.float32)[y], epochs=10,
+                     batch_size=4)
+    print("fine-tune loss:", round(hist.loss_curve.losses[0], 3), "->",
+          round(hist.loss_curve.losses[-1], 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Switch-MoE with expert parallelism over a (data, expert) mesh.
+
+Run with a virtual CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/moe_expert_parallel.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import (
+    EXPERT_AXIS, expert_parallel_specs, init_moe_params, moe_train_step)
+
+
+def main():
+    n = jax.device_count()
+    ep = 2 if n % 2 == 0 else 1
+    dp = max(n // ep, 1)
+    d, f, e = 16, 64, ep * 2
+    rng = np.random.default_rng(0)
+    params = init_moe_params(rng, d, f, e)
+    x = jnp.asarray(rng.normal(size=(dp * 64, d)), jnp.float32)
+    tgt = jnp.tanh(x)
+
+    mesh = Mesh(np.array(jax.devices()[:dp * ep]).reshape(dp, ep),
+                ("data", EXPERT_AXIS))
+    specs = expert_parallel_specs()
+    with mesh:
+        p = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+             for k, v in params.items()}
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        ts = jax.device_put(tgt, NamedSharding(mesh, P("data", None)))
+        step = jax.jit(lambda pp, a, b: moe_train_step(
+            pp, a, b, expert_sharded=True))
+        for i in range(10):
+            p, loss = step(p, xs, ts)
+        print(f"mesh data={dp} x expert={ep}, {e} experts, "
+              f"final loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

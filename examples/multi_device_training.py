@@ -1,0 +1,54 @@
+"""Data x tensor parallel training over a device mesh.
+
+Run with a virtual CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/multi_device_training.py
+On real hardware the same code uses the actual chips.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel import (
+    DeviceMesh, ParallelTrainer, data_parallel,
+    megatron_data_and_tensor_parallel)
+
+
+def main():
+    n = jax.device_count()
+    model = 2 if n % 2 == 0 else 1
+    data = max(n // model, 1)
+    print(f"{n} devices -> mesh data={data} x model={model}")
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    mesh = DeviceMesh.create(devices=jax.devices()[:data * model],
+                             data=data, model=model)
+    strategy = (megatron_data_and_tensor_parallel(mesh, net)
+                if model > 1 else data_parallel(mesh))
+    trainer = ParallelTrainer(net, strategy)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(data * 32, 16)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, len(X))]
+    history = trainer.fit([(X, Y)], epochs=5)
+    print("losses:", [round(l, 3) for l in history.loss_curve.losses])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Word2Vec on a toy corpus: train skip-gram embeddings on device, query
+nearest words (the deeplearning4j-nlp quickstart)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+from deeplearning4j_tpu.nlp import Word2Vec
+
+CORPUS = [
+    "the king rules the kingdom",
+    "the queen rules the kingdom",
+    "the king is a royal man",
+    "the queen is a royal woman",
+    "a man walks the dog",
+    "a woman walks the dog",
+    "the dog chases the cat",
+    "the cat sees the dog",
+] * 40
+
+
+def main():
+    w2v = (Word2Vec.builder()
+           .vector_size(24).window_size(3).min_word_frequency(2)
+           .epochs(12).seed(7).build())
+    w2v.fit(CORPUS)
+    for word in ("king", "dog"):
+        print(word, "->", w2v.words_nearest(word, top_n=3))
+    print("similarity(king, queen):",
+          round(w2v.similarity("king", "queen"), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Every example script must actually run (the dl4j-examples role: these
+are the first thing a migrating user executes)."""
+import os
+import runpy
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(HERE, "examples")
+
+SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
+           "keras_import_finetune.py", "word2vec_text.py",
+           "multi_device_training.py", "moe_expert_parallel.py",
+           "early_stopping_holdout.py"]
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (script, proc.stdout[-1500:],
+                                  proc.stderr[-1500:])
+    assert proc.stdout.strip(), script

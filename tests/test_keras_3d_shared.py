@@ -407,3 +407,56 @@ def test_dot_merge_import_cosine_similarity(tmp_path):
         inputs=["in_a", "in_b"], outputs=[("d", 0)], weights={})
     with pytest.raises(ValueError, match="axes"):
         import_keras_model_and_weights(p2)
+
+
+def test_new_layer_types_serde_roundtrip(tmp_path):
+    """Every round-5 layer/vertex type survives config JSON + model zip
+    round-trips (LAYER_TYPES/VERTEX_TYPES registration is easy to forget
+    and fails only at load time)."""
+    import numpy as np
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.nn import (
+        AlphaDropoutLayer, ComputationGraph, Cropping3DLayer, DenseLayer,
+        DotProductVertex, GaussianDropoutLayer, GaussianNoiseLayer,
+        InputType, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
+        SpatialDropoutLayer)
+    from deeplearning4j_tpu.nn.recurrent_layers import ConvLSTM2DLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(ConvLSTM2DLayer(n_out=2, kernel_size=(3, 3),
+                                   return_sequences=True))
+            .layer(Cropping3DLayer(cropping=(0, 0, 1, 1, 1, 1)))
+            .layer(SpatialDropoutLayer(dropout=0.9))
+            .layer(GaussianNoiseLayer(stddev=0.1))
+            .layer(GaussianDropoutLayer(rate=0.1))
+            .layer(AlphaDropoutLayer(dropout=0.95))
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.convolutional3d(3, 6, 6, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    X = np.random.RandomState(0).rand(2, 1, 3, 6, 6).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[[0, 1]]
+    net.fit(X, Y, epochs=1, batch_size=2)
+    p = str(tmp_path / "m.zip")
+    net.save(p)
+    loaded = MultiLayerNetwork.load(p)
+    np.testing.assert_allclose(loaded.output(X).to_numpy(),
+                               net.output(X).to_numpy(), atol=1e-6)
+
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+         .graph_builder().add_inputs("a", "b")
+         .set_input_types(InputType.feed_forward(4),
+                          InputType.feed_forward(4)))
+    g.add_layer("ea", DenseLayer(n_out=3), "a")
+    g.add_layer("eb", DenseLayer(n_out=3), "b")
+    g.add_vertex("dot", DotProductVertex(normalize=True), "ea", "eb")
+    g.add_layer("out", OutputLayer(n_out=2, loss_function="MCXENT"), "dot")
+    gnet = ComputationGraph(g.set_outputs("out").build()).init()
+    Xa = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    p2 = str(tmp_path / "g.zip")
+    gnet.save(p2)
+    gl = ComputationGraph.load(p2)
+    np.testing.assert_allclose(
+        np.asarray(gl.output(Xa, Xa)[0].data),
+        np.asarray(gnet.output(Xa, Xa)[0].data), atol=1e-6)
