@@ -89,7 +89,10 @@ class BestScoreEpochTerminationCondition:
         self.best_expected_score = best_expected_score
 
     def terminate(self, epoch: int, score: float, improved: bool) -> bool:
-        return score <= self.best_expected_score
+        # strict <: merely REACHING the target is not beating it
+        # (reference BestScoreEpochTerminationCondition.java uses
+        # score < bestExpectedScore when lesser is better)
+        return score < self.best_expected_score
 
     def __repr__(self):
         return (f"BestScoreEpochTerminationCondition"

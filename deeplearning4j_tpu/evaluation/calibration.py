@@ -163,13 +163,17 @@ class EvaluationCalibration:
             rbins.reshape(-1), minlength=hb)
         pbins = np.clip((p * hb).astype(np.int64), 0, hb - 1)
         self._prob_all += np.bincount(pbins.reshape(-1), minlength=hb)
-        # Per-label-class versions use only the rows labeled that class.
-        row_flat = (lab_idx[:, None] * hb + rbins).reshape(-1)
+        # Per-label-class versions: for rows labeled class c, bin ONLY
+        # column c — the positive-label entry (i, c) — matching the
+        # reference residualPlotByLabelClass / probHistogramByLabelClass
+        # (l.mul(currBinBitMask).sum(0): the label one-hot masks out the
+        # other classes' columns). One entry per row, not C.
+        rbin_lab = rbins[np.arange(n), lab_idx]
         self._residual_by_label += np.bincount(
-            row_flat, minlength=c * hb).reshape(c, hb)
-        prow_flat = (lab_idx[:, None] * hb + pbins).reshape(-1)
+            lab_idx * hb + rbin_lab, minlength=c * hb).reshape(c, hb)
+        pbin_lab = pbins[np.arange(n), lab_idx]
         self._prob_by_label += np.bincount(
-            prow_flat, minlength=c * hb).reshape(c, hb)
+            lab_idx * hb + pbin_lab, minlength=c * hb).reshape(c, hb)
 
     def merge(self, other: "EvaluationCalibration") -> None:
         if other._num_classes is None:

@@ -10,14 +10,21 @@
 // kernel accelerates the schema-all-numeric case that feeds training.
 //
 // Exported C ABI:
-//   csv_probe(path, delim, skip, *rows, *cols) -> 0 ok / negative error
-//   csv_parse_f32(path, delim, skip, out, rows, cols) -> 0 ok / -row
-//     (negative (row+1) of the first malformed cell)
+//   csv_probe(path, delim, skip, *rows, *cols) -> 0 ok / CSV_EIO on
+//     unreadable file / -2 on ragged input
+//   csv_parse_f32(path, delim, skip, out, rows, cols) -> 0 ok /
+//     CSV_EIO on unreadable or truncated file / -(row+2) for the first
+//     malformed cell (so a bad cell at data row 0 returns -2, never
+//     colliding with an I/O code)
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <cstdint>
+#include <climits>
 #include <vector>
+
+// I/O failure sentinel, far outside the -(row+2) bad-cell range.
+#define CSV_EIO INT_MIN
 
 namespace {
 
@@ -58,7 +65,7 @@ int csv_probe(const char* path, char delim, int skip,
               int64_t* rows, int64_t* cols) {
     size_t len = 0;
     char* buf = read_all(path, &len);
-    if (!buf) return -1;
+    if (!buf) return CSV_EIO;
     const char* p = buf;
     const char* end = buf + len;
     p = skip_lines(p, end, skip);
@@ -87,7 +94,7 @@ int csv_parse_f32(const char* path, char delim, int skip,
                   float* out, int64_t rows, int64_t cols) {
     size_t len = 0;
     char* buf = read_all(path, &len);
-    if (!buf) return -1;
+    if (!buf) return CSV_EIO;
     char* p = buf;
     char* end = buf + len;
     p = const_cast<char*>(skip_lines(p, end, skip));
@@ -109,7 +116,7 @@ int csv_parse_f32(const char* path, char delim, int skip,
                 if (after == q) {            // empty or non-numeric cell
                     *line_end = saved;
                     std::free(buf);
-                    return static_cast<int>(-(r + 1));
+                    return static_cast<int>(-(r + 2));
                 }
                 out[r * cols + c] = v;
                 q = after;
@@ -122,7 +129,7 @@ int csv_parse_f32(const char* path, char delim, int skip,
                     if (q >= line_end || *q != delim) {
                         *line_end = saved;
                         std::free(buf);
-                        return static_cast<int>(-(r + 1));
+                        return static_cast<int>(-(r + 2));
                     }
                     ++q;
                 }
@@ -133,7 +140,8 @@ int csv_parse_f32(const char* path, char delim, int skip,
         p = nl ? nl + 1 : end;
     }
     std::free(buf);
-    return (r == rows) ? 0 : -1;
+    // fewer rows than probed = file changed between probe and parse
+    return (r == rows) ? 0 : CSV_EIO;
 }
 
 }  // extern "C"

@@ -9,6 +9,10 @@ import numpy as np
 
 from deeplearning4j_tpu.native.build import load
 
+# I/O-failure sentinel shared with fastcsv.cpp (CSV_EIO = INT_MIN); bad
+# cells come back as -(row+2), so the two ranges can never collide.
+CSV_EIO = -(2 ** 31)
+
 
 def _bind(lib: ctypes.CDLL) -> None:
     lib.csv_probe.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
@@ -49,9 +53,11 @@ def read_csv_f32(path: str, delimiter: str = ",",
             path.encode(), delimiter.encode(), skip_num_lines,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             rows.value, cols.value)
+        if rc == CSV_EIO:
+            raise ValueError(f"{path}: cannot read")
         if rc != 0:
             raise ValueError(f"{path}: non-numeric cell at data row "
-                             f"{-rc - 1}")
+                             f"{-rc - 2}")
         return out
     # fallback: pure numpy
     try:

@@ -496,6 +496,17 @@ class ComputationGraph:
             if n in tgt._vars and n in tgt._arrays:
                 tgt._arrays[n] = arr
 
+    def serving_spec(self):
+        """Replica-extraction hook for the serving/ subsystem: the
+        inference graph, declared input names, resolved output variable
+        names, and the parameter sync (see
+        MultiLayerNetwork.serving_spec)."""
+        if self._sd_infer is None:
+            raise RuntimeError("call init() first")
+        out_names = [self._map_infer[o] for o in self.conf.outputs]
+        return (self._sd_infer, list(self.conf.inputs), out_names,
+                self._sync_infer)
+
     def output(self, *inputs, training: bool = False):
         """Forward pass; returns list of output NDArrays (reference:
         ComputationGraph.output(INDArray...))."""

@@ -335,6 +335,14 @@ class MultiLayerNetwork:
             if n in tgt._vars and n in tgt._arrays:
                 tgt._arrays[n] = arr
 
+    def serving_spec(self):
+        """Replica-extraction hook for the serving/ subsystem: the
+        inference graph, its IO names, and the parameter sync that pulls
+        current trained weights into it. Serving executes the SAME graph
+        ``output()`` uses, so served results match it bit for bit."""
+        self._require_init()
+        return self._sd_infer, ["input"], ["output"], self._sync_infer
+
     def output(self, x, training: bool = False):
         """Forward pass (reference: MultiLayerNetwork.output :2471)."""
         self._require_init()

@@ -41,12 +41,15 @@ def switch_gating(x, gate_w, capacity: int):
     expert_1h = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
     gate = jnp.sum(probs * expert_1h, axis=-1)            # (N,)
 
-    # position of each token within its expert's queue (arrival order)
-    pos_in_expert = (jnp.cumsum(expert_1h, axis=0) - expert_1h)
-    pos = jnp.sum(pos_in_expert * expert_1h, axis=-1)     # (N,) float
+    # position of each token within its expert's queue (arrival order).
+    # Accumulated in int32: a float32 cumsum loses exactness past 2^24
+    # tokens per group, silently corrupting queue positions (and thus
+    # capacity drops) at scale.
+    expert_1h_i = expert_1h.astype(jnp.int32)
+    pos_in_expert = jnp.cumsum(expert_1h_i, axis=0) - expert_1h_i
+    pos = jnp.sum(pos_in_expert * expert_1h_i, axis=-1)   # (N,) int32
     keep = pos < capacity                                 # overflow drops
-    slot_1h = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                             dtype=jnp.float32)
+    slot_1h = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
     dispatch = (expert_1h * keep[:, None])[:, :, None] * slot_1h[:, None, :]
     combine = dispatch * gate[:, None, None]
 

@@ -21,17 +21,31 @@ numerical tolerance (tested on the CPU mesh).
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:                           # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from deeplearning4j_tpu.parallel import collectives
 from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS, DeviceMesh
+
+
+def _shard_map_norep(**kw):
+    """shard_map with the replication check off, across jax versions
+    (>= 0.8 spells it check_vma; older, check_rep)."""
+    def deco(f):
+        try:
+            return _shard_map(f, check_vma=False, **kw)
+        except TypeError:
+            return _shard_map(f, check_rep=False, **kw)
+    return deco
 
 
 def _block_attn(q, k, v, m, l, o, scale, mask=None):
@@ -74,9 +88,8 @@ def ring_attention(q, k, v, mesh: DeviceMesh, causal: bool = False,
 
     spec = P(None, axis_name, None, None)
 
-    @functools.partial(
-        shard_map, mesh=mesh.mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False)
+    @_shard_map_norep(mesh=mesh.mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     def _ring(q_blk, k_blk, v_blk):
         b, tq, h, d = q_blk.shape
         tk = k_blk.shape[1]
@@ -118,9 +131,8 @@ def ulysses_attention(q, k, v, mesh: DeviceMesh, causal: bool = False,
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis_name, None, None)
 
-    @functools.partial(
-        shard_map, mesh=mesh.mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False)
+    @_shard_map_norep(mesh=mesh.mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     def _ulysses(q_blk, k_blk, v_blk):
         # (B, T/n, H, D) --a2a--> (B, T, H/n, D)
         def seq_to_head(x):

@@ -1,0 +1,43 @@
+"""serving — ParallelInference-style model serving.
+
+Reference parity: deeplearning4j-parallelwrapper's ParallelInference
+layer (the L7 serving tier of the reference ecosystem's map, PAPER.md
+§1), redesigned for a jit-compiled runtime:
+
+- ``inference``: :class:`ParallelInference` — thread-safe submit/observe
+  front-end with SEQUENTIAL / BATCHED / INPLACE modes over any
+  MultiLayerNetwork or ComputationGraph.
+- ``batching``: dynamic batcher coalescing requests up to
+  ``max_batch_size``/``max_delay_ms``, padded to power-of-two shape
+  buckets so the server compiles O(buckets) XLA programs, not
+  O(request shapes).
+- ``queue``: bounded request queue — admission backpressure
+  (:class:`ServerOverloadedError`), per-request deadlines
+  (:class:`RequestTimeoutError`), graceful drain on shutdown.
+- ``metrics``: counters + latency histograms exported through
+  ``ui.stats.StatsStorage`` records (``{"type": "serving", ...}``).
+- ``loadgen``: closed/open-loop load generator for tests and examples.
+
+See docs/serving.md for the full knob reference.
+"""
+from deeplearning4j_tpu.serving.batching import (
+    Batch, BucketSpec, DynamicBatcher, pad_to_bucket, pow2_buckets)
+from deeplearning4j_tpu.serving.inference import (
+    InferenceMode, ParallelInference, ServingSpec)
+from deeplearning4j_tpu.serving.loadgen import LoadGenerator, LoadResult
+from deeplearning4j_tpu.serving.metrics import (
+    LatencyHistogram, ServingMetrics)
+from deeplearning4j_tpu.serving.queue import (
+    InferenceRequest, RequestQueue, RequestTimeoutError, ServerClosedError,
+    ServerOverloadedError, ServingError)
+
+__all__ = [
+    "ParallelInference", "InferenceMode", "ServingSpec",
+    "DynamicBatcher", "Batch", "BucketSpec", "pow2_buckets",
+    "pad_to_bucket",
+    "RequestQueue", "InferenceRequest",
+    "ServingError", "ServerOverloadedError", "RequestTimeoutError",
+    "ServerClosedError",
+    "ServingMetrics", "LatencyHistogram",
+    "LoadGenerator", "LoadResult",
+]

@@ -1,0 +1,377 @@
+"""ParallelInference: a thread-safe, batching model server.
+
+Reference parity: deeplearning4j-parallelwrapper's ParallelInference
+(parallelism/ParallelInference.java:54) — the L7 layer that turns a
+trained network into a shared inference service. The reference clones
+the model once per worker thread and pins workers to devices; modes:
+
+- ``SEQUENTIAL``: each request runs alone, in arrival order;
+- ``BATCHED``: concurrent requests coalesce into one model invocation
+  (BatchedInferenceObservable);
+- ``INPLACE``: no queue — the holder model is invoked directly in the
+  calling thread (lowest latency, no coalescing).
+
+TPU-native redesign: worker replicas do NOT clone parameters — they
+share ONE inference graph whose jit cache (one compiled XLA program per
+bucket shape, see serving/batching.py) is the shared "replica". Device
+execution is serialized behind a lock (a single XLA stream saturates
+the chip; thread-level concurrency buys host-side overlap of padding /
+scatter with device compute, not parallel kernels). Backpressure,
+deadlines and drain come from serving/queue.py; counters and latency
+histograms from serving/metrics.py; an optional per-batch
+ProfilerSession drops xplane traces for the profiler/ tooling.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batching import Batch, DynamicBatcher
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.queue import (
+    InferenceRequest, RequestQueue, RequestTimeoutError, ServerClosedError,
+    ServerOverloadedError, ServingError, collapse_outputs)
+
+
+class InferenceMode(enum.Enum):
+    """Request scheduling policy (reference: ParallelInference
+    InferenceMode)."""
+
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+    INPLACE = "inplace"
+
+
+class ServingSpec(NamedTuple):
+    """A network's serving contract: inference graph + IO names + the
+    sync that pulls current trained parameters into it (produced by
+    ``MultiLayerNetwork.serving_spec()`` / ``ComputationGraph
+    .serving_spec()``)."""
+
+    sd: object                      # inference-mode SameDiff
+    input_names: List[str]
+    output_names: List[str]
+    sync: Callable[[], None]
+
+
+def _extract_spec(model) -> ServingSpec:
+    if hasattr(model, "serving_spec"):
+        return ServingSpec(*model.serving_spec())
+    raise TypeError(
+        f"{type(model).__name__} is not servable: expected a "
+        f"MultiLayerNetwork / ComputationGraph (anything exposing "
+        f"serving_spec())")
+
+
+class ParallelInference:
+    """Shared, thread-safe inference front-end over a trained network.
+
+    ::
+
+        pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                               max_batch_size=32, max_delay_ms=3.0)
+        y = pi.output(x)                  # blocking
+        fut = pi.submit(x)                # async -> Future
+        ...
+        pi.shutdown()                     # drains the queue
+
+    ``output``/``submit`` accept a (rows, *features) array, one
+    unbatched example (*features), or — for multi-input graphs in
+    SEQUENTIAL/INPLACE mode — a tuple of per-input arrays. Results
+    mirror the wrapped model's ``output()`` (single array, or a list for
+    multi-output graphs). Overload raises
+    :class:`ServerOverloadedError` at submit; expired deadlines surface
+    as :class:`RequestTimeoutError` from the future.
+    """
+
+    def __init__(self, model,
+                 mode: InferenceMode = InferenceMode.BATCHED,
+                 workers: int = 2,
+                 max_batch_size: int = 32,
+                 max_delay_ms: float = 5.0,
+                 max_queue_len: int = 256,
+                 buckets: Optional[Sequence[int]] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 stats_storage=None,
+                 profile_dir: Optional[str] = None):
+        self.model = model
+        self.mode = InferenceMode(mode)
+        self.max_batch_size = int(max_batch_size)
+        if self.mode is InferenceMode.INPLACE and \
+                default_timeout_ms is not None:
+            raise ValueError("INPLACE mode executes synchronously in the "
+                             "calling thread — there is no queue wait for "
+                             "default_timeout_ms to bound")
+        self.default_timeout_ms = default_timeout_ms
+        self.metrics = ServingMetrics()
+        self.stats_storage = stats_storage
+        self.profile_dir = profile_dir
+        self._spec = _extract_spec(model)
+        if self.mode is InferenceMode.BATCHED and \
+                len(self._spec.input_names) != 1:
+            raise ValueError(
+                f"BATCHED mode needs a single-input model; "
+                f"{type(model).__name__} has inputs "
+                f"{self._spec.input_names} — use SEQUENTIAL or INPLACE")
+        self._ph_shapes = [self._placeholder_shape(n)
+                           for n in self._spec.input_names]
+        self._feat_rank = (len(self._ph_shapes[0])
+                           if self._ph_shapes[0] is not None else None)
+        self._exec_lock = threading.Lock()
+        self._shapes_seen = set()
+        self._req_id = 0
+        self._id_lock = threading.Lock()
+        self._closed = False
+        self._spec.sync()           # pull current trained params once
+        self._queue = RequestQueue(
+            max_queue_len,
+            on_timeout=lambda req: self.metrics.inc("requests_timed_out"))
+        self._batcher = DynamicBatcher(
+            self._queue, max_batch_size=self.max_batch_size,
+            max_delay_ms=max_delay_ms, buckets=buckets) \
+            if self.mode is InferenceMode.BATCHED else None
+        self._workers: List[threading.Thread] = []
+        if self.mode is not InferenceMode.INPLACE:
+            for i in range(max(1, int(workers))):
+                t = threading.Thread(target=self._worker_loop,
+                                     name=f"ParallelInference-{i}",
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
+
+    # ------------------------------------------------------------------
+    def _placeholder_shape(self, input_name: str):
+        try:
+            shape = self._spec.sd._vars[input_name].shape
+            return tuple(shape) if shape is not None else None
+        except Exception:
+            return None
+
+    def _next_id(self) -> int:
+        with self._id_lock:
+            self._req_id += 1
+            return self._req_id
+
+    def _prepare(self, x) -> tuple:
+        """-> (list of per-input arrays with a batch dim, squeeze flag)."""
+        if isinstance(x, (tuple, list)):
+            arrs = [np.asarray(a) for a in x]
+        else:
+            arrs = [np.asarray(x)]
+        if len(arrs) != len(self._spec.input_names):
+            raise ValueError(
+                f"model has {len(self._spec.input_names)} inputs "
+                f"{self._spec.input_names}; got {len(arrs)} arrays")
+        squeeze = False
+        if len(arrs) == 1 and self._feat_rank is not None and \
+                arrs[0].ndim == self._feat_rank - 1:
+            arrs = [arrs[0][None]]      # single example: add the row dim
+            squeeze = True
+        if arrs[0].ndim == 0:
+            raise ValueError("scalar input is not a request")
+        # reject wrong feature shapes at admission: a mismatched request
+        # must not reach a coalesced batch (it would fail the whole
+        # dispatch, or worse, a worker thread)
+        for arr, ph, name in zip(arrs, self._ph_shapes,
+                                 self._spec.input_names):
+            if ph is None:
+                continue
+            if arr.ndim != len(ph) or any(
+                    d is not None and d != a
+                    for d, a in zip(ph[1:], arr.shape[1:])):
+                raise ValueError(
+                    f"input {name!r} expects shape {ph} (leading dim = "
+                    f"rows); got {arr.shape}")
+        return arrs, squeeze
+
+    # -- execution core (shared by every mode/worker) -------------------
+    def _execute(self, features: List[np.ndarray],
+                 real_rows: Optional[int] = None) -> List[np.ndarray]:
+        """Run one forward. One compiled program per distinct input
+        shape, shared by all workers (the jit cache lives on the
+        inference graph); the lock serializes device execution AND makes
+        the graph's internal caches safe under concurrent callers."""
+        sig = tuple(tuple(f.shape) for f in features)
+        rows = features[0].shape[0]
+        real = rows if real_rows is None else real_rows
+        ph = dict(zip(self._spec.input_names, features))
+        t0 = time.perf_counter()
+        with self._exec_lock:
+            if sig not in self._shapes_seen:
+                self._shapes_seen.add(sig)
+                self.metrics.inc("compiles")
+            prof = self._profiler_session()
+            try:
+                res = self._spec.sd.output(ph, self._spec.output_names)
+            finally:
+                if prof is not None:
+                    prof.__exit__(None, None, None)
+        outs = [np.asarray(res[n].to_numpy())
+                for n in self._spec.output_names]
+        self.metrics.observe_batch(
+            rows=real, padding=rows - real,
+            exec_ms=(time.perf_counter() - t0) * 1000.0)
+        return outs
+
+    def _profiler_session(self):
+        if not self.profile_dir:
+            return None
+        from deeplearning4j_tpu.profiler import ProfilerSession
+        n = self.metrics.counters["batches_dispatched"]
+        sess = ProfilerSession(
+            log_dir=os.path.join(self.profile_dir, f"batch_{n:06d}"))
+        try:
+            return sess.__enter__()
+        except Exception:
+            return None             # profiling is best-effort
+
+    # -- worker loops ---------------------------------------------------
+    def _worker_loop(self):
+        if self.mode is InferenceMode.BATCHED:
+            loop_body = self._batched_step
+        else:
+            loop_body = self._sequential_step
+        while True:
+            try:
+                progressed = loop_body()
+            except Exception:
+                # last-ditch guard: a worker thread must never die while
+                # the queue accepts work (stranded futures hang clients).
+                # Per-request failure paths live inside the step fns;
+                # anything reaching here is unexpected — keep serving.
+                time.sleep(0.01)
+                progressed = True
+            if not progressed and self._queue.finished:
+                return
+
+    def _batched_step(self) -> bool:
+        batch = self._batcher.next_batch(poll_timeout=0.05)
+        if batch is None:
+            return False
+        try:
+            outs = self._execute([batch.features], real_rows=batch.rows)
+        except Exception as e:
+            self.metrics.inc("requests_failed", len(batch.requests))
+            batch.fail(e)
+            return True
+        batch.resolve(outs)
+        done = time.monotonic()
+        for req in batch.requests:
+            self.metrics.observe_request(
+                queue_wait_ms=(batch.created_t - req.enqueue_t) * 1000.0,
+                e2e_ms=(done - req.enqueue_t) * 1000.0)
+        return True
+
+    def _sequential_step(self) -> bool:
+        reqs = self._queue.take(max_rows=1, timeout=0.05)
+        if not reqs:
+            return False
+        req = reqs[0]
+        t_pop = time.monotonic()
+        try:
+            outs = self._execute(list(req.x))
+        except Exception as e:
+            self.metrics.inc("requests_failed")
+            req.fail(e)
+            return True
+        req.complete(outs)
+        done = time.monotonic()
+        self.metrics.observe_request(
+            queue_wait_ms=(t_pop - req.enqueue_t) * 1000.0,
+            e2e_ms=(done - req.enqueue_t) * 1000.0)
+        return True
+
+    # -- client API -----------------------------------------------------
+    def submit(self, x, timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the model
+        output rows for exactly this request. Raises
+        :class:`ServerOverloadedError` (queue full) or
+        :class:`ServerClosedError` (after shutdown) at the call site."""
+        if self._closed:
+            raise ServerClosedError("ParallelInference is shut down")
+        features, squeeze = self._prepare(x)
+        if self.mode is InferenceMode.BATCHED and \
+                features[0].shape[0] > self.max_batch_size:
+            raise ValueError(
+                f"request of {features[0].shape[0]} rows exceeds "
+                f"max_batch_size {self.max_batch_size}; split it or call "
+                f"the model's output() directly")
+        self.metrics.inc("requests_submitted")
+        if self.mode is InferenceMode.INPLACE:
+            if timeout_ms is not None:
+                raise ValueError("INPLACE mode has no queue; timeout_ms "
+                                 "is not applicable (use BATCHED or "
+                                 "SEQUENTIAL for deadline-bounded "
+                                 "requests)")
+            return self._inplace(features, squeeze)
+        timeout_ms = timeout_ms if timeout_ms is not None \
+            else self.default_timeout_ms
+        deadline = time.monotonic() + timeout_ms / 1000.0 \
+            if timeout_ms is not None else None
+        fut: Future = Future()
+        req = InferenceRequest(x=features, future=fut,
+                               rows=features[0].shape[0], deadline=deadline,
+                               squeeze=squeeze, id=self._next_id())
+        try:
+            self._queue.put(req)
+        except ServerOverloadedError:
+            self.metrics.inc("requests_rejected")
+            raise
+        return fut
+
+    def _inplace(self, features: List[np.ndarray], squeeze: bool) -> Future:
+        fut: Future = Future()
+        t0 = time.monotonic()
+        try:
+            outs = self._execute(features)
+        except Exception as e:
+            self.metrics.inc("requests_failed")
+            fut.set_exception(e)
+            return fut
+        fut.set_result(collapse_outputs(outs, squeeze))
+        self.metrics.observe_request(
+            queue_wait_ms=0.0, e2e_ms=(time.monotonic() - t0) * 1000.0)
+        return fut
+
+    def output(self, x, timeout_ms: Optional[float] = None):
+        """Blocking convenience around :meth:`submit` (reference:
+        ParallelInference.output)."""
+        return self.submit(x, timeout_ms=timeout_ms).result()
+
+    def update_model(self) -> None:
+        """Re-pull trained parameters into the serving graph (reference:
+        ParallelInference.updateModel) — call after further fit()."""
+        with self._exec_lock:
+            self._spec.sync()
+
+    # -- lifecycle ------------------------------------------------------
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop intake; with ``drain`` (default) serve what is queued,
+        otherwise fail pending futures with ServerClosedError. Further
+        submits raise :class:`ServerClosedError`. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.close(drain=drain)
+        for t in self._workers:
+            t.join(timeout=timeout)
+        if self.stats_storage is not None:
+            self.metrics.publish(self.stats_storage)
+
+    def __enter__(self) -> "ParallelInference":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.shutdown(drain=exc_type is None)
+
+
+__all__ = ["InferenceMode", "ParallelInference", "ServingSpec",
+           "ServingError", "ServerOverloadedError", "ServerClosedError",
+           "RequestTimeoutError"]

@@ -1,0 +1,171 @@
+"""Closed- and open-loop load generation for the serving stack.
+
+No reference analogue (the reference ships no load tool); this is the
+standard serving-benchmark pair:
+
+- **closed loop**: N client threads, each issuing its next request only
+  after the previous one completes — measures latency under a fixed
+  concurrency, throughput is an OUTPUT;
+- **open loop**: requests submitted on a fixed-rate clock regardless of
+  completion — the arrival process a real fleet produces; exposes
+  queueing collapse (rejections/timeouts) that closed loops hide.
+
+Used by tests/test_serving.py and examples/serving_mnist.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.queue import (
+    RequestTimeoutError, ServerClosedError, ServerOverloadedError)
+
+
+@dataclass
+class LoadResult:
+    """Outcome of one load run."""
+
+    n_ok: int = 0
+    n_rejected: int = 0             # ServerOverloadedError at submit
+    n_timed_out: int = 0            # RequestTimeoutError from the future
+    n_failed: int = 0               # anything else
+    duration_s: float = 0.0
+    latencies_ms: List[float] = field(default_factory=list)
+
+    @property
+    def n_issued(self) -> int:
+        return self.n_ok + self.n_rejected + self.n_timed_out + self.n_failed
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.n_ok / self.duration_s if self.duration_s > 0 else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(np.asarray(self.latencies_ms), p))
+
+    def stats(self) -> str:
+        return (f"LoadResult: {self.n_ok}/{self.n_issued} ok "
+                f"({self.n_rejected} rejected, {self.n_timed_out} timed "
+                f"out, {self.n_failed} failed) in {self.duration_s:.2f}s "
+                f"-> {self.throughput_rps:.1f} req/s; latency p50 "
+                f"{self.percentile(50):.2f} ms, p95 "
+                f"{self.percentile(95):.2f} ms, p99 "
+                f"{self.percentile(99):.2f} ms")
+
+
+class LoadGenerator:
+    """Drives a :class:`~deeplearning4j_tpu.serving.ParallelInference`.
+
+    ``request_fn(rng, i)`` builds the i-th request payload (a
+    (rows, *features) array); each worker thread gets an independent
+    seeded Generator so runs are reproducible.
+    """
+
+    def __init__(self, server,
+                 request_fn: Callable[[np.random.Generator, int], object],
+                 seed: int = 0):
+        self.server = server
+        self.request_fn = request_fn
+        self.seed = int(seed)
+
+    # -- closed loop ----------------------------------------------------
+    def run_closed(self, n_requests: int = 256, concurrency: int = 4,
+                   timeout_ms: Optional[float] = None) -> LoadResult:
+        result = LoadResult()
+        lock = threading.Lock()
+        counter = {"next": 0}
+
+        def worker(wid: int):
+            rng = np.random.default_rng(self.seed + wid)
+            while True:
+                with lock:
+                    i = counter["next"]
+                    if i >= n_requests:
+                        return
+                    counter["next"] = i + 1
+                x = self.request_fn(rng, i)
+                t0 = time.monotonic()
+                try:
+                    self.server.output(x, timeout_ms=timeout_ms)
+                except ServerOverloadedError:
+                    with lock:
+                        result.n_rejected += 1
+                    continue
+                except RequestTimeoutError:
+                    with lock:
+                        result.n_timed_out += 1
+                    continue
+                except Exception:
+                    with lock:
+                        result.n_failed += 1
+                    continue
+                ms = (time.monotonic() - t0) * 1000.0
+                with lock:
+                    result.n_ok += 1
+                    result.latencies_ms.append(ms)
+
+        t_start = time.monotonic()
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(max(1, int(concurrency)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        result.duration_s = time.monotonic() - t_start
+        return result
+
+    # -- open loop ------------------------------------------------------
+    def run_open(self, n_requests: int = 256, rate_rps: float = 200.0,
+                 timeout_ms: Optional[float] = None) -> LoadResult:
+        result = LoadResult()
+        lock = threading.Lock()
+        rng = np.random.default_rng(self.seed)
+        interval = 1.0 / max(rate_rps, 1e-9)
+        pending = []
+        t_start = time.monotonic()
+        for i in range(n_requests):
+            target = t_start + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            x = self.request_fn(rng, i)
+            t0 = time.monotonic()
+            try:
+                fut = self.server.submit(x, timeout_ms=timeout_ms)
+            except ServerOverloadedError:
+                with lock:              # callbacks also mutate result
+                    result.n_rejected += 1
+                continue
+            except ServerClosedError:
+                with lock:
+                    result.n_failed += 1
+                continue
+
+            def _done(f, t0=t0):
+                with lock:
+                    try:
+                        f.result()
+                    except RequestTimeoutError:
+                        result.n_timed_out += 1
+                    except Exception:
+                        result.n_failed += 1
+                    else:
+                        result.n_ok += 1
+                        result.latencies_ms.append(
+                            (time.monotonic() - t0) * 1000.0)
+
+            fut.add_done_callback(_done)
+            pending.append(fut)
+        for fut in pending:
+            try:
+                fut.exception()     # wait for completion; counted above
+            except Exception:
+                pass
+        result.duration_s = time.monotonic() - t_start
+        return result

@@ -1,0 +1,173 @@
+"""Serving metrics: counters + latency histograms in the ui/stats format.
+
+Reference parity: the reference exposes ParallelInference health only
+through its own counters; the wider reference UI stack persists training
+stats through StatsStorage (ui-model BaseStatsListener ->
+api/storage/StatsStorage). This module gives serving the same treatment:
+everything a load balancer or dashboard needs — queue wait, end-to-end
+latency, batch occupancy, padding waste, compile count, rejection /
+timeout totals — accumulated lock-cheaply in-process and exported as
+``{"type": "serving", ...}`` JSON-lines records through the EXISTING
+:class:`deeplearning4j_tpu.ui.stats.StatsStorage`, so the same tooling
+that reads training stats reads serving stats.
+
+Latency is histogram-based (fixed log-spaced bins, microsecond to
+minute): recording is O(1) with no unbounded memory, percentiles are
+read from the cumulative counts — the standard production shape for
+serving metrics (vs storing every sample).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+# log-spaced bin edges (ms): 0.01 ms .. 60 s, ~12 bins per decade
+_EDGES = np.geomspace(0.01, 60_000.0, 82)
+
+
+class LatencyHistogram:
+    """Fixed-bin log-scale latency histogram with percentile readout."""
+
+    def __init__(self, edges: Optional[np.ndarray] = None):
+        self.edges = np.asarray(edges if edges is not None else _EDGES,
+                                np.float64)
+        # one underflow + one overflow bucket
+        self.counts = np.zeros(len(self.edges) + 1, np.int64)
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        self.counts[int(np.searchsorted(self.edges, ms, side="left"))] += 1
+        self.count += 1
+        self.total_ms += ms
+        self.max_ms = max(self.max_ms, ms)
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; returns the upper edge of the bucket holding
+        the p-th sample (a conservative estimate), 0.0 when empty."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, int(np.ceil(p / 100.0 * self.count)))
+        cum = np.cumsum(self.counts)
+        idx = int(np.searchsorted(cum, target))
+        if idx >= len(self.edges):
+            return float(self.max_ms)
+        # upper edge of the bucket holding the target sample, clamped to
+        # the exact observed max (an edge can overshoot it)
+        return float(min(self.edges[idx], self.max_ms))
+
+    def mean(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": int(self.count),
+                "mean": round(self.mean(), 4),
+                "p50": round(self.percentile(50), 4),
+                "p95": round(self.percentile(95), 4),
+                "p99": round(self.percentile(99), 4),
+                "max": round(self.max_ms, 4)}
+
+
+_COUNTERS = ("requests_submitted", "requests_served", "requests_rejected",
+             "requests_timed_out", "requests_failed", "batches_dispatched",
+             "rows_served", "rows_padded", "compiles")
+
+
+class ServingMetrics:
+    """Thread-safe accumulator for one ParallelInference instance."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {c: 0 for c in _COUNTERS}
+        self.queue_wait_ms = LatencyHistogram()
+        self.e2e_ms = LatencyHistogram()
+        self.exec_ms = LatencyHistogram()
+        self.batch_sizes: Dict[int, int] = {}   # real rows -> dispatches
+        self._start_t = time.time()
+
+    # -- recording ------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe_batch(self, rows: int, padding: int, exec_ms: float) -> None:
+        with self._lock:
+            self.counters["batches_dispatched"] += 1
+            self.counters["rows_served"] += rows
+            self.counters["rows_padded"] += padding
+            self.batch_sizes[rows] = self.batch_sizes.get(rows, 0) + 1
+            self.exec_ms.record(exec_ms)
+
+    def observe_request(self, queue_wait_ms: float, e2e_ms: float) -> None:
+        with self._lock:
+            self.counters["requests_served"] += 1
+            self.queue_wait_ms.record(queue_wait_ms)
+            self.e2e_ms.record(e2e_ms)
+
+    # -- readout --------------------------------------------------------
+    def padding_waste(self) -> float:
+        """Fraction of dispatched rows that were padding."""
+        with self._lock:
+            total = self.counters["rows_served"] + self.counters["rows_padded"]
+            return self.counters["rows_padded"] / total if total else 0.0
+
+    def mean_batch_size(self) -> float:
+        with self._lock:
+            n = self.counters["batches_dispatched"]
+            return self.counters["rows_served"] / n if n else 0.0
+
+    def to_record(self) -> dict:
+        """One ``{"type": "serving", ...}`` record in the ui/stats
+        JSON-lines convention (see ui/stats.py module docstring)."""
+        with self._lock:
+            return {
+                "type": "serving",
+                "t": time.time(),
+                "uptime_s": round(time.time() - self._start_t, 3),
+                "counters": dict(self.counters),
+                "latency_ms": {"queue_wait": self.queue_wait_ms.summary(),
+                               "e2e": self.e2e_ms.summary(),
+                               "exec": self.exec_ms.summary()},
+                "batch": {
+                    "mean_size": round(self.counters["rows_served"] /
+                                       self.counters["batches_dispatched"], 3)
+                    if self.counters["batches_dispatched"] else 0.0,
+                    "padding_waste": round(
+                        self.counters["rows_padded"] /
+                        (self.counters["rows_served"] +
+                         self.counters["rows_padded"]), 4)
+                    if (self.counters["rows_served"] +
+                        self.counters["rows_padded"]) else 0.0,
+                    "size_hist": {str(k): v for k, v in
+                                  sorted(self.batch_sizes.items())}},
+            }
+
+    def publish(self, storage) -> dict:
+        """Append the current snapshot to a ui.stats.StatsStorage."""
+        rec = self.to_record()
+        storage.put(rec)
+        return rec
+
+    def stats(self) -> str:
+        """Printable summary (the Evaluation.stats() convention)."""
+        rec = self.to_record()
+        c = rec["counters"]
+        lines = [f"ServingMetrics: {c['requests_served']} served / "
+                 f"{c['requests_submitted']} submitted "
+                 f"({c['requests_rejected']} rejected, "
+                 f"{c['requests_timed_out']} timed out, "
+                 f"{c['requests_failed']} failed)",
+                 f"  batches: {c['batches_dispatched']} dispatched, "
+                 f"mean size {rec['batch']['mean_size']}, padding waste "
+                 f"{rec['batch']['padding_waste']:.1%}, "
+                 f"{c['compiles']} compiled shapes"]
+        for name in ("queue_wait", "e2e", "exec"):
+            s = rec["latency_ms"][name]
+            lines.append(f"  {name:<10} p50 {s['p50']:.3f} ms  "
+                         f"p95 {s['p95']:.3f} ms  p99 {s['p99']:.3f} ms  "
+                         f"max {s['max']:.3f} ms  (n={s['count']})")
+        return "\n".join(lines)

@@ -16,6 +16,10 @@ Storage format: JSON-lines, one record per event
     {"type": "params", "epoch": e, "params": {name: {mean, std, norm,
         hist, edges, update_norm, update_ratio}}}
     {"type": "memory", "epoch": e, "bytes_in_use": n, "peak_bytes": n}
+    {"type": "serving", "t": wall, "counters": {...}, "latency_ms":
+        {"queue_wait"|"e2e"|"exec": {count, mean, p50, p95, p99, max}},
+        "batch": {mean_size, padding_waste, size_hist}}
+        (written by serving/metrics.ServingMetrics.publish)
 """
 from __future__ import annotations
 

@@ -1,0 +1,69 @@
+"""Model serving: train an MNIST MLP, then serve it with
+ParallelInference — dynamic batching, shape buckets, backpressure, and
+a closed-loop load test with latency percentiles.
+
+The served path is bit-identical to ``net.output()`` while compiling
+only O(buckets) XLA programs for arbitrarily mixed request sizes.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+import numpy as np
+
+from deeplearning4j_tpu.dataset import load_mnist
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.serving import (InferenceMode, LoadGenerator,
+                                        ParallelInference)
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def main():
+    X, y = load_mnist(train=True, n_synthetic=2048)
+    Y = np.eye(10, dtype=np.float32)[y]
+    X = X.reshape(len(X), -1)
+
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=128, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(X, Y, epochs=2, batch_size=128)
+
+    # serve it: coalesce concurrent requests into padded bucket batches
+    storage = StatsStorage()
+    server = ParallelInference(net, mode=InferenceMode.BATCHED, workers=2,
+                               max_batch_size=32, max_delay_ms=3.0,
+                               max_queue_len=256, stats_storage=storage)
+
+    # the served path matches the direct path bit for bit
+    probe = X[:5]
+    assert np.array_equal(server.output(probe),
+                          net.output(probe).to_numpy())
+    print("served output == direct output(): bit-identical")
+
+    # closed-loop load: 4 clients, mixed request sizes 1..8 rows
+    def make_request(rng, i):
+        rows = int(rng.integers(1, 9))
+        idx = rng.integers(0, len(X), size=rows)
+        return X[idx]
+
+    result = LoadGenerator(server, make_request, seed=7).run_closed(
+        n_requests=200, concurrency=4)
+    print(result.stats())
+
+    server.shutdown()               # drains, then publishes metrics
+    print(server.metrics.stats())
+    rec = storage.of_type("serving")[0]
+    print("compiled shapes:", rec["counters"]["compiles"],
+          "| padding waste:", rec["batch"]["padding_waste"])
+
+
+if __name__ == "__main__":
+    main()

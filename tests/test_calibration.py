@@ -54,7 +54,10 @@ def test_residual_plot_counts():
     h = ec.residual_plot_all_classes()
     assert h.bin_counts[0] == 2 and h.bin_counts.sum() == 2
     h0 = ec.residual_plot(0)
-    assert h0.bin_counts.sum() == 2    # the single row is labeled class 0
+    # per-class plots count ONLY the label column (i, c) of rows labeled
+    # c (reference residualPlotByLabelClass): one entry for the one row
+    assert h0.bin_counts.sum() == 1
+    assert h0.bin_counts[0] == 1       # residual |1 - 0.95| -> bin 0
     assert ec.residual_plot(1).bin_counts.sum() == 0
 
 

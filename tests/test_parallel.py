@@ -183,7 +183,10 @@ def test_ulysses_rejects_bad_head_count():
 
 def test_collectives_inside_shard_map():
     from functools import partial
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                       # older jax
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     from deeplearning4j_tpu.parallel import collectives as C
     mesh = DeviceMesh.create(data=8)
