@@ -259,7 +259,12 @@ class InMemoryModelSaver:
 
 class LocalFileModelSaver:
     """Save the best model as a zip in a directory (reference:
-    saver/LocalFileModelSaver — bestModel.bin)."""
+    saver/LocalFileModelSaver — bestModel.bin).
+
+    Writes are atomic (checkpoint/atomic.py): a crash during an
+    improvement save cannot corrupt the previously saved best model —
+    bestModel.zip is either the old complete artifact or the new one.
+    """
 
     def __init__(self, directory: str):
         import os
@@ -271,10 +276,15 @@ class LocalFileModelSaver:
         self.latest_path = None
         self.latest_epoch = -1
 
+    @staticmethod
+    def _atomic_model_save(model, path) -> None:
+        from deeplearning4j_tpu.checkpoint.atomic import atomic_write_via
+        atomic_write_via(path, model.save)
+
     def save_best(self, model, epoch: int, score: float) -> None:
         import os
         path = os.path.join(self.directory, "bestModel.zip")
-        model.save(path)
+        self._atomic_model_save(model, path)
         self.best_path = path
         self.best_epoch = epoch
         self.best_score = score
@@ -282,7 +292,7 @@ class LocalFileModelSaver:
     def save_latest(self, model, epoch: int, score: float) -> None:
         import os
         path = os.path.join(self.directory, "latestModel.zip")
-        model.save(path)
+        self._atomic_model_save(model, path)
         self.latest_path = path
         self.latest_epoch = epoch
 

@@ -937,6 +937,11 @@ class SameDiff:
         constants = self.constants_map()
         iteration = getattr(tc, "iteration_count", 0)
         it_dev = jnp.asarray(iteration, jnp.int32)    # one transfer per fit
+        # the base seed is part of the resumable training state: per-step
+        # keys are fold_in(key(base_seed), absolute_iteration), so a
+        # checkpoint capturing this seed + the iteration counter resumes
+        # the exact key sequence (checkpoint/state.py)
+        self._fit_base_seed = self._seed
         base_key = jax.random.key(self._seed)          # one key per fit
         self._seed += 1
         history = History()
@@ -977,8 +982,15 @@ class SameDiff:
                         np.asarray(jnp.stack([lv for _, lv in pending]))]
                 epoch_losses.extend(vals)
                 if sync_params_on_flush:
+                    # the FULL training state, not just params: a
+                    # checkpoint taken at this flush must capture updater
+                    # state and the iteration counter too (mid-epoch
+                    # snapshots resume bit-exact, checkpoint/listener.py)
                     for n, p in {**params, **svars}.items():
                         self._arrays[n] = jnp.copy(p)
+                    self._updater_state = jax.tree_util.tree_map(
+                        jnp.copy, state)
+                    tc.iteration_count = iters[-1] + 1
                 if self._nan_panic_active(tc):
                     for it, v in zip(iters, vals):
                         if not np.isfinite(v):
@@ -1036,6 +1048,7 @@ class SameDiff:
                     jnp.mean(jnp.stack(epoch_losses)) if epoch_losses
                     else jnp.asarray(float("nan")))
             history.add_epoch(epoch, mean_loss)
+            tc.epoch_count = getattr(tc, "epoch_count", 0) + 1
             if listeners:
                 # sync current params/state into the graph (copies — the next
                 # step donates the working buffers) so listeners can save/eval
@@ -1077,6 +1090,7 @@ class SameDiff:
         constants = self.constants_map()
         iteration = getattr(tc, "iteration_count", 0)
         it_dev = jnp.asarray(iteration, jnp.int32)
+        self._fit_base_seed = self._seed    # resumable RNG state, see fit()
         base_key = jax.random.key(self._seed)
         self._seed += 1
         feats, labels = dataset_iterator.stacked_batches()
@@ -1107,6 +1121,7 @@ class SameDiff:
             self._arrays[n] = p
         self._updater_state = state
         tc.iteration_count = iteration
+        tc.epoch_count = getattr(tc, "epoch_count", 0) + epochs
         return history
 
     # ------------------------------------------------------------------

@@ -86,17 +86,22 @@ def save(sd, path, include_updater_state: bool = True) -> None:
 
     arrays = {name: np.asarray(arr) for name, arr in sd._arrays.items()}
 
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("graph.json", json.dumps(graph, indent=1))
-        buf = io.BytesIO()
-        np.savez(buf, **arrays)
-        zf.writestr("arrays.npz", buf.getvalue())
-        if include_updater_state and sd._updater_state is not None:
-            leaves, treedef = jax.tree_util.tree_flatten(sd._updater_state)
+    # crash-safe: assemble in a temp file, atomically rename into place
+    # (checkpoint/atomic.py) — a killed process never tears the artifact
+    from deeplearning4j_tpu.checkpoint.atomic import atomic_output_file
+    with atomic_output_file(path) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("graph.json", json.dumps(graph, indent=1))
             buf = io.BytesIO()
-            np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                             for i, l in enumerate(leaves)})
-            zf.writestr("updater.npz", buf.getvalue())
+            np.savez(buf, **arrays)
+            zf.writestr("arrays.npz", buf.getvalue())
+            if include_updater_state and sd._updater_state is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    sd._updater_state)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+                zf.writestr("updater.npz", buf.getvalue())
 
 
 def load(path):

@@ -300,7 +300,13 @@ class PerformanceListener(Listener):
 class CheckpointListener(Listener):
     """Periodic model save (reference: optimize/listeners/CheckpointListener
     + autodiff/listeners/checkpoint/CheckpointListener): keep-last-N,
-    every-N-epochs."""
+    every-N-epochs.
+
+    Legacy whole-model-zip variant. Production checkpointing lives in
+    ``deeplearning4j_tpu.checkpoint`` (``checkpoint.CheckpointListener``):
+    asynchronous writes, atomic commits with integrity manifests,
+    iteration/seconds cadences, retention policies, and bit-exact
+    resume including updater/RNG state."""
 
     def __init__(self, save_dir, every_n_epochs: int = 1, keep_last: int = 3):
         import os

@@ -1,0 +1,45 @@
+"""checkpoint/ — asynchronous, atomic, sharded training checkpoints.
+
+The persistence layer the elastic-training roadmap builds on:
+
+- ``atomic``      — crash-safe write primitives (temp file + fsync +
+  ``os.replace``) shared by every serde path in the codebase;
+- ``manifest``    — per-file sha256 manifest + COMMIT marker: the
+  commit protocol that makes a checkpoint directory verifiable;
+- ``state``       — ``TrainingState`` capture/restore: params, updater
+  state, iteration/epoch counters, RNG base seed, normalizer stats —
+  everything needed for BIT-EXACT resume;
+- ``manager``     — ``CheckpointManager``: async background writer,
+  atomic commits, retention (keep-last-N / keep-every-N-epochs /
+  pin-best), multihost per-process shards with a pre-commit barrier;
+- ``listener``    — DL4J-parity ``CheckpointListener`` (every N
+  iterations / epochs / seconds) for any ``fit(listeners=...)`` path;
+- ``savers``      — early-stopping model saver routed through the
+  manager;
+- ``preemption``  — SIGTERM → final synchronous checkpoint → exit.
+
+Reference parity: util/ModelSerializer + optimize/listeners/
+CheckpointListener, redesigned Orbax-style (off-critical-path
+serialization, atomic publish, integrity-verified restore).
+"""
+from deeplearning4j_tpu.checkpoint.atomic import (
+    atomic_copy, atomic_output_file, atomic_write_bytes, atomic_write_via,
+    fsync_dir)
+from deeplearning4j_tpu.checkpoint.listener import CheckpointListener
+from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
+                                                   CheckpointManager)
+from deeplearning4j_tpu.checkpoint.manifest import (is_committed, sha256_file,
+                                                    verify_dir)
+from deeplearning4j_tpu.checkpoint.preemption import Preempted, PreemptionHook
+from deeplearning4j_tpu.checkpoint.savers import CheckpointModelSaver
+from deeplearning4j_tpu.checkpoint.state import (TrainingState,
+                                                 capture_training_state,
+                                                 restore_training_state)
+
+__all__ = [
+    "CheckpointError", "CheckpointListener", "CheckpointManager",
+    "CheckpointModelSaver", "Preempted", "PreemptionHook", "TrainingState",
+    "atomic_copy", "atomic_output_file", "atomic_write_bytes",
+    "atomic_write_via", "capture_training_state", "fsync_dir",
+    "is_committed", "restore_training_state", "sha256_file", "verify_dir",
+]

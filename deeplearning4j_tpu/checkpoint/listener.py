@@ -1,0 +1,175 @@
+"""DL4J-parity CheckpointListener backed by the CheckpointManager.
+
+Reference parity: optimize/listeners/CheckpointListener.java — the
+builder cadences (every N epochs / every N iterations / every N
+seconds) and keep policies, re-based onto the atomic async manager so a
+listener-driven checkpoint can neither tear a file nor stall the train
+loop for serialization.
+
+Plugs into every fit path that accepts ``listeners=``:
+``MultiLayerNetwork.fit``, ``ComputationGraph.fit``, ``SameDiff.fit``,
+and ``parallel.ParallelTrainer.fit``. Declares ``needs_params`` so the
+fit loop syncs current params/updater state/iteration into the graph at
+each listener flush — mid-epoch snapshots see the real training state,
+not the state from the last epoch boundary.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.autodiff.training import Listener
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+from deeplearning4j_tpu.checkpoint.state import capture_training_state
+
+
+class CheckpointListener(Listener):
+    """Periodic checkpoints on an iteration / epoch / wall-clock cadence.
+
+    ``manager_or_dir``: a CheckpointManager, or a directory path (a
+    manager with ``keep_last_n=keep_last`` is created over it).
+    At least one cadence must be set. Checkpoint steps are the global
+    count of iterations COMPLETED at snapshot time (== the restored
+    ``state.iteration``), identical across cadences and stable across
+    restarts.
+    """
+
+    #: fit() syncs params + updater state + iteration into the graph at
+    #: every listener flush when this is set
+    needs_params = True
+
+    def __init__(self, manager_or_dir,
+                 every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = None,
+                 every_n_seconds: Optional[float] = None,
+                 keep_last: int = 3, normalizer=None,
+                 save_on_training_end: bool = False):
+        if isinstance(manager_or_dir, CheckpointManager):
+            self.manager = manager_or_dir
+        else:
+            self.manager = CheckpointManager(manager_or_dir,
+                                             keep_last_n=keep_last)
+        if not any((every_n_iterations, every_n_epochs, every_n_seconds)):
+            raise ValueError("set at least one cadence: every_n_iterations, "
+                             "every_n_epochs, every_n_seconds")
+        if every_n_iterations is not None and every_n_iterations <= 0:
+            raise ValueError("every_n_iterations must be positive")
+        if every_n_epochs is not None and every_n_epochs <= 0:
+            raise ValueError("every_n_epochs must be positive")
+        if every_n_seconds is not None and self.manager.process_count > 1:
+            # each host's wall clock would fire divergently and the
+            # processes would hang on mismatched commit barriers —
+            # multihost cadence must be deterministic (iterations/epochs)
+            raise ValueError(
+                "every_n_seconds is not supported multihost: processes "
+                "would decide to save at different steps and deadlock on "
+                "the commit barrier; use every_n_iterations/every_n_epochs")
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.every_n_seconds = every_n_seconds
+        self.normalizer = normalizer
+        self.save_on_training_end = save_on_training_end
+        # scalar-delivery cadence: iteration checkpoints need flushes on
+        # their own cadence; time-based ones need frequent flushes to
+        # bound save latency (per-iteration delivery — the documented
+        # cost of wall-clock cadence under a compiled step); epoch-only
+        # listeners never need mid-epoch flushes, and because
+        # needs_params makes every flush copy params + the optimizer
+        # tree, their frequency is set huge so fit only flushes at
+        # epoch boundaries
+        if every_n_iterations is not None:
+            self.frequency = every_n_iterations
+        elif every_n_seconds is not None:
+            self.frequency = 1
+        else:
+            self.frequency = 1_000_000_000
+        self._epoch = 0
+        self._last_time_save = None
+        self._last_step: Optional[int] = None
+
+    # -- builder (reference: CheckpointListener.builder(dir)...) --------
+    class Builder:
+        def __init__(self, directory):
+            self._dir = directory
+            self._kw = {}
+
+        def keep_last(self, n: int):
+            self._kw["keep_last"] = int(n); return self
+
+        def save_every_n_epochs(self, n: int):
+            self._kw["every_n_epochs"] = int(n); return self
+
+        def save_every_n_iterations(self, n: int):
+            self._kw["every_n_iterations"] = int(n); return self
+
+        def save_every(self, seconds: float):
+            self._kw["every_n_seconds"] = float(seconds); return self
+
+        def build(self) -> "CheckpointListener":
+            return CheckpointListener(self._dir, **self._kw)
+
+    @staticmethod
+    def builder(directory) -> "CheckpointListener.Builder":
+        return CheckpointListener.Builder(directory)
+
+    # -- cadence --------------------------------------------------------
+    def _save(self, sd, step: int, blocking: bool = False) -> None:
+        state = capture_training_state(sd, epoch=self._epoch,
+                                       normalizer=self.normalizer)
+        # capture_training_state reads tc.iteration_count, which the fit
+        # flush has just synced; step is passed explicitly for cadence
+        self.manager.save(step, state, blocking=blocking)
+        self._last_step = step
+
+    def on_training_start(self, sd):
+        if self._last_time_save is None:
+            self._last_time_save = time.perf_counter()
+
+    def on_epoch_start(self, sd, epoch: int):
+        self._epoch = epoch
+
+    def iterations_done(self, sd, epoch: int, iterations: Sequence[int],
+                        losses: Sequence[float]):
+        self._epoch = epoch
+        it = iterations[-1]
+        fire = False
+        # scalars arrive in bursts; the snapshot granularity is the
+        # burst, so fire if ANY iteration in it hit the cadence (bursts
+        # are at most ``frequency`` long, so at most one hit per burst)
+        if self.every_n_iterations is not None and any(
+                (i + 1) % self.every_n_iterations == 0 for i in iterations):
+            fire = True
+        if self.every_n_seconds is not None:
+            now = time.perf_counter()
+            if now - (self._last_time_save or 0) >= self.every_n_seconds:
+                self._last_time_save = now
+                fire = True
+        # step = iterations COMPLETED (same numbering as the epoch
+        # cadence's tc.iteration_count, so a step checkpointed by both
+        # cadences dedupes instead of committing twice)
+        step = it + 1
+        if fire and step != self._last_step:
+            self._save(sd, step)
+
+    def on_epoch_end(self, sd, epoch: int, mean_loss: float):
+        self._epoch = epoch
+        if self.every_n_epochs is not None and \
+                (epoch + 1) % self.every_n_epochs == 0:
+            tc = sd.training_config
+            step = int(getattr(tc, "iteration_count", 0)) if tc else epoch
+            if step != self._last_step:       # iteration cadence may have
+                self._save(sd, step)          # just committed this state
+
+    def on_training_end(self, sd):
+        if self.save_on_training_end:
+            tc = sd.training_config
+            step = int(getattr(tc, "iteration_count", 0)) if tc else 0
+            if step != self._last_step:
+                self._save(sd, step, blocking=True)
+        # surface any async write error before fit() returns
+        self.manager.wait_until_finished()
+
+    # -- introspection --------------------------------------------------
+    def last_checkpoint(self) -> Optional[int]:
+        """Newest committed step (after wait_until_finished)."""
+        return self.manager.latest_step()

@@ -1,0 +1,66 @@
+"""Early-stopping model saver backed by the CheckpointManager.
+
+Implements the saver protocol ``autodiff.earlystopping`` expects
+(``save_best`` / ``save_latest`` / ``restore_best``) on top of the
+atomic commit path, so "best model so far" can never be torn by a crash
+during an improvement save — the previous best stays committed until
+the new one is.
+
+Reference parity: earlystopping/saver/LocalFileModelSaver, with the
+manager's protocol replacing the direct bestModel.bin write.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+from deeplearning4j_tpu.checkpoint.state import (capture_training_state,
+                                                 restore_training_state)
+
+
+class CheckpointModelSaver:
+    """Saves best/latest models as committed checkpoint steps.
+
+    Steps are the epoch number; the best step is pinned (retention never
+    deletes it) and tagged with the score, so ``manager.best_step()``
+    agrees with ``restore_best``.
+    """
+
+    def __init__(self, manager_or_dir, blocking: bool = True):
+        if isinstance(manager_or_dir, CheckpointManager):
+            self.manager = manager_or_dir
+        else:
+            self.manager = CheckpointManager(
+                manager_or_dir, keep_last_n=2, pin_best_metric="score")
+        self.blocking = blocking
+        self.best_step: Optional[int] = None
+        self.best_epoch = -1
+        self.best_score = float("inf")
+        self.latest_epoch = -1
+
+    def save_best(self, model, epoch: int, score: float) -> None:
+        state = capture_training_state(model, epoch=epoch)
+        prev_best = self.best_step
+        self.manager.save(int(epoch), state, metrics={"score": float(score)},
+                          blocking=self.blocking, pin=True)
+        # only the CURRENT best stays pinned; the dethroned one ages out
+        # through keep_last_n like any other step
+        if prev_best is not None and prev_best != int(epoch):
+            self.manager.unpin(prev_best)
+        self.best_step = int(epoch)
+        self.best_epoch = int(epoch)
+        self.best_score = float(score)
+
+    def save_latest(self, model, epoch: int, score: float) -> None:
+        state = capture_training_state(model, epoch=epoch)
+        self.manager.save(int(epoch), state, metrics={"score": float(score)},
+                          blocking=self.blocking)
+        self.latest_epoch = int(epoch)
+
+    def restore_best(self, model):
+        self.manager.wait_until_finished()
+        if self.best_step is None:
+            return model
+        state = self.manager.restore(self.best_step)
+        restore_training_state(model, state)
+        return model

@@ -1,0 +1,261 @@
+"""Complete training-state snapshot for bit-exact resume.
+
+What "complete" means here (everything the compiled train step threads
+between iterations, plus the host-side counters that derive its inputs):
+
+- ``arrays``         — trainable params AND non-trainable state vars
+  (BN running stats) as host numpy copies;
+- ``updater_leaves`` — the optimizer state pytree, flattened (the
+  treedef is rebuilt from a fresh ``updater.init`` template at restore,
+  the same idiom autodiff/serde uses);
+- ``iteration`` / ``epoch`` — the counters;
+- ``rng_seed``       — the base-key seed of the *current* training run.
+  ``SameDiff.fit`` derives every step's dropout/noise key as
+  ``fold_in(key(seed), absolute_iteration)``, so restoring this seed and
+  the iteration counter makes the resumed run consume exactly the key
+  sequence the uninterrupted run would have — randomness is bit-exact,
+  not just statistics;
+- ``normalizer``     — fitted data-normalizer statistics, so the resumed
+  process preprocesses identically without refitting.
+
+``capture_training_state`` is the ONLY synchronous cost the async
+checkpoint path puts on ``fit()``: a device→host copy of the arrays.
+Serialization, hashing and fsync happen on the manager's writer thread.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+STATE_JSON = "state.json"
+ARRAYS_NPZ = "arrays.npz"          # single-process / shard template below
+ARRAYS_SHARD = "arrays.shard{i:05d}-of-{n:05d}.npz"
+UPDATER_NPZ = "updater.npz"
+NORMALIZER_NPZ = "normalizer.npz"
+FORMAT_VERSION = 1
+
+
+def _as_sd(model_or_sd):
+    return getattr(model_or_sd, "samediff", model_or_sd)
+
+
+@dataclasses.dataclass
+class TrainingState:
+    """Host-memory snapshot of everything needed to resume bit-exactly."""
+    arrays: Dict[str, np.ndarray]
+    updater_leaves: Optional[List[np.ndarray]] = None
+    iteration: int = 0
+    epoch: int = 0
+    rng_seed: Optional[int] = None
+    normalizer_state: Optional[Dict[str, np.ndarray]] = None
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def nbytes(self) -> int:
+        total = sum(a.nbytes for a in self.arrays.values())
+        total += sum(l.nbytes for l in (self.updater_leaves or []))
+        total += sum(np.asarray(v).nbytes
+                     for v in (self.normalizer_state or {}).values())
+        return total
+
+    def make_normalizer(self):
+        """Rebuild the fitted Normalizer object (or None)."""
+        if not self.normalizer_state:
+            return None
+        from deeplearning4j_tpu.dataset import normalizers as nz
+        cls_name = str(np.asarray(self.normalizer_state["__class__"]))
+        cls = {c.__name__: c for c in
+               [nz.NormalizerStandardize, nz.NormalizerMinMaxScaler,
+                nz.ImagePreProcessingScaler]}[cls_name]
+        obj = cls.__new__(cls)
+        obj._load_state(self.normalizer_state)
+        return obj
+
+
+def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
+                           metadata: Optional[Dict[str, Any]] = None
+                           ) -> TrainingState:
+    """Snapshot a SameDiff (or network wrapping one) to host memory.
+
+    This is the device→host copy — the only blocking step of an async
+    save. Arrays are materialized with ``np.asarray`` so later training
+    steps (which DONATE device buffers) cannot alias the snapshot.
+    """
+    import jax
+    sd = _as_sd(model_or_sd)
+    arrays = {n: np.asarray(a) for n, a in
+              {**sd.trainable_params(), **sd.state_vars_map()}.items()}
+    updater_leaves = None
+    if sd._updater_state is not None:
+        updater_leaves = [np.asarray(l) for l in
+                          jax.tree_util.tree_leaves(sd._updater_state)]
+    tc = sd.training_config
+    iteration = int(getattr(tc, "iteration_count", 0)) if tc else 0
+    # the base seed of the run in flight (recorded by fit); falling back
+    # to the next-fit seed keeps pre-fit checkpoints restorable
+    rng_seed = getattr(sd, "_fit_base_seed", None)
+    if rng_seed is None:
+        rng_seed = int(getattr(sd, "_seed", 0))
+    norm_state = None
+    if normalizer is not None:
+        norm_state = {"__class__": np.asarray(type(normalizer).__name__),
+                      **{k: np.asarray(v)
+                         for k, v in normalizer._state().items()}}
+    return TrainingState(arrays=arrays, updater_leaves=updater_leaves,
+                         iteration=iteration, epoch=int(epoch),
+                         rng_seed=int(rng_seed),
+                         normalizer_state=norm_state,
+                         metadata=dict(metadata or {}))
+
+
+def restore_training_state(model_or_sd, state: TrainingState,
+                           strict: bool = True):
+    """Pour a snapshot back into a live (initialized) model/SameDiff.
+
+    strict: raise if the snapshot does not cover every live parameter —
+    a renamed/added layer must not silently resume from fresh init.
+    Returns the rebuilt Normalizer (or None).
+    """
+    import jax
+    import jax.numpy as jnp
+    sd = _as_sd(model_or_sd)
+    live = set(sd.trainable_params()) | set(sd._state_var_names)
+    missing = sorted(live - set(state.arrays))
+    if strict and missing:
+        raise ValueError(
+            f"checkpoint does not cover live parameters "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''} — the graph "
+            f"changed since the snapshot; pass strict=False to restore "
+            f"the matching subset")
+    for n, arr in state.arrays.items():
+        if n not in sd._arrays:
+            continue
+        if tuple(sd._arrays[n].shape) != tuple(arr.shape):
+            if strict:
+                raise ValueError(
+                    f"checkpoint array {n!r} has shape {tuple(arr.shape)} "
+                    f"but the live graph expects "
+                    f"{tuple(sd._arrays[n].shape)}")
+            continue       # non-strict: same-name different-layer, skip
+        sd._arrays[n] = jnp.asarray(arr)
+    if state.updater_leaves is not None and sd.training_config is not None:
+        template = sd.training_config.updater.init(sd.trainable_params())
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        compatible = (len(t_leaves) == len(state.updater_leaves) and all(
+            tuple(np.shape(t)) == tuple(np.shape(s))
+            for t, s in zip(t_leaves, state.updater_leaves)))
+        if compatible:
+            sd._updater_state = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(l) for l in state.updater_leaves])
+        elif strict:
+            raise ValueError(
+                "checkpoint updater state does not match the live "
+                "graph's optimizer structure")
+    tc = sd.training_config
+    if tc is not None:
+        tc.iteration_count = int(state.iteration)
+        tc.epoch_count = int(state.epoch)
+    if state.rng_seed is not None:
+        # next fit() reuses this base key; per-step keys fold in the
+        # absolute iteration, so the continuation replays the exact key
+        # sequence of an uninterrupted run
+        sd._seed = int(state.rng_seed)
+        sd._fit_base_seed = int(state.rng_seed)
+    if hasattr(model_or_sd, "_sync_infer"):
+        model_or_sd._sync_infer()
+    return state.make_normalizer()
+
+
+# ---------------------------------------------------------------------------
+# directory (de)serialization — called on the manager's writer thread
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def shard_names(state: TrainingState, shard_index: int, shard_count: int
+                ) -> List[str]:
+    """Deterministic partition of array names across processes: sorted
+    names round-robined over shards, so every process writes a disjoint
+    subset and the union is total."""
+    names = sorted(state.arrays)
+    return [n for i, n in enumerate(names) if i % shard_count == shard_index]
+
+
+def write_state_files(directory: str, state: TrainingState,
+                      shard_index: int = 0, shard_count: int = 1) -> None:
+    """Write this process's portion of the snapshot into ``directory``
+    (the step's ``.tmp`` staging dir). Every process writes its array
+    shard; process 0 also writes counters/updater/normalizer. Files are
+    fsynced here; manifest/COMMIT/rename are the caller's commit step."""
+    shard = {n: state.arrays[n]
+             for n in shard_names(state, shard_index, shard_count)}
+    fname = ARRAYS_NPZ if shard_count == 1 else \
+        ARRAYS_SHARD.format(i=shard_index, n=shard_count)
+    _write_durable(os.path.join(directory, fname), _npz_bytes(shard))
+    if shard_index != 0:
+        return
+    if state.updater_leaves is not None:
+        _write_durable(
+            os.path.join(directory, UPDATER_NPZ),
+            _npz_bytes({f"leaf_{i}": l
+                        for i, l in enumerate(state.updater_leaves)}))
+    if state.normalizer_state:
+        _write_durable(os.path.join(directory, NORMALIZER_NPZ),
+                       _npz_bytes(state.normalizer_state))
+    meta = {"format_version": FORMAT_VERSION,
+            "iteration": int(state.iteration),
+            "epoch": int(state.epoch),
+            "rng_seed": state.rng_seed,
+            "shard_count": int(shard_count),
+            "has_updater": state.updater_leaves is not None,
+            "has_normalizer": bool(state.normalizer_state),
+            "metadata": state.metadata}
+    _write_durable(os.path.join(directory, STATE_JSON),
+                   json.dumps(meta, indent=1, sort_keys=True).encode())
+
+
+def _write_durable(path: str, data: bytes) -> None:
+    with open(path, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def read_state_files(directory: str) -> TrainingState:
+    """Load a committed step directory back into a TrainingState (merges
+    all array shards)."""
+    with open(os.path.join(directory, STATE_JSON), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    shard_count = int(meta.get("shard_count", 1))
+    arrays: Dict[str, np.ndarray] = {}
+    if shard_count == 1:
+        paths = [os.path.join(directory, ARRAYS_NPZ)]
+    else:
+        paths = [os.path.join(directory,
+                              ARRAYS_SHARD.format(i=i, n=shard_count))
+                 for i in range(shard_count)]
+    for p in paths:
+        with np.load(p) as npz:
+            for k in npz.files:
+                arrays[k] = npz[k]
+    updater_leaves = None
+    if meta.get("has_updater"):
+        with np.load(os.path.join(directory, UPDATER_NPZ)) as npz:
+            updater_leaves = [npz[f"leaf_{i}"] for i in range(len(npz.files))]
+    norm_state = None
+    if meta.get("has_normalizer"):
+        with np.load(os.path.join(directory, NORMALIZER_NPZ)) as npz:
+            norm_state = {k: npz[k] for k in npz.files}
+    return TrainingState(arrays=arrays, updater_leaves=updater_leaves,
+                         iteration=int(meta.get("iteration", 0)),
+                         epoch=int(meta.get("epoch", 0)),
+                         rng_seed=meta.get("rng_seed"),
+                         normalizer_state=norm_state,
+                         metadata=dict(meta.get("metadata", {})))

@@ -5,7 +5,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-import shutil
 from typing import Dict, List, Optional
 
 # named artifacts the zoo knows how to consume (reference: each ZooModel
@@ -42,10 +41,16 @@ class ModelHub:
         os.makedirs(self.cache_dir, exist_ok=True)
 
     def add(self, name: str, src_path: str) -> str:
-        """Copy an artifact into the cache under ``name``."""
+        """Copy an artifact into the cache under ``name``.
+
+        Atomic: the copy lands in a temp file inside the cache dir and
+        is renamed into place (checkpoint/atomic.py), so a partially
+        copied artifact is never visible to ``contains()``/``path()``
+        — a crashed add() leaves the cache entry absent, not torn."""
+        from deeplearning4j_tpu.checkpoint.atomic import atomic_copy
         dst = os.path.join(self.cache_dir, name)
         if os.path.abspath(src_path) != os.path.abspath(dst):
-            shutil.copy2(src_path, dst)
+            atomic_copy(src_path, dst)
         return dst
 
     def contains(self, name: str) -> bool:

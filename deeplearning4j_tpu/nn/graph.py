@@ -572,6 +572,19 @@ class ComputationGraph:
             ev.eval(labs[0], preds[0])
         return ev
 
+    # --- checkpointing (checkpoint/ subsystem) ------------------------
+    def capture_training_state(self, epoch: int = 0, normalizer=None):
+        """Host snapshot for the checkpoint manager
+        (checkpoint.capture_training_state)."""
+        from deeplearning4j_tpu.checkpoint import capture_training_state
+        return capture_training_state(self, epoch=epoch,
+                                      normalizer=normalizer)
+
+    def restore_training_state(self, state, strict: bool = True):
+        """Restore a TrainingState snapshot into this initialized graph."""
+        from deeplearning4j_tpu.checkpoint import restore_training_state
+        return restore_training_state(self, state, strict=strict)
+
     # --- serde --------------------------------------------------------
     def save(self, path, include_updater_state: bool = True) -> None:
         from deeplearning4j_tpu.nn.model_serde import save_net_zip

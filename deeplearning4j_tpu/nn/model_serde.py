@@ -17,23 +17,30 @@ import numpy as np
 def save_net_zip(path, conf_json: str, sd, include_updater_state: bool = True
                  ) -> None:
     """Write the ModelSerializer-style container for a network whose
-    parameters live in SameDiff graph ``sd``."""
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", conf_json)
-        buf = io.BytesIO()
-        np.savez(buf, **{n: np.asarray(a) for n, a in sd._arrays.items()
-                         if n in sd._vars})
-        zf.writestr("parameters.npz", buf.getvalue())
-        if include_updater_state and sd._updater_state is not None:
-            import jax
-            leaves = jax.tree_util.tree_leaves(sd._updater_state)
+    parameters live in SameDiff graph ``sd``.
+
+    Crash-safe: the zip is assembled in a temp file next to ``path`` and
+    atomically renamed into place (checkpoint/atomic.py), so a killed
+    process never leaves a torn zip at the target — the previous file,
+    if any, stays intact until the new one is complete."""
+    from deeplearning4j_tpu.checkpoint.atomic import atomic_output_file
+    with atomic_output_file(path) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", conf_json)
             buf = io.BytesIO()
-            np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                             for i, l in enumerate(leaves)})
-            zf.writestr("updater.npz", buf.getvalue())
-        zf.writestr("iteration.json", json.dumps({
-            "iteration_count": sd.training_config.iteration_count
-            if sd.training_config else 0}))
+            np.savez(buf, **{n: np.asarray(a) for n, a in sd._arrays.items()
+                             if n in sd._vars})
+            zf.writestr("parameters.npz", buf.getvalue())
+            if include_updater_state and sd._updater_state is not None:
+                import jax
+                leaves = jax.tree_util.tree_leaves(sd._updater_state)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+                zf.writestr("updater.npz", buf.getvalue())
+            zf.writestr("iteration.json", json.dumps({
+                "iteration_count": sd.training_config.iteration_count
+                if sd.training_config else 0}))
 
 
 def read_net_zip(path):

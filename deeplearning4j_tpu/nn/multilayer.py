@@ -406,6 +406,23 @@ class MultiLayerNetwork:
         return "\n".join(lines)
 
     # ------------------------------------------------------------------
+    # checkpointing (checkpoint/ subsystem: atomic, async, bit-exact)
+    def capture_training_state(self, epoch: int = 0, normalizer=None):
+        """Host snapshot of params/updater/counters/RNG for the
+        checkpoint manager (checkpoint.capture_training_state)."""
+        from deeplearning4j_tpu.checkpoint import capture_training_state
+        self._require_init()
+        return capture_training_state(self, epoch=epoch,
+                                      normalizer=normalizer)
+
+    def restore_training_state(self, state, strict: bool = True):
+        """Restore a TrainingState snapshot into this initialized net;
+        returns the rebuilt Normalizer (or None)."""
+        from deeplearning4j_tpu.checkpoint import restore_training_state
+        self._require_init()
+        return restore_training_state(self, state, strict=strict)
+
+    # ------------------------------------------------------------------
     # serde (reference: util/ModelSerializer zip of config JSON + params +
     # updater state)
     def save(self, path, include_updater_state: bool = True) -> None:

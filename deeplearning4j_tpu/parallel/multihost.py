@@ -154,6 +154,9 @@ class ElasticTrainer:
     def _save(self, epoch: int) -> None:
         if not is_coordinator():
             return
+        # sd.save is atomic (checkpoint/atomic.py): a preemption mid-save
+        # cannot leave a torn zip that latest() would then restore. For
+        # sharded/async/retained checkpoints use checkpoint.CheckpointManager.
         self.sd.save(self._path(epoch), include_updater_state=True)
         saved = sorted(
             glob.glob(os.path.join(self.dir, "elastic_epoch_*.zip")),

@@ -94,9 +94,22 @@ class ParallelTrainer:
             sd._updater_state = new_state
 
     def fit(self, dataset_iterator, epochs: int = 1, listeners: Sequence = ()):
+        """Listeners pass through to the underlying SameDiff fit — a
+        checkpoint.CheckpointListener here checkpoints sharded training
+        exactly like single-device training."""
         self.shard_params()
         return self.sd.fit(_ShardedIterator(dataset_iterator, self.strategy),
                            epochs=epochs, listeners=listeners)
+
+    def restore_latest(self, manager, strict: bool = True):
+        """Resume from a checkpoint.CheckpointManager: restore the newest
+        committed step into the model (host arrays), then re-commit the
+        arrays to their mesh shardings. Returns (step, TrainingState) or
+        None when no committed checkpoint exists."""
+        res = manager.restore_latest(model=self.model, strict=strict)
+        if res is not None:
+            self.shard_params()
+        return res
 
 
 class ParallelInference:

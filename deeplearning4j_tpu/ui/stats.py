@@ -20,6 +20,11 @@ Storage format: JSON-lines, one record per event
         {"queue_wait"|"e2e"|"exec": {count, mean, p50, p95, p99, max}},
         "batch": {mean_size, padding_waste, size_hist}}
         (written by serving/metrics.ServingMetrics.publish)
+    {"type": "checkpoint", "step": n, "epoch": e, "iteration": i,
+        "bytes": n, "serialize_seconds": s, "commit_seconds": s,
+        "queue_seconds": s, "async": bool, "t": wall}
+        (written by checkpoint/manager.CheckpointManager on each commit
+        when constructed with stats_storage=)
 """
 from __future__ import annotations
 

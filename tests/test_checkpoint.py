@@ -1,0 +1,758 @@
+"""checkpoint/ subsystem: atomic commit protocol, async writer, retention,
+bit-exact resume, torn-checkpoint recovery, preemption.
+
+The two acceptance properties of ISSUE 2:
+- resume-from-checkpoint reproduces the uninterrupted run bit-exactly
+  (params, updater state, RNG, loss trajectory);
+- a checkpoint directory killed mid-write is detected as uncommitted
+  and skipped by restore_latest().
+"""
+import json
+import os
+import signal
+import threading
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.checkpoint import (
+    CheckpointError, CheckpointListener, CheckpointManager,
+    CheckpointModelSaver, Preempted, PreemptionHook, atomic_copy,
+    atomic_output_file, atomic_write_bytes, capture_training_state,
+    restore_training_state)
+from deeplearning4j_tpu.checkpoint import manifest as ckpt_manifest
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+
+
+def _conf(dropout=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(7)
+         .updater(Adam(learning_rate=0.05)))
+    dense = DenseLayer(n_out=16, activation="tanh", **(
+        {"dropout": dropout} if dropout else {}))
+    return (b.list()
+            .layer(dense)
+            .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+
+
+def _xor():
+    X = np.tile(np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32),
+                (16, 1))
+    Y = np.eye(2, dtype=np.float32)[
+        (X[:, 0].astype(int) ^ X[:, 1].astype(int))]
+    return X, Y
+
+
+def _net(dropout=None):
+    return MultiLayerNetwork(_conf(dropout)).init()
+
+
+# ---------------------------------------------------------------------------
+# atomic primitives (satellites)
+
+class TestAtomic:
+    def test_write_bytes_publishes_complete_file(self, tmp_path):
+        p = tmp_path / "x.bin"
+        atomic_write_bytes(p, b"hello")
+        assert p.read_bytes() == b"hello"
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+    def test_failed_write_preserves_previous_content(self, tmp_path):
+        p = tmp_path / "x.bin"
+        atomic_write_bytes(p, b"old complete artifact")
+        with pytest.raises(RuntimeError):
+            with atomic_output_file(p) as tmp:
+                with open(tmp, "wb") as fh:
+                    fh.write(b"partial garb")
+                raise RuntimeError("simulated crash mid-write")
+        assert p.read_bytes() == b"old complete artifact"
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+    def test_failed_write_leaves_no_target(self, tmp_path):
+        p = tmp_path / "never.bin"
+        with pytest.raises(RuntimeError):
+            with atomic_output_file(p) as tmp:
+                with open(tmp, "wb") as fh:
+                    fh.write(b"part")
+                raise RuntimeError("crash")
+        assert not p.exists()
+
+    def test_published_file_honors_umask(self, tmp_path):
+        """mkstemp's 0600 must not leak onto published artifacts —
+        shared checkpoint dirs need the same mode a plain open() gives."""
+        p = tmp_path / "x.bin"
+        atomic_write_bytes(p, b"data")
+        umask = os.umask(0)
+        os.umask(umask)
+        assert (os.stat(p).st_mode & 0o777) == (0o666 & ~umask)
+
+    def test_atomic_copy(self, tmp_path):
+        src = tmp_path / "src.bin"
+        src.write_bytes(b"artifact")
+        dst = tmp_path / "cache" / "dst.bin"
+        atomic_copy(src, dst)
+        assert dst.read_bytes() == b"artifact"
+
+
+def test_save_net_zip_is_crash_safe(tmp_path, monkeypatch):
+    """A save that dies mid-serialization must not tear an existing zip."""
+    net = _net()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=1, batch_size=16)
+    path = tmp_path / "model.zip"
+    net.save(path)
+    before = path.read_bytes()
+    # crash inside the serializer, after the zip is partially written
+    import deeplearning4j_tpu.nn.model_serde as ms
+    real_savez = np.savez
+
+    def boom(*a, **k):
+        raise OSError("simulated disk failure")
+    monkeypatch.setattr(ms.np, "savez", boom)
+    with pytest.raises(OSError):
+        net.save(path)
+    monkeypatch.setattr(ms.np, "savez", real_savez)
+    assert path.read_bytes() == before          # old artifact intact
+    assert MultiLayerNetwork.load(path) is not None
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_hub_add_atomic(tmp_path, monkeypatch):
+    from deeplearning4j_tpu.hub.cache import ModelHub
+    hub = ModelHub(cache_dir=str(tmp_path / "hub"))
+    src = tmp_path / "weights.h5"
+    src.write_bytes(b"w" * 4096)
+    hub.add("weights.h5", str(src))
+    assert hub.contains("weights.h5")
+    # interrupted copy: entry must not become visible
+    import deeplearning4j_tpu.checkpoint.atomic as at
+
+    def boom(src_, dst_):
+        with open(dst_, "wb") as fh:
+            fh.write(b"half")
+        raise OSError("copy died")
+    monkeypatch.setattr(at.shutil, "copy2", boom)
+    with pytest.raises(OSError):
+        hub.add("other.h5", str(src))
+    assert not hub.contains("other.h5")
+    assert "other.h5" not in hub.list()
+
+
+def test_earlystopping_saver_atomic(tmp_path):
+    """LocalFileModelSaver best-model files survive a crash during an
+    improvement save (routed through the atomic helper)."""
+    from deeplearning4j_tpu.autodiff.earlystopping import LocalFileModelSaver
+    net = _net()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=1, batch_size=16)
+    saver = LocalFileModelSaver(str(tmp_path))
+    saver.save_best(net, 0, 0.5)
+    before = open(saver.best_path, "rb").read()
+
+    class CrashyModel:
+        def save(self, path):
+            with open(path, "wb") as fh:
+                fh.write(b"torn")
+            raise OSError("crash mid improvement save")
+
+    with pytest.raises(OSError):
+        saver.save_best(CrashyModel(), 1, 0.4)
+    assert open(saver.best_path, "rb").read() == before
+    with zipfile.ZipFile(saver.best_path) as zf:   # still a valid zip
+        assert "configuration.json" in zf.namelist()
+
+
+# ---------------------------------------------------------------------------
+# manager: commit protocol + retention
+
+class TestManagerBasics:
+    def test_sync_roundtrip(self, tmp_path):
+        net = _net()
+        X, Y = _xor()
+        net.fit(X, Y, epochs=2, batch_size=16)
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(4, model=net, epoch=2)
+        assert mgr.all_steps() == [4]
+        net2 = _net()
+        step, state = mgr.restore_latest(model=net2)
+        assert step == 4
+        for n, a in net.params().items():
+            np.testing.assert_array_equal(a, net2.params()[n])
+        assert state.iteration == net.samediff.training_config.iteration_count
+
+    def test_commit_layout(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        net = _net()
+        mgr.save(1, model=net)
+        d = mgr.step_dir(1)
+        names = set(os.listdir(d))
+        assert {"COMMIT", "MANIFEST.json", "state.json",
+                "arrays.npz"} <= names
+        with open(os.path.join(d, "MANIFEST.json")) as fh:
+            man = json.load(fh)["files"]
+        assert "arrays.npz" in man
+        assert set(man["arrays.npz"]) == {"size", "sha256"}
+        assert ckpt_manifest.is_committed(d)
+
+    def test_keep_last_n(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep_last_n=2, async_write=False)
+        net = _net()
+        for s in (1, 2, 3, 4):
+            mgr.save(s, model=net)
+        assert mgr.all_steps() == [3, 4]
+
+    def test_keep_every_n_epochs(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep_last_n=1,
+                                keep_every_n_epochs=2, async_write=False)
+        net = _net()
+        for s, e in [(1, 1), (2, 2), (3, 3), (4, 4), (5, 5)]:
+            mgr.save(s, model=net, epoch=e)
+        # epochs 2 and 4 kept permanently, plus last-1 (step 5)
+        assert mgr.all_steps() == [2, 4, 5]
+
+    def test_pin_best(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep_last_n=1,
+                                pin_best_metric="loss", async_write=False)
+        net = _net()
+        for s, l in [(1, 0.9), (2, 0.2), (3, 0.5), (4, 0.6)]:
+            mgr.save(s, model=net, metrics={"loss": l})
+        assert mgr.best_step() == 2
+        assert mgr.all_steps() == [2, 4]      # best pinned + last 1
+
+    def test_explicit_pin(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep_last_n=1, async_write=False)
+        net = _net()
+        mgr.save(1, model=net, pin=True)
+        for s in (2, 3):
+            mgr.save(s, model=net)
+        assert mgr.all_steps() == [1, 3]
+
+    def test_resave_same_step(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        net = _net()
+        mgr.save(1, model=net)
+        mgr.save(1, model=net)        # e.g. restart re-saves its step
+        assert mgr.all_steps() == [1]
+        assert not [e for e in os.listdir(tmp_path)
+                    if e.endswith((".tmp", ".old"))]
+
+    def test_resave_crash_keeps_committed_step(self, tmp_path, monkeypatch):
+        """A crash while RE-saving an existing step must not destroy the
+        committed checkpoint — the old dir is only swapped aside across
+        the rename, never deleted before the replacement is staged."""
+        import deeplearning4j_tpu.checkpoint.manager as mg
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        net = _net()
+        mgr.save(1, model=net)
+        want = mgr.restore(1).arrays
+
+        def boom(*a, **k):
+            raise OSError("killed during re-save staging")
+        monkeypatch.setattr(mg, "write_state_files", boom)
+        with pytest.raises(OSError):
+            mgr.save(1, model=net)
+        monkeypatch.undo()
+        state = mgr.restore(1)         # original commit fully intact
+        for n, a in want.items():
+            np.testing.assert_array_equal(a, state.arrays[n])
+
+
+class TestAsyncWriter:
+    def test_no_tmp_entries_after_wait(self, tmp_path):
+        """Required by ISSUE satellite: after wait_until_finished() the
+        directory never contains .tmp entries."""
+        mgr = CheckpointManager(tmp_path, keep_last_n=None)
+        net = _net()
+        X, Y = _xor()
+        net.fit(X, Y, epochs=1, batch_size=16)
+        for s in range(5):
+            mgr.save(s, model=net, epoch=s)
+        mgr.wait_until_finished()
+        entries = os.listdir(tmp_path)
+        assert not [e for e in entries if e.endswith(".tmp")], entries
+        assert mgr.all_steps() == [0, 1, 2, 3, 4]
+        mgr.close()
+
+    def test_async_error_surfaces(self, tmp_path, monkeypatch):
+        import deeplearning4j_tpu.checkpoint.manager as mg
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+        monkeypatch.setattr(mg, "write_state_files", boom)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(1, model=_net())
+        with pytest.raises(CheckpointError, match="disk full"):
+            mgr.wait_until_finished()
+        assert mgr.all_steps() == []
+
+    def test_async_error_surfaces_on_next_save(self, tmp_path, monkeypatch):
+        import deeplearning4j_tpu.checkpoint.manager as mg
+        real = mg.write_state_files
+        calls = []
+
+        def boom_once(*a, **k):
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("transient")
+            return real(*a, **k)
+        monkeypatch.setattr(mg, "write_state_files", boom_once)
+        mgr = CheckpointManager(tmp_path)
+        net = _net()
+        mgr.save(1, model=net)
+        # wait for the failure to land, then the NEXT save raises
+        with mgr._cv:
+            mgr._cv.wait_for(lambda: mgr._inflight == 0, timeout=30)
+        with pytest.raises(CheckpointError, match="transient"):
+            mgr.save(2, model=net)
+        # error is cleared after raising; manager keeps working
+        mgr.save(3, model=net)
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [3]
+
+
+# ---------------------------------------------------------------------------
+# torn-checkpoint detection (acceptance criterion)
+
+class TestTornCheckpointRecovery:
+    def _mgr_with_two(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep_last_n=None,
+                                async_write=False)
+        net = _net()
+        X, Y = _xor()
+        net.fit(X, Y, epochs=1, batch_size=16)
+        mgr.save(10, model=net)
+        net.fit(X, Y, epochs=1, batch_size=16)
+        mgr.save(20, model=net)
+        assert mgr.all_steps() == [10, 20]
+        return mgr
+
+    def test_truncated_payload_skipped(self, tmp_path):
+        mgr = self._mgr_with_two(tmp_path)
+        p = os.path.join(mgr.step_dir(20), "arrays.npz")
+        with open(p, "r+b") as fh:
+            fh.truncate(os.path.getsize(p) // 2)
+        step, _ = mgr.restore_latest()
+        assert step == 10
+
+    def test_bitflip_payload_skipped(self, tmp_path):
+        """Same size, corrupted content — only the sha256 catches it."""
+        mgr = self._mgr_with_two(tmp_path)
+        p = os.path.join(mgr.step_dir(20), "arrays.npz")
+        data = bytearray(open(p, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with open(p, "wb") as fh:
+            fh.write(data)
+        step, _ = mgr.restore_latest()
+        assert step == 10
+
+    def test_corrupt_manifest_skipped(self, tmp_path):
+        mgr = self._mgr_with_two(tmp_path)
+        with open(os.path.join(mgr.step_dir(20), "MANIFEST.json"),
+                  "w") as fh:
+            fh.write("{not json")
+        step, _ = mgr.restore_latest()
+        assert step == 10
+
+    def test_missing_commit_marker_skipped(self, tmp_path):
+        mgr = self._mgr_with_two(tmp_path)
+        os.remove(os.path.join(mgr.step_dir(20), "COMMIT"))
+        step, _ = mgr.restore_latest()
+        assert step == 10
+
+    def test_tmp_dir_from_killed_writer_skipped_and_gcd(self, tmp_path):
+        mgr = self._mgr_with_two(tmp_path)
+        torn = os.path.join(str(tmp_path), "step_00000030.tmp")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "arrays.npz"), "wb") as fh:
+            fh.write(b"half a checkpoint")
+        step, _ = mgr.restore_latest()
+        assert step == 20
+        removed = mgr.gc_uncommitted()
+        assert torn in removed
+        assert not os.path.exists(torn)
+
+    def test_interrupted_resave_swap_recovers_old_commit(self, tmp_path):
+        """Crash between the two re-save renames leaves step_N.old (the
+        committed old checkpoint) and no step_N — recovery renames it
+        back rather than gc-ing committed data."""
+        mgr = self._mgr_with_two(tmp_path)
+        final = mgr.step_dir(20)
+        os.rename(final, final + ".old")          # crash mid-swap
+        step, _ = mgr.restore_latest()            # in-process recovery
+        assert step == 20
+        assert os.path.isdir(final)
+        # and a fresh manager (process restart) also recovers
+        os.rename(final, final + ".old")
+        mgr2 = CheckpointManager(tmp_path, async_write=False)
+        assert mgr2.latest_step() == 20
+        assert mgr2.gc_uncommitted() == []
+
+    def test_all_torn_returns_none(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        net = _net()
+        mgr.save(5, model=net)
+        os.remove(os.path.join(mgr.step_dir(5), "COMMIT"))
+        assert mgr.restore_latest() is None
+
+    def test_restore_specific_step_verifies(self, tmp_path):
+        mgr = self._mgr_with_two(tmp_path)
+        os.remove(os.path.join(mgr.step_dir(20), "COMMIT"))
+        with pytest.raises(CheckpointError, match="COMMIT"):
+            mgr.restore(20)
+
+
+# ---------------------------------------------------------------------------
+# bit-exact resume (THE acceptance criterion)
+
+class TestBitExactResume:
+    K, J = 6, 3        # epochs: straight K vs J + (K-J) resumed
+
+    def _fit_losses(self, net, X, Y, epochs):
+        h = net.fit(X, Y, epochs=epochs, batch_size=16)
+        return list(h.loss_curve.losses)
+
+    @pytest.mark.parametrize("dropout", [None, 0.8],
+                             ids=["deterministic", "dropout_rng"])
+    def test_resume_matches_uninterrupted(self, tmp_path, dropout):
+        X, Y = _xor()
+        # --- uninterrupted run -------------------------------------
+        netA = _net(dropout)
+        lossesA = self._fit_losses(netA, X, Y, self.K)
+        # --- interrupted run: J epochs, checkpoint, "new process" --
+        netB = _net(dropout)
+        lossesB = self._fit_losses(netB, X, Y, self.J)
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(self.J, model=netB, epoch=self.J)
+        # fresh net = fresh process (same conf/seed, new arrays)
+        netC = _net(dropout)
+        step, state = mgr.restore_latest(model=netC)
+        assert step == self.J
+        lossesC = self._fit_losses(netC, X, Y, self.K - self.J)
+        # --- loss trajectory identical -----------------------------
+        np.testing.assert_array_equal(
+            np.asarray(lossesA), np.asarray(lossesB + lossesC))
+        # --- params bit-exact --------------------------------------
+        pA, pC = netA.params(), netC.params()
+        assert set(pA) == set(pC)
+        for n in pA:
+            np.testing.assert_array_equal(pA[n], pC[n], err_msg=n)
+        # --- updater leaves bit-exact ------------------------------
+        import jax
+        lA = jax.tree_util.tree_leaves(netA.samediff._updater_state)
+        lC = jax.tree_util.tree_leaves(netC.samediff._updater_state)
+        assert len(lA) == len(lC) > 0
+        for a, c in zip(lA, lC):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        # --- RNG + counters ----------------------------------------
+        assert netC.samediff._fit_base_seed == netA.samediff._fit_base_seed
+        assert (netC.samediff.training_config.iteration_count ==
+                netA.samediff.training_config.iteration_count)
+
+    def test_mid_epoch_listener_checkpoint_resumes_bit_exact(self, tmp_path):
+        """Checkpoint taken by the listener MID-epoch (iteration cadence)
+        carries updater state + iteration, so resume from it matches the
+        uninterrupted run from that iteration on."""
+        X, Y = _xor()                 # 64 rows = 4 batches of 16 / epoch
+        netA = _net()
+        netA.fit(X, Y, epochs=2, batch_size=16)     # iterations 0..7
+        netB = _net()
+        mgr = CheckpointManager(tmp_path, keep_last_n=None,
+                                async_write=False)
+        lst = CheckpointListener(mgr, every_n_iterations=3)
+        netB.fit(X, Y, epochs=2, batch_size=16, listeners=[lst])
+        steps = mgr.all_steps()
+        assert 3 in steps             # fired mid-epoch after iteration 2
+        state = mgr.restore(3)
+        assert state.iteration == 3   # 3 steps done at snapshot time
+        netC = _net()
+        restore_training_state(netC, state)
+        # finish the epoch the snapshot interrupted: batch 3 alone,
+        # then the full second epoch — iterations 3, then 4..7
+        netC.fit(X[48:64], Y[48:64], epochs=1, batch_size=16)
+        netC.fit(X, Y, epochs=1, batch_size=16)
+        pA, pC = netA.params(), netC.params()
+        for n in pA:
+            np.testing.assert_array_equal(pA[n], pC[n], err_msg=n)
+
+    def test_normalizer_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.dataset.normalizers import \
+            NormalizerStandardize
+        X, Y = _xor()
+        norm = NormalizerStandardize().fit(X + np.float32(3.5))
+        net = _net()
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(0, model=net, normalizer=norm)
+        _, state = mgr.restore_latest()
+        norm2 = state.make_normalizer()
+        assert isinstance(norm2, NormalizerStandardize)
+        np.testing.assert_array_equal(norm.mean, norm2.mean)
+        np.testing.assert_array_equal(norm.std, norm2.std)
+
+    def test_strict_restore_rejects_mismatched_graph(self, tmp_path):
+        net = _net()
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(0, model=net)
+        other = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(1)
+             .updater(Adam(learning_rate=0.05)).list()
+             .layer(DenseLayer(n_out=4, activation="relu"))
+             .layer(DenseLayer(n_out=16, activation="tanh"))
+             .layer(OutputLayer(n_out=2))
+             .set_input_type(InputType.feed_forward(2)).build())).init()
+        with pytest.raises(ValueError, match="does not cover"):
+            mgr.restore_latest(model=other)
+        # non-strict restores the intersection
+        assert mgr.restore_latest(model=other, strict=False) is not None
+
+
+# ---------------------------------------------------------------------------
+# listener cadences + stats + savers + preemption
+
+class TestCheckpointListener:
+    def test_epoch_cadence(self, tmp_path):
+        net = _net()
+        X, Y = _xor()
+        mgr = CheckpointManager(tmp_path, keep_last_n=None)
+        lst = CheckpointListener(mgr, every_n_epochs=2)
+        net.fit(X, Y, epochs=5, batch_size=16, listeners=[lst])
+        # on_training_end waits, so commits are visible here
+        assert len(mgr.all_steps()) == 2          # after epochs 2 and 4
+        assert lst.last_checkpoint() == mgr.latest_step()
+        assert not [e for e in os.listdir(tmp_path)
+                    if e.endswith(".tmp")]
+
+    def test_iteration_cadence_keep_last(self, tmp_path):
+        net = _net()
+        X, Y = _xor()
+        X, Y = np.tile(X, (4, 1)), np.tile(Y, (4, 1))
+        mgr = CheckpointManager(tmp_path, keep_last_n=2)
+        lst = CheckpointListener(mgr, every_n_iterations=2)
+        net.fit(X, Y, epochs=2, batch_size=16, listeners=[lst])
+        steps = mgr.all_steps()
+        assert len(steps) == 2                    # retention applied
+        state = mgr.restore(steps[-1])
+        assert state.iteration == steps[-1]       # step = iters completed
+
+    def test_cadences_dedupe_same_step(self, tmp_path):
+        """Iteration cadence firing at an epoch boundary must not commit
+        the identical state twice (same step numbering across cadences)."""
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        storage = StatsStorage()
+        net = _net()
+        X, Y = _xor()                 # 4 batches of 16 per epoch
+        mgr = CheckpointManager(tmp_path, keep_last_n=None,
+                                stats_storage=storage)
+        lst = CheckpointListener(mgr, every_n_iterations=4,
+                                 every_n_epochs=1)
+        net.fit(X, Y, epochs=2, batch_size=16, listeners=[lst])
+        assert mgr.all_steps() == [4, 8]
+        assert len(storage.of_type("checkpoint")) == 2   # no doubles
+
+    def test_builder_parity(self, tmp_path):
+        lst = (CheckpointListener.builder(str(tmp_path))
+               .keep_last(5)
+               .save_every_n_epochs(2)
+               .build())
+        assert lst.every_n_epochs == 2
+        assert lst.manager.keep_last_n == 5
+
+    def test_requires_cadence(self, tmp_path):
+        with pytest.raises(ValueError, match="cadence"):
+            CheckpointListener(str(tmp_path))
+
+    def test_epoch_only_listener_stays_off_hot_path(self, tmp_path):
+        """Epoch-only cadence must not force frequent mid-epoch flushes:
+        needs_params makes every flush copy params + updater state."""
+        lst = CheckpointListener(CheckpointManager(tmp_path),
+                                 every_n_epochs=1)
+        assert lst.frequency >= 10 ** 6
+
+    def test_seconds_cadence_rejected_multihost(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, process_index=0, process_count=2,
+                                barrier=lambda tag: None)
+        with pytest.raises(ValueError, match="multihost"):
+            CheckpointListener(mgr, every_n_seconds=10)
+
+    def test_stats_records(self, tmp_path):
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        storage = StatsStorage()
+        net = _net()
+        X, Y = _xor()
+        mgr = CheckpointManager(tmp_path, stats_storage=storage)
+        lst = CheckpointListener(mgr, every_n_epochs=1)
+        net.fit(X, Y, epochs=3, batch_size=16, listeners=[lst])
+        recs = storage.of_type("checkpoint")
+        assert len(recs) == 3
+        for r in recs:
+            assert r["bytes"] > 0
+            assert r["commit_seconds"] >= 0
+            assert r["async"] is True
+
+
+def test_computation_graph_checkpoint_roundtrip(tmp_path):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2), "d")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    X, Y = _xor()
+    g.fit(X, Y, epochs=2, batch_size=16)
+    mgr = CheckpointManager(tmp_path, async_write=False)
+    mgr.save(0, state=g.capture_training_state(epoch=2))
+    g2 = ComputationGraph(conf).init()
+    _, state = mgr.restore_latest()
+    g2.restore_training_state(state)
+    for n, a in g.params().items():
+        np.testing.assert_array_equal(a, g2.params()[n])
+
+
+def test_checkpoint_model_saver_earlystopping(tmp_path):
+    from deeplearning4j_tpu.autodiff.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition)
+    net = _net()
+    X, Y = _xor()
+    saver = CheckpointModelSaver(str(tmp_path))
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+           .model_saver(saver)
+           .build())
+    from deeplearning4j_tpu.nn.multilayer import _ArrayIterator
+    result = EarlyStoppingTrainer(
+        cfg, net, _ArrayIterator(X, Y, 16)).fit(max_epochs=10)
+    assert result.best_model_epoch >= 0
+    assert saver.best_step == result.best_model_epoch
+    assert saver.manager.best_step() == saver.best_step
+    # the best checkpoint survived retention and restores cleanly
+    state = saver.manager.restore(saver.best_step)
+    assert state.metadata["metrics"]["score"] == pytest.approx(
+        result.best_model_score)
+
+
+class TestPreemption:
+    def test_sigterm_commits_final_checkpoint(self, tmp_path):
+        net = _net()
+        X, Y = _xor()
+        net.fit(X, Y, epochs=2, batch_size=16)
+        mgr = CheckpointManager(tmp_path)
+        with pytest.raises(Preempted) as ei:
+            with PreemptionHook(mgr, net,
+                                epoch_provider=lambda: 2) as hook:
+                PreemptionHook.simulate()       # scheduler sends SIGTERM
+        assert ei.value.code == 128 + signal.SIGTERM
+        assert hook.preempted
+        it = net.samediff.training_config.iteration_count
+        assert hook.final_step == it
+        # committed, verified, and bit-exact restorable
+        net2 = _net()
+        step, state = mgr.restore_latest(model=net2)
+        assert step == it and state.epoch == 2
+        for n, a in net.params().items():
+            np.testing.assert_array_equal(a, net2.params()[n])
+
+    def test_handlers_restored_after_uninstall(self, tmp_path):
+        prev = signal.getsignal(signal.SIGTERM)
+        hook = PreemptionHook(CheckpointManager(tmp_path), _net(),
+                              reraise=False)
+        hook.install()
+        assert signal.getsignal(signal.SIGTERM) is not prev
+        hook.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+    def test_no_reraise_mode_polls(self, tmp_path):
+        net = _net()
+        mgr = CheckpointManager(tmp_path)
+        with PreemptionHook(mgr, net, reraise=False) as hook:
+            PreemptionHook.simulate()
+            assert hook.preempted               # caller decides when to exit
+        assert mgr.restore_latest() is not None
+
+
+# ---------------------------------------------------------------------------
+# multihost sharding + heavier async churn (slow tier)
+
+@pytest.mark.slow
+def test_multihost_sharded_commit_with_barrier(tmp_path):
+    """Two 'processes' write disjoint shards into the same staging dir;
+    the barrier gates the manifest so the commit can never miss a shard;
+    restore merges shards back into the full array set."""
+    net = _net()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=1, batch_size=16)
+    state0 = capture_training_state(net, epoch=1)
+    n_params = len(state0.arrays)
+    assert n_params >= 4
+    barrier = threading.Barrier(2, timeout=30)
+    mgrs = [CheckpointManager(tmp_path, process_index=i, process_count=2,
+                              barrier=lambda tag: barrier.wait(),
+                              async_write=False)
+            for i in range(2)]
+    errs = []
+
+    def run(i):
+        try:
+            mgrs[i].save(7, state=capture_training_state(net, epoch=1))
+        except BaseException as e:
+            errs.append(e)
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert not errs
+    d = mgrs[0].step_dir(7)
+    names = sorted(os.listdir(d))
+    shard_files = [n for n in names if n.startswith("arrays.shard")]
+    assert shard_files == ["arrays.shard00000-of-00002.npz",
+                           "arrays.shard00001-of-00002.npz"]
+    # every shard is covered by the manifest process 0 committed
+    with open(os.path.join(d, "MANIFEST.json")) as fh:
+        man = json.load(fh)["files"]
+    assert set(shard_files) <= set(man)
+    net2 = _net()
+    step, state = mgrs[0].restore_latest(model=net2)
+    assert step == 7
+    assert set(state.arrays) == set(state0.arrays)
+    for n, a in net.params().items():
+        np.testing.assert_array_equal(a, net2.params()[n])
+
+
+@pytest.mark.slow
+def test_async_churn_many_steps_retention_consistent(tmp_path):
+    """Sustained async saves with aggressive retention: directory ends
+    consistent (committed steps only, no .tmp, retention honored)."""
+    net = _net()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=1, batch_size=16)
+    with CheckpointManager(tmp_path, keep_last_n=3) as mgr:
+        for s in range(30):
+            mgr.save(s, model=net, epoch=s)
+        mgr.wait_until_finished()
+        steps = mgr.all_steps(verify=True)
+        assert steps == [27, 28, 29]
+        assert not [e for e in os.listdir(tmp_path) if e.endswith(".tmp")]
+
+
+def test_parallel_trainer_restore_latest(tmp_path):
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    net = _net()
+    X, Y = _xor()
+    net.fit(X, Y, epochs=1, batch_size=16)
+    mgr = CheckpointManager(tmp_path, async_write=False)
+    mgr.save(3, model=net, epoch=1)
+    net2 = _net()
+    pt = ParallelTrainer(net2)
+    res = pt.restore_latest(mgr)
+    assert res is not None and res[0] == 3
+    for n, a in net.params().items():
+        np.testing.assert_array_equal(a, net2.params()[n])
