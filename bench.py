@@ -10,9 +10,12 @@ Configs (BASELINE.md):
 3. resnet50         — zoo ResNet-50, 224x224 ImageNet shapes, batch 128,
                       bf16 mixed precision (f32 master params)
 
-All configs train through the scanned whole-epoch step (one device
+All base configs train through the scanned whole-epoch step (one device
 dispatch per epoch) with device-cached data — the same code path fit()
-takes for any listener-free DeviceCachedIterator run.
+takes for any listener-free DeviceCachedIterator run. The *_listener
+configs attach a ScoreIterationListener and run the fused-window tier
+(fused_steps=8, docs/training_performance.md) — the production path —
+and additionally report dispatches_per_epoch.
 
 The reference publishes no benchmark numbers (BASELINE.json
 "published": {}), so vs_baseline is null — an honest "no measured
@@ -42,7 +45,23 @@ def _median_rate(fit_fn, n_samples, trials=3):
     return sorted(rates)[trials // 2]
 
 
-def bench_lenet(batch=128):
+def _dispatch_stats(sd):
+    """dispatches_per_epoch + tier from the fit dispatch accounting."""
+    st = getattr(sd, "last_fit_stats", None) or {}
+    out = {}
+    if "dispatches_per_epoch" in st:
+        out["dispatches_per_epoch"] = st["dispatches_per_epoch"]
+        out["tier"] = st.get("tier")
+    return out
+
+
+def bench_lenet(batch=128, listener=False, fused_steps=1):
+    """BASELINE config 1 — plus the ``lenet_listener`` variant: a
+    ScoreIterationListener attached (forcing off the scanned tier, as
+    any production run with score/checkpoint listeners is) and
+    ``fused_steps=8`` fused windows, tracking the listener-path
+    throughput that BENCH_r05 showed dispatch-bound at ~1.8% MFU."""
+    from deeplearning4j_tpu.autodiff import ScoreIterationListener
     from deeplearning4j_tpu.dataset import DeviceCachedIterator, load_mnist
     from deeplearning4j_tpu.zoo import LeNet
 
@@ -51,9 +70,14 @@ def bench_lenet(batch=128):
     n = (len(X) // batch) * batch
     net = LeNet(height=28, width=28, channels=1).build()
     it = DeviceCachedIterator(X, Y, batch_size=batch)
-    net.fit(it, epochs=2)                       # warmup/compile
+    listeners = [ScoreIterationListener(print_every=10 ** 9,
+                                        print_fn=lambda *a: None)] \
+        if listener else []
+    fit = lambda epochs: net.fit(it, epochs=epochs, listeners=listeners,
+                                 fused_steps=fused_steps)
+    fit(2)                                      # warmup/compile
     epochs = 6
-    sps = _median_rate(lambda: net.fit(it, epochs=epochs), epochs * n)
+    sps = _median_rate(lambda: fit(epochs), epochs * n)
     # fwd conv+matmul FLOPs per image (LeNet 28x28: conv1 20x5x5 @28x28,
     # conv2 50x20x5x5 @14x14, fc 2450x500, out 500x10)
     fwd_flops = 2 * (20 * 5 * 5 * 1 * 28 * 28 + 50 * 5 * 5 * 20 * 14 * 14
@@ -61,13 +85,17 @@ def bench_lenet(batch=128):
     return {"samples_per_sec": round(sps, 1),
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
-            "batch": batch}
+            "batch": batch, **_dispatch_stats(net.samediff)}
 
 
-def bench_samediff_mlp(batch=128, hidden=(512, 256)):
+def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
+                       fused_steps=1):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
-    (reference TrainingSession.java:74)."""
-    from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+    (reference TrainingSession.java:74). ``listener``/``fused_steps``
+    give the listener-path variant (see bench_lenet)."""
+    from deeplearning4j_tpu.autodiff import (SameDiff,
+                                             ScoreIterationListener,
+                                             TrainingConfig)
     from deeplearning4j_tpu.learning.updaters import Adam
 
     rng = np.random.default_rng(0)
@@ -88,7 +116,8 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256)):
     sd.training_config = (TrainingConfig.builder()
                           .updater(Adam(learning_rate=1e-3))
                           .data_set_feature_mapping("x")
-                          .data_set_label_mapping("labels").build())
+                          .data_set_label_mapping("labels")
+                          .fused_steps(fused_steps).build())
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     n = 2048
@@ -96,15 +125,19 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256)):
     Y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
     it = DeviceCachedIterator(X, Y, batch_size=batch)
 
-    sd.fit(it, epochs=2)                        # warmup/compile
+    listeners = [ScoreIterationListener(print_every=10 ** 9,
+                                        print_fn=lambda *a: None)] \
+        if listener else []
+    sd.fit(it, epochs=2, listeners=listeners)   # warmup/compile
     epochs = 6
-    sps = _median_rate(lambda: sd.fit(it, epochs=epochs), epochs * n)
+    sps = _median_rate(lambda: sd.fit(it, epochs=epochs,
+                                      listeners=listeners), epochs * n)
     fwd_flops = 2 * (784 * hidden[0] + hidden[0] * hidden[1]
                      + hidden[1] * 10)
     return {"samples_per_sec": round(sps, 1),
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
-            "batch": batch}
+            "batch": batch, **_dispatch_stats(sd)}
 
 
 def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
@@ -220,6 +253,15 @@ def main():
     configs = {}
     for name, fn in (("lenet_mnist", bench_lenet),
                      ("samediff_mlp", bench_samediff_mlp),
+                     # listener-path tiers (fused windows, K=8): the
+                     # production configuration BENCH_r05 showed
+                     # dispatch-bound — tracked so the listener-path
+                     # speedup shows up in BENCH_r*.json going forward
+                     ("lenet_listener",
+                      lambda: bench_lenet(listener=True, fused_steps=8)),
+                     ("samediff_mlp_listener",
+                      lambda: bench_samediff_mlp(listener=True,
+                                                 fused_steps=8)),
                      ("resnet50", bench_resnet50),
                      ("bert_base", bench_bert_base),
                      ("gpt_medium", bench_gpt_medium)):

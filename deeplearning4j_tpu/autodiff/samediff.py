@@ -86,6 +86,10 @@ class SameDiff:
         self.training_config = None
         self._updater_state = None
         self._seed = 0
+        # dispatch/compile accounting of the most recent fit() epoch
+        # (tier, dispatches_per_epoch, window sizes/compiles) — consumed
+        # by ui/stats StatsListener and bench.py
+        self.last_fit_stats = None
         # op namespaces (reference: SDMath/SDNN/... generated classes)
         from deeplearning4j_tpu.autodiff.ops_namespaces import make_namespaces
         for ns_name, ns in make_namespaces(self).items():
@@ -746,12 +750,20 @@ class SameDiff:
     # training (reference: SameDiff.fit → TrainingSession.java:74; here the
     # step — forward+backward+updater+param update — is ONE jitted fn with
     # donated param/state buffers)
-    def _build_step_body(self):
-        """The train-step body shared by the per-batch step and the
-        scanned whole-epoch step: forward + backward + updater + param
-        update, with the optional mixed-precision policy applied (cast
-        params/inputs to the compute dtype inside the trace; gradients
-        flow back through the casts as float32 master-param grads)."""
+    def _build_step_parts(self):
+        """The two halves of the train step, separated so gradient
+        accumulation (autodiff/window.py) can run the gradient half every
+        micro-step and the apply half every ``accum_steps``-th:
+
+        - ``grad_fn(params, svars, iteration, constants, phv, base_key)
+          -> (grads, new_svars, data_loss)`` — forward + backward with
+          the optional mixed-precision policy applied (cast params/inputs
+          to the compute dtype inside the trace; gradients flow back
+          through the casts as float32 master-param grads);
+        - ``apply_fn(params, grads, state, iteration)
+          -> (new_params, new_state)`` — regularization + clipping +
+          updater + parameter update.
+        """
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
@@ -779,8 +791,7 @@ class SameDiff:
             loss_scale = None
             _cast = None
 
-        def step_body(params, svars, state, iteration, constants, phv,
-                      base_key):
+        def grad_fn(params, svars, iteration, constants, phv, base_key):
             # per-step key derived ON DEVICE (a host-side jax.random.key per
             # step costs a tunnel round-trip; fold_in is free inside the jit)
             key = jax.random.fold_in(base_key, iteration)
@@ -811,6 +822,9 @@ class SameDiff:
                          for sn, src in state_updates.items()}
             # state vars with no declared update carry over unchanged
             new_svars = {**svars, **new_svars}
+            return grads, new_svars, data_loss
+
+        def apply_fn(params, grads, state, iteration):
             lr = resolve_lr(getattr(updater, "learning_rate", 0.0), iteration, 0)
             # L1/L2 modify the gradient pre-updater; WeightDecay modifies the
             # update post-updater (reference: BaseMultiLayerUpdater.update)
@@ -824,6 +838,21 @@ class SameDiff:
                     lambda p, u: r.apply(p, u, lr), params, updates)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p - u, params, updates)
+            return new_params, new_state
+
+        return grad_fn, apply_fn, loss_names
+
+    def _build_step_body(self):
+        """One full train step (forward + backward + updater + param
+        update) composed from _build_step_parts — shared by the per-batch
+        step, the fused-window step and the scanned whole-epoch step."""
+        grad_fn, apply_fn, loss_names = self._build_step_parts()
+
+        def step_body(params, svars, state, iteration, constants, phv,
+                      base_key):
+            grads, new_svars, data_loss = grad_fn(params, svars, iteration,
+                                                  constants, phv, base_key)
+            new_params, new_state = apply_fn(params, grads, state, iteration)
             # iteration advances on device — no per-step int transfer
             return new_params, new_svars, new_state, iteration + 1, data_loss
 
@@ -868,27 +897,92 @@ class SameDiff:
         analogue; the reference pays per-OP dispatch, SURVEY §3.2).
         ``unroll`` unrolls the scan body (fewer while-loop iterations at
         the cost of compile time; the runtime's per-iteration sync can
-        dominate small step bodies)."""
-        step_body, loss_names = self._build_step_body()
+        dominate small step bodies).
 
-        def epoch_fn(params, svars, state, iteration, constants, stacked_phv,
-                     base_key):
-            def body(carry, phv):
-                params, svars, state, it = carry
-                new_params, new_svars, new_state, new_it, loss = step_body(
-                    params, svars, state, it, constants, phv, base_key)
-                return (new_params, new_svars, new_state, new_it), loss
+        An epoch IS a window of length n_steps — this delegates to
+        make_train_window."""
+        return self.make_train_window(donate=donate, unroll=unroll)
 
-            (params, svars, state, iteration), losses = jax.lax.scan(
-                body, (params, svars, state, iteration), stacked_phv,
-                unroll=unroll)
-            return params, svars, state, iteration, losses
+    def make_train_window(self, accum_steps: int = 1, donate: bool = True,
+                          unroll: int = 1):
+        """Fused-window train step: K consecutive steps in ONE compiled
+        dispatch — a lax.scan of the step body over a (K, batch, ...)
+        stacked window of placeholders. Per-step losses come back as a
+        device-side (K,) buffer, so listeners cost one transfer per
+        flush, not one per step (autodiff/window.py owns the loop).
 
-        cache_key = ("train_epoch", self._version, loss_names, donate, unroll)
+        The returned jitted fn specializes per window length K (the
+        leading dim of the stacked placeholders), so ONE cache entry
+        serves the full window and every ragged-tail bucket.
+
+        With ``accum_steps > 1``, micro-batch gradients accumulate in the
+        scan carry and the updater applies every ``accum_steps``-th
+        micro-step on the AVERAGED gradient (effective batch =
+        accum_steps * batch). The updater sees the update count
+        (``iteration // accum_steps``) so schedules/bias-correction step
+        per update, while RNG keys still fold the absolute micro-step
+        iteration. Signature then gains an ``accum`` carry (zeros_like
+        params) threaded between windows — an accumulation cycle may
+        span window boundaries.
+        """
+        if accum_steps <= 1:
+            step_body, loss_names = self._build_step_body()
+
+            def window_fn(params, svars, state, iteration, constants,
+                          stacked_phv, base_key):
+                def body(carry, phv):
+                    p, sv, st, it = carry
+                    p, sv, st, it, loss = step_body(
+                        p, sv, st, it, constants, phv, base_key)
+                    return (p, sv, st, it), loss
+
+                (params, svars, state, iteration), losses = jax.lax.scan(
+                    body, (params, svars, state, iteration), stacked_phv,
+                    unroll=unroll)
+                return params, svars, state, iteration, losses
+
+            donate_args = (0, 1, 2, 3)
+        else:
+            grad_fn, apply_fn, loss_names = self._build_step_parts()
+            n_accum = int(accum_steps)
+
+            def window_fn(params, svars, state, accum, iteration, constants,
+                          stacked_phv, base_key):
+                def body(carry, phv):
+                    p, sv, st, acc, it = carry
+                    grads, sv, loss = grad_fn(p, sv, it, constants, phv,
+                                              base_key)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+
+                    def do_apply(args):
+                        p_, st_, acc_ = args
+                        mean_g = jax.tree_util.tree_map(
+                            lambda g: g / n_accum, acc_)
+                        p_, st_ = apply_fn(p_, mean_g, st_, it // n_accum)
+                        return (p_, st_, jax.tree_util.tree_map(
+                            jnp.zeros_like, acc_))
+
+                    p, st, acc = jax.lax.cond(
+                        (it + 1) % n_accum == 0, do_apply, lambda a: a,
+                        (p, st, acc))
+                    return (p, sv, st, acc, it + 1), loss
+
+                (params, svars, state, accum, iteration), losses = \
+                    jax.lax.scan(body, (params, svars, state, accum,
+                                        iteration), stacked_phv,
+                                 unroll=unroll)
+                return params, svars, state, accum, iteration, losses
+
+            donate_args = (0, 1, 2, 3, 4)
+        cache_key = ("train_window", self._version, loss_names,
+                     int(accum_steps), donate, int(unroll))
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
-            compiled = jax.jit(epoch_fn,
-                               donate_argnums=(0, 1, 2, 3) if donate else ())
+            self._verbose_log(
+                f"compiling fused-window step (graph v{self._version}, "
+                f"accum_steps={accum_steps}, donate={donate})")
+            compiled = jax.jit(window_fn,
+                               donate_argnums=donate_args if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
 
@@ -897,16 +991,22 @@ class SameDiff:
         SameDiff.java:1833). ``dataset_iterator`` yields objects with
         ``features``/``labels`` (DataSet) or (features, labels) tuples.
 
-        TWO execution tiers (this is a documented contract, not an
-        internal detail):
+        THREE execution tiers (this is a documented contract, not an
+        internal detail — see docs/training_performance.md):
 
         - **scanned fast path** — zero listeners AND an iterator exposing
           ``stacked_batches`` (``DeviceCachedIterator``): the whole epoch
           compiles to ONE lax.scan dispatch. Use this for benchmarking
           and small models, where per-step dispatch latency dominates.
-        - **per-step path** — any listeners, or a host-streaming
-          iterator: one dispatch per step with burst loss delivery.
-          Expect ~ms-scale extra latency per step on a tunneled chip.
+        - **fused windows** — ``TrainingConfig.fused_steps > 1`` (or
+          ``accum_steps > 1``): K steps per compiled dispatch with
+          device-buffered losses flushed to listeners at window
+          boundaries and a background stager double-buffering the next
+          window's host→HBM transfer. Works with listeners AND
+          host-streaming iterators — the production default fast path.
+        - **per-step path** — the legacy tier: one dispatch per step
+          with burst loss delivery. Expect ~ms-scale extra latency per
+          step on a tunneled chip.
 
         Environment verbose mode announces which tier each fit() took.
         """
@@ -914,14 +1014,27 @@ class SameDiff:
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
-        if not listeners and hasattr(dataset_iterator, "stacked_batches"):
+        fused = max(1, int(getattr(tc, "fused_steps", 1) or 1))
+        accum = max(1, int(getattr(tc, "accum_steps", 1) or 1))
+        if not listeners and hasattr(dataset_iterator, "stacked_batches") \
+                and fused <= 1 and accum <= 1:
             self._verbose_log("fit: scanned whole-epoch path "
                               "(one dispatch per epoch)")
             return self._fit_scanned(dataset_iterator, epochs)
+        if fused > 1 or accum > 1:
+            from deeplearning4j_tpu.autodiff.window import fit_windowed
+            self._verbose_log(
+                f"fit: fused-window path (fused_steps={fused}, "
+                f"accum_steps={accum} — ceil(steps/{fused}) dispatches "
+                f"per epoch)")
+            return fit_windowed(self, dataset_iterator, epochs,
+                                listeners=listeners)
         why = ("listeners need per-iteration scalars" if listeners
                else "iterator has no stacked_batches (use "
                     "DeviceCachedIterator for the scanned path)")
-        self._verbose_log(f"fit: per-step path — {why}")
+        self._verbose_log(f"fit: per-step path — {why} "
+                          f"(set TrainingConfig.fused_steps>1 for fused "
+                          f"windows)")
         step = self.make_train_step()
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
@@ -972,6 +1085,7 @@ class SameDiff:
 
         for epoch in range(epochs):
             epoch_losses = []
+            epoch_start_iter = iteration
             pending: List[Tuple[int, jax.Array]] = []
 
             def _flush(pending):
@@ -1049,6 +1163,13 @@ class SameDiff:
                     else jnp.asarray(float("nan")))
             history.add_epoch(epoch, mean_loss)
             tc.epoch_count = getattr(tc, "epoch_count", 0) + 1
+            # dispatch accounting (ui/stats 'dispatch' records, bench.py)
+            self.last_fit_stats = {
+                "tier": "per_step", "fused_steps": 1, "accum_steps": 1,
+                "steps_per_epoch": iteration - epoch_start_iter,
+                "dispatches_per_epoch": iteration - epoch_start_iter,
+                "window_sizes": {1: iteration - epoch_start_iter},
+                "window_compiles": 0}
             if listeners:
                 # sync current params/state into the graph (copies — the next
                 # step donates the working buffers) so listeners can save/eval
@@ -1113,6 +1234,11 @@ class SameDiff:
                     f"(nan_panic); localize with sd.exec_debug()")
             epoch_means.append(m)
             iteration += n_steps
+            self.last_fit_stats = {
+                "tier": "scanned_epoch", "fused_steps": n_steps,
+                "accum_steps": 1, "steps_per_epoch": n_steps,
+                "dispatches_per_epoch": 1, "window_sizes": {n_steps: 1},
+                "window_compiles": 0}
         # ONE device fetch for all epoch means at fit end
         fetched = np.asarray(jnp.stack(epoch_means))
         for e in range(epochs):

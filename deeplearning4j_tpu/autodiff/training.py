@@ -78,6 +78,18 @@ class TrainingConfig:
     # unroll factor for the scanned whole-epoch fit path (compile-time
     # cost vs fewer while-loop iterations; runtime-tuning knob, not serde)
     scan_unroll: int = 1
+    # fused training windows (autodiff/window.py): K consecutive train
+    # steps execute as ONE compiled lax.scan dispatch, with per-step
+    # losses buffered on device and flushed to listeners at window
+    # boundaries. 1 = per-step dispatch (the legacy tier). Works with
+    # listeners AND host-streaming iterators — unlike the scanned
+    # whole-epoch tier, which needs neither.
+    fused_steps: int = 1
+    # gradient accumulation: micro-batch grads accumulate in the window
+    # scan carry and the updater applies every ``accum_steps``-th
+    # micro-step (grads averaged — an effective batch of
+    # accum_steps * batch). 1 = update every step.
+    accum_steps: int = 1
     # NaN/Inf panic (reference: DefaultOpExecutioner ProfilingMode
     # NAN_PANIC/INF_PANIC): fit() checks fetched losses and raises
     # NumericsException naming the iteration; localize the producing op
@@ -134,6 +146,8 @@ class TrainingConfig:
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold":
                 self.gradient_normalization_threshold,
+            "fused_steps": self.fused_steps,
+            "accum_steps": self.accum_steps,
         }
 
     @staticmethod
@@ -152,6 +166,8 @@ class TrainingConfig:
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get(
                 "gradient_normalization_threshold", 1.0),
+            fused_steps=d.get("fused_steps", 1),
+            accum_steps=d.get("accum_steps", 1),
         )
 
     class Builder:
@@ -176,6 +192,10 @@ class TrainingConfig:
             self._kw["gradient_normalization"] = mode
             self._kw["gradient_normalization_threshold"] = threshold
             return self
+        def fused_steps(self, k: int):
+            self._kw["fused_steps"] = int(k); return self
+        def accum_steps(self, n: int):
+            self._kw["accum_steps"] = int(n); return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 

@@ -1,0 +1,430 @@
+"""Fused multi-step training windows: K train steps per compiled dispatch.
+
+BENCH_r05 showed the per-step fit tier is host-dispatch-bound on small
+models (lenet_mnist ~1.4 ms/step at ~1.8% MFU): the device finishes the
+step long before the host can enqueue the next one, and the scanned
+whole-epoch tier that fixes this was only reachable with zero listeners
+and a fully device-cached dataset. This module makes the fused path work
+under PRODUCTION constraints:
+
+- **K steps, one dispatch** — ``SameDiff.make_train_window`` scans the
+  train-step body over a ``(K, batch, ...)`` stacked window, so the
+  per-epoch dispatch count drops from ``steps`` to ``ceil(steps / K)``.
+- **listeners keep working** — per-step losses accumulate in the scan's
+  device-side ``(K,)`` output buffer; the burst-flush machinery from the
+  per-step tier delivers them via ``Listener.iterations_done`` at window
+  boundaries (one device→host transfer per flush). Checkpoint flushes
+  stay bit-exact: params + updater state + the iteration counter sync at
+  window boundaries, which is exactly the granularity the checkpoint/
+  listener contract records (a saved step is always a window boundary).
+  Exception: the gradient-accumulation carry is NOT part of the
+  checkpoint schema — with ``accum_steps > 1`` use a checkpoint cadence
+  that is a multiple of ``accum_steps`` (docs/training_performance.md).
+- **streaming data keeps working** — a background ``WindowStager`` thread
+  stacks the NEXT window's batches and enqueues its host→HBM transfer
+  while the current window computes (double buffering, queue depth 2).
+- **ragged final windows stay fused** — a tail of ``r < K`` steps is
+  decomposed into power-of-two buckets (serving-style shape bucketing:
+  at most ``log2(K)+1`` compiled window lengths EVER, vs one compile per
+  distinct tail if dispatched raw, vs per-step dispatch if not fused).
+- **gradient accumulation rides along** — ``TrainingConfig.accum_steps``
+  accumulates micro-batch grads in the scan carry and applies the
+  updater every N-th micro-step (see ``make_train_window``); the accum
+  carry threads BETWEEN windows, so accumulation cycles may span window
+  boundaries.
+
+The reference has no analogue: DL4J dispatched per-op, its
+GradientsAccumulator shared grads across workers but never fused steps.
+This is the lax.scan generalization of the whole-epoch tier (SURVEY
+L3/L4) to the listener + streaming-ETL workloads production runs have.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pow2_buckets(r: int) -> List[int]:
+    """Binary decomposition of a ragged tail length into descending
+    powers of two — the bounded compiled-shape set (serving/ bucketing
+    idiom applied to window lengths). ``pow2_buckets(13) == [8, 4, 1]``."""
+    out = []
+    b = 1
+    while r > 0:
+        if r & 1:
+            out.append(b)
+        r >>= 1
+        b <<= 1
+    return out[::-1]
+
+
+class WindowStager:
+    """Background double-buffering window stager.
+
+    Pulls raw ``{placeholder: array}`` batch dicts from ``source``,
+    stacks ``window`` of them on a new leading axis, finalizes the stack
+    (dtype coercion + device placement — this is where the host→HBM
+    transfer of the NEXT window is enqueued while the CURRENT one
+    computes), and hands ``(k, stacked)`` pairs to the consumer through
+    a bounded queue (``depth=2`` → classic double buffering).
+
+    Stacking happens host-side (one ``np.stack`` + ONE transfer per
+    window) when the batches are host arrays, and device-side
+    (``jnp.stack`` of resident slices) when they already live in HBM
+    (DeviceCachedIterator, pre-sharded batches).
+
+    Shutdown is leak-proof: ``close()`` (also called by ``__iter__``'s
+    ``finally``) sets a stop flag, drains the queue to unblock the
+    worker's bounded put, and joins the thread — abandoning the
+    iterator mid-epoch cannot strand a blocked thread.
+    """
+
+    _END = object()
+
+    def __init__(self, source, window: int, finalize=None, depth: int = 2):
+        self._source = source
+        self._window = max(1, int(window))
+        self._finalize = finalize or (lambda d: d)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # -- worker side ----------------------------------------------------
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the consumer is gone."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _stack(self, batches: List[Dict[str, object]]):
+        names = batches[0].keys()
+        stacked = {}
+        for n in names:
+            items = [b[n] for b in batches]
+            if all(isinstance(a, np.ndarray) for a in items):
+                stacked[n] = np.stack(items)
+            else:
+                stacked[n] = jnp.stack([jnp.asarray(a) for a in items])
+        return len(batches), self._finalize(stacked)
+
+    def _emit_bucketed(self, buf) -> bool:
+        i = 0
+        for k in pow2_buckets(len(buf)):
+            if not self._put(self._stack(buf[i:i + k])):
+                return False
+            i += k
+        return True
+
+    @staticmethod
+    def _sig(batch) -> tuple:
+        return tuple(sorted((n, tuple(np.shape(v)))
+                            for n, v in batch.items()))
+
+    def _worker(self):
+        try:
+            buf: List[Dict[str, object]] = []
+            sig = None
+            for b in self._source:
+                if self._stop.is_set():
+                    return
+                # only same-shaped batches stack into one window: a
+                # ragged final BATCH (fewer rows than the rest) flushes
+                # the current buffer and forms its own (smaller-shape)
+                # window — the same extra compiled shape the per-step
+                # tier pays for it
+                bsig = self._sig(b)
+                if buf and bsig != sig:
+                    if not self._emit_bucketed(buf):
+                        return
+                    buf = []
+                if not buf:
+                    sig = bsig
+                buf.append(b)
+                if len(buf) == self._window:
+                    if not self._put(self._stack(buf)):
+                        return
+                    buf = []
+            # ragged tail → bounded power-of-two buckets
+            if buf and not self._emit_bucketed(buf):
+                return
+        except BaseException as e:     # propagate to the consumer
+            self._err = e
+        finally:
+            self._put(self._END)
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            self.close()
+        if self._err is not None:
+            raise self._err
+
+    def close(self):
+        self._stop.set()
+        while True:                    # unblock a worker stuck on put
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+
+
+def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
+    """The fused-window fit tier (``TrainingConfig.fused_steps`` /
+    ``accum_steps``). Called by ``SameDiff.fit`` — see its docstring for
+    the tier contract. Structure mirrors the per-step loop; the unit of
+    dispatch is a window instead of a step."""
+    from deeplearning4j_tpu.autodiff.samediff import (NumericsException,
+                                                      _split_batch)
+    from deeplearning4j_tpu.autodiff.training import History
+
+    tc = sd.training_config
+    K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
+    A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
+    window_fn = sd.make_train_window(accum_steps=A)
+    # window_fn donates param/state buffers; work on copies so the
+    # graph's stored arrays stay valid for output()/save() mid-fit
+    params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
+    svars = jax.tree_util.tree_map(jnp.copy, sd.state_vars_map())
+    if sd._updater_state is not None and \
+            set(sd._updater_state.keys()) == set(params.keys()):
+        state = jax.tree_util.tree_map(jnp.copy, sd._updater_state)
+    else:
+        state = tc.updater.init(params)
+    constants = sd.constants_map()
+    iteration = int(getattr(tc, "iteration_count", 0))
+    it_dev = jnp.asarray(iteration, jnp.int32)
+    accum = None
+    if A > 1:
+        # resume a mid-cycle accumulation from the previous fit: the
+        # apply phase is (iteration+1) % A on the ABSOLUTE iteration, so
+        # a fit ending mid-cycle leaves partial grads that the next fit
+        # must continue from (otherwise those micro-batches are lost)
+        prev = getattr(sd, "_grad_accum", None)
+        if prev is not None and set(prev.keys()) == set(params.keys()) \
+                and iteration % A != 0:
+            accum = jax.tree_util.tree_map(jnp.copy, prev)
+        else:
+            accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+    # resumable RNG contract (checkpoint/state.py): per-step keys are
+    # fold_in(key(base_seed), absolute_iteration)
+    sd._fit_base_seed = sd._seed
+    base_key = jax.random.key(sd._seed)
+    sd._seed += 1
+    history = History()
+    deferred_means = []                # device scalars, fetched at fit end
+    panic = sd._nan_panic_active(tc)
+    for l in listeners:
+        l.on_training_start(sd)
+    flush_every = min((max(1, int(getattr(l, "frequency", 10)))
+                       for l in listeners), default=0)
+    # next absolute iteration whose crossing triggers a listener flush
+    next_flush = (iteration // flush_every + 1) * flush_every \
+        if flush_every else 0
+    sync_params_on_flush = any(getattr(l, "needs_params", False)
+                               for l in listeners)
+    # compiled window lengths (jit retraces per leading-dim K): tracked
+    # per (graph version, accum) so stats report real compile counts
+    seen_sizes = sd.__dict__.setdefault("_window_traces", {}) \
+        .setdefault((sd._version, A), set())
+
+    def _name_batch(batch):
+        if isinstance(batch, dict):
+            # dict keys may be SDVariables (same contract as the
+            # per-step tier's _prep_placeholders)
+            from deeplearning4j_tpu.autodiff.variable import SDVariable
+            return {k.name if isinstance(k, SDVariable) else k: v
+                    for k, v in batch.items()}
+        feats, labels = _split_batch(batch)
+        ph = dict(zip(tc.data_set_feature_mapping, feats))
+        ph.update(zip(tc.data_set_label_mapping, labels))
+        return ph
+
+    window_sharding = getattr(dataset_iterator, "window_sharding", None)
+
+    def _finalize(stacked):
+        ph = sd._prep_placeholders(stacked)
+        if window_sharding is not None:
+            ph = {k: jax.device_put(v, window_sharding(v.ndim))
+                  for k, v in ph.items()}
+        return ph
+
+    # device-cached source (stacked_batches): the window content is
+    # identical every epoch, so build the window list ONCE as device
+    # slices of the pre-stacked arrays and reuse it — no stager thread,
+    # no per-epoch re-stack/re-upload churn
+    cached_windows = None
+    if hasattr(dataset_iterator, "stacked_batches"):
+        feats, labels = dataset_iterator.stacked_batches()
+        stacked = _finalize(dict(
+            list(zip(tc.data_set_feature_mapping, feats)) +
+            list(zip(tc.data_set_label_mapping, labels))))
+        n_steps = next(iter(stacked.values())).shape[0]
+        parts, i = [], 0
+        while n_steps - i >= K:
+            parts.append((i, K))
+            i += K
+        for k in pow2_buckets(n_steps - i):
+            parts.append((i, k))
+            i += k
+        cached_windows = [(k, {nm: a[j:j + k] for nm, a in stacked.items()})
+                          for j, k in parts]
+
+    stop = False
+    for epoch in range(epochs):
+        epoch_losses: List[float] = []       # floats (listener path)
+        epoch_loss_bufs: List[jax.Array] = []  # device (K,) buffers
+        pending = []                         # (start_iter, k, (k,) losses)
+        epoch_start_iter = iteration
+        dispatches = 0
+        compiles = 0
+        sizes: Dict[int, int] = {}     # window length -> dispatch count
+
+        def _flush():
+            if not pending:
+                return
+            iters: List[int] = []
+            for start, k, _ in pending:
+                iters.extend(range(start, start + k))
+            # ONE device→host transfer for the whole burst
+            vals = [float(v) for v in
+                    np.asarray(jnp.concatenate([lv for _, _, lv in pending]))]
+            epoch_losses.extend(vals)
+            if sync_params_on_flush:
+                # the FULL training state at the window boundary: a
+                # checkpoint taken at this flush captures params, updater
+                # state and the iteration counter of the LAST completed
+                # window — bit-exact resume (checkpoint/listener.py)
+                for n, p in {**params, **svars}.items():
+                    sd._arrays[n] = jnp.copy(p)
+                sd._updater_state = jax.tree_util.tree_map(jnp.copy, state)
+                tc.iteration_count = iters[-1] + 1
+            if panic:
+                for it, v in zip(iters, vals):
+                    if not np.isfinite(v):
+                        raise NumericsException(
+                            f"non-finite loss {v} at iteration {it} "
+                            f"(nan_panic); localize the producing op with "
+                            f"sd.exec_debug(placeholders)")
+            for l in listeners:
+                l.iterations_done(sd, epoch, iters, vals)
+            pending.clear()
+
+        for l in listeners:
+            l.on_epoch_start(sd, epoch)
+        if cached_windows is not None:
+            stager, source = None, cached_windows
+        else:
+            if hasattr(dataset_iterator, "reset"):
+                dataset_iterator.reset()
+            stager = WindowStager(map(_name_batch, iter(dataset_iterator)),
+                                  K, finalize=_finalize)
+            source = stager
+        try:
+            for k, win in source:
+                for l in listeners:
+                    if getattr(l, "batch_size", -1) is None:
+                        l.batch_size = next(iter(win.values())).shape[1]
+                # jit retraces per full placeholder shape set (a ragged
+                # final BATCH recompiles even at an already-seen k)
+                trace_sig = tuple(sorted((n, tuple(v.shape))
+                                         for n, v in win.items()))
+                if trace_sig not in seen_sizes:
+                    seen_sizes.add(trace_sig)
+                    compiles += 1
+                    sd._verbose_log(f"fit: compiling window length {k}")
+                if A > 1:
+                    params, svars, state, accum, it_dev, losses = window_fn(
+                        params, svars, state, accum, it_dev, constants, win,
+                        base_key)
+                else:
+                    params, svars, state, it_dev, losses = window_fn(
+                        params, svars, state, it_dev, constants, win,
+                        base_key)
+                dispatches += 1
+                sizes[k] = sizes.get(k, 0) + 1
+                if listeners:
+                    pending.append((iteration, k, losses))
+                    iteration += k
+                    # flush at the FIRST window boundary at-or-after each
+                    # multiple of the listener cadence (absolute
+                    # iterations), so an every-N listener sees its burst
+                    # as soon as a boundary crosses N — not only when a
+                    # full N steps have buffered (docs/checkpointing.md)
+                    if iteration >= next_flush:
+                        _flush()
+                        next_flush = (iteration // flush_every + 1) \
+                            * flush_every
+                else:
+                    epoch_loss_bufs.append(losses)
+                    iteration += k
+        finally:
+            if stager is not None:
+                stager.close()
+        if listeners:
+            _flush()
+            if flush_every:
+                next_flush = (iteration // flush_every + 1) * flush_every
+            mean_loss = float(np.mean(epoch_losses)) \
+                if epoch_losses else float("nan")
+        elif panic:
+            mean_loss = float(jnp.mean(jnp.concatenate(epoch_loss_bufs))) \
+                if epoch_loss_bufs else float("nan")
+            if epoch_loss_bufs and not np.isfinite(mean_loss):
+                raise NumericsException(
+                    f"non-finite epoch-{epoch} mean loss {mean_loss} "
+                    f"(nan_panic); localize with sd.exec_debug()")
+        else:
+            # mean on device, fetch deferred to fit end (one transfer)
+            mean_loss = None
+            deferred_means.append(
+                jnp.mean(jnp.concatenate(epoch_loss_bufs))
+                if epoch_loss_bufs else jnp.asarray(float("nan")))
+        history.add_epoch(epoch, mean_loss)
+        tc.epoch_count = getattr(tc, "epoch_count", 0) + 1
+        sd.last_fit_stats = {
+            "tier": "windowed", "fused_steps": K, "accum_steps": A,
+            "steps_per_epoch": iteration - epoch_start_iter,
+            "dispatches_per_epoch": dispatches,
+            "window_sizes": sizes, "window_compiles": compiles}
+        if listeners:
+            # sync current training state into the graph (copies — the
+            # next window donates the working buffers)
+            for n, p in {**params, **svars}.items():
+                sd._arrays[n] = jnp.copy(p)
+            sd._updater_state = jax.tree_util.tree_map(jnp.copy, state)
+            tc.iteration_count = iteration
+        for l in listeners:
+            if l.on_epoch_end(sd, epoch, mean_loss) is False:
+                stop = True
+        if stop:
+            break
+    if deferred_means:
+        fetched = np.asarray(jnp.stack(deferred_means))
+        history.loss_curve.losses = [float(v) for v in fetched]
+    # write trained params back into the graph
+    for n, p in {**params, **svars}.items():
+        sd._arrays[n] = p
+    sd._updater_state = state
+    sd._grad_accum = accum         # partial accumulation survives the fit
+    tc.iteration_count = iteration
+    for l in listeners:
+        l.on_training_end(sd)
+    return history

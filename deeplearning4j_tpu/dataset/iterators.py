@@ -142,13 +142,21 @@ class DeviceCachedIterator(DataSetIterator):
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference: AsyncDataSetIterator.java:32,
-    wrapped around fit() inputs at MultiLayerNetwork.java:1678)."""
+    wrapped around fit() inputs at MultiLayerNetwork.java:1678).
+
+    Shutdown-safe: the worker uses a bounded put that polls a stop flag,
+    and the consumer's ``finally`` (run on normal exhaustion AND on
+    ``GeneratorExit`` when a consumer abandons the generator mid-epoch)
+    sets the flag, drains the queue, and joins the thread — an abandoned
+    iteration can no longer strand a daemon thread blocked on ``q.put``
+    forever."""
 
     _END = object()
 
     def __init__(self, wrapped: DataSetIterator, queue_size: int = 4):
         self._wrapped = wrapped
         self._queue_size = queue_size
+        self._last_thread: Optional[threading.Thread] = None  # test hook
 
     def reset(self):
         if hasattr(self._wrapped, "reset"):
@@ -156,35 +164,67 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
+        stop = threading.Event()
         err: List[BaseException] = []
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for item in self._wrapped:
-                    q.put(item)
+                    if not put(item):
+                        return          # consumer gone
             except BaseException as e:   # propagate to consumer
                 err.append(e)
             finally:
-                q.put(self._END)
+                put(self._END)
 
         t = threading.Thread(target=worker, daemon=True)
+        self._last_thread = t
         t.start()
-        while True:
-            item = q.get()
-            if item is self._END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while True:                  # unblock a worker stuck on put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
         if err:
             raise err[0]
 
 
 class BenchmarkDataSetIterator(DataSetIterator):
     """Synthetic fixed batches (reference: BenchmarkDataSetIterator.java —
-    same batch object yielded n times; measures pure train throughput)."""
+    same batch object yielded n times; measures pure train throughput).
+
+    ``device_cached=True`` uploads the one batch to HBM ONCE and yields
+    the resident array every step — without it, every step pays a
+    redundant host→device transfer of identical bytes, and a dispatch-
+    bound benchmark measures the PCIe/tunnel instead of the model.
+    ``stacked_batches()`` additionally exposes the scanned-tier
+    contract: the batch broadcast along a leading steps axis. NOTE the
+    broadcast is committed to HBM (n_batches × batch bytes — XLA needs
+    a concrete scan operand); for step counts where that doesn't fit,
+    keep ``device_cached=False`` and train through the fused-window
+    tier (``fused_steps``), whose stager stages K batches at a time."""
 
     def __init__(self, feature_shape: Sequence[int], n_classes: int,
-                 n_batches: int, seed: int = 0, regression: bool = False):
+                 n_batches: int, seed: int = 0, regression: bool = False,
+                 device_cached: bool = False):
         rng = np.random.default_rng(seed)
         self._X = rng.normal(size=tuple(feature_shape)).astype(np.float32)
         if regression:
@@ -194,10 +234,34 @@ class BenchmarkDataSetIterator(DataSetIterator):
                 rng.integers(0, n_classes, feature_shape[0])]
         self._n = n_batches
         self._batch = feature_shape[0]
+        self._device_cached = device_cached
+        self._dev = None
+        if device_cached:
+            # the scanned tier routes on hasattr(it, "stacked_batches"),
+            # so the method is exposed per-instance, only in cached mode
+            self.stacked_batches = self._stacked_batches
+
+    def _device_batch(self):
+        if self._dev is None:
+            import jax.numpy as jnp
+            self._dev = (jnp.asarray(self._X), jnp.asarray(self._Y))
+        return self._dev
 
     def __iter__(self):
+        if self._device_cached:
+            X, Y = self._device_batch()
+        else:
+            X, Y = self._X, self._Y
         for _ in range(self._n):
-            yield self._X, self._Y
+            yield X, Y
+
+    def _stacked_batches(self):
+        """Scanned-tier contract (see DeviceCachedIterator): the single
+        batch broadcast to (n_batches, batch, ...) on device."""
+        import jax.numpy as jnp
+        X, Y = self._device_batch()
+        return ([jnp.broadcast_to(X[None], (self._n, *X.shape))],
+                [jnp.broadcast_to(Y[None], (self._n, *Y.shape))])
 
 
 class MultipleEpochsIterator(DataSetIterator):

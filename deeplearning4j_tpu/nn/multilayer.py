@@ -204,10 +204,19 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
-            listeners: Sequence = ()):
+            listeners: Sequence = (), fused_steps: Optional[int] = None,
+            accum_steps: Optional[int] = None):
         """Train. ``data`` = DataSetIterator-alike (yielding (features,
-        labels) / DataSet / dict) or a feature array with ``labels=``."""
+        labels) / DataSet / dict) or a feature array with ``labels=``.
+
+        ``fused_steps``/``accum_steps`` override the TrainingConfig knobs
+        for this and subsequent fits: K fused steps per compiled dispatch
+        / gradient accumulation (docs/training_performance.md)."""
         self._require_init()
+        if fused_steps is not None:
+            self._sd_train.training_config.fused_steps = int(fused_steps)
+        if accum_steps is not None:
+            self._sd_train.training_config.accum_steps = int(accum_steps)
         if labels is not None:
             data = _ArrayIterator(np.asarray(data), np.asarray(labels),
                                   batch_size)
@@ -226,7 +235,15 @@ class MultiLayerNetwork:
         across chunk steps by the compiled train step (state-var inputs
         are stop-gradiented there, which IS the truncation); states reset
         to zero per sequence minibatch. Equivalent to full BPTT when
-        tbptt_length >= T (tested)."""
+        tbptt_length >= T (tested).
+
+        Truncation segments are a natural fused window: all full-length
+        chunks of one minibatch dispatch as ONE compiled lax.scan
+        (SameDiff.make_train_window), with a single extra dispatch for a
+        ragged final chunk when ``T % tbptt_length != 0``. Per-chunk
+        losses stay in the window's device-side buffer — ONE stacked
+        fetch per fit instead of thousands of device scalars held across
+        epochs."""
         import jax
         import jax.numpy as jnp
         self._require_init()
@@ -265,6 +282,7 @@ class MultiLayerNetwork:
 
         from deeplearning4j_tpu.autodiff.training import History
         step = sd.make_train_step()
+        window_fn = sd.make_train_window()
         tc = sd.training_config
         params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
         svars = jax.tree_util.tree_map(jnp.copy, sd.state_vars_map())
@@ -294,25 +312,45 @@ class MultiLayerNetwork:
         zero_np = {nm: np.zeros(svars[nm].shape,
                                 np.asarray(svars[nm]).dtype)
                    for nm in rnn_states}
+        # truncation segments as ONE fused window per minibatch: the
+        # n_full full-length chunks stack on a leading axis and dispatch
+        # as one lax.scan; a ragged tail chunk (T % L != 0) is one extra
+        # per-step dispatch of its own compiled shape (as before)
+        n_full = T // tbptt_length
+        rem = T % tbptt_length
+        t_full = n_full * tbptt_length
+        epoch_means = []   # DEVICE scalars; ONE stacked fetch at fit end
         for epoch in range(epochs):
-            losses = []
+            losses = []    # device loss buffers, never fetched per chunk
             for i in range(0, n, batch_size):
                 # new sequences: recurrent carries restart at zero
                 svars = {**svars, **{nm: jnp.asarray(z)
                                      for nm, z in zero_np.items()}}
-                for t0 in range(0, T, tbptt_length):
-                    ph = {"input": jnp.asarray(X[i:i + batch_size,
-                                                 t0:t0 + tbptt_length]),
-                          "labels": jnp.asarray(Y[i:i + batch_size,
-                                                  t0:t0 + tbptt_length])}
+                if n_full:
+                    xb = X[i:i + batch_size, :t_full].reshape(
+                        batch_size, n_full, tbptt_length, *X.shape[2:])
+                    yb = Y[i:i + batch_size, :t_full].reshape(
+                        batch_size, n_full, tbptt_length, *Y.shape[2:])
+                    win = {"input": jnp.asarray(xb.swapaxes(0, 1)),
+                           "labels": jnp.asarray(yb.swapaxes(0, 1))}
+                    params, svars, state, it_dev, win_losses = window_fn(
+                        params, svars, state, it_dev, constants, win,
+                        base_key)
+                    iteration += n_full
+                    losses.append(win_losses)
+                if rem:
+                    ph = {"input": jnp.asarray(X[i:i + batch_size, t_full:]),
+                          "labels": jnp.asarray(Y[i:i + batch_size, t_full:])}
                     params, svars, state, it_dev, loss_val = step(
                         params, svars, state, it_dev, constants, ph,
                         base_key)
                     iteration += 1
-                    losses.append(loss_val)
-            mean = float(jnp.mean(jnp.stack(losses))) if losses else \
-                float("nan")
-            history.add_epoch(epoch, mean)
+                    losses.append(loss_val[None])
+            epoch_means.append(jnp.mean(jnp.concatenate(losses))
+                               if losses else jnp.asarray(float("nan")))
+            history.add_epoch(epoch, None)
+        fetched = np.asarray(jnp.stack(epoch_means))     # one transfer
+        history.loss_curve.losses = [float(v) for v in fetched]
         # trained params back into BOTH graphs (by name)
         for tgt in (sd, self._sd_train):
             for pn, arr in params.items():

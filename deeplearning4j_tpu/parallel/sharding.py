@@ -58,6 +58,14 @@ class ShardingStrategy:
         spec = (list(self.batch_axes) + [None] * ndim)[:ndim]
         return NamedSharding(self.mesh.mesh, PartitionSpec(*spec))
 
+    def window_sharding(self, ndim: int) -> NamedSharding:
+        """Sharding for a fused-window stacked batch (K, batch, ...):
+        the leading steps axis replicates (every step runs on every
+        chip), the batch axes shard as usual one dim further in — so
+        windows stack under the existing NamedShardings."""
+        spec = ([None] + list(self.batch_axes) + [None] * ndim)[:ndim]
+        return NamedSharding(self.mesh.mesh, PartitionSpec(*spec))
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh.mesh, PartitionSpec())
 

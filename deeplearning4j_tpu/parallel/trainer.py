@@ -41,6 +41,12 @@ class _ShardedIterator:
         a = np.asarray(a)
         return jax.device_put(a, self._strategy.batch_sharding(a.ndim))
 
+    def window_sharding(self, ndim: int):
+        """Fused-window placement hook (autodiff/window.py probes for
+        this): stacked (K, batch, ...) windows land with the steps axis
+        replicated and the batch axes sharded as usual."""
+        return self._strategy.window_sharding(ndim)
+
     def __iter__(self):
         for batch in self._it:
             if isinstance(batch, dict):

@@ -25,6 +25,13 @@ Storage format: JSON-lines, one record per event
         "queue_seconds": s, "async": bool, "t": wall}
         (written by checkpoint/manager.CheckpointManager on each commit
         when constructed with stats_storage=)
+    {"type": "dispatch", "epoch": e, "tier": "per_step"|"windowed"|
+        "scanned_epoch", "fused_steps": k, "accum_steps": a,
+        "steps_per_epoch": n, "dispatches_per_epoch": n,
+        "window_compiles": n, "window_sizes": {length: count}}
+        (the fit tier's dispatch/compile accounting, read from
+        SameDiff.last_fit_stats at each epoch end — the observable for
+        the fused-window executor, docs/training_performance.md)
 """
 from __future__ import annotations
 
@@ -147,6 +154,10 @@ class StatsListener(Listener):
         mem = self._memory_stats()
         if mem:
             self.storage.put({"type": "memory", "epoch": int(epoch), **mem})
+        disp = getattr(sd, "last_fit_stats", None)
+        if disp:
+            self.storage.put({"type": "dispatch", "epoch": int(epoch),
+                              **disp})
 
     def on_training_end(self, sd):
         self.storage.put({"type": "end",

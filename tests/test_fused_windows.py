@@ -1,0 +1,613 @@
+"""Fused multi-step training windows (autodiff/window.py).
+
+Covers the windowed-tier contract: dispatch count drops from ``steps``
+to ``ceil(steps/K)`` (counted via a counting wrapper around the
+compiled window fn), numerics match the per-step tier (to float
+rounding — buffer donation changes the per-step program's codegen, see
+docs/training_performance.md), same-tier runs and checkpoint resumes
+are BIT-exact including dropout, ragged tails run through bounded
+power-of-two buckets, gradient accumulation matches the equivalent
+large batch, and the stager/async-iterator threads cannot leak.
+"""
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff import (SameDiff, ScoreIterationListener,
+                                         TrainingConfig)
+from deeplearning4j_tpu.autodiff.window import WindowStager, pow2_buckets
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+
+
+def _mlp(seed=42, dropout=None, updater=None):
+    sd = SameDiff()
+    rng = np.random.default_rng(seed)
+    x = sd.placeholder("x", shape=(-1, 2), dtype="float32")
+    labels = sd.placeholder("labels", shape=(-1, 2), dtype="float32")
+    w0 = sd.var("w0", value=rng.normal(0, 0.5, (2, 16)).astype(np.float32))
+    b0 = sd.var("b0", shape=(16,))
+    w1 = sd.var("w1", value=rng.normal(0, 0.5, (16, 2)).astype(np.float32))
+    b1 = sd.var("b1", shape=(2,))
+    h = (x.mmul(w0) + b0).tanh()
+    if dropout is not None:
+        h = sd.random.dropout(h, p=dropout)
+    logits = h.mmul(w1) + b1
+    loss = sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    loss.mark_as_loss()
+    sd.training_config = (
+        TrainingConfig.builder()
+        .updater(updater or Adam(learning_rate=0.05))
+        .data_set_feature_mapping("x").data_set_label_mapping("labels")
+        .build())
+    return sd
+
+
+def _xor(n_rows=192):
+    X = np.tile(np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32),
+                (n_rows // 4, 1))
+    Y = np.eye(2, dtype=np.float32)[
+        X[:, 0].astype(int) ^ X[:, 1].astype(int)]
+    return X, Y
+
+
+class _StreamIt:
+    """Host-streaming iterator (no stacked_batches) — the production ETL
+    shape the windowed tier must handle."""
+
+    def __init__(self, X, Y, batch):
+        self.X, self.Y, self.batch = X, Y, batch
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for i in range(0, len(self.X), self.batch):
+            yield self.X[i:i + self.batch], self.Y[i:i + self.batch]
+
+
+def _quiet_listener(every=10 ** 9):
+    return ScoreIterationListener(print_every=every, print_fn=lambda *a: None)
+
+
+def _params(sd):
+    return {n: np.asarray(a) for n, a in sd.trainable_params().items()}
+
+
+def _count_dispatches(sd):
+    """Counting wrapper around the compiled window fn: every invocation
+    of the wrapped callable = one compiled-step dispatch."""
+    counts = []
+    orig = sd.make_train_window
+
+    def counting(*a, **k):
+        fn = orig(*a, **k)
+
+        def wrapped(*fa, **fk):
+            # window length = leading dim of any stacked placeholder
+            stacked = fa[-2]
+            counts.append(next(iter(stacked.values())).shape[0])
+            return fn(*fa, **fk)
+
+        return wrapped
+
+    sd.make_train_window = counting
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+
+def test_pow2_buckets():
+    assert pow2_buckets(0) == []
+    assert pow2_buckets(1) == [1]
+    assert pow2_buckets(4) == [4]
+    assert pow2_buckets(13) == [8, 4, 1]
+    for r in range(1, 64):
+        bs = pow2_buckets(r)
+        assert sum(bs) == r
+        assert all(b & (b - 1) == 0 for b in bs)       # powers of two
+        assert bs == sorted(bs, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-count regression (THE windowed-tier contract)
+
+def test_windowed_dispatch_count_and_params_match_per_step():
+    """K=8 over 16 steps/epoch → exactly ceil(16/8)=2 dispatches per
+    epoch, and final params match the per-step tier."""
+    X, Y = _xor(256)                        # 16 batches of 16
+    sd_ref = _mlp()
+    sd_ref.fit(_StreamIt(X, Y, 16), epochs=2,
+               listeners=[_quiet_listener()])
+    assert sd_ref.last_fit_stats["tier"] == "per_step"
+    assert sd_ref.last_fit_stats["dispatches_per_epoch"] == 16
+
+    sd_win = _mlp()
+    sd_win.training_config.fused_steps = 8
+    counts = _count_dispatches(sd_win)
+    sd_win.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    assert counts == [8, 8, 8, 8]           # ceil(16/8)=2 per epoch
+    st = sd_win.last_fit_stats
+    assert st["tier"] == "windowed"
+    assert st["dispatches_per_epoch"] == math.ceil(16 / 8)
+    assert st["steps_per_epoch"] == 16
+    # same math, independently compiled programs (donation changes the
+    # per-step tier's codegen): equal to float rounding
+    p_ref, p_win = _params(sd_ref), _params(sd_win)
+    for n in p_ref:
+        np.testing.assert_allclose(p_win[n], p_ref[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+    assert sd_win.training_config.iteration_count == \
+        sd_ref.training_config.iteration_count == 32
+
+
+def test_windowed_ragged_tail_pow2_buckets():
+    """13 steps, K=8 → windows [8, 4, 1]: the tail stays fused through
+    bounded pow2 buckets instead of falling back to per-step."""
+    X, Y = _xor(13 * 16)
+    sd_ref = _mlp()
+    sd_ref.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[_quiet_listener()])
+    sd_win = _mlp()
+    sd_win.training_config.fused_steps = 8
+    counts = _count_dispatches(sd_win)
+    sd_win.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[_quiet_listener()])
+    assert counts == [8, 4, 1]
+    assert sd_win.last_fit_stats["window_sizes"] == {8: 1, 4: 1, 1: 1}
+    p_ref, p_win = _params(sd_ref), _params(sd_win)
+    for n in p_ref:
+        np.testing.assert_allclose(p_win[n], p_ref[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_windowed_ragged_final_batch():
+    """An iterator whose LAST batch has fewer rows (170 rows, batch 32 →
+    32×5 + 10) must not crash the stacker: the odd-shaped batch forms
+    its own window, exactly the extra compiled shape the per-step tier
+    pays for it. Review regression: np.stack of mixed shapes raised."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(170, 2)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 170)]
+    sd_ref = _mlp()
+    sd_ref.fit(_StreamIt(X, Y, 32), epochs=1, listeners=[_quiet_listener()])
+    sd_win = _mlp()
+    sd_win.training_config.fused_steps = 4
+    counts = _count_dispatches(sd_win)
+    sd_win.fit(_StreamIt(X, Y, 32), epochs=1, listeners=[_quiet_listener()])
+    # b0-b3 → one full window; b4 (32 rows) flushed alone when the
+    # 10-row b5 arrives; b5 → its own window
+    assert counts == [4, 1, 1]
+    p_ref, p_win = _params(sd_ref), _params(sd_win)
+    for n in p_ref:
+        np.testing.assert_allclose(p_win[n], p_ref[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_windowed_device_cached_windows_built_once():
+    """A stacked_batches source (DeviceCachedIterator) reuses one
+    device-resident window list across epochs — no stager thread, no
+    per-epoch re-stack — and matches the streaming windowed run."""
+    from deeplearning4j_tpu.dataset import DeviceCachedIterator
+    X, Y = _xor(192)
+    sd_dev = _mlp()
+    sd_dev.training_config.fused_steps = 8
+    n_before = threading.active_count()
+    counts = _count_dispatches(sd_dev)
+    sd_dev.fit(DeviceCachedIterator(X, Y, 16), epochs=3,
+               listeners=[_quiet_listener()])
+    assert threading.active_count() == n_before      # no stager spawned
+    assert counts == [8, 4] * 3
+    sd_str = _mlp()
+    sd_str.training_config.fused_steps = 8
+    sd_str.fit(_StreamIt(X, Y, 16), epochs=3, listeners=[_quiet_listener()])
+    p_dev, p_str = _params(sd_dev), _params(sd_str)
+    for n in p_dev:
+        np.testing.assert_allclose(p_dev[n], p_str[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_windowed_bit_identical_rerun_with_dropout():
+    """Same tier + same seed → BIT-identical params, dropout included
+    (per-step RNG keys fold the absolute iteration)."""
+    X, Y = _xor(192)
+    results = []
+    for _ in range(2):
+        sd = _mlp(dropout=0.8)
+        sd.training_config.fused_steps = 8
+        sd.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+        results.append(_params(sd))
+    for n in results[0]:
+        np.testing.assert_array_equal(results[0][n], results[1][n],
+                                      err_msg=n)
+
+
+def test_windowed_matches_per_step_with_dropout():
+    """Dropout key schedule is iteration-folded, so the windowed tier
+    consumes the exact key sequence of the per-step tier."""
+    X, Y = _xor(192)
+    sd_ref = _mlp(dropout=0.8)
+    sd_ref.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    sd_win = _mlp(dropout=0.8)
+    sd_win.training_config.fused_steps = 8
+    sd_win.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    p_ref, p_win = _params(sd_ref), _params(sd_win)
+    for n in p_ref:
+        np.testing.assert_allclose(p_win[n], p_ref[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_windowed_no_listeners_streaming():
+    """fused_steps>1 + streaming iterator + zero listeners: windowed
+    tier (not per-step), deferred loss fetch, learning happens."""
+    X, Y = _xor(192)
+    sd = _mlp()
+    sd.training_config.fused_steps = 4
+    h = sd.fit(_StreamIt(X, Y, 16), epochs=30)
+    assert sd.last_fit_stats["tier"] == "windowed"
+    assert sd.last_fit_stats["dispatches_per_epoch"] == 3
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
+
+
+def test_windowed_accepts_sdvariable_keyed_dict_batches():
+    """Per-step-tier parity: dict batches may be keyed by SDVariable
+    objects, not just names (review regression: the stager's shape
+    signature sort raised TypeError on unorderable keys)."""
+    X, Y = _xor(64)
+    sd = _mlp()
+    sd.training_config.fused_steps = 2
+    xv, lv = sd.get_variable("x"), sd.get_variable("labels")
+
+    class VarKeyIt:
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            for i in range(0, 64, 16):
+                yield {xv: X[i:i + 16], lv: Y[i:i + 16]}
+
+    h = sd.fit(VarKeyIt(), epochs=2, listeners=[_quiet_listener()])
+    assert np.isfinite(h.final_loss())
+    assert sd.last_fit_stats["dispatches_per_epoch"] == 2
+
+
+def test_scanned_tier_still_preferred_without_listeners():
+    from deeplearning4j_tpu.dataset import DeviceCachedIterator
+    X, Y = _xor(192)
+    sd = _mlp()
+    sd.fit(DeviceCachedIterator(X, Y, 16), epochs=1)
+    assert sd.last_fit_stats["tier"] == "scanned_epoch"
+    assert sd.last_fit_stats["dispatches_per_epoch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch checkpoint flush + bit-exact resume
+
+def test_checkpoint_cadence_first_boundary_after_each_multiple(tmp_path):
+    """every_n_iterations=10 with K=8 windows saves at the FIRST window
+    boundary at-or-after each multiple of 10 (docs/checkpointing.md) —
+    not only when a full 10 steps have buffered (review regression:
+    sum-based flushing drifted the cadence to 16)."""
+    from deeplearning4j_tpu.checkpoint import (CheckpointListener,
+                                               CheckpointManager)
+    X, Y = _xor(48 * 16)               # 48 batches of 16 per epoch
+    sd = _mlp()
+    sd.training_config.fused_steps = 8
+    mgr = CheckpointManager(tmp_path, keep_last_n=None, async_write=False)
+    lst = CheckpointListener(mgr, every_n_iterations=10)
+    sd.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[lst])
+    # boundaries 8,16,24,32,40,48; multiples 10,20,30,40 → 16,24,32,40
+    # (48 is the epoch-end flush: no multiple crossed since 40)
+    assert mgr.all_steps() == [16, 24, 32, 40]
+
+
+def test_windowed_mid_epoch_checkpoint_resumes_bit_exact(tmp_path):
+    """A CheckpointListener firing MID-epoch under the windowed tier
+    snapshots at a window boundary; resuming replays the identical
+    window partition and matches the uninterrupted run bit-for-bit."""
+    from deeplearning4j_tpu.checkpoint import (CheckpointListener,
+                                               CheckpointManager,
+                                               restore_training_state)
+    X, Y = _xor(64)                     # 4 batches of 16 per epoch
+    # --- uninterrupted windowed run (2 epochs, K=2 → windows [2,2]) --
+    sd_a = _mlp()
+    sd_a.training_config.fused_steps = 2
+    sd_a.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    # --- run with a mid-epoch iteration-cadence checkpoint ----------
+    sd_b = _mlp()
+    sd_b.training_config.fused_steps = 2
+    mgr = CheckpointManager(tmp_path, keep_last_n=None, async_write=False)
+    lst = CheckpointListener(mgr, every_n_iterations=2)
+    sd_b.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[lst])
+    steps = mgr.all_steps()
+    assert 2 in steps                   # fired after the first window
+    state = mgr.restore(2)
+    assert state.iteration == 2         # a window boundary
+    # --- fresh process resumes from the mid-epoch snapshot ----------
+    sd_c = _mlp()
+    sd_c.training_config.fused_steps = 2
+    restore_training_state(sd_c, state)
+    # finish the interrupted epoch: batches 2..3 = one window of 2
+    sd_c.fit(_StreamIt(X[32:], Y[32:], 16), epochs=1,
+             listeners=[_quiet_listener()])
+    # the uninterrupted run keeps ONE base key across both epochs; a
+    # resumed process replays it by re-pinning the restored seed
+    sd_c._seed = state.rng_seed
+    sd_c.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[_quiet_listener()])
+    p_a, p_c = _params(sd_a), _params(sd_c)
+    for n in p_a:
+        np.testing.assert_array_equal(p_a[n], p_c[n], err_msg=n)
+    la = jax.tree_util.tree_leaves(sd_a._updater_state)
+    lc = jax.tree_util.tree_leaves(sd_c._updater_state)
+    assert len(la) == len(lc) > 0
+    for a, c in zip(la, lc):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation
+
+def test_accum_steps_match_large_batch():
+    """accum_steps=2 at batch 16 == one update per 32-row batch (mean
+    loss ⇒ averaged micro-grads ≡ full-batch grad) with plain SGD."""
+    X, Y = _xor(192)
+    sd_big = _mlp(updater=Sgd(learning_rate=0.2))
+    sd_big.fit(_StreamIt(X, Y, 32), epochs=3, listeners=[_quiet_listener()])
+    sd_acc = _mlp(updater=Sgd(learning_rate=0.2))
+    sd_acc.training_config.fused_steps = 4
+    sd_acc.training_config.accum_steps = 2
+    sd_acc.fit(_StreamIt(X, Y, 16), epochs=3, listeners=[_quiet_listener()])
+    p_big, p_acc = _params(sd_big), _params(sd_acc)
+    for n in p_big:
+        np.testing.assert_allclose(p_acc[n], p_big[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_accum_cycle_spans_window_boundary():
+    """The accumulator carries BETWEEN window dispatches: K=3 with
+    accum_steps=2 (cycle straddles the window edge) must equal one
+    K=6 window of the same 6 micro-batches."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(96, 2)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 96)]
+    outs = []
+    for k in (3, 6):
+        sd = _mlp(updater=Sgd(learning_rate=0.2))
+        sd.training_config.fused_steps = k
+        sd.training_config.accum_steps = 2
+        sd.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+        outs.append(_params(sd))
+    for n in outs[0]:
+        np.testing.assert_allclose(outs[0][n], outs[1][n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_accum_updater_steps_once_per_cycle():
+    """With lr=0-equivalent NoOp-style freeze: accum must not change
+    params between updates — probe that exactly floor(steps/accum)
+    updates happen by comparing against per-update SGD math."""
+    X, Y = _xor(64)                     # 4 micro-batches of 16
+    sd = _mlp(updater=Sgd(learning_rate=0.5))
+    sd.training_config.fused_steps = 4
+    sd.training_config.accum_steps = 4
+    sd.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[_quiet_listener()])
+    after = _params(sd)
+    # one update from the mean grad over all 64 rows == full-batch SGD
+    sd_ref = _mlp(updater=Sgd(learning_rate=0.5))
+    sd_ref.fit(_StreamIt(X, Y, 64), epochs=1, listeners=[_quiet_listener()])
+    p_ref = _params(sd_ref)
+    for n in p_ref:
+        np.testing.assert_allclose(after[n], p_ref[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_accum_carry_persists_across_fits():
+    """A fit ending mid-accumulation-cycle must not drop its partial
+    grads: two sequential 1-epoch fits (6 steps each, accum_steps=4 →
+    each fit ends mid-cycle) equal one 2-epoch fit."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(96, 2)).astype(np.float32)   # 6 batches of 16
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 96)]
+    sd_one = _mlp(updater=Sgd(learning_rate=0.2))
+    sd_one.training_config.fused_steps = 8
+    sd_one.training_config.accum_steps = 4
+    sd_one.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    sd_two = _mlp(updater=Sgd(learning_rate=0.2))
+    sd_two.training_config.fused_steps = 8
+    sd_two.training_config.accum_steps = 4
+    for _ in range(2):
+        sd_two.fit(_StreamIt(X, Y, 16), epochs=1,
+                   listeners=[_quiet_listener()])
+    p_one, p_two = _params(sd_one), _params(sd_two)
+    for n in p_one:
+        np.testing.assert_allclose(p_two[n], p_one[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# listener delivery + stats + networks
+
+def test_windowed_listener_burst_delivery():
+    """Every iteration's scalar arrives exactly once, in order, in
+    window-boundary bursts."""
+    X, Y = _xor(192)                    # 12 steps/epoch
+    seen = []
+
+    class Recorder(ScoreIterationListener):
+        frequency = 5
+
+        def __init__(self):
+            super().__init__(print_every=10 ** 9, print_fn=lambda *a: None)
+            self.frequency = 5
+
+        def iterations_done(self, sd, epoch, iterations, losses):
+            seen.append(list(iterations))
+            assert len(iterations) == len(losses)
+            assert all(np.isfinite(l) for l in losses)
+
+    sd = _mlp()
+    sd.training_config.fused_steps = 4
+    sd.fit(_StreamIt(X, Y, 16), epochs=1, listeners=[Recorder()])
+    flat = [i for burst in seen for i in burst]
+    assert flat == list(range(12))
+    # flush at the first window boundary at-or-after frequency=5 → 8
+    assert seen[0] == list(range(8))
+
+
+def test_windowed_stats_listener_dispatch_record():
+    from deeplearning4j_tpu.ui.stats import StatsListener, StatsStorage
+    X, Y = _xor(192)
+    sd = _mlp()
+    sd.training_config.fused_steps = 8
+    storage = StatsStorage()
+    sd.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[StatsListener(storage)])
+    recs = storage.of_type("dispatch")
+    assert len(recs) == 2
+    assert recs[0]["tier"] == "windowed"
+    assert recs[0]["dispatches_per_epoch"] == 2     # ceil(12/8) = [8,4]
+    assert recs[0]["fused_steps"] == 8
+
+
+def test_multilayer_network_fused_steps_kwarg():
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    X, Y = _xor(192)
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(0)
+         .updater(Adam(learning_rate=0.05)).list()
+         .layer(DenseLayer(n_out=16, activation="tanh"))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(InputType.feed_forward(2)).build())).init()
+    h = net.fit(X, Y, epochs=20, batch_size=16,
+                listeners=[_quiet_listener()], fused_steps=4)
+    assert net.samediff.last_fit_stats["tier"] == "windowed"
+    assert net.samediff.last_fit_stats["dispatches_per_epoch"] == 3
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
+
+
+def test_parallel_trainer_windowed_fit():
+    """Windows stack under the mesh shardings (window_sharding hook)."""
+    from deeplearning4j_tpu.parallel import DeviceMesh, ParallelTrainer
+    from deeplearning4j_tpu.parallel.sharding import data_parallel
+    X, Y = _xor(192)
+    sd = _mlp()
+    sd.training_config.fused_steps = 4
+    tr = ParallelTrainer(sd, strategy=data_parallel(DeviceMesh.create()))
+    h = tr.fit(_StreamIt(X, Y, 16), epochs=2, listeners=[_quiet_listener()])
+    assert sd.last_fit_stats["tier"] == "windowed"
+    assert np.isfinite(h.final_loss())
+
+
+def test_training_config_serde_roundtrip_fused_knobs():
+    tc = (TrainingConfig.builder().updater(Adam(learning_rate=1e-3))
+          .fused_steps(8).accum_steps(4).build())
+    tc2 = TrainingConfig.from_json(tc.to_json())
+    assert tc2.fused_steps == 8 and tc2.accum_steps == 4
+    # defaults survive old-format JSON (no keys)
+    d = tc.to_json()
+    del d["fused_steps"], d["accum_steps"]
+    tc3 = TrainingConfig.from_json(d)
+    assert tc3.fused_steps == 1 and tc3.accum_steps == 1
+
+
+# ---------------------------------------------------------------------------
+# thread hygiene: stager + AsyncDataSetIterator
+
+def test_window_stager_abandoned_consumer_no_leak():
+    n_before = threading.active_count()
+    stager = WindowStager(iter({"x": np.zeros((4, 2), np.float32)}
+                               for _ in range(10000)), window=4, depth=2)
+    it = iter(stager)
+    next(it)
+    it.close()                          # GeneratorExit → finally → close()
+    assert not stager._thread.is_alive()
+    assert threading.active_count() <= n_before + 1
+
+
+def test_window_stager_propagates_source_error():
+    def bad_source():
+        yield {"x": np.zeros((4, 2), np.float32)}
+        raise RuntimeError("etl failure")
+
+    stager = WindowStager(bad_source(), window=1)
+    with pytest.raises(RuntimeError, match="etl failure"):
+        list(stager)
+
+
+def test_async_iterator_abandoned_consumer_no_leak():
+    from deeplearning4j_tpu.dataset.iterators import (ArrayDataSetIterator,
+                                                      AsyncDataSetIterator)
+    X = np.zeros((4096, 2), np.float32)
+    wrapped = ArrayDataSetIterator(X, X, batch_size=1)   # 4096 batches
+    ait = AsyncDataSetIterator(wrapped, queue_size=2)
+    gen = iter(ait)
+    next(gen)
+    gen.close()             # abandon mid-epoch (the leak regression)
+    t = ait._last_thread
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_async_iterator_full_pass_and_error_propagation():
+    from deeplearning4j_tpu.dataset.iterators import (ArrayDataSetIterator,
+                                                      AsyncDataSetIterator)
+    X = np.arange(64, dtype=np.float32).reshape(32, 2)
+    ait = AsyncDataSetIterator(ArrayDataSetIterator(X, X, batch_size=8),
+                               queue_size=2)
+    got = list(ait)
+    assert len(got) == 4
+    np.testing.assert_array_equal(got[0][0], X[:8])
+
+    class Bad:
+        def __iter__(self):
+            yield X[:8], X[:8]
+            raise ValueError("reader died")
+
+    with pytest.raises(ValueError, match="reader died"):
+        list(AsyncDataSetIterator(Bad(), queue_size=2))
+
+
+def test_windowed_fit_through_async_iterator():
+    """The windowed tier consumes a prefetching iterator end-to-end."""
+    from deeplearning4j_tpu.dataset.iterators import (ArrayDataSetIterator,
+                                                      AsyncDataSetIterator)
+    X, Y = _xor(192)
+    sd = _mlp()
+    sd.training_config.fused_steps = 4
+    ait = AsyncDataSetIterator(ArrayDataSetIterator(X, Y, batch_size=16),
+                               queue_size=2)
+    h = sd.fit(ait, epochs=2, listeners=[_quiet_listener()])
+    assert np.isfinite(h.final_loss())
+    assert sd.last_fit_stats["dispatches_per_epoch"] == 3
+
+
+# ---------------------------------------------------------------------------
+# BenchmarkDataSetIterator device-cached mode
+
+def test_benchmark_iterator_device_cached_and_stacked():
+    from deeplearning4j_tpu.dataset.iterators import BenchmarkDataSetIterator
+    it = BenchmarkDataSetIterator((8, 4), 3, 5, device_cached=True)
+    batches = list(it)
+    assert len(batches) == 5
+    assert isinstance(batches[0][0], jax.Array)
+    # the SAME resident array every step — no per-step re-upload
+    assert batches[0][0] is batches[1][0]
+    fs, ls = it.stacked_batches()
+    assert fs[0].shape == (5, 8, 4) and ls[0].shape == (5, 8, 3)
+    # host mode keeps the legacy contract and no scanned-tier hook
+    it2 = BenchmarkDataSetIterator((8, 4), 3, 5)
+    assert not hasattr(it2, "stacked_batches")
+    assert isinstance(next(iter(it2))[0], np.ndarray)
+
+
+def test_benchmark_iterator_drives_scanned_tier():
+    from deeplearning4j_tpu.dataset.iterators import BenchmarkDataSetIterator
+    sd = _mlp()
+    it = BenchmarkDataSetIterator((16, 2), 2, 6, device_cached=True)
+    sd.fit(it, epochs=1)
+    assert sd.last_fit_stats["tier"] == "scanned_epoch"
+    assert sd.training_config.iteration_count == 6
