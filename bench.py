@@ -89,10 +89,11 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
 
 
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
-                       fused_steps=1):
+                       fused_steps=1, sentinel=False):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
-    give the listener-path variant (see bench_lenet)."""
+    give the listener-path variant (see bench_lenet); ``sentinel`` arms
+    the device-side divergence sentinel (docs/fault_tolerance.md)."""
     from deeplearning4j_tpu.autodiff import (SameDiff,
                                              ScoreIterationListener,
                                              TrainingConfig)
@@ -117,7 +118,8 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                           .updater(Adam(learning_rate=1e-3))
                           .data_set_feature_mapping("x")
                           .data_set_label_mapping("labels")
-                          .fused_steps(fused_steps).build())
+                          .fused_steps(fused_steps)
+                          .sentinel(sentinel).build())
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     n = 2048
@@ -138,6 +140,32 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
             "batch": batch, **_dispatch_stats(sd)}
+
+
+def bench_sentinel_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the divergence rail (faults/, docs/fault_tolerance.md):
+    the fused-window listener config with the device sentinel off vs on.
+    The sentinel adds one finiteness reduction per step inside the scan
+    and one int32 per window — the acceptance bar is ≤5% steps/s.
+
+    Run-to-run jitter on a tunneled chip easily exceeds the effect
+    size, so each flag is measured ``repeats`` times interleaved and
+    the best rate per flag is compared (the min-overhead estimator for
+    a one-sided cost)."""
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            r = bench_samediff_mlp(batch=batch, listener=True,
+                                   fused_steps=fused_steps, sentinel=flag)
+            best[flag] = max(best[flag], r["samples_per_sec"])
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_sentinel_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "sentinel_overhead_pct": round(overhead, 2),
+            "batch": batch, "fused_steps": fused_steps}
 
 
 def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
@@ -262,6 +290,9 @@ def main():
                      ("samediff_mlp_listener",
                       lambda: bench_samediff_mlp(listener=True,
                                                  fused_steps=8)),
+                     # the fault rail's cost stays visible: fused-window
+                     # steps/s with divergence sentinels on vs off
+                     ("sentinel_overhead", bench_sentinel_overhead),
                      ("resnet50", bench_resnet50),
                      ("bert_base", bench_bert_base),
                      ("gpt_medium", bench_gpt_medium)):

@@ -818,6 +818,18 @@ class SameDiff:
             if loss_scale is not None:
                 grads = jax.tree_util.tree_map(
                     lambda g: g / loss_scale, grads)
+            # chaos harness (faults/chaos.py): deterministic NaN-gradient
+            # injection at one absolute iteration, traced into the
+            # program — fires inside fused windows/scans too. A None
+            # spec (production) leaves the trace untouched.
+            _chaos = getattr(tc, "_chaos_spec", None)
+            _nan_at = getattr(_chaos, "nan_grads_at", None) \
+                if _chaos is not None else None
+            if _nan_at is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(iteration == int(_nan_at),
+                                        jnp.full_like(g, jnp.nan), g),
+                    grads)
             new_svars = {sn: outs[src].astype(svars[sn].dtype)
                          for sn, src in state_updates.items()}
             # state vars with no declared update carry over unchanged
@@ -842,10 +854,17 @@ class SameDiff:
 
         return grad_fn, apply_fn, loss_names
 
-    def _build_step_body(self):
+    def _build_step_body(self, sentinel: bool = False):
         """One full train step (forward + backward + updater + param
         update) composed from _build_step_parts — shared by the per-batch
-        step, the fused-window step and the scanned whole-epoch step."""
+        step, the fused-window step and the scanned whole-epoch step.
+
+        ``sentinel=True`` (TrainingConfig.sentinel, faults/sentinels.py)
+        makes the body additionally emit one boolean from
+        ``_sentinel_ok``: finite loss AND finite global gradient norm.
+        The flag is computed from values the step already produces;
+        parameter math is untouched (sentinel-on training is
+        bit-identical to sentinel-off)."""
         grad_fn, apply_fn, loss_names = self._build_step_parts()
 
         def step_body(params, svars, state, iteration, constants, phv,
@@ -853,14 +872,19 @@ class SameDiff:
             grads, new_svars, data_loss = grad_fn(params, svars, iteration,
                                                   constants, phv, base_key)
             new_params, new_state = apply_fn(params, grads, state, iteration)
-            # iteration advances on device — no per-step int transfer
-            return new_params, new_svars, new_state, iteration + 1, data_loss
+            if not sentinel:
+                # iteration advances on device — no per-step int transfer
+                return (new_params, new_svars, new_state, iteration + 1,
+                        data_loss)
+            return (new_params, new_svars, new_state, iteration + 1,
+                    data_loss, self._sentinel_ok(data_loss, grads))
 
         return step_body, loss_names
 
-    def make_train_step(self, donate: bool = True):
-        step_body, loss_names = self._build_step_body()
-        cache_key = ("train_step", self._version, loss_names, donate)
+    def make_train_step(self, donate: bool = True, sentinel: bool = False):
+        step_body, loss_names = self._build_step_body(sentinel=sentinel)
+        cache_key = ("train_step", self._version, loss_names, donate,
+                     bool(sentinel))
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             self._verbose_log(f"compiling train step (graph v{self._version}, "
@@ -869,6 +893,24 @@ class SameDiff:
                                donate_argnums=(0, 1, 2, 3) if donate else ())
             self._fn_cache[cache_key] = compiled
         return compiled
+
+    @staticmethod
+    def _sentinel_ok(data_loss, grads):
+        """The divergence sentinel's per-step verdict: finite loss AND a
+        finite global gradient L1 norm. The norm touches every gradient
+        leaf — NO sampling — because a where-based op (relu, dropout
+        masks) can launder NaN activations into a FINITE loss while one
+        weight's gradient (``x^T @ delta`` with NaN x) silently poisons
+        that parameter forever; only a reduction over all leaves sees
+        it. The check is a boolean ``isfinite``-AND reduce (not a float
+        norm accumulation): XLA fuses the elementwise ``isfinite`` into
+        each gradient's producer and the AND-reduce has no serial float
+        dependency chain — measured noise-level next to the step's
+        matmuls (bench.py sentinel_overhead tracks it)."""
+        ok = jnp.isfinite(data_loss)
+        for g in jax.tree_util.tree_leaves(grads):
+            ok = ok & jnp.all(jnp.isfinite(g))
+        return ok
 
     @staticmethod
     def _nan_panic_active(tc) -> bool:
@@ -889,7 +931,8 @@ class SameDiff:
         if env.is_verbose() or env.is_debug():
             print(f"[deeplearning4j_tpu] {msg}")
 
-    def make_train_epoch(self, donate: bool = True, unroll: int = 1):
+    def make_train_epoch(self, donate: bool = True, unroll: int = 1,
+                         sentinel: bool = False):
         """Whole-epoch train step: lax.scan of the step body over batches
         stacked on a leading steps axis. ONE device dispatch per epoch —
         on a tunneled/host-bottlenecked chip this removes the per-step
@@ -901,10 +944,11 @@ class SameDiff:
 
         An epoch IS a window of length n_steps — this delegates to
         make_train_window."""
-        return self.make_train_window(donate=donate, unroll=unroll)
+        return self.make_train_window(donate=donate, unroll=unroll,
+                                      sentinel=sentinel)
 
     def make_train_window(self, accum_steps: int = 1, donate: bool = True,
-                          unroll: int = 1):
+                          unroll: int = 1, sentinel: bool = False):
         """Fused-window train step: K consecutive steps in ONE compiled
         dispatch — a lax.scan of the step body over a (K, batch, ...)
         stacked window of placeholders. Per-step losses come back as a
@@ -924,18 +968,40 @@ class SameDiff:
         iteration. Signature then gains an ``accum`` carry (zeros_like
         params) threaded between windows — an accumulation cycle may
         span window boundaries.
+
+        ``sentinel=True`` (TrainingConfig.sentinel) adds ONE extra int32
+        output: the absolute iteration of the first step in the window
+        whose loss or gradients went non-finite (-1 = clean). The
+        flag folds into the scan carry, so the window still syncs with
+        the host only at its boundaries (faults/sentinels.py).
         """
         if accum_steps <= 1:
-            step_body, loss_names = self._build_step_body()
+            step_body, loss_names = self._build_step_body(sentinel=sentinel)
 
             def window_fn(params, svars, state, iteration, constants,
                           stacked_phv, base_key):
                 def body(carry, phv):
+                    if sentinel:
+                        p, sv, st, it, bad = carry
+                        p, sv, st, it2, loss, ok = step_body(
+                            p, sv, st, it, constants, phv, base_key)
+                        # absolute iteration of the FIRST bad step in the
+                        # window; -1 = clean (faults/sentinels.py)
+                        bad = jnp.where((bad < 0) & jnp.logical_not(ok),
+                                        it, bad)
+                        return (p, sv, st, it2, bad), loss
                     p, sv, st, it = carry
                     p, sv, st, it, loss = step_body(
                         p, sv, st, it, constants, phv, base_key)
                     return (p, sv, st, it), loss
 
+                if sentinel:
+                    carry0 = (params, svars, state, iteration,
+                              jnp.asarray(-1, jnp.int32))
+                    (params, svars, state, iteration, bad), losses = \
+                        jax.lax.scan(body, carry0, stacked_phv,
+                                     unroll=unroll)
+                    return params, svars, state, iteration, losses, bad
                 (params, svars, state, iteration), losses = jax.lax.scan(
                     body, (params, svars, state, iteration), stacked_phv,
                     unroll=unroll)
@@ -949,7 +1015,10 @@ class SameDiff:
             def window_fn(params, svars, state, accum, iteration, constants,
                           stacked_phv, base_key):
                 def body(carry, phv):
-                    p, sv, st, acc, it = carry
+                    if sentinel:
+                        p, sv, st, acc, it, bad = carry
+                    else:
+                        p, sv, st, acc, it = carry
                     grads, sv, loss = grad_fn(p, sv, it, constants, phv,
                                               base_key)
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -965,8 +1034,23 @@ class SameDiff:
                     p, st, acc = jax.lax.cond(
                         (it + 1) % n_accum == 0, do_apply, lambda a: a,
                         (p, st, acc))
+                    if sentinel:
+                        # the MICRO-step grads, pre-accumulation: the bad
+                        # step is named, not its whole cycle
+                        ok = self._sentinel_ok(loss, grads)
+                        bad = jnp.where((bad < 0) & jnp.logical_not(ok),
+                                        it, bad)
+                        return (p, sv, st, acc, it + 1, bad), loss
                     return (p, sv, st, acc, it + 1), loss
 
+                if sentinel:
+                    carry0 = (params, svars, state, accum, iteration,
+                              jnp.asarray(-1, jnp.int32))
+                    (params, svars, state, accum, iteration, bad), losses = \
+                        jax.lax.scan(body, carry0, stacked_phv,
+                                     unroll=unroll)
+                    return (params, svars, state, accum, iteration, losses,
+                            bad)
                 (params, svars, state, accum, iteration), losses = \
                     jax.lax.scan(body, (params, svars, state, accum,
                                         iteration), stacked_phv,
@@ -975,7 +1059,7 @@ class SameDiff:
 
             donate_args = (0, 1, 2, 3, 4)
         cache_key = ("train_window", self._version, loss_names,
-                     int(accum_steps), donate, int(unroll))
+                     int(accum_steps), donate, int(unroll), bool(sentinel))
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             self._verbose_log(
@@ -1035,7 +1119,8 @@ class SameDiff:
         self._verbose_log(f"fit: per-step path — {why} "
                           f"(set TrainingConfig.fused_steps>1 for fused "
                           f"windows)")
-        step = self.make_train_step()
+        use_sentinel = bool(getattr(tc, "sentinel", False))
+        step = self.make_train_step(sentinel=use_sentinel)
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
@@ -1085,15 +1170,32 @@ class SameDiff:
 
         for epoch in range(epochs):
             epoch_losses = []
+            epoch_oks: List[jax.Array] = []   # sentinel flags, device-side
             epoch_start_iter = iteration
             pending: List[Tuple[int, jax.Array]] = []
+            pending_oks: List[Tuple[int, jax.Array]] = []
 
             def _flush(pending):
                 if not pending:
                     return
                 iters = [it for it, _ in pending]
-                vals = [float(v) for v in
-                        np.asarray(jnp.stack([lv for _, lv in pending]))]
+                if pending_oks:
+                    # losses + sentinel verdicts in ONE device->host
+                    # transfer; verdicts are checked (and may raise)
+                    # BEFORE the burst reaches listeners
+                    from deeplearning4j_tpu.faults.sentinels import \
+                        check_ok_flags
+                    ok_iters = [it for it, _ in pending_oks]
+                    vals_arr, oks = jax.device_get(
+                        (jnp.stack([lv for _, lv in pending]),
+                         jnp.stack([o for _, o in pending_oks])))
+                    pending_oks.clear()
+                    check_ok_flags(np.asarray(oks), ok_iters, epoch,
+                                   epoch_start_iter)
+                else:
+                    vals_arr = np.asarray(
+                        jnp.stack([lv for _, lv in pending]))
+                vals = [float(v) for v in vals_arr]
                 epoch_losses.extend(vals)
                 if sync_params_on_flush:
                     # the FULL training state, not just params: a
@@ -1131,8 +1233,18 @@ class SameDiff:
                 for l in listeners:
                     if getattr(l, "batch_size", -1) is None:
                         l.batch_size = next(iter(ph.values())).shape[0]
-                params, svars, state, it_dev, loss_val = step(
-                    params, svars, state, it_dev, constants, ph, base_key)
+                if use_sentinel:
+                    params, svars, state, it_dev, loss_val, ok = step(
+                        params, svars, state, it_dev, constants, ph,
+                        base_key)
+                    if listeners:
+                        pending_oks.append((iteration, ok))
+                    else:
+                        epoch_oks.append(ok)
+                else:
+                    params, svars, state, it_dev, loss_val = step(
+                        params, svars, state, it_dev, constants, ph,
+                        base_key)
                 # without listeners, never force a device sync: losses stay
                 # async device scalars (a scalar fetch = tunnel round-trip)
                 if listeners:
@@ -1143,6 +1255,16 @@ class SameDiff:
                     epoch_losses.append(loss_val)
                 iteration += 1
                 ph = nxt
+            if epoch_oks:
+                # sentinel without listeners: ONE stacked verdict fetch
+                # per epoch (the rail's only extra sync on this path)
+                from deeplearning4j_tpu.faults.sentinels import \
+                    check_ok_flags
+                oks = np.asarray(jnp.stack(epoch_oks))
+                epoch_oks.clear()
+                check_ok_flags(oks, range(epoch_start_iter,
+                                          epoch_start_iter + len(oks)),
+                               epoch, epoch_start_iter)
             if listeners:
                 _flush(pending)
                 mean_loss = float(np.mean(epoch_losses)) \
@@ -1199,8 +1321,10 @@ class SameDiff:
         """fit() fast path: epochs of lax.scan over device-stacked batches."""
         from deeplearning4j_tpu.autodiff.training import History
         tc = self.training_config
+        use_sentinel = bool(getattr(tc, "sentinel", False))
         epoch_step = self.make_train_epoch(
-            unroll=getattr(tc, "scan_unroll", 1) or 1)
+            unroll=getattr(tc, "scan_unroll", 1) or 1,
+            sentinel=use_sentinel)
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
         svars = jax.tree_util.tree_map(jnp.copy, self.state_vars_map())
         if self._updater_state is not None and \
@@ -1224,9 +1348,22 @@ class SameDiff:
         history = History()
         epoch_means = []
         panic = self._nan_panic_active(tc)
-        for _ in range(epochs):
-            params, svars, state, it_dev, losses = epoch_step(
-                params, svars, state, it_dev, constants, stacked, base_key)
+        for epoch in range(epochs):
+            if use_sentinel:
+                params, svars, state, it_dev, losses, bad = epoch_step(
+                    params, svars, state, it_dev, constants, stacked,
+                    base_key)
+                bad = int(bad)     # one scalar sync per scanned epoch
+                if bad >= 0:
+                    from deeplearning4j_tpu.faults.sentinels import \
+                        raise_diverged
+                    # epoch = this fit's loop index, matching the
+                    # per-step and windowed tiers' provenance
+                    raise_diverged(bad, epoch, iteration)
+            else:
+                params, svars, state, it_dev, losses = epoch_step(
+                    params, svars, state, it_dev, constants, stacked,
+                    base_key)
             m = jnp.mean(losses)
             if panic and not np.isfinite(float(m)):
                 raise NumericsException(

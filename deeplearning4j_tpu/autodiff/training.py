@@ -99,6 +99,15 @@ class TrainingConfig:
     # $DL4J_TPU_DEBUG, reference: Environment.h debug mode).
     nan_panic: bool = dataclasses.field(default_factory=lambda: bool(
         _env().get("nan_panic") or _env().get("debug")))
+    # device-side divergence sentinel (faults/sentinels.py): the compiled
+    # step additionally emits isfinite(loss) AND an isfinite check over
+    # EVERY gradient leaf (SameDiff._sentinel_ok — deliberately not a
+    # sampled leaf); fused windows fold it into the scan carry (one
+    # extra scalar per window, no per-step host sync) and the fit tiers
+    # raise a structured faults.TrainingDivergedError naming
+    # step/epoch/batch. Parameter math is untouched — sentinel-on
+    # training is bit-identical.
+    sentinel: bool = False
 
     def clip_gradients(self, grads):
         """Apply elementwise clip + the configured normalization mode to a
@@ -148,6 +157,7 @@ class TrainingConfig:
                 self.gradient_normalization_threshold,
             "fused_steps": self.fused_steps,
             "accum_steps": self.accum_steps,
+            "sentinel": self.sentinel,
         }
 
     @staticmethod
@@ -168,6 +178,7 @@ class TrainingConfig:
                 "gradient_normalization_threshold", 1.0),
             fused_steps=d.get("fused_steps", 1),
             accum_steps=d.get("accum_steps", 1),
+            sentinel=d.get("sentinel", False),
         )
 
     class Builder:
@@ -196,6 +207,8 @@ class TrainingConfig:
             self._kw["fused_steps"] = int(k); return self
         def accum_steps(self, n: int):
             self._kw["accum_steps"] = int(n); return self
+        def sentinel(self, on: bool = True):
+            self._kw["sentinel"] = bool(on); return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 

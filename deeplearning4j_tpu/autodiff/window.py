@@ -198,7 +198,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     tc = sd.training_config
     K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
     A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
-    window_fn = sd.make_train_window(accum_steps=A)
+    use_sentinel = bool(getattr(tc, "sentinel", False))
+    window_fn = sd.make_train_window(accum_steps=A, sentinel=use_sentinel)
     # window_fn donates param/state buffers; work on copies so the
     # graph's stored arrays stay valid for output()/save() mid-fit
     params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
@@ -243,7 +244,7 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     # compiled window lengths (jit retraces per leading-dim K): tracked
     # per (graph version, accum) so stats report real compile counts
     seen_sizes = sd.__dict__.setdefault("_window_traces", {}) \
-        .setdefault((sd._version, A), set())
+        .setdefault((sd._version, A, use_sentinel), set())
 
     def _name_batch(batch):
         if isinstance(batch, dict):
@@ -292,10 +293,23 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         epoch_losses: List[float] = []       # floats (listener path)
         epoch_loss_bufs: List[jax.Array] = []  # device (K,) buffers
         pending = []                         # (start_iter, k, (k,) losses)
+        pending_bads: List[jax.Array] = []   # sentinel scalars, device
+        epoch_bads: List[jax.Array] = []     # ... for the listener-free path
         epoch_start_iter = iteration
         dispatches = 0
         compiles = 0
         sizes: Dict[int, int] = {}     # window length -> dispatch count
+
+        def _check_bads(bads):
+            """Device-sentinel verdicts for a burst of windows: ONE
+            stacked fetch; the first non-negative entry is the absolute
+            iteration of the diverged step (faults/sentinels.py)."""
+            if not bads:
+                return
+            from deeplearning4j_tpu.faults.sentinels import check_bad_steps
+            fetched = np.asarray(jnp.stack(bads))
+            bads.clear()
+            check_bad_steps(fetched, epoch, epoch_start_iter)
 
         def _flush():
             if not pending:
@@ -303,9 +317,22 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             iters: List[int] = []
             for start, k, _ in pending:
                 iters.extend(range(start, start + k))
-            # ONE device→host transfer for the whole burst
-            vals = [float(v) for v in
-                    np.asarray(jnp.concatenate([lv for _, _, lv in pending]))]
+            losses_cat = jnp.concatenate([lv for _, _, lv in pending])
+            if pending_bads:
+                # losses + sentinel verdicts in ONE device→host
+                # transfer; poisoned windows must not feed listeners/
+                # checkpoints, so verdicts are checked (and may raise)
+                # before the burst is delivered
+                from deeplearning4j_tpu.faults.sentinels import \
+                    check_bad_steps
+                vals_arr, bads = jax.device_get(
+                    (losses_cat, jnp.stack(pending_bads)))
+                pending_bads.clear()
+                check_bad_steps(np.asarray(bads), epoch, epoch_start_iter)
+            else:
+                # ONE device→host transfer for the whole burst
+                vals_arr = np.asarray(losses_cat)
+            vals = [float(v) for v in vals_arr]
             epoch_losses.extend(vals)
             if sync_params_on_flush:
                 # the FULL training state at the window boundary: a
@@ -350,9 +377,18 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                     seen_sizes.add(trace_sig)
                     compiles += 1
                     sd._verbose_log(f"fit: compiling window length {k}")
-                if A > 1:
+                bad = None
+                if A > 1 and use_sentinel:
+                    (params, svars, state, accum, it_dev, losses,
+                     bad) = window_fn(params, svars, state, accum, it_dev,
+                                      constants, win, base_key)
+                elif A > 1:
                     params, svars, state, accum, it_dev, losses = window_fn(
                         params, svars, state, accum, it_dev, constants, win,
+                        base_key)
+                elif use_sentinel:
+                    params, svars, state, it_dev, losses, bad = window_fn(
+                        params, svars, state, it_dev, constants, win,
                         base_key)
                 else:
                     params, svars, state, it_dev, losses = window_fn(
@@ -360,6 +396,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                         base_key)
                 dispatches += 1
                 sizes[k] = sizes.get(k, 0) + 1
+                if bad is not None:
+                    (pending_bads if listeners else epoch_bads).append(bad)
                 if listeners:
                     pending.append((iteration, k, losses))
                     iteration += k
@@ -378,6 +416,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         finally:
             if stager is not None:
                 stager.close()
+        # listener-free sentinel path: one stacked verdict fetch per epoch
+        _check_bads(epoch_bads)
         if listeners:
             _flush()
             if flush_every:
@@ -403,7 +443,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             "tier": "windowed", "fused_steps": K, "accum_steps": A,
             "steps_per_epoch": iteration - epoch_start_iter,
             "dispatches_per_epoch": dispatches,
-            "window_sizes": sizes, "window_compiles": compiles}
+            "window_sizes": sizes, "window_compiles": compiles,
+            "sentinel": use_sentinel}
         if listeners:
             # sync current training state into the graph (copies — the
             # next window donates the working buffers)

@@ -113,6 +113,20 @@ class CheckpointListener(Listener):
         return CheckpointListener.Builder(directory)
 
     # -- cadence --------------------------------------------------------
+    @staticmethod
+    def _global_epoch(sd, fallback: int) -> int:
+        """Epochs COMPLETED globally (tc.epoch_count), not the fit's
+        local loop index. restore_training_state writes state.epoch back
+        into tc.epoch_count, so a snapshot must record the global
+        counter — a fit-local index from a resumed/retried fit would
+        roll the epoch budget backwards on restore (the
+        faults.FaultTolerantFit remaining-epochs accounting relies on
+        this)."""
+        tc = getattr(sd, "training_config", None)
+        if tc is None:
+            return int(fallback)
+        return int(getattr(tc, "epoch_count", fallback))
+
     def _save(self, sd, step: int, blocking: bool = False) -> None:
         state = capture_training_state(sd, epoch=self._epoch,
                                        normalizer=self.normalizer)
@@ -126,11 +140,11 @@ class CheckpointListener(Listener):
             self._last_time_save = time.perf_counter()
 
     def on_epoch_start(self, sd, epoch: int):
-        self._epoch = epoch
+        self._epoch = self._global_epoch(sd, epoch)
 
     def iterations_done(self, sd, epoch: int, iterations: Sequence[int],
                         losses: Sequence[float]):
-        self._epoch = epoch
+        self._epoch = self._global_epoch(sd, epoch)
         it = iterations[-1]
         fire = False
         # scalars arrive in bursts; the snapshot granularity is the
@@ -152,9 +166,14 @@ class CheckpointListener(Listener):
             self._save(sd, step)
 
     def on_epoch_end(self, sd, epoch: int, mean_loss: float):
-        self._epoch = epoch
+        # tc.epoch_count is incremented before on_epoch_end fires, so
+        # this is the completed count INCLUDING this epoch — restoring
+        # an epoch-end snapshot resumes at the next epoch. The cadence
+        # runs on the global count too, so it stays stable across
+        # resumed/retried fits (for a fresh model it equals epoch + 1).
+        self._epoch = self._global_epoch(sd, epoch + 1)
         if self.every_n_epochs is not None and \
-                (epoch + 1) % self.every_n_epochs == 0:
+                self._epoch % self.every_n_epochs == 0:
             tc = sd.training_config
             step = int(getattr(tc, "iteration_count", 0)) if tc else epoch
             if step != self._last_step:       # iteration cadence may have

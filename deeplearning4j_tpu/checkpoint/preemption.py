@@ -53,6 +53,11 @@ class PreemptionHook:
     the snapshot metadata. ``reraise=False`` suppresses ``Preempted``
     (the handler only checkpoints and sets ``.preempted``; the caller
     polls and exits on its own schedule).
+
+    Stacks with outer supervisors: a non-default handler that was
+    installed for the same signal BEFORE this hook is invoked after the
+    final checkpoint commits, so its cleanup still runs; if it raises
+    (its own exit path), that wins over ``Preempted``.
     """
 
     def __init__(self, manager: CheckpointManager, model,
@@ -124,9 +129,22 @@ class PreemptionHook:
             self.final_step = step
         except Exception:
             if not self.reraise:
+                self._chain_previous(signum, frame)
                 return
+        # an outer supervisor's handler installed BEFORE this hook still
+        # runs (after our commit): stacking hooks must not silently drop
+        # the outer cleanup. Its exception (often its own SystemExit)
+        # wins over our Preempted.
+        self._chain_previous(signum, frame)
         if self.reraise:
             raise Preempted(signum, self.final_step)
+
+    def _chain_previous(self, signum, frame) -> None:
+        """Invoke the handler that was installed for ``signum`` before
+        this hook, when it is a real (non-default) handler."""
+        prev = self._previous.get(signum)
+        if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+            prev(signum, frame)
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -149,7 +149,15 @@ class AsyncDataSetIterator(DataSetIterator):
     ``GeneratorExit`` when a consumer abandons the generator mid-epoch)
     sets the flag, drains the queue, and joins the thread — an abandoned
     iteration can no longer strand a daemon thread blocked on ``q.put``
-    forever."""
+    forever.
+
+    Worker-thread failures travel IN the stream: the worker enqueues a
+    poisoned sentinel carrying the exception and the index of the batch
+    that failed to materialize, and the consumer re-raises it — in
+    stream order, after the batches that preceded it — as a structured
+    ``faults.DataPipelineError`` (the original exception chained as
+    ``__cause__``). An epoch can no longer end silently short, and the
+    recovery rail learns WHICH batch died."""
 
     _END = object()
 
@@ -165,7 +173,11 @@ class AsyncDataSetIterator(DataSetIterator):
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
         stop = threading.Event()
-        err: List[BaseException] = []
+
+        class _Poison:
+            def __init__(self, error: BaseException, batch_index: int):
+                self.error = error
+                self.batch_index = batch_index
 
         def put(item) -> bool:
             while not stop.is_set():
@@ -177,22 +189,29 @@ class AsyncDataSetIterator(DataSetIterator):
             return False
 
         def worker():
+            index = 0                   # batch currently being produced
             try:
                 for item in self._wrapped:
                     if not put(item):
                         return          # consumer gone
-            except BaseException as e:   # propagate to consumer
-                err.append(e)
+                    index += 1
+            except BaseException as e:   # poisoned sentinel, in-stream
+                put(_Poison(e, index))
+                return
             finally:
                 put(self._END)
 
         t = threading.Thread(target=worker, daemon=True)
         self._last_thread = t
         t.start()
+        poison: List[_Poison] = []
         try:
             while True:
                 item = q.get()
                 if item is self._END:
+                    break
+                if isinstance(item, _Poison):
+                    poison.append(item)
                     break
                 yield item
         finally:
@@ -203,8 +222,14 @@ class AsyncDataSetIterator(DataSetIterator):
                 except queue.Empty:
                     break
             t.join(timeout=5)
-        if err:
-            raise err[0]
+        if poison:
+            from deeplearning4j_tpu.faults.errors import DataPipelineError
+            p = poison[0]
+            raise DataPipelineError(
+                f"async prefetch worker failed producing batch "
+                f"{p.batch_index}: {p.error!r}",
+                batch_index=p.batch_index,
+                cause="async_worker") from p.error
 
 
 class BenchmarkDataSetIterator(DataSetIterator):

@@ -1,0 +1,31 @@
+"""faults/ — the robustness layer: detect → decide → recover.
+
+- ``errors``    : structured fault taxonomy (step/epoch/batch provenance)
+- ``sentinels`` : device-side divergence sentinel semantics + host-side
+  loss-spike / plateau watchers
+- ``recovery``  : FaultTolerantFit — rollback-and-retry training over
+  the checkpoint/ manager, bounded backoff, clean abort
+- ``iterators`` : RetryingIterator — loader retry + corrupt-batch
+  quarantine for the data pipeline
+- ``chaos``     : deterministic seed-driven fault injection (NaN grads,
+  loader exceptions, torn checkpoint commits, SIGTERM mid-window)
+
+See docs/fault_tolerance.md.
+"""
+from deeplearning4j_tpu.faults.chaos import ChaosMonkey
+from deeplearning4j_tpu.faults.errors import (DataPipelineError,
+                                              FaultBudgetExhaustedError,
+                                              FaultError,
+                                              TrainingDivergedError,
+                                              TransientDeviceError,
+                                              retryable_errors)
+from deeplearning4j_tpu.faults.iterators import RetryingIterator
+from deeplearning4j_tpu.faults.recovery import FaultTolerantFit, RetryPolicy
+from deeplearning4j_tpu.faults.sentinels import (LossSpikeWatcher,
+                                                 PlateauWatcher)
+
+__all__ = ["ChaosMonkey", "DataPipelineError", "FaultBudgetExhaustedError",
+           "FaultError", "FaultTolerantFit", "LossSpikeWatcher",
+           "PlateauWatcher", "RetryPolicy", "RetryingIterator",
+           "TrainingDivergedError", "TransientDeviceError",
+           "retryable_errors"]

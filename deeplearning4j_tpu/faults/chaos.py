@@ -1,0 +1,294 @@
+"""Deterministic, seed-driven fault injection for the training stack.
+
+Every injector is reproducible: given the same seed and the same
+training run, the same fault fires at the same place — which is what
+makes the chaos suite a *regression* suite rather than a flake
+generator. Faults on offer (the ones the recovery rail must survive):
+
+- ``nan_gradients(sd, at_step)`` — device-side: the compiled train step
+  replaces every gradient leaf with NaN at absolute iteration
+  ``at_step`` (traced into the XLA program, so it works inside fused
+  windows and scans). Arms via ``TrainingConfig`` and retraces; exiting
+  the context disarms and retraces back to the clean program.
+- ``poison_batches(it, at_step)`` — host-side one-shot: the batch
+  feeding absolute step ``at_step`` has its features replaced with NaN.
+  One-shot means a rolled-back retry passes cleanly — the
+  self-healing end-to-end test's fault of choice.
+- ``flaky_iterator(it, fail_at_batch)`` — the loader raises a transient
+  ``IOError`` at a chosen batch index, a limited number of times.
+- ``failing_os_replace(times)`` / ``failing_fsync(times)`` — the next
+  ``times`` checkpoint commit renames / durability fsyncs raise
+  ``OSError``, leaving exactly the torn ``step_N.tmp`` state a killed
+  writer leaves.
+- ``sigterm_listener(at_iteration)`` — delivers SIGTERM to this process
+  at a training iteration, mid-window (drives PreemptionHook drills).
+
+Reference parity: optimize/listeners/FailureTestingListener.java
+injected OOM/exit/exception at listener trigger points; this harness
+additionally reaches INSIDE the compiled step (NaN grads), the data
+pipeline, and the checkpoint commit protocol.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal as _signal
+import time
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.training import Listener
+from deeplearning4j_tpu.dataset.iterators import DataSetIterator
+from deeplearning4j_tpu.faults.errors import TransientDeviceError
+
+
+class ChaosSpec:
+    """Device-side injection knobs read by the train-step tracer
+    (``SameDiff._build_step_parts``). Attached as
+    ``TrainingConfig._chaos_spec``; a None spec (the default) leaves the
+    compiled program untouched."""
+
+    def __init__(self, nan_grads_at: Optional[int] = None):
+        self.nan_grads_at = nan_grads_at
+
+
+class FlakyIterator(DataSetIterator):
+    """Raises a transient loader error at batch ``fail_at_batch``
+    (index within the pass), ``times`` times total across passes."""
+
+    def __init__(self, wrapped: DataSetIterator, fail_at_batch: int,
+                 times: int = 1, exc_factory=None, log: Optional[List] = None):
+        self._wrapped = wrapped
+        self.fail_at_batch = int(fail_at_batch)
+        self.times_left = int(times)
+        self._exc_factory = exc_factory or (
+            lambda i: IOError(f"chaos: injected loader failure at "
+                              f"batch {i}"))
+        self._log = log if log is not None else []
+
+    def reset(self):
+        if hasattr(self._wrapped, "reset"):
+            self._wrapped.reset()
+
+    def __iter__(self):
+        for i, batch in enumerate(self._wrapped):
+            if i == self.fail_at_batch and self.times_left > 0:
+                self.times_left -= 1
+                self._log.append({"event": "loader_exception",
+                                  "batch_index": i, "t": time.time()})
+                raise self._exc_factory(i)
+            yield batch
+
+
+class BatchPoisoner(DataSetIterator):
+    """Replaces the batch at yield-count ``at_step`` with NaN features,
+    ``times`` times total (default one-shot). The counter is batches
+    yielded BY THIS WRAPPER across passes/epochs — equal to the absolute
+    training iteration only while nothing upstream replays batches. An
+    outer RetryingIterator's reset-and-fast-forward (or quarantine
+    skips) re-consume earlier batches and shift the firing point
+    relative to training iterations, so tests needing an EXACT step
+    should assert on the sentinel's reported provenance (or use
+    ``ChaosMonkey.nan_gradients``, which is iteration-exact by
+    construction); ``at_step`` here chooses roughly-where, one-shot —
+    which is all the self-heal drills need."""
+
+    def __init__(self, wrapped: DataSetIterator, at_step: int,
+                 times: int = 1, log: Optional[List] = None):
+        self._wrapped = wrapped
+        self.at_step = int(at_step)
+        self.times_left = int(times)
+        self._step = 0                  # absolute batches yielded ever
+        self._log = log if log is not None else []
+
+    def reset(self):
+        if hasattr(self._wrapped, "reset"):
+            self._wrapped.reset()
+
+    @staticmethod
+    def _poison(part):
+        if isinstance(part, (tuple, list)):
+            return type(part)(BatchPoisoner._poison(p) for p in part)
+        a = np.array(part, copy=True)
+        if np.issubdtype(a.dtype, np.floating):
+            a[...] = np.nan
+        return a
+
+    def __iter__(self):
+        for batch in self._wrapped:
+            if self._step == self.at_step and self.times_left > 0:
+                self.times_left -= 1
+                self._log.append({"event": "batch_poisoned",
+                                  "step": self._step, "t": time.time()})
+                if isinstance(batch, dict):
+                    batch = {k: self._poison(v) for k, v in batch.items()}
+                elif hasattr(batch, "features") and hasattr(batch, "labels"):
+                    batch = (self._poison(batch.features), batch.labels)
+                else:
+                    f, l = batch
+                    batch = (self._poison(f), l)
+            self._step += 1
+            yield batch
+
+
+class SigtermListener(Listener):
+    """Delivers SIGTERM to this process at a chosen training iteration
+    (one-shot) — mid-window under the fused tier, since flushes happen
+    at window boundaries. Pair with checkpoint.PreemptionHook."""
+
+    frequency = 1
+
+    def __init__(self, at_iteration: int, log: Optional[List] = None):
+        self.at_iteration = int(at_iteration)
+        self.fired = False
+        self._log = log if log is not None else []
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if not self.fired and iteration >= self.at_iteration:
+            self.fired = True
+            self._log.append({"event": "sigterm", "iteration": iteration,
+                              "t": time.time()})
+            os.kill(os.getpid(), _signal.SIGTERM)
+
+
+class ChaosMonkey:
+    """Deterministic fault-injection front end. All randomness flows
+    from the constructor seed; every injection is appended to ``log``.
+
+    ::
+
+        chaos = ChaosMonkey(seed=7)
+        it = chaos.poison_batches(it, at_step=12)       # NaN at step 12
+        it = chaos.flaky_iterator(it, fail_at_batch=3)  # loader IOError
+        with chaos.failing_os_replace(times=1):
+            mgr.save(step, state, blocking=True)        # torn commit
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+        self.log: List[dict] = []
+
+    def draw_step(self, lo: int, hi: int) -> int:
+        """A seed-deterministic step/batch index in [lo, hi)."""
+        return int(self.rng.integers(lo, hi))
+
+    # -- data-pipeline faults -------------------------------------------
+    def flaky_iterator(self, wrapped, fail_at_batch: Optional[int] = None,
+                       n_batches: Optional[int] = None,
+                       times: int = 1) -> FlakyIterator:
+        if fail_at_batch is None:
+            if n_batches is None:
+                raise ValueError("pass fail_at_batch= or n_batches= to "
+                                 "draw one from the seed")
+            fail_at_batch = self.draw_step(0, n_batches)
+        return FlakyIterator(wrapped, fail_at_batch, times=times,
+                             log=self.log)
+
+    def poison_batches(self, wrapped, at_step: Optional[int] = None,
+                       n_steps: Optional[int] = None,
+                       times: int = 1) -> BatchPoisoner:
+        if at_step is None:
+            if n_steps is None:
+                raise ValueError("pass at_step= or n_steps= to draw one "
+                                 "from the seed")
+            at_step = self.draw_step(0, n_steps)
+        return BatchPoisoner(wrapped, at_step, times=times, log=self.log)
+
+    # -- device faults --------------------------------------------------
+    @contextlib.contextmanager
+    def nan_gradients(self, sd, at_step: int) -> Iterator[None]:
+        """Arm device-side NaN-gradient injection at absolute iteration
+        ``at_step`` for the duration of the context. Retraces the train
+        step on entry and exit (the injection is part of the compiled
+        program)."""
+        tc = sd.training_config
+        if tc is None:
+            raise ValueError("set sd.training_config first")
+        prev = getattr(tc, "_chaos_spec", None)
+        tc._chaos_spec = ChaosSpec(nan_grads_at=int(at_step))
+        sd._mutated()
+        self.log.append({"event": "nan_gradients_armed",
+                         "step": int(at_step), "t": time.time()})
+        try:
+            yield
+        finally:
+            tc._chaos_spec = prev
+            sd._mutated()
+
+    @contextlib.contextmanager
+    def transient_device_error(self, sd, at_call: int = 0) -> Iterator[None]:
+        """Make the model's next fit attempt fail host-side with a
+        :class:`TransientDeviceError` (simulates a lost device /
+        preempted slice surfacing as a runtime error)."""
+        raise_at = {"n": int(at_call)}
+        orig = sd.fit
+
+        def flaky_fit(*a, **kw):
+            if raise_at["n"] == 0:
+                raise_at["n"] = -1
+                self.log.append({"event": "transient_device_error",
+                                 "t": time.time()})
+                raise TransientDeviceError(
+                    "chaos: injected transient device loss",
+                    cause="device")
+            if raise_at["n"] > 0:
+                raise_at["n"] -= 1
+            return orig(*a, **kw)
+
+        sd.fit = flaky_fit
+        try:
+            yield
+        finally:
+            sd.fit = orig
+
+    # -- checkpoint/storage faults --------------------------------------
+    @contextlib.contextmanager
+    def failing_os_replace(self, times: int = 1,
+                           match: str = "step_") -> Iterator[None]:
+        """The next ``times`` ``os.replace`` calls whose source path
+        contains ``match`` raise OSError — exactly the crash point the
+        commit protocol's atomic publish must tolerate (everything is
+        staged; the rename never lands)."""
+        state = {"left": int(times)}
+        orig = os.replace
+
+        def chaotic_replace(src, dst, *a, **kw):
+            if state["left"] > 0 and match in os.path.basename(str(src)):
+                state["left"] -= 1
+                self.log.append({"event": "os_replace_failed",
+                                 "path": str(dst), "t": time.time()})
+                raise OSError(f"chaos: injected os.replace failure "
+                              f"publishing {dst}")
+            return orig(src, dst, *a, **kw)
+
+        os.replace = chaotic_replace
+        try:
+            yield
+        finally:
+            os.replace = orig
+
+    @contextlib.contextmanager
+    def failing_fsync(self, times: int = 1) -> Iterator[None]:
+        """The next ``times`` ``os.fsync`` calls raise OSError (a dying
+        disk / full quota during checkpoint staging)."""
+        state = {"left": int(times)}
+        orig = os.fsync
+
+        def chaotic_fsync(fd):
+            if state["left"] > 0:
+                state["left"] -= 1
+                self.log.append({"event": "fsync_failed", "t": time.time()})
+                raise OSError("chaos: injected fsync failure")
+            return orig(fd)
+
+        os.fsync = chaotic_fsync
+        try:
+            yield
+        finally:
+            os.fsync = orig
+
+    # -- process faults -------------------------------------------------
+    def sigterm_listener(self, at_iteration: int) -> SigtermListener:
+        return SigtermListener(at_iteration, log=self.log)

@@ -1,0 +1,98 @@
+"""Structured fault taxonomy for the training stack.
+
+Every error the detect → decide → recover loop routes on carries machine-
+readable provenance (absolute step, epoch, batch index, cause tag) so the
+recovery driver — and a postmortem reading ``{"type": "faults"}`` stats
+records — can answer *where* and *why* without parsing message strings.
+
+Reference parity: the reference signals failure with bare
+``ND4JIllegalStateException`` / ``RuntimeException`` from deep inside the
+executor (DefaultOpExecutioner NAN_PANIC, FailureTestingListener); the
+caller learns "something broke" but not at which iteration of which
+epoch. Here the fault rail is typed end-to-end.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class FaultError(RuntimeError):
+    """Base for all structured training-stack faults.
+
+    ``provenance()`` returns the machine-readable view used for
+    ``'faults'`` stats records and recovery decisions.
+    """
+
+    cause_tag: str = "fault"
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 epoch: Optional[int] = None,
+                 batch_index: Optional[int] = None,
+                 cause: Optional[str] = None,
+                 value: Optional[float] = None):
+        super().__init__(message)
+        self.step = step
+        self.epoch = epoch
+        self.batch_index = batch_index
+        self.cause = cause or self.cause_tag
+        self.value = value
+
+    def provenance(self) -> Dict[str, Any]:
+        return {"error": type(self).__name__, "cause": self.cause,
+                "step": self.step, "epoch": self.epoch,
+                "batch_index": self.batch_index, "value": self.value}
+
+
+class TrainingDivergedError(FaultError, ArithmeticError):
+    """Training left the healthy regime: non-finite loss or gradient
+    (device sentinel, ``TrainingConfig.sentinel``), a host-side loss
+    spike, or a plateau watcher firing. Also an ``ArithmeticError`` so
+    callers already catching ``NumericsException``-style numerics
+    failures see it."""
+
+    cause_tag = "divergence"
+
+
+class DataPipelineError(FaultError):
+    """A data loader/iterator failed: a worker-thread exception
+    (``AsyncDataSetIterator``'s poisoned sentinel), a retry budget
+    exhausted (``faults.RetryingIterator``), or a corrupt batch that
+    could not be quarantined. ``batch_index`` is the index of the batch
+    (within the current pass) that failed to materialize."""
+
+    cause_tag = "data_pipeline"
+
+
+class TransientDeviceError(FaultError):
+    """A device/runtime error believed transient (injected by the chaos
+    harness; real runs map backend runtime errors onto the same retry
+    path via ``retryable_errors()``)."""
+
+    cause_tag = "device"
+
+
+class FaultBudgetExhaustedError(FaultError):
+    """The recovery driver's retry budget ran out. The model has been
+    rolled back to the last committed checkpoint and a final checkpoint
+    is committed — the run aborted *cleanly*; ``__cause__`` is the last
+    underlying fault."""
+
+    cause_tag = "budget_exhausted"
+
+
+def retryable_errors() -> tuple:
+    """Exception classes the recovery driver treats as recoverable:
+    the structured fault taxonomy, numerics panics from the fit tiers,
+    checkpoint-write failures, and the backend's runtime errors
+    (preemption / transient device loss surface there)."""
+    types = [TrainingDivergedError, DataPipelineError, TransientDeviceError]
+    from deeplearning4j_tpu.autodiff.samediff import NumericsException
+    types.append(NumericsException)
+    from deeplearning4j_tpu.checkpoint.manager import CheckpointError
+    types.append(CheckpointError)
+    try:
+        from jax.errors import JaxRuntimeError
+        types.append(JaxRuntimeError)
+    except ImportError:      # pragma: no cover - older jax
+        pass
+    return tuple(types)

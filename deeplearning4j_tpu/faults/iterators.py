@@ -1,0 +1,208 @@
+"""RetryingIterator — the data pipeline's recovery rail.
+
+Production loaders fail in three ways and each gets its own treatment:
+
+- **transient loader exceptions** (flaky NFS, a hiccuping decoder): the
+  wrapped iterator is reset and fast-forwarded past the batches already
+  delivered, then iteration continues — the consumer sees an unbroken
+  batch stream. Bounded by a per-pass retry budget and an exponential
+  backoff between attempts.
+- **corrupt batches** (NaN/Inf features from a torn shard): quarantined
+  — the batch index is recorded, the batch is skipped on this and every
+  later pass, and iteration continues. A poisoned batch must not reach
+  the compiled train step where it becomes a divergence.
+- **persistent failure**: when the consecutive-failure budget is spent,
+  a structured :class:`DataPipelineError` carrying the failing batch
+  index escapes to the caller (where ``FaultTolerantFit`` decides).
+
+Fast-forward replays the wrapped iterator from ``reset()``, so exact
+recovery (no sample trained twice or dropped, index-keyed quarantine
+naming the right batch) requires a source that is restartable and
+deterministic per pass. Shuffling/sampling sources
+(``ArrayDataSetIterator(shuffle=True)``, ``SamplingDataSetIterator``)
+produce a FRESH order each pass: a retry then resumes at position
+``index`` of a different permutation — some samples of the recovered
+pass repeat and others drop. That is usually acceptable for SGD (the
+pass is stochastic anyway) but not for exact-order pipelines; wrap a
+deterministic view, or disable with
+``RetryPolicy(data_max_retries=0)``. Reference parity: the
+reference's executor retry loops (EarlyStoppingTrainer's fit loop
+catches per-minibatch exceptions); here the budget, backoff and
+quarantine are explicit and observable via ``events``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.dataset.iterators import DataSetIterator
+from deeplearning4j_tpu.faults.errors import DataPipelineError
+
+
+def _batch_arrays(batch) -> list:
+    if isinstance(batch, dict):
+        return list(batch.values())
+    if hasattr(batch, "features") and hasattr(batch, "labels"):
+        batch = (batch.features, batch.labels)
+    if isinstance(batch, (tuple, list)):
+        out = []
+        for part in batch:
+            out.extend(part if isinstance(part, (tuple, list)) else [part])
+        return out
+    return [batch]
+
+
+def batch_is_corrupt(batch) -> bool:
+    """True when any HOST-RESIDENT floating-point array in the batch
+    holds NaN/Inf. Device-resident arrays (DeviceCachedIterator slices,
+    pre-sharded batches) are deliberately NOT pulled back to host — a
+    D2H copy per step would defeat the transfer/compute overlap the
+    fused-window pipeline exists for, and the armed device sentinel
+    already catches NaN that reaches the compiled step. The scan is one
+    memory-bound pass over loader output — the cost of validating
+    untrusted bytes where they enter."""
+    for a in _batch_arrays(batch):
+        if not isinstance(a, np.ndarray):
+            continue
+        if np.issubdtype(a.dtype, np.floating) and \
+                not np.isfinite(a).all():
+            return True
+    return False
+
+
+class RetryingIterator(DataSetIterator):
+    """Wrap a DataSetIterator with retry + quarantine semantics.
+
+    ``max_retries``: total transient-failure retries per pass;
+    ``max_consecutive_failures``: failures at the SAME batch index
+    before giving up on it (a batch that fails every attempt is not
+    transient); ``quarantine_corrupt``: skip (and remember) NaN/Inf
+    batches instead of yielding them; ``transient``: exception classes
+    eligible for retry (anything else propagates immediately);
+    ``on_event``: callback receiving one dict per retry/quarantine
+    (also appended to ``self.events``).
+    """
+
+    def __init__(self, wrapped: DataSetIterator, max_retries: int = 3,
+                 max_consecutive_failures: int = 2,
+                 quarantine_corrupt: bool = True,
+                 backoff_base: float = 0.0, backoff_max: float = 5.0,
+                 transient: Tuple[type, ...] = (Exception,),
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._wrapped = wrapped
+        self.max_retries = int(max_retries)
+        self.max_consecutive_failures = int(max_consecutive_failures)
+        self.quarantine_corrupt = bool(quarantine_corrupt)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self._transient = tuple(transient)
+        self._on_event = on_event
+        self._sleep = sleep
+        self.quarantined: set = set()      # batch indices skipped forever
+        self.events: List[dict] = []
+
+    def reset(self):
+        if hasattr(self._wrapped, "reset"):
+            self._wrapped.reset()
+
+    def batch_size(self):
+        if hasattr(self._wrapped, "batch_size"):
+            return self._wrapped.batch_size()
+        return None
+
+    # -- event plumbing -------------------------------------------------
+    def _event(self, kind: str, index: int, error=None) -> None:
+        ev = {"type": "faults", "event": kind, "batch_index": int(index),
+              "t": time.time()}
+        if error is not None:
+            ev["error"] = repr(error)
+        self.events.append(ev)
+        if self._on_event is not None:
+            self._on_event(ev)
+
+    # -- iteration ------------------------------------------------------
+    def _restarted(self, skip: int):
+        """Reset the wrapped source and fast-forward past ``skip``
+        already-delivered batches; returns a fresh iterator positioned
+        at batch index ``skip``. A source that shrank below ``skip``
+        between attempts is a pipeline fault, not a clean end-of-pass —
+        silent truncation is exactly what this rail exists to prevent."""
+        self.reset()
+        it = iter(self._wrapped)
+        for i in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                raise DataPipelineError(
+                    f"data source shrank during retry: expected at least "
+                    f"{skip} batches, ended at {i}", batch_index=i,
+                    cause="source_shrank") from None
+        return it
+
+    def __iter__(self):
+        self.reset()
+        it = iter(self._wrapped)
+        index = 0                       # index of the batch being fetched
+        retries_left = self.max_retries
+        consecutive = 0
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            except self._transient as e:
+                consecutive += 1
+                retries_left -= 1
+                if retries_left < 0 or \
+                        consecutive > self.max_consecutive_failures:
+                    self._event("loader_failed", index, e)
+                    raise DataPipelineError(
+                        f"data loader failed at batch {index} after "
+                        f"{self.max_retries - max(retries_left, 0) } "
+                        f"retries ({consecutive} consecutive): {e!r}",
+                        batch_index=index, cause="loader_exhausted") from e
+                self._event("loader_retry", index, e)
+                if self.backoff_base > 0:
+                    self._sleep(min(self.backoff_max, self.backoff_base *
+                                    (2 ** (consecutive - 1))))
+                # keep attempting the restart until it succeeds or the
+                # budget is spent — NEVER fall back to the old iterator:
+                # a generator that raised is closed, and next() on it
+                # returns StopIteration, which would silently END the
+                # pass short (the truncation this rail exists to stop)
+                while True:
+                    try:
+                        it = self._restarted(index)
+                        break
+                    except DataPipelineError:
+                        raise      # source shrank: not a retryable fault
+                    except self._transient as e2:
+                        consecutive += 1
+                        retries_left -= 1
+                        self._event("loader_retry", index, e2)
+                        if retries_left < 0 or \
+                                consecutive > self.max_consecutive_failures:
+                            raise DataPipelineError(
+                                f"data loader restart failed at batch "
+                                f"{index}: {e2!r}", batch_index=index,
+                                cause="loader_exhausted") from e2
+                        if self.backoff_base > 0:
+                            self._sleep(min(
+                                self.backoff_max, self.backoff_base *
+                                (2 ** (consecutive - 1))))
+                continue
+            consecutive = 0
+            if index in self.quarantined:
+                self._event("quarantine_skip", index)
+                index += 1
+                continue
+            if self.quarantine_corrupt and batch_is_corrupt(batch):
+                self.quarantined.add(index)
+                self._event("quarantine", index)
+                index += 1
+                continue
+            index += 1
+            yield batch

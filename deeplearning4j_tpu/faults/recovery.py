@@ -1,0 +1,286 @@
+"""FaultTolerantFit — the decide/recover half of the fault rail.
+
+Closes the loop the sentinels open: on a structured fault (divergence,
+data-pipeline failure, transient device/runtime error, checkpoint-write
+error) during ``fit``, the driver
+
+1. waits out / clears the checkpoint writer, garbage-collects torn
+   staging dirs, and **rolls the model back** to the newest committed
+   ``CheckpointManager`` snapshot (params, updater state, iteration,
+   epoch, RNG base seed — bit-exact resume, checkpoint/state.py);
+2. optionally **rescales the learning rate** (``RetryPolicy.lr_rescale``)
+   so a genuinely-too-hot run heals instead of re-diverging;
+3. sleeps a **bounded exponential backoff** and retries the remaining
+   epochs — the retry budget counts consecutive rollbacks *without
+   checkpoint progress* (a run that diverges, heals, trains further and
+   diverges again later is progressing, not crash-looping);
+4. when the budget is spent, restores the last good state, re-commits it
+   as a pinned final checkpoint, and raises
+   :class:`FaultBudgetExhaustedError` — a clean abort whose ``__cause__``
+   is the last underlying fault.
+
+The data pipeline gets the same treatment one layer down: the input
+iterator is wrapped in :class:`~deeplearning4j_tpu.faults.iterators.
+RetryingIterator` (transient loader retries, corrupt-batch quarantine)
+unless the caller already did.
+
+Works with every fit front end — ``SameDiff``, ``MultiLayerNetwork``,
+``ComputationGraph`` and ``parallel.ParallelTrainer`` (restores re-shard
+onto the mesh via the trainer's own ``restore_latest``).
+
+Every recovery decision is published as a ``{"type": "faults"}`` record
+to the optional ``stats_storage`` (ui/stats.py) and kept in ``events``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.checkpoint.listener import CheckpointListener
+from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
+                                                   CheckpointManager)
+from deeplearning4j_tpu.faults.errors import (FaultBudgetExhaustedError,
+                                              FaultError,
+                                              retryable_errors)
+from deeplearning4j_tpu.faults.iterators import RetryingIterator
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounds and knobs for the rollback-and-retry loop.
+
+    ``max_retries``: consecutive rollbacks without checkpoint progress
+    before aborting; ``backoff_base``/``backoff_max``: bounded
+    exponential backoff seconds between attempts; ``lr_rescale``:
+    multiply the updater's learning rate by this on every rollback
+    (1.0 = off; rescaling retraces the train step);
+    ``data_max_retries``: transient-loader retry budget per pass
+    (0 = don't wrap the iterator); ``quarantine_corrupt``: skip NaN/Inf
+    batches instead of training on them.
+    """
+    max_retries: int = 3
+    backoff_base: float = 0.5
+    backoff_max: float = 30.0
+    lr_rescale: float = 1.0
+    data_max_retries: int = 3
+    quarantine_corrupt: bool = True
+
+
+class FaultTolerantFit:
+    """Supervised training: ``fit()`` that survives divergence, flaky
+    loaders, torn checkpoints and transient device errors.
+
+    ::
+
+        mgr = CheckpointManager(ckpt_dir, keep_last_n=3)
+        ftf = FaultTolerantFit(net, mgr, policy=RetryPolicy(max_retries=2),
+                               checkpoint_every_n_iterations=50,
+                               stats_storage=storage)
+        history = ftf.fit(train_iter, epochs=20)
+
+    ``sentinel=True`` (default) arms the device-side divergence sentinel
+    on the model's TrainingConfig — the rail that turns a NaN gradient
+    inside a fused window into a structured, recoverable error instead
+    of silently-poisoned parameters.
+    """
+
+    def __init__(self, model, manager: CheckpointManager,
+                 policy: Optional[RetryPolicy] = None,
+                 checkpoint_every_n_iterations: Optional[int] = None,
+                 checkpoint_every_n_epochs: Optional[int] = None,
+                 stats_storage=None, sentinel: bool = True,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.model = model
+        self.sd = getattr(model, "samediff", None) or \
+            getattr(model, "sd", None) or model
+        self.manager = manager
+        self.policy = policy or RetryPolicy()
+        self.stats_storage = stats_storage
+        self._sleep = sleep
+        if checkpoint_every_n_iterations is None and \
+                checkpoint_every_n_epochs is None:
+            checkpoint_every_n_epochs = 1
+        self._ckpt_iters = checkpoint_every_n_iterations
+        self._ckpt_epochs = checkpoint_every_n_epochs
+        self.events: List[dict] = []
+        self.recovery_seconds = 0.0
+        self.rollbacks = 0
+        if sentinel and self.sd.training_config is not None:
+            if not getattr(self.sd.training_config, "sentinel", False):
+                self.sd.training_config.sentinel = True
+                self.sd._mutated()
+
+    # ------------------------------------------------------------------
+    def _publish(self, event: str, **fields) -> dict:
+        rec = {"type": "faults", "event": event, "t": time.time(), **fields}
+        self.events.append(rec)
+        if self.stats_storage is not None:
+            self.stats_storage.put(rec)
+        return rec
+
+    def _tc(self):
+        tc = self.sd.training_config
+        if tc is None:
+            raise ValueError("model has no TrainingConfig; set it (or "
+                             "init() the network) before FaultTolerantFit")
+        return tc
+
+    def _restore_latest(self):
+        """Restore the newest committed checkpoint into the model via
+        the most specific hook it offers (ParallelTrainer re-shards)."""
+        if hasattr(self.model, "restore_latest") and \
+                not isinstance(self.model, CheckpointManager):
+            return self.model.restore_latest(self.manager)
+        return self.manager.restore_latest(model=self.model)
+
+    def _rollback(self, cause: BaseException):
+        t0 = time.perf_counter()
+        try:
+            self.manager.wait_until_finished(timeout=60.0)
+        except Exception:
+            pass
+        try:
+            self.manager.check_error()
+        except CheckpointError:
+            pass               # a failed async write IS the fault here
+        removed = self.manager.gc_uncommitted()
+        res = self._restore_latest()
+        if res is None:
+            raise FaultBudgetExhaustedError(
+                "no committed checkpoint to roll back to",
+                cause="no_checkpoint") from cause
+        step, _state = res
+        if self.policy.lr_rescale != 1.0:
+            upd = self._tc().updater
+            lr = getattr(upd, "learning_rate", None)
+            if isinstance(lr, (int, float)):
+                upd.learning_rate = lr * self.policy.lr_rescale
+                self.sd._mutated()     # the LR is baked into the program
+        dt = time.perf_counter() - t0
+        self.recovery_seconds += dt
+        self.rollbacks += 1
+        self._publish(
+            "rollback", restored_step=int(step),
+            gc_removed=len(removed), overhead_s=round(dt, 6),
+            lr_rescale=self.policy.lr_rescale,
+            **(cause.provenance() if isinstance(cause, FaultError)
+               else {"error": type(cause).__name__, "cause": "exception"}))
+        return step
+
+    # ------------------------------------------------------------------
+    def fit(self, dataset_iterator, epochs: int = 1,
+            listeners: Sequence = ()):
+        """Train ``epochs`` epochs (counted from the model's current
+        ``epoch_count``), surviving recoverable faults within the retry
+        budget. Returns the History of the final (successful) attempt."""
+        tc = self._tc()
+        policy = self.policy
+        if policy.data_max_retries > 0 and \
+                not isinstance(dataset_iterator, RetryingIterator) and \
+                not hasattr(dataset_iterator, "stacked_batches"):
+            # device-cached sources (stacked_batches) stay unwrapped:
+            # wrapping would hide the attribute the scanned/windowed
+            # fast paths route on (re-staging every epoch from host),
+            # and buys nothing — an in-memory device source has no
+            # transient loader failures, and the corrupt scan skips
+            # device-resident arrays anyway (the sentinel covers them)
+            dataset_iterator = RetryingIterator(
+                dataset_iterator, max_retries=policy.data_max_retries,
+                quarantine_corrupt=policy.quarantine_corrupt,
+                on_event=(self.stats_storage.put
+                          if self.stats_storage is not None else None))
+        ckpt_iters = self._ckpt_iters
+        accum = max(1, int(getattr(tc, "accum_steps", 1) or 1))
+        if ckpt_iters is not None and accum > 1 and ckpt_iters % accum:
+            # the partial gradient accumulator is NOT part of the
+            # checkpoint schema (autodiff/window.py): a rollback target
+            # must sit on an accumulation-cycle boundary or the resumed
+            # cycle restarts from zeros. Round the cadence up so every
+            # snapshot is a boundary. Residual constraint the rounding
+            # cannot fix (documented, docs/fault_tolerance.md): snapshots
+            # actually land on WINDOW boundaries at-or-after the cadence,
+            # and epoch-cadence snapshots land wherever the epoch ends —
+            # with accum_steps > 1 also keep fused_steps and the
+            # steps-per-epoch multiples of accum_steps, or accept that a
+            # rollback into a mid-cycle snapshot averages only the
+            # post-resume micro-grads of that one cycle.
+            ckpt_iters = ((ckpt_iters + accum - 1) // accum) * accum
+        ckpt = CheckpointListener(
+            self.manager, every_n_iterations=ckpt_iters,
+            every_n_epochs=self._ckpt_epochs)
+        all_listeners = list(listeners) + [ckpt]
+        # a rollback target must exist before the first step can fail
+        if self.manager.latest_step() is None:
+            step0 = int(getattr(tc, "iteration_count", 0))
+            self.manager.save(step0, model=self.sd,
+                              epoch=int(getattr(tc, "epoch_count", 0)),
+                              blocking=True)
+        target = int(getattr(tc, "epoch_count", 0)) + int(epochs)
+        attempts = 0
+        last_restore_step = -1
+        history = None
+        retryable = retryable_errors()
+        while True:
+            remaining = target - int(getattr(tc, "epoch_count", 0))
+            if remaining <= 0:
+                break
+            try:
+                history = self.model.fit(dataset_iterator,
+                                         epochs=remaining,
+                                         listeners=all_listeners)
+                break          # done (or a listener chose to stop early)
+            except retryable as e:
+                self._publish(
+                    "fault",
+                    **(e.provenance() if isinstance(e, FaultError)
+                       else {"error": type(e).__name__,
+                             "cause": "exception"}))
+                step = self._rollback(e)
+                if step > last_restore_step:
+                    attempts = 1          # progress since the last loop
+                else:
+                    attempts += 1
+                last_restore_step = step
+                if attempts > policy.max_retries:
+                    # budget spent: re-commit the known-good state as a
+                    # pinned final checkpoint and abort cleanly
+                    try:
+                        self.manager.save(int(step), model=self.sd,
+                                          epoch=int(getattr(
+                                              tc, "epoch_count", 0)),
+                                          blocking=True, pin=True)
+                    except Exception:
+                        pass   # the restored step is already on disk
+                    self._publish("retry_exhausted", attempts=attempts,
+                                  restored_step=int(step))
+                    raise FaultBudgetExhaustedError(
+                        f"retry budget exhausted after {attempts - 1} "
+                        f"rollbacks to step {step}: {e!r}",
+                        step=int(step), cause="budget_exhausted") from e
+                # stateful listeners (watchers with EMAs/best-scores)
+                # must judge the replayed timeline fresh — statistics
+                # from the discarded attempt would fire spuriously
+                for l in listeners:
+                    reset = getattr(l, "reset", None)
+                    if callable(reset):
+                        reset()
+                backoff = min(policy.backoff_max,
+                              policy.backoff_base * (2 ** (attempts - 1)))
+                self._publish("retry", attempt=attempts,
+                              backoff_s=round(backoff, 6),
+                              resume_step=int(step))
+                if backoff > 0:
+                    self._sleep(backoff)
+        self.manager.wait_until_finished()
+        if self.rollbacks:
+            self._publish("recovered", rollbacks=self.rollbacks,
+                          overhead_s=round(self.recovery_seconds, 6))
+        return history
+
+    # ------------------------------------------------------------------
+    def report(self) -> dict:
+        """Machine-readable recovery summary for the run so far."""
+        return {"rollbacks": self.rollbacks,
+                "recovery_seconds": round(self.recovery_seconds, 6),
+                "events": list(self.events)}

@@ -1,0 +1,172 @@
+"""Divergence sentinels: device-side rail + host-side watchers.
+
+Two detection layers with very different costs:
+
+- **Device sentinel** (``TrainingConfig.sentinel = True``): the compiled
+  train-step body additionally emits one boolean — ``isfinite(loss)``
+  AND-ed with ``all(isfinite(g))`` over EVERY gradient leaf. Full
+  coverage matters because a where-based op (relu, dropout masks) can
+  launder NaN activations into a finite loss while a single weight's
+  gradient silently poisons that parameter; the boolean reduce fuses
+  into the gradient producers and is noise-level next to the step's
+  matmuls. In the
+  fused-window tier the flag folds into the ``lax.scan`` carry as the
+  absolute iteration of the FIRST bad step (``-1`` = clean window), so a
+  K-step window pays ONE extra scalar output and the host only looks at
+  it at the flush boundaries it already syncs on — no per-step host
+  round-trip. The sentinel never touches the parameter math: with no
+  fault present, sentinel-on training is bit-identical to sentinel-off
+  (tested). Detection raises :class:`~deeplearning4j_tpu.faults.errors.
+  TrainingDivergedError` with the absolute step, epoch and in-epoch
+  batch index.
+
+- **Host watchers** (this module): listeners that inspect the loss
+  scalars fit() already fetches — catching *finite-but-wrong* regimes
+  the device flag cannot see (a 100x loss spike, a dead plateau).
+  They cost nothing extra: they ride the existing burst flushes.
+
+Reference parity: NanScoreWatcher (org.deeplearning4j.optimize.listeners)
+checked ``Double.isNaN(score)`` per iteration on the host; here the
+finite check happens inside the XLA program and the host-side family
+grows spike/plateau detection with structured provenance.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.training import Listener
+from deeplearning4j_tpu.faults.errors import TrainingDivergedError
+
+
+class LossSpikeWatcher(Listener):
+    """Raise :class:`TrainingDivergedError` when the loss jumps more
+    than ``spike_factor``x above its exponential moving average (or goes
+    non-finite). ``warmup`` iterations are observed before spikes fire,
+    so the noisy first steps cannot trip it.
+
+    ``frequency`` is the scalar-delivery cadence the watcher asks of
+    the fit loop (the flush interval is the MIN across listeners). The
+    default of 10 rides the standard burst flushes — detection lags a
+    spike by at most one burst, which a rollback driver absorbs for
+    free. Set ``frequency=1`` only when the extra per-step device
+    round-trip on the per-step tier is acceptable.
+    """
+
+    def __init__(self, spike_factor: float = 10.0, warmup: int = 20,
+                 ema_decay: float = 0.9, frequency: int = 10):
+        if spike_factor <= 1.0:
+            raise ValueError("spike_factor must be > 1")
+        self.spike_factor = float(spike_factor)
+        self.warmup = int(warmup)
+        self.ema_decay = float(ema_decay)
+        self.frequency = max(1, int(frequency))
+        self._ema: Optional[float] = None
+        self._seen = 0
+
+    def reset(self) -> None:
+        """Forget the EMA/warmup state. FaultTolerantFit calls this on
+        every rollback: replayed iterations must be judged fresh, not
+        against statistics from the discarded (pre-fault) timeline."""
+        self._ema = None
+        self._seen = 0
+
+    def iterations_done(self, sd, epoch: int, iterations: Sequence[int],
+                        losses: Sequence[float]):
+        for it, loss in zip(iterations, losses):
+            loss = float(loss)
+            if not np.isfinite(loss):
+                raise TrainingDivergedError(
+                    f"non-finite loss {loss} at iteration {it} "
+                    f"(epoch {epoch})", step=int(it), epoch=int(epoch),
+                    cause="non_finite_loss", value=loss)
+            if self._ema is not None and self._seen >= self.warmup and \
+                    loss > self.spike_factor * max(self._ema, 1e-12):
+                raise TrainingDivergedError(
+                    f"loss spike: {loss:.6g} > {self.spike_factor}x EMA "
+                    f"{self._ema:.6g} at iteration {it} (epoch {epoch})",
+                    step=int(it), epoch=int(epoch), cause="loss_spike",
+                    value=loss)
+            self._ema = loss if self._ema is None else \
+                self.ema_decay * self._ema + (1 - self.ema_decay) * loss
+            self._seen += 1
+
+
+class PlateauWatcher(Listener):
+    """Raise :class:`TrainingDivergedError` (cause ``"plateau"``) when
+    the epoch mean loss has not improved by ``min_delta`` for
+    ``patience`` consecutive epochs — a stalled run on a preemptible pod
+    is budget burning that a supervisor should see as a fault, not as
+    progress. Opt-in (only attach it to runs that must keep moving)."""
+
+    #: epoch-only listener: never force mid-epoch burst flushes (the
+    #: fit loop flushes at the MIN frequency across listeners — the
+    #: same huge-frequency idiom as checkpoint/listener.py's
+    #: epoch-cadence branch)
+    frequency = 1_000_000_000
+
+    def __init__(self, patience: int = 5, min_delta: float = 0.0):
+        self.patience = int(patience)
+        self.min_delta = float(min_delta)
+        self.best = float("inf")
+        self._stale = 0
+
+    def reset(self) -> None:
+        """Forget best/staleness. FaultTolerantFit calls this on every
+        rollback: epochs replayed from an earlier snapshot cannot beat
+        the discarded timeline's best, and must not count as a
+        plateau."""
+        self.best = float("inf")
+        self._stale = 0
+
+    def on_epoch_end(self, sd, epoch: int, mean_loss: float):
+        if mean_loss is None:
+            return
+        if mean_loss < self.best - self.min_delta:
+            self.best = float(mean_loss)
+            self._stale = 0
+            return
+        self._stale += 1
+        if self._stale >= self.patience:
+            raise TrainingDivergedError(
+                f"loss plateaued for {self._stale} epochs (best "
+                f"{self.best:.6g}, epoch {epoch} mean {mean_loss:.6g})",
+                epoch=int(epoch), cause="plateau", value=float(mean_loss))
+
+
+def check_ok_flags(oks, iterations, epoch: int,
+                   epoch_start_iter: int) -> None:
+    """Host-side verdict check shared by the fit tiers: ``oks`` is a
+    fetched bool array of per-step sentinel flags aligned with
+    ``iterations``; the first False raises with that step's
+    provenance."""
+    if oks.all():
+        return
+    iterations = list(iterations)
+    raise_diverged(int(iterations[int(np.argmin(oks))]), epoch,
+                   epoch_start_iter)
+
+
+def check_bad_steps(bads, epoch: int, epoch_start_iter: int) -> None:
+    """Windowed-tier variant: ``bads`` is a fetched int array of
+    per-window first-bad-step markers (-1 = clean window); the earliest
+    marked step raises."""
+    hit = bads[bads >= 0]
+    if hit.size:
+        raise_diverged(int(hit.min()), epoch, epoch_start_iter)
+
+
+def raise_diverged(bad_step: int, epoch: int, epoch_start_iter: int,
+                   loss: Optional[float] = None) -> None:
+    """Shared raise site for the device sentinel (called by the fit
+    tiers when a fetched sentinel flag names a bad step)."""
+    raise TrainingDivergedError(
+        f"device sentinel: non-finite loss/gradient at iteration "
+        f"{bad_step} (epoch {epoch}, batch {bad_step - epoch_start_iter} "
+        f"of the epoch); roll back to the last committed checkpoint or "
+        f"localize the producing op with sd.exec_debug()",
+        step=int(bad_step), epoch=int(epoch),
+        batch_index=int(bad_step - epoch_start_iter),
+        cause="device_sentinel",
+        value=None if loss is None else float(loss))

@@ -480,17 +480,21 @@ class ComputationGraph:
 
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
             listeners: Sequence = (), fused_steps: Optional[int] = None,
-            accum_steps: Optional[int] = None):
+            accum_steps: Optional[int] = None,
+            sentinel: Optional[bool] = None):
         """Train. ``data`` = iterator of (features-list, labels-list) /
         MultiDataSet / dict batches; or single-input arrays with labels=.
 
         ``fused_steps``/``accum_steps`` override the TrainingConfig knobs
         for this and subsequent fits — the fused-window execution tier
-        (docs/training_performance.md)."""
+        (docs/training_performance.md). ``sentinel`` arms the device-side
+        divergence sentinel (docs/fault_tolerance.md)."""
         if fused_steps is not None:
             self._sd_train.training_config.fused_steps = int(fused_steps)
         if accum_steps is not None:
             self._sd_train.training_config.accum_steps = int(accum_steps)
+        if sentinel is not None:
+            self._sd_train.training_config.sentinel = bool(sentinel)
         if labels is not None:
             from deeplearning4j_tpu.nn.multilayer import _ArrayIterator
             data = _ArrayIterator(np.asarray(data), np.asarray(labels),

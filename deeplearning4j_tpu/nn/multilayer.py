@@ -205,18 +205,23 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
             listeners: Sequence = (), fused_steps: Optional[int] = None,
-            accum_steps: Optional[int] = None):
+            accum_steps: Optional[int] = None,
+            sentinel: Optional[bool] = None):
         """Train. ``data`` = DataSetIterator-alike (yielding (features,
         labels) / DataSet / dict) or a feature array with ``labels=``.
 
         ``fused_steps``/``accum_steps`` override the TrainingConfig knobs
         for this and subsequent fits: K fused steps per compiled dispatch
-        / gradient accumulation (docs/training_performance.md)."""
+        / gradient accumulation (docs/training_performance.md).
+        ``sentinel`` arms the device-side divergence sentinel
+        (docs/fault_tolerance.md)."""
         self._require_init()
         if fused_steps is not None:
             self._sd_train.training_config.fused_steps = int(fused_steps)
         if accum_steps is not None:
             self._sd_train.training_config.accum_steps = int(accum_steps)
+        if sentinel is not None:
+            self._sd_train.training_config.sentinel = bool(sentinel)
         if labels is not None:
             data = _ArrayIterator(np.asarray(data), np.asarray(labels),
                                   batch_size)
@@ -281,8 +286,14 @@ class MultiLayerNetwork:
                 sd._arrays[n] = arr
 
         from deeplearning4j_tpu.autodiff.training import History
-        step = sd.make_train_step()
-        window_fn = sd.make_train_window()
+        # the divergence sentinel follows the network's main config onto
+        # the dedicated TBPTT graph — an armed rail must not silently go
+        # inert on this fit path (docs/fault_tolerance.md)
+        use_sentinel = bool(getattr(self._sd_train.training_config,
+                                    "sentinel", False))
+        sd.training_config.sentinel = use_sentinel
+        step = sd.make_train_step(sentinel=use_sentinel)
+        window_fn = sd.make_train_window(sentinel=use_sentinel)
         tc = sd.training_config
         params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
         svars = jax.tree_util.tree_map(jnp.copy, sd.state_vars_map())
@@ -322,6 +333,8 @@ class MultiLayerNetwork:
         epoch_means = []   # DEVICE scalars; ONE stacked fetch at fit end
         for epoch in range(epochs):
             losses = []    # device loss buffers, never fetched per chunk
+            bads = []      # sentinel markers, device (one per dispatch)
+            epoch_start_iter = iteration
             for i in range(0, n, batch_size):
                 # new sequences: recurrent carries restart at zero
                 svars = {**svars, **{nm: jnp.asarray(z)
@@ -333,19 +346,41 @@ class MultiLayerNetwork:
                         batch_size, n_full, tbptt_length, *Y.shape[2:])
                     win = {"input": jnp.asarray(xb.swapaxes(0, 1)),
                            "labels": jnp.asarray(yb.swapaxes(0, 1))}
-                    params, svars, state, it_dev, win_losses = window_fn(
-                        params, svars, state, it_dev, constants, win,
-                        base_key)
+                    if use_sentinel:
+                        (params, svars, state, it_dev, win_losses,
+                         bad) = window_fn(params, svars, state, it_dev,
+                                          constants, win, base_key)
+                        bads.append(bad)
+                    else:
+                        params, svars, state, it_dev, win_losses = window_fn(
+                            params, svars, state, it_dev, constants, win,
+                            base_key)
                     iteration += n_full
                     losses.append(win_losses)
                 if rem:
                     ph = {"input": jnp.asarray(X[i:i + batch_size, t_full:]),
                           "labels": jnp.asarray(Y[i:i + batch_size, t_full:])}
-                    params, svars, state, it_dev, loss_val = step(
-                        params, svars, state, it_dev, constants, ph,
-                        base_key)
+                    if use_sentinel:
+                        params, svars, state, it_dev, loss_val, ok = step(
+                            params, svars, state, it_dev, constants, ph,
+                            base_key)
+                        # normalize the per-step flag to the window
+                        # tier's bad-step form (-1 = clean)
+                        bads.append(jnp.where(ok, jnp.int32(-1),
+                                              jnp.int32(iteration)))
+                    else:
+                        params, svars, state, it_dev, loss_val = step(
+                            params, svars, state, it_dev, constants, ph,
+                            base_key)
                     iteration += 1
                     losses.append(loss_val[None])
+            if bads:
+                # one stacked verdict fetch per epoch (the sentinel's
+                # only extra sync on this path)
+                from deeplearning4j_tpu.faults.sentinels import \
+                    check_bad_steps
+                check_bad_steps(np.asarray(jnp.stack(bads)), epoch,
+                                epoch_start_iter)
             epoch_means.append(jnp.mean(jnp.concatenate(losses))
                                if losses else jnp.asarray(float("nan")))
             history.add_epoch(epoch, None)

@@ -131,7 +131,7 @@ class ParallelInference:
         self._spec.sync()           # pull current trained params once
         self._queue = RequestQueue(
             max_queue_len,
-            on_timeout=lambda req: self.metrics.inc("requests_timed_out"))
+            on_timeout=lambda req: self.metrics.record_timeout("deadline"))
         self._batcher = DynamicBatcher(
             self._queue, max_batch_size=self.max_batch_size,
             max_delay_ms=max_delay_ms, buckets=buckets) \
@@ -257,7 +257,7 @@ class ParallelInference:
         try:
             outs = self._execute([batch.features], real_rows=batch.rows)
         except Exception as e:
-            self.metrics.inc("requests_failed", len(batch.requests))
+            self.metrics.record_failure(e, n=len(batch.requests))
             batch.fail(e)
             return True
         batch.resolve(outs)
@@ -277,7 +277,7 @@ class ParallelInference:
         try:
             outs = self._execute(list(req.x))
         except Exception as e:
-            self.metrics.inc("requests_failed")
+            self.metrics.record_failure(e)
             req.fail(e)
             return True
         req.complete(outs)
@@ -331,7 +331,7 @@ class ParallelInference:
         try:
             outs = self._execute(features)
         except Exception as e:
-            self.metrics.inc("requests_failed")
+            self.metrics.record_failure(e)
             fut.set_exception(e)
             return fut
         fut.set_result(collapse_outputs(outs, squeeze))

@@ -87,12 +87,41 @@ class ServingMetrics:
         self.e2e_ms = LatencyHistogram()
         self.exec_ms = LatencyHistogram()
         self.batch_sizes: Dict[int, int] = {}   # real rows -> dispatches
+        # per-cause breakdowns + the most recent failure, so serving
+        # degradation (a creeping OOM, a model bug after update_model)
+        # is attributable BEFORE it becomes an outage
+        self.failure_causes: Dict[str, int] = {}
+        self.timeout_causes: Dict[str, int] = {}
+        self.last_error: Optional[dict] = None
         self._start_t = time.time()
 
     # -- recording ------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    def record_failure(self, error: BaseException,
+                       cause: Optional[str] = None, n: int = 1) -> None:
+        """One failed dispatch affecting ``n`` requests; ``cause``
+        defaults to the exception class name."""
+        cause = cause or type(error).__name__
+        with self._lock:
+            self.counters["requests_failed"] += n
+            self.failure_causes[cause] = \
+                self.failure_causes.get(cause, 0) + n
+            self.last_error = {"kind": "failure", "cause": cause,
+                              "error": repr(error), "t": time.time()}
+
+    def record_timeout(self, cause: str = "deadline",
+                       error: Optional[BaseException] = None,
+                       n: int = 1) -> None:
+        with self._lock:
+            self.counters["requests_timed_out"] += n
+            self.timeout_causes[cause] = \
+                self.timeout_causes.get(cause, 0) + n
+            self.last_error = {"kind": "timeout", "cause": cause,
+                              "error": repr(error) if error else None,
+                              "t": time.time()}
 
     def observe_batch(self, rows: int, padding: int, exec_ms: float) -> None:
         with self._lock:
@@ -129,6 +158,10 @@ class ServingMetrics:
                 "t": time.time(),
                 "uptime_s": round(time.time() - self._start_t, 3),
                 "counters": dict(self.counters),
+                "failure_causes": dict(self.failure_causes),
+                "timeout_causes": dict(self.timeout_causes),
+                "last_error": dict(self.last_error)
+                if self.last_error else None,
                 "latency_ms": {"queue_wait": self.queue_wait_ms.summary(),
                                "e2e": self.e2e_ms.summary(),
                                "exec": self.exec_ms.summary()},
@@ -170,4 +203,13 @@ class ServingMetrics:
             lines.append(f"  {name:<10} p50 {s['p50']:.3f} ms  "
                          f"p95 {s['p95']:.3f} ms  p99 {s['p99']:.3f} ms  "
                          f"max {s['max']:.3f} ms  (n={s['count']})")
+        causes = {**rec["failure_causes"],
+                  **{f"timeout:{k}": v
+                     for k, v in rec["timeout_causes"].items()}}
+        if causes:
+            lines.append("  causes: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(causes.items())))
+        if rec["last_error"]:
+            le = rec["last_error"]
+            lines.append(f"  last_error: [{le['cause']}] {le['error']}")
         return "\n".join(lines)

@@ -16,7 +16,9 @@ Storage format: JSON-lines, one record per event
     {"type": "params", "epoch": e, "params": {name: {mean, std, norm,
         hist, edges, update_norm, update_ratio}}}
     {"type": "memory", "epoch": e, "bytes_in_use": n, "peak_bytes": n}
-    {"type": "serving", "t": wall, "counters": {...}, "latency_ms":
+    {"type": "serving", "t": wall, "counters": {...},
+        "failure_causes": {cause: n}, "timeout_causes": {cause: n},
+        "last_error": {kind, cause, error, t} | null, "latency_ms":
         {"queue_wait"|"e2e"|"exec": {count, mean, p50, p95, p99, max}},
         "batch": {mean_size, padding_waste, size_hist}}
         (written by serving/metrics.ServingMetrics.publish)
@@ -32,6 +34,14 @@ Storage format: JSON-lines, one record per event
         (the fit tier's dispatch/compile accounting, read from
         SameDiff.last_fit_stats at each epoch end — the observable for
         the fused-window executor, docs/training_performance.md)
+    {"type": "faults", "event": "fault"|"rollback"|"retry"|"recovered"|
+        "retry_exhausted"|"loader_retry"|"loader_failed"|"quarantine"|
+        "quarantine_skip", "t": wall, ...event-specific fields: cause,
+        step, epoch, batch_index, restored_step, attempt, backoff_s,
+        overhead_s, rollbacks}
+        (written by faults/recovery.FaultTolerantFit and
+        faults/iterators.RetryingIterator when given a stats storage —
+        the recovery rail's observable, docs/fault_tolerance.md)
 """
 from __future__ import annotations
 

@@ -1,0 +1,84 @@
+"""Self-healing training: divergence sentinels + rollback-and-retry.
+
+A small MLP trains under injected faults — a flaky loader that raises
+mid-epoch and a poisoned (all-NaN) batch that would silently corrupt the
+parameters — and finishes with a finite loss anyway:
+
+- the device-side sentinel (TrainingConfig.sentinel) flags the non-finite
+  step inside the fused window and names it;
+- FaultTolerantFit rolls back to the last committed checkpoint, retries
+  under a bounded backoff budget, and completes the run;
+- the loader exception is retried one layer down by RetryingIterator
+  without costing a rollback.
+
+See docs/fault_tolerance.md.
+"""
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.checkpoint import CheckpointManager
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.faults import (ChaosMonkey, FaultTolerantFit,
+                                       RetryPolicy)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def build_mlp():
+    rng = np.random.default_rng(0)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 16))
+    w0 = sd.var("w0", value=rng.normal(0, .1, (16, 32)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(32, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    w1 = sd.var("w1", value=rng.normal(0, .1, (32, 4)).astype(np.float32))
+    logits = h.mmul(w1)
+    labels = sd.placeholder("labels", shape=(-1, 4))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"],
+        fused_steps=4)               # the production fused-window tier
+    return sd
+
+
+def main():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(256, 16)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 256)]
+
+    sd = build_mlp()
+    chaos = ChaosMonkey(seed=7)
+    it = ArrayDataSetIterator(X, Y, batch_size=16)      # 16 steps/epoch
+    it = chaos.flaky_iterator(it, fail_at_batch=5)      # loader IOError
+    it = chaos.poison_batches(it, at_step=21)           # NaN mid-epoch-1
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        storage = StatsStorage()
+        manager = CheckpointManager(ckpt_dir, keep_last_n=3)
+        ftf = FaultTolerantFit(
+            sd, manager,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0,
+                               quarantine_corrupt=False),
+            checkpoint_every_n_iterations=8,
+            stats_storage=storage)
+        history = ftf.fit(it, epochs=4)
+        manager.close()
+
+        print(f"final loss: {history.final_loss():.4f}")
+        print(f"rollbacks: {ftf.rollbacks}, recovery overhead: "
+              f"{ftf.recovery_seconds:.3f}s")
+        for rec in storage.of_type("faults"):
+            detail = {k: v for k, v in rec.items()
+                      if k not in ("type", "t") and v is not None}
+            print(f"  faults event: {detail}")
+        assert np.isfinite(history.final_loss())
+        assert ftf.rollbacks >= 1
+        print("self-healed: finite loss after injected NaN + loader fault")
+
+
+if __name__ == "__main__":
+    main()

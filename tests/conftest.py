@@ -25,6 +25,8 @@ jax.config.update("jax_enable_x64", True)
 # the config update does.
 jax.config.update("jax_platforms", "cpu")
 
+import signal
+
 import numpy as np
 import pytest
 
@@ -32,3 +34,31 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
+
+
+CHAOS_DEFAULT_TIMEOUT = 120
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Individually timeout-guard @pytest.mark.chaos tests: fault
+    injection that wedges a run (a retry loop that never converges, a
+    signal handler that deadlocks) must fail ONE test, not hang tier-1.
+    SIGALRM-based, so it interrupts even a blocked main thread; chaos
+    tests run on the main thread (pytest default) as required."""
+    marker = item.get_closest_marker("chaos")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        return (yield)
+    timeout = int(marker.kwargs.get("timeout", CHAOS_DEFAULT_TIMEOUT))
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded its {timeout}s timeout guard")
+
+    prev = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(timeout)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)

@@ -1,0 +1,797 @@
+"""faults/ — divergence sentinels, rollback-and-retry, chaos harness.
+
+The chaos-marked tests drive deterministic fault injection end-to-end:
+NaN gradients inside compiled windows, loader exceptions mid-epoch, torn
+checkpoint commits, SIGTERM mid-window — each must be detected with
+step/epoch/batch provenance and healed (or cleanly aborted) by
+FaultTolerantFit. Every chaos test is individually timeout-guarded by
+conftest's SIGALRM hook.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import (SameDiff, ScoreIterationListener,
+                                         TrainingConfig)
+from deeplearning4j_tpu.checkpoint import CheckpointManager
+from deeplearning4j_tpu.dataset.iterators import (ArrayDataSetIterator,
+                                                  DeviceCachedIterator)
+from deeplearning4j_tpu.faults import (ChaosMonkey, DataPipelineError,
+                                       FaultBudgetExhaustedError,
+                                       FaultTolerantFit, LossSpikeWatcher,
+                                       PlateauWatcher, RetryPolicy,
+                                       RetryingIterator,
+                                       TrainingDivergedError)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _mlp(fused_steps=4, sentinel=False, accum_steps=1, lr=1e-2):
+    rng = np.random.default_rng(0)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 8))
+    w0 = sd.var("w0", value=rng.normal(0, .1, (8, 16)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(16, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    w1 = sd.var("w1", value=rng.normal(0, .1, (16, 2)).astype(np.float32))
+    logits = h.mmul(w1)
+    labels = sd.placeholder("labels", shape=(-1, 2))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = TrainingConfig(
+        updater=Adam(lr), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"], fused_steps=fused_steps,
+        accum_steps=accum_steps, sentinel=sentinel)
+    return sd
+
+
+def _data(n=128, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return X, Y
+
+
+def _quiet():
+    return ScoreIterationListener(print_every=10 ** 9,
+                                  print_fn=lambda *a: None)
+
+
+# ---------------------------------------------------------------------------
+# device-side sentinel
+
+class TestDeviceSentinel:
+    @pytest.mark.chaos
+    def test_windowed_divergence_named_at_exact_step(self):
+        sd = _mlp(fused_steps=4, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=5):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        e = ei.value
+        assert e.step == 5 and e.epoch == 0 and e.batch_index == 5
+        assert e.cause == "device_sentinel"
+
+    @pytest.mark.chaos
+    def test_windowed_divergence_with_listeners_before_delivery(self):
+        """Poisoned losses must not reach listeners: the flush checks
+        sentinel verdicts BEFORE delivering the burst."""
+        seen = []
+
+        class Recorder(ScoreIterationListener):
+            def iteration_done(self, sd, epoch, iteration, loss):
+                seen.append(iteration)
+
+        sd = _mlp(fused_steps=4, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=6):
+            with pytest.raises(TrainingDivergedError):
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1,
+                       listeners=[Recorder(print_every=4,
+                                           print_fn=lambda *a: None)])
+        assert all(i < 4 for i in seen)   # only the pre-fault flush
+
+    @pytest.mark.chaos
+    def test_per_step_tier_divergence(self):
+        sd = _mlp(fused_steps=1, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=3):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1,
+                       listeners=[_quiet()])
+        assert ei.value.step == 3
+
+    @pytest.mark.chaos
+    def test_per_step_tier_no_listeners_divergence(self):
+        sd = _mlp(fused_steps=1, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=2):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        assert ei.value.step == 2
+
+    @pytest.mark.chaos
+    def test_scanned_tier_divergence(self):
+        sd = _mlp(fused_steps=1, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=4):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=2)
+        assert ei.value.step == 4
+
+    @pytest.mark.chaos
+    def test_scanned_tier_divergence_in_later_epoch(self):
+        """Epoch provenance on the scanned tier: a fault in epoch 1 of a
+        multi-epoch fit names epoch 1, not the fit-start epoch."""
+        sd = _mlp(fused_steps=1, sentinel=True)
+        X, Y = _data()                               # 8 steps/epoch
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=10):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=3)
+        assert ei.value.step == 10 and ei.value.epoch == 1
+
+    @pytest.mark.chaos
+    def test_accum_windowed_divergence(self):
+        sd = _mlp(fused_steps=4, sentinel=True, accum_steps=2)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(sd, at_step=5):
+            with pytest.raises(TrainingDivergedError) as ei:
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        assert ei.value.step == 5     # the micro-step, not its cycle
+
+    @pytest.mark.chaos
+    def test_nan_input_finite_loss_still_detected(self):
+        """A where-based relu launders all-NaN FEATURES into a finite
+        loss (NaN > 0 is False -> 0 activations -> loss = log(2)) while
+        the first weight's gradient x^T @ delta still goes NaN and
+        silently kills that parameter. Only the global grad-norm term of
+        the sentinel can see this — pinned so the sentinel never regresses
+        to loss-only or sampled-leaf checks."""
+        sd = _mlp(fused_steps=4, sentinel=True)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        it = chaos.poison_batches(
+            ArrayDataSetIterator(X, Y, batch_size=16), at_step=3)
+        with pytest.raises(TrainingDivergedError) as ei:
+            sd.fit(it, epochs=1)
+        assert ei.value.step == 3
+        assert ei.value.cause == "device_sentinel"
+
+    @pytest.mark.chaos
+    def test_tbptt_path_honors_sentinel(self):
+        """fit_tbptt builds its own graph + TrainingConfig; an armed
+        sentinel must follow onto it, not silently go inert."""
+        from deeplearning4j_tpu.nn import (InputType, LSTMLayer,
+                                           NeuralNetConfiguration,
+                                           RnnOutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-2)).list()
+                .layer(LSTMLayer(n_out=8))
+                .layer(RnnOutputLayer(n_out=2, loss_function="MCXENT"))
+                .set_input_type(InputType.recurrent(3, 12))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net._sd_train.training_config.sentinel = True
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(16, 12, 3)).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, (16, 12))]
+        h = net.fit_tbptt(X, Y, tbptt_length=4, epochs=1, batch_size=16)
+        assert np.isfinite(h.final_loss())       # clean run unaffected
+        # arm device chaos on the cached TBPTT graph and expect the rail
+        tb_sd, _ = net._tbptt_graphs[("tbptt", 16)]
+        chaos = ChaosMonkey(seed=0)
+        with chaos.nan_gradients(tb_sd, at_step=4):
+            with pytest.raises(TrainingDivergedError) as ei:
+                net.fit_tbptt(X, Y, tbptt_length=4, epochs=2,
+                              batch_size=16)
+        assert ei.value.step == 4
+
+    def test_sentinel_off_vs_on_bit_identical(self):
+        """Acceptance bar: with injection disabled, sentinel-enabled
+        fused-window training is bit-identical to sentinel-off."""
+        X, Y = _data()
+        results = {}
+        for flag in (False, True):
+            sd = _mlp(fused_steps=4, sentinel=flag)
+            h = sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=3,
+                       listeners=[_quiet()])
+            results[flag] = ({n: np.asarray(a) for n, a in
+                              sd.trainable_params().items()},
+                             h.final_loss())
+        for n, a in results[False][0].items():
+            np.testing.assert_array_equal(a, results[True][0][n])
+        assert results[False][1] == results[True][1]
+
+    def test_sentinel_keeps_dispatch_count_and_stats(self):
+        sd = _mlp(fused_steps=4, sentinel=True)
+        X, Y = _data()
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1,
+               listeners=[_quiet()])
+        st = sd.last_fit_stats
+        assert st["dispatches_per_epoch"] == 2     # ceil(8 / 4)
+        assert st["sentinel"] is True
+
+    def test_sentinel_serde_roundtrip(self):
+        tc = TrainingConfig.builder().updater(Adam(1e-3)) \
+            .sentinel(True).build()
+        assert TrainingConfig.from_json(tc.to_json()).sentinel is True
+
+
+# ---------------------------------------------------------------------------
+# host-side watchers
+
+class TestWatchers:
+    def test_loss_spike_raises_with_provenance(self):
+        w = LossSpikeWatcher(spike_factor=5.0, warmup=3)
+        w.iterations_done(None, 0, [0, 1, 2, 3], [1.0, 0.9, 0.8, 0.9])
+        with pytest.raises(TrainingDivergedError) as ei:
+            w.iterations_done(None, 1, [4, 5], [0.85, 50.0])
+        assert ei.value.step == 5 and ei.value.epoch == 1
+        assert ei.value.cause == "loss_spike" and ei.value.value == 50.0
+
+    def test_loss_spike_non_finite(self):
+        w = LossSpikeWatcher()
+        with pytest.raises(TrainingDivergedError) as ei:
+            w.iterations_done(None, 0, [0], [float("nan")])
+        assert ei.value.cause == "non_finite_loss"
+
+    def test_no_false_positive_on_decreasing_loss(self):
+        w = LossSpikeWatcher(spike_factor=3.0, warmup=2)
+        losses = list(np.linspace(2.0, 0.1, 50))
+        w.iterations_done(None, 0, list(range(50)), losses)
+
+    def test_plateau_watcher(self):
+        w = PlateauWatcher(patience=2, min_delta=0.01)
+        w.on_epoch_end(None, 0, 1.0)
+        w.on_epoch_end(None, 1, 0.5)
+        w.on_epoch_end(None, 2, 0.499)          # stale 1
+        with pytest.raises(TrainingDivergedError) as ei:
+            w.on_epoch_end(None, 3, 0.498)      # stale 2 = patience
+        assert ei.value.cause == "plateau"
+
+
+# ---------------------------------------------------------------------------
+# data pipeline rail
+
+class _FlakyOnce:
+    """Raises once at a given batch index, then works on the retry."""
+
+    def __init__(self, X, Y, batch, fail_at, times=1):
+        self._it = ArrayDataSetIterator(X, Y, batch_size=batch)
+        self.fail_at = fail_at
+        self.times = times
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for i, b in enumerate(self._it):
+            if i == self.fail_at and self.times > 0:
+                self.times -= 1
+                raise IOError("flaky shard")
+            yield b
+
+
+class TestRetryingIterator:
+    def test_transient_failure_recovers_full_stream(self):
+        X, Y = _data(64)
+        rit = RetryingIterator(_FlakyOnce(X, Y, 16, fail_at=2),
+                               max_retries=3)
+        batches = list(rit)
+        assert len(batches) == 4
+        np.testing.assert_array_equal(batches[2][0], X[32:48])
+        assert [e["event"] for e in rit.events] == ["loader_retry"]
+
+    def test_budget_exhausted_raises_structured(self):
+        X, Y = _data(64)
+        rit = RetryingIterator(
+            _FlakyOnce(X, Y, 16, fail_at=1, times=100),
+            max_retries=2, max_consecutive_failures=5)
+        with pytest.raises(DataPipelineError) as ei:
+            list(rit)
+        assert ei.value.batch_index == 1
+        assert isinstance(ei.value.__cause__, IOError)
+
+    def test_consecutive_failure_budget(self):
+        X, Y = _data(64)
+        rit = RetryingIterator(
+            _FlakyOnce(X, Y, 16, fail_at=1, times=100),
+            max_retries=100, max_consecutive_failures=2)
+        with pytest.raises(DataPipelineError):
+            list(rit)
+
+    def test_quarantine_corrupt_batch(self):
+        X, Y = _data(64)
+        X[17] = np.nan                             # poisons batch 1 of 4
+        rit = RetryingIterator(ArrayDataSetIterator(X, Y, batch_size=16))
+        assert len(list(rit)) == 3
+        assert rit.quarantined == {1}
+        # second pass: skipped on sight, stream stays clean
+        assert len(list(rit)) == 3
+        kinds = [e["event"] for e in rit.events]
+        assert "quarantine" in kinds and "quarantine_skip" in kinds
+
+    def test_restart_failure_retries_instead_of_truncating(self):
+        """A transient failure during the restart's fast-forward replay
+        must trigger another restart — never a fall-back to the closed
+        generator, whose next() is StopIteration (a silently short
+        epoch)."""
+        X, Y = _data(64)
+        state = {"calls": 0}
+        fail_calls = {3, 4}     # batch 2 of pass 1, then replay batch 0
+
+        class FlakyByCall:
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for i in range(4):
+                    state["calls"] += 1
+                    if state["calls"] in fail_calls:
+                        raise IOError(f"flaky fetch #{state['calls']}")
+                    yield X[i * 16:(i + 1) * 16], Y[i * 16:(i + 1) * 16]
+
+        rit = RetryingIterator(FlakyByCall(), max_retries=5,
+                               max_consecutive_failures=5)
+        batches = list(rit)
+        assert len(batches) == 4               # nothing silently dropped
+        np.testing.assert_array_equal(batches[3][0], X[48:])
+        assert [e["event"] for e in rit.events] == \
+            ["loader_retry", "loader_retry"]
+
+    def test_source_shrank_during_retry_is_a_fault(self):
+        """A source that comes back SHORTER after a retry reset must
+        surface as a structured fault, not silently truncate the pass."""
+        X, Y = _data(64)
+
+        class Shrinking:
+            passes = 0
+
+            def reset(self):
+                Shrinking.passes += 1
+
+            def __iter__(self):
+                n = 4 if Shrinking.passes <= 1 else 2
+                for i in range(n):
+                    if Shrinking.passes <= 1 and i == 3:
+                        raise IOError("flaky")
+                    yield X[i * 16:(i + 1) * 16], Y[i * 16:(i + 1) * 16]
+
+        rit = RetryingIterator(Shrinking(), max_retries=3)
+        with pytest.raises(DataPipelineError) as ei:
+            list(rit)
+        assert ei.value.cause == "source_shrank"
+
+    def test_non_transient_propagates_immediately(self):
+        class Bad:
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                raise KeyboardInterrupt()
+                yield  # pragma: no cover
+
+        rit = RetryingIterator(Bad(), max_retries=5)
+        with pytest.raises(KeyboardInterrupt):
+            list(rit)
+
+
+class TestAsyncPoison:
+    def test_poisoned_sentinel_carries_batch_index(self):
+        from deeplearning4j_tpu.dataset.iterators import AsyncDataSetIterator
+        X, _ = _data(64)
+
+        class Bad:
+            def __iter__(self):
+                yield X[:8], X[:8]
+                yield X[8:16], X[8:16]
+                raise ValueError("shard checksum mismatch")
+
+        got = []
+        with pytest.raises(DataPipelineError) as ei:
+            for b in AsyncDataSetIterator(Bad(), queue_size=2):
+                got.append(b)
+        # the good prefix was delivered IN ORDER before the poison
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[1][0], X[8:16])
+        assert ei.value.batch_index == 2
+        assert ei.value.cause == "async_worker"
+        assert isinstance(ei.value.__cause__, ValueError)
+
+    def test_retrying_iterator_wraps_async(self):
+        """RetryingIterator on top of the async prefetch: the poisoned
+        sentinel is a transient error, so the pass completes."""
+        from deeplearning4j_tpu.dataset.iterators import AsyncDataSetIterator
+        X, Y = _data(64)
+        inner = _FlakyOnce(X, Y, 16, fail_at=3)
+        rit = RetryingIterator(AsyncDataSetIterator(inner, queue_size=2),
+                               max_retries=2)
+        assert len(list(rit)) == 4
+
+
+# ---------------------------------------------------------------------------
+# preemption handler chaining
+
+class TestPreemptionChaining:
+    @pytest.mark.chaos
+    def test_chains_to_previous_handler_after_commit(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import Preempted, PreemptionHook
+        sd = _mlp()
+        calls = []
+
+        def supervisor(signum, frame):
+            # the outer supervisor must observe the committed checkpoint
+            calls.append((signum, mgr.latest_step()))
+
+        prev = signal.signal(signal.SIGTERM, supervisor)
+        try:
+            mgr = CheckpointManager(tmp_path, async_write=False)
+            with pytest.raises(Preempted):
+                with PreemptionHook(mgr, sd):
+                    PreemptionHook.simulate()
+            assert len(calls) == 1
+            assert calls[0][0] == signal.SIGTERM
+            assert calls[0][1] is not None      # commit BEFORE the chain
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    @pytest.mark.chaos
+    def test_no_chain_for_default_handler(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import Preempted, PreemptionHook
+        sd = _mlp()
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        with pytest.raises(Preempted):
+            with PreemptionHook(mgr, sd):
+                PreemptionHook.simulate()
+        assert mgr.latest_step() is not None
+
+
+# ---------------------------------------------------------------------------
+# torn checkpoints under injected storage faults
+
+class TestTornCheckpoints:
+    @pytest.mark.chaos
+    def test_fsync_failure_torn_dir_skipped_gc_next_save_ok(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.state import \
+            capture_training_state
+        sd = _mlp()
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(1, capture_training_state(sd))
+        chaos = ChaosMonkey(seed=0)
+        with chaos.failing_fsync(times=1):
+            with pytest.raises(OSError):
+                mgr.save(2, capture_training_state(sd))
+        # the torn staging dir is skipped by restore and reclaimed by gc
+        assert mgr.all_steps() == [1]
+        step, _ = mgr.restore_latest(model=sd)
+        assert step == 1
+        torn = mgr.uncommitted_dirs()
+        assert len(torn) == 1 and torn[0].endswith(".tmp")
+        assert mgr.gc_uncommitted() == torn
+        assert mgr.uncommitted_dirs() == []
+        mgr.save(2, capture_training_state(sd))      # next save succeeds
+        assert mgr.all_steps() == [1, 2]
+
+    @pytest.mark.chaos
+    def test_replace_failure_fully_staged_dir_salvaged(self, tmp_path):
+        """os.replace dying AFTER the manifest+COMMIT are staged leaves a
+        fully-verifiable .tmp — _recover_aside salvages it instead of
+        discarding a durable checkpoint."""
+        from deeplearning4j_tpu.checkpoint.state import \
+            capture_training_state
+        sd = _mlp()
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        mgr.save(1, capture_training_state(sd))
+        chaos = ChaosMonkey(seed=0)
+        with chaos.failing_os_replace(times=1):
+            with pytest.raises(OSError):
+                mgr.save(2, capture_training_state(sd))
+        assert mgr.all_steps() == [1]
+        step, _ = mgr.restore_latest(model=sd)       # salvage, then restore
+        assert step == 2
+        assert mgr.all_steps() == [1, 2]
+
+    @pytest.mark.chaos
+    def test_async_writer_fault_is_sticky(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.manager import CheckpointError
+        from deeplearning4j_tpu.checkpoint.state import \
+            capture_training_state
+        sd = _mlp()
+        mgr = CheckpointManager(tmp_path, async_write=True)
+        chaos = ChaosMonkey(seed=0)
+        with chaos.failing_fsync(times=1):
+            mgr.save(1, capture_training_state(sd))
+            with pytest.raises(CheckpointError):
+                mgr.wait_until_finished()
+        mgr.gc_uncommitted()
+        mgr.save(2, capture_training_state(sd), blocking=True)
+        assert mgr.all_steps() == [2]
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# FaultTolerantFit: the rollback-and-retry driver
+
+class TestFaultTolerantFit:
+    @pytest.mark.chaos
+    def test_end_to_end_self_heal(self, tmp_path):
+        """Acceptance: NaN injected into a mid-run step AND a loader
+        exception mid-epoch — the run restores from the last committed
+        checkpoint, resumes, and completes with a finite final loss."""
+        sd = _mlp(fused_steps=4)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=7)
+        it = ArrayDataSetIterator(X, Y, batch_size=16)     # 8 steps/epoch
+        it = chaos.flaky_iterator(it, fail_at_batch=2)     # epoch 0 loader
+        it = chaos.poison_batches(it, at_step=13)          # NaN mid-epoch-1
+        storage = StatsStorage()
+        mgr = CheckpointManager(tmp_path, keep_last_n=5)
+        ftf = FaultTolerantFit(
+            sd, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0,
+                               quarantine_corrupt=False),
+            checkpoint_every_n_iterations=4, stats_storage=storage,
+            sleep=lambda s: None)
+        h = ftf.fit(it, epochs=4)
+        assert np.isfinite(h.final_loss())
+        assert sd.training_config.epoch_count == 4
+        assert ftf.rollbacks >= 1
+        for n, a in sd.trainable_params().items():
+            assert np.isfinite(np.asarray(a)).all(), n
+        events = [r["event"] for r in storage.of_type("faults")]
+        assert "loader_retry" in events
+        assert "fault" in events and "rollback" in events
+        assert "recovered" in events
+        mgr.close()
+
+    @pytest.mark.chaos
+    def test_epoch_budget_preserved_with_nonzero_start(self, tmp_path):
+        """Checkpoints taken inside a retry attempt must carry the
+        GLOBAL epoch count: a fit-local index would roll tc.epoch_count
+        backwards on restore and inflate the remaining-epochs budget."""
+        sd = _mlp(fused_steps=4)
+        X, Y = _data()
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        assert sd.training_config.epoch_count == 2
+        epochs_trained = []
+
+        class Counter(ScoreIterationListener):
+            def __init__(self):
+                super().__init__(print_every=10 ** 9,
+                                 print_fn=lambda *a: None)
+
+            def on_epoch_end(self, sd, epoch, mean_loss):
+                epochs_trained.append(epoch)
+
+        chaos = ChaosMonkey(seed=3)
+        it = chaos.poison_batches(
+            ArrayDataSetIterator(X, Y, batch_size=16), at_step=4)
+        mgr = CheckpointManager(tmp_path, keep_last_n=5)
+        ftf = FaultTolerantFit(
+            sd, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0,
+                               quarantine_corrupt=False),
+            checkpoint_every_n_iterations=4, sleep=lambda s: None)
+        h = ftf.fit(it, epochs=2, listeners=[Counter()])
+        mgr.close()
+        assert np.isfinite(h.final_loss())
+        assert ftf.rollbacks == 1
+        assert sd.training_config.epoch_count == 4     # 2 + exactly 2
+        # the interrupted epoch replays once; nothing beyond the budget
+        assert len(epochs_trained) == 2
+
+    @pytest.mark.chaos
+    def test_quarantine_heals_without_rollback(self, tmp_path):
+        """Corrupt batches are the data rail's job: quarantined before
+        they can become a divergence, no rollback needed."""
+        sd = _mlp(fused_steps=4)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=3)
+        it = chaos.poison_batches(
+            ArrayDataSetIterator(X, Y, batch_size=16), at_step=2)
+        storage = StatsStorage()
+        mgr = CheckpointManager(tmp_path)
+        ftf = FaultTolerantFit(sd, mgr, policy=RetryPolicy(backoff_base=0.0),
+                               stats_storage=storage, sleep=lambda s: None)
+        h = ftf.fit(it, epochs=2)
+        assert np.isfinite(h.final_loss())
+        assert ftf.rollbacks == 0
+        assert "quarantine" in [r["event"] for r in storage.of_type("faults")]
+        mgr.close()
+
+    @pytest.mark.chaos
+    def test_budget_exhausted_aborts_cleanly(self, tmp_path):
+        """A permanent fault: rollback budget runs out, the model ends
+        at the last good state and a pinned final checkpoint exists."""
+        sd = _mlp(fused_steps=4)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        storage = StatsStorage()
+        mgr = CheckpointManager(tmp_path, keep_last_n=3)
+        ftf = FaultTolerantFit(
+            sd, mgr, policy=RetryPolicy(max_retries=2, backoff_base=0.0),
+            checkpoint_every_n_iterations=4, stats_storage=storage,
+            sleep=lambda s: None)
+        with chaos.nan_gradients(sd, at_step=6):   # re-injects every pass
+            with pytest.raises(FaultBudgetExhaustedError) as ei:
+                ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        assert isinstance(ei.value.__cause__, TrainingDivergedError)
+        for n, a in sd.trainable_params().items():
+            assert np.isfinite(np.asarray(a)).all(), n
+        events = [r["event"] for r in storage.of_type("faults")]
+        assert "retry_exhausted" in events
+        assert mgr.latest_step() is not None
+        mgr.close()
+
+    @pytest.mark.chaos
+    def test_transient_device_error_retried(self, tmp_path):
+        sd = _mlp(fused_steps=4)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        mgr = CheckpointManager(tmp_path)
+        ftf = FaultTolerantFit(sd, mgr,
+                               policy=RetryPolicy(max_retries=2,
+                                                  backoff_base=0.0),
+                               sleep=lambda s: None)
+        with chaos.transient_device_error(sd):
+            h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        assert np.isfinite(h.final_loss())
+        assert ftf.rollbacks == 1
+        assert sd.training_config.epoch_count == 2
+        mgr.close()
+
+    @pytest.mark.chaos
+    def test_lr_rescale_on_rollback(self, tmp_path):
+        sd = _mlp(fused_steps=4, lr=1e-2)
+        X, Y = _data()
+        chaos = ChaosMonkey(seed=0)
+        it = chaos.poison_batches(
+            ArrayDataSetIterator(X, Y, batch_size=16), at_step=3)
+        mgr = CheckpointManager(tmp_path)
+        ftf = FaultTolerantFit(
+            sd, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0,
+                               lr_rescale=0.5, quarantine_corrupt=False),
+            checkpoint_every_n_iterations=2, sleep=lambda s: None)
+        h = ftf.fit(it, epochs=2)
+        assert np.isfinite(h.final_loss())
+        assert ftf.rollbacks == 1
+        assert sd.training_config.updater.learning_rate == \
+            pytest.approx(5e-3)
+        mgr.close()
+
+    @pytest.mark.chaos
+    def test_sigterm_mid_window_then_elastic_resume(self, tmp_path):
+        """The preemption drill: SIGTERM mid-run commits a final
+        checkpoint and raises Preempted; the relaunched run restores
+        and finishes with finite loss."""
+        from deeplearning4j_tpu.checkpoint import Preempted, PreemptionHook
+        X, Y = _data()
+        sd = _mlp(fused_steps=2)
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        chaos = ChaosMonkey(seed=0)
+        with pytest.raises(Preempted):
+            with PreemptionHook(mgr, sd):
+                sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=8,
+                       listeners=[chaos.sigterm_listener(at_iteration=9)])
+        # the final snapshot carries the last state the fit loop synced
+        # into the graph (a window/epoch boundary at or before step 9)
+        final = mgr.latest_step()
+        assert final is not None and final >= 1
+        # "relaunch": fresh process state, restore, finish the run
+        sd2 = _mlp(fused_steps=2)
+        mgr2 = CheckpointManager(tmp_path)
+        step, _ = mgr2.restore_latest(model=sd2)
+        assert step == final
+        ftf = FaultTolerantFit(sd2, mgr2, sleep=lambda s: None)
+        h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        assert np.isfinite(h.final_loss())
+        mgr2.close()
+
+    def test_device_cached_source_keeps_fast_path(self, tmp_path):
+        """A stacked_batches source must NOT be wrapped in
+        RetryingIterator: the wrapper would hide the attribute the
+        windowed tier's cached-windows path routes on, re-staging from
+        host every epoch."""
+        sd = _mlp(fused_steps=4)
+        X, Y = _data(64)
+        captured = {}
+        orig_fit = sd.fit
+
+        def spy(it, **kw):
+            captured["it"] = it
+            return orig_fit(it, **kw)
+
+        sd.fit = spy
+        mgr = CheckpointManager(tmp_path)
+        ftf = FaultTolerantFit(sd, mgr, sleep=lambda s: None)
+        h = ftf.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=2)
+        assert np.isfinite(h.final_loss())
+        assert hasattr(captured["it"], "stacked_batches")
+        assert sd.last_fit_stats["tier"] == "windowed"
+        mgr.close()
+
+    def test_report_shape(self, tmp_path):
+        sd = _mlp(fused_steps=2)
+        X, Y = _data(64)
+        mgr = CheckpointManager(tmp_path)
+        ftf = FaultTolerantFit(sd, mgr, sleep=lambda s: None)
+        ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        rep = ftf.report()
+        assert rep["rollbacks"] == 0 and rep["recovery_seconds"] == 0.0
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# serving failure observability
+
+class TestServingCauses:
+    def test_record_failure_and_timeout_causes(self):
+        from deeplearning4j_tpu.serving.metrics import ServingMetrics
+        m = ServingMetrics()
+        m.record_failure(ValueError("bad shape"))
+        m.record_failure(RuntimeError("xla oom"), n=3)
+        m.record_timeout("deadline")
+        rec = m.to_record()
+        assert rec["counters"]["requests_failed"] == 4
+        assert rec["failure_causes"] == {"ValueError": 1, "RuntimeError": 3}
+        assert rec["timeout_causes"] == {"deadline": 1}
+        assert rec["last_error"]["kind"] == "timeout"
+        assert "causes:" in m.stats() and "last_error:" in m.stats()
+
+    def test_inference_failure_attributed(self):
+        from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                           NeuralNetConfiguration,
+                                           OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.serving import InferenceMode, ParallelInference
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, loss_function="MCXENT"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        pi = ParallelInference(net, mode=InferenceMode.INPLACE)
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected model fault")
+
+        pi._spec = pi._spec._replace(sd=type("X", (), {
+            "output": staticmethod(boom), "_vars": pi._spec.sd._vars})())
+        with pytest.raises(RuntimeError):
+            pi.output(np.zeros((2, 4), np.float32))
+        rec = pi.metrics.to_record()
+        assert rec["failure_causes"] == {"RuntimeError": 1}
+        assert rec["last_error"]["cause"] == "RuntimeError"
+        pi.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos determinism
+
+class TestChaosDeterminism:
+    def test_seeded_draws_reproduce(self):
+        a = ChaosMonkey(seed=42)
+        b = ChaosMonkey(seed=42)
+        assert [a.draw_step(0, 100) for _ in range(5)] == \
+            [b.draw_step(0, 100) for _ in range(5)]
+
+    def test_injections_are_logged(self):
+        chaos = ChaosMonkey(seed=1)
+        X, Y = _data(64)
+        it = chaos.poison_batches(ArrayDataSetIterator(X, Y, batch_size=16),
+                                  at_step=1)
+        list(it)
+        assert chaos.log[0]["event"] == "batch_poisoned"

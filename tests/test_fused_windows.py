@@ -567,8 +567,13 @@ def test_async_iterator_full_pass_and_error_propagation():
             yield X[:8], X[:8]
             raise ValueError("reader died")
 
-    with pytest.raises(ValueError, match="reader died"):
+    # worker failure arrives as a poisoned sentinel: structured error
+    # carrying the failing batch index, the original chained as __cause__
+    from deeplearning4j_tpu.faults import DataPipelineError
+    with pytest.raises(DataPipelineError, match="reader died") as ei:
         list(AsyncDataSetIterator(Bad(), queue_size=2))
+    assert ei.value.batch_index == 1
+    assert isinstance(ei.value.__cause__, ValueError)
 
 
 def test_windowed_fit_through_async_iterator():
