@@ -89,11 +89,14 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
 
 
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
-                       fused_steps=1, sentinel=False):
+                       fused_steps=1, sentinel=False,
+                       monitor_storage=None):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
     give the listener-path variant (see bench_lenet); ``sentinel`` arms
-    the device-side divergence sentinel (docs/fault_tolerance.md)."""
+    the device-side divergence sentinel (docs/fault_tolerance.md);
+    ``monitor_storage`` attaches a monitor.MonitorListener publishing
+    steptime/metrics records into it (docs/observability.md)."""
     from deeplearning4j_tpu.autodiff import (SameDiff,
                                              ScoreIterationListener,
                                              TrainingConfig)
@@ -131,6 +134,13 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                                         print_fn=lambda *a: None)] \
         if listener else []
     sd.fit(it, epochs=2, listeners=listeners)   # warmup/compile
+    if monitor_storage is not None:
+        # attached AFTER warmup so the steptime records describe
+        # steady state — the one-time XLA compile happens inside the
+        # warmup windows' dispatch spans and must not inflate the
+        # published dispatch share
+        from deeplearning4j_tpu.monitor import MonitorListener
+        listeners = listeners + [MonitorListener(monitor_storage)]
     epochs = 6
     sps = _median_rate(lambda: sd.fit(it, epochs=epochs,
                                       listeners=listeners), epochs * n)
@@ -165,6 +175,66 @@ def bench_sentinel_overhead(batch=128, fused_steps=8, repeats=2):
             "step_time_ms": round(1000.0 * batch / best[True], 3)
             if best[True] else 0.0,
             "sentinel_overhead_pct": round(overhead, 2),
+            "batch": batch, "fused_steps": fused_steps}
+
+
+def bench_tracer_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the observability rail (monitor/, docs/observability.md):
+    the fused-window listener config with span tracing off vs on. The
+    disabled path adds one no-op attribute check per span site (bar:
+    unmeasurable, guarded ≤1% analytically in tests/test_monitor.py);
+    enabled tracing adds two clock reads + a locked ring append per
+    span, ~5 spans per K-step window — the acceptance bar is ≤3%
+    steps/s. Same best-of-``repeats`` interleaved estimator as
+    sentinel_overhead (run-to-run tunnel jitter exceeds the effect
+    size).
+
+    Also reports the measured step-time breakdown — the aggregate of
+    the monitored run's {"type": "steptime"} records: where the wall
+    time of a fused listener-path step actually goes (data-wait vs
+    dispatch vs flush), the number BENCH_r05 had to hand-derive."""
+    from deeplearning4j_tpu.monitor import disable_tracing, enable_tracing
+    from deeplearning4j_tpu.ui.stats import StatsStorage
+
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            if flag:
+                enable_tracing(reset=True)
+            else:
+                disable_tracing()
+            try:
+                r = bench_samediff_mlp(batch=batch, listener=True,
+                                       fused_steps=fused_steps)
+            finally:
+                disable_tracing()
+            best[flag] = max(best[flag], r["samples_per_sec"])
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    # one monitored (traced + MonitorListener) run for the breakdown —
+    # not part of the timed comparison
+    storage = StatsStorage()
+    enable_tracing(reset=True)
+    try:
+        bench_samediff_mlp(batch=batch, listener=True,
+                           fused_steps=fused_steps,
+                           monitor_storage=storage)
+    finally:
+        disable_tracing()
+    recs = [r for r in storage.of_type("steptime")
+            if r.get("event") != "straggler"]
+    wall = sum(r.get("wall_s", 0.0) for r in recs) or 1.0
+    breakdown = {f"{stage}_pct": round(
+        100.0 * sum(r.get(f"{stage}_s", 0.0) for r in recs) / wall, 2)
+        for stage in ("data_wait", "dispatch", "flush", "other")}
+    breakdown["step_ms_p50"] = recs[-1].get("step_ms_p50") if recs else None
+    breakdown["steps"] = sum(r.get("steps", 0) for r in recs)
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_tracing_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "tracer_overhead_pct": round(overhead, 2),
+            "steptime_breakdown": breakdown,
             "batch": batch, "fused_steps": fused_steps}
 
 
@@ -293,6 +363,10 @@ def main():
                      # the fault rail's cost stays visible: fused-window
                      # steps/s with divergence sentinels on vs off
                      ("sentinel_overhead", bench_sentinel_overhead),
+                     # the observability rail's cost + the step-time
+                     # breakdown (where fused listener-path wall time
+                     # goes), emitted into BENCH_r*.json going forward
+                     ("tracer_overhead", bench_tracer_overhead),
                      ("resnet50", bench_resnet50),
                      ("bert_base", bench_bert_base),
                      ("gpt_medium", bench_gpt_medium)):

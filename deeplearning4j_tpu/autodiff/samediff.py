@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.ndarray.dtype import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ops import registry
@@ -1179,22 +1180,23 @@ class SameDiff:
                 if not pending:
                     return
                 iters = [it for it, _ in pending]
-                if pending_oks:
-                    # losses + sentinel verdicts in ONE device->host
-                    # transfer; verdicts are checked (and may raise)
-                    # BEFORE the burst reaches listeners
-                    from deeplearning4j_tpu.faults.sentinels import \
-                        check_ok_flags
-                    ok_iters = [it for it, _ in pending_oks]
-                    vals_arr, oks = jax.device_get(
-                        (jnp.stack([lv for _, lv in pending]),
-                         jnp.stack([o for _, o in pending_oks])))
-                    pending_oks.clear()
-                    check_ok_flags(np.asarray(oks), ok_iters, epoch,
-                                   epoch_start_iter)
-                else:
-                    vals_arr = np.asarray(
-                        jnp.stack([lv for _, lv in pending]))
+                with _tracer.span("flush", cat="train", steps=len(iters)):
+                    if pending_oks:
+                        # losses + sentinel verdicts in ONE device->host
+                        # transfer; verdicts are checked (and may raise)
+                        # BEFORE the burst reaches listeners
+                        from deeplearning4j_tpu.faults.sentinels import \
+                            check_ok_flags
+                        ok_iters = [it for it, _ in pending_oks]
+                        vals_arr, oks = jax.device_get(
+                            (jnp.stack([lv for _, lv in pending]),
+                             jnp.stack([o for _, o in pending_oks])))
+                        pending_oks.clear()
+                        check_ok_flags(np.asarray(oks), ok_iters, epoch,
+                                       epoch_start_iter)
+                    else:
+                        vals_arr = np.asarray(
+                            jnp.stack([lv for _, lv in pending]))
                 vals = [float(v) for v in vals_arr]
                 epoch_losses.extend(vals)
                 if sync_params_on_flush:
@@ -1229,31 +1231,40 @@ class SameDiff:
             batch_iter = iter(dataset_iterator)
             ph = next((_prep_batch(b) for b in batch_iter), None)
             while ph is not None:
-                nxt = next((_prep_batch(b) for b in batch_iter), None)
-                for l in listeners:
-                    if getattr(l, "batch_size", -1) is None:
-                        l.batch_size = next(iter(ph.values())).shape[0]
-                if use_sentinel:
-                    params, svars, state, it_dev, loss_val, ok = step(
-                        params, svars, state, it_dev, constants, ph,
-                        base_key)
+                # one "step" span per dispatch (the per-step tier's
+                # window of k=1) with data_wait/dispatch children;
+                # listener flushes record outside it (monitor/steptime)
+                with _tracer.span("step", cat="train", k=1,
+                                  iteration=iteration):
+                    with _tracer.span("data_wait", cat="train"):
+                        nxt = next((_prep_batch(b) for b in batch_iter),
+                                   None)
+                    for l in listeners:
+                        if getattr(l, "batch_size", -1) is None:
+                            l.batch_size = next(iter(ph.values())).shape[0]
+                    with _tracer.span("dispatch", cat="train"):
+                        if use_sentinel:
+                            params, svars, state, it_dev, loss_val, ok = \
+                                step(params, svars, state, it_dev,
+                                     constants, ph, base_key)
+                            if listeners:
+                                pending_oks.append((iteration, ok))
+                            else:
+                                epoch_oks.append(ok)
+                        else:
+                            params, svars, state, it_dev, loss_val = step(
+                                params, svars, state, it_dev, constants,
+                                ph, base_key)
+                    # without listeners, never force a device sync: losses
+                    # stay async device scalars (a scalar fetch = tunnel
+                    # round-trip)
                     if listeners:
-                        pending_oks.append((iteration, ok))
+                        pending.append((iteration, loss_val))
                     else:
-                        epoch_oks.append(ok)
-                else:
-                    params, svars, state, it_dev, loss_val = step(
-                        params, svars, state, it_dev, constants, ph,
-                        base_key)
-                # without listeners, never force a device sync: losses stay
-                # async device scalars (a scalar fetch = tunnel round-trip)
-                if listeners:
-                    pending.append((iteration, loss_val))
-                    if len(pending) >= flush_every:
-                        _flush(pending)
-                else:
-                    epoch_losses.append(loss_val)
-                iteration += 1
+                        epoch_losses.append(loss_val)
+                    iteration += 1
+                if pending and len(pending) >= flush_every:
+                    _flush(pending)
                 ph = nxt
             if epoch_oks:
                 # sentinel without listeners: ONE stacked verdict fetch

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
+
 
 def pow2_buckets(r: int) -> List[int]:
     """Binary decomposition of a ragged tail length into descending
@@ -108,15 +110,20 @@ class WindowStager:
         return False
 
     def _stack(self, batches: List[Dict[str, object]]):
-        names = batches[0].keys()
-        stacked = {}
-        for n in names:
-            items = [b[n] for b in batches]
-            if all(isinstance(a, np.ndarray) for a in items):
-                stacked[n] = np.stack(items)
-            else:
-                stacked[n] = jnp.stack([jnp.asarray(a) for a in items])
-        return len(batches), self._finalize(stacked)
+        # the H2D stage of the window pipeline: stacking + the enqueue
+        # of the next window's host→HBM transfer, on the stager thread
+        # (its own swimlane in the chrome trace — overlap with the
+        # consumer's dispatch lane is the double-buffering working)
+        with _tracer.span("h2d_stage", cat="train", k=len(batches)):
+            names = batches[0].keys()
+            stacked = {}
+            for n in names:
+                items = [b[n] for b in batches]
+                if all(isinstance(a, np.ndarray) for a in items):
+                    stacked[n] = np.stack(items)
+                else:
+                    stacked[n] = jnp.stack([jnp.asarray(a) for a in items])
+            return len(batches), self._finalize(stacked)
 
     def _emit_bucketed(self, buf) -> bool:
         i = 0
@@ -311,27 +318,35 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             bads.clear()
             check_bad_steps(fetched, epoch, epoch_start_iter)
 
-        def _flush():
+        def _fetch_flush():
+            """The device-sync half of a listener flush: fetch the loss
+            burst (+ sentinel verdicts), sync training state. Returns
+            the (iters, vals) burst for :func:`_deliver`, or None. Split
+            from delivery so the ``flush`` span records the WINDOW
+            boundary's device wait (as a child of the window span that
+            triggered it) while listener callbacks run outside it."""
             if not pending:
-                return
+                return None
             iters: List[int] = []
             for start, k, _ in pending:
                 iters.extend(range(start, start + k))
-            losses_cat = jnp.concatenate([lv for _, _, lv in pending])
-            if pending_bads:
-                # losses + sentinel verdicts in ONE device→host
-                # transfer; poisoned windows must not feed listeners/
-                # checkpoints, so verdicts are checked (and may raise)
-                # before the burst is delivered
-                from deeplearning4j_tpu.faults.sentinels import \
-                    check_bad_steps
-                vals_arr, bads = jax.device_get(
-                    (losses_cat, jnp.stack(pending_bads)))
-                pending_bads.clear()
-                check_bad_steps(np.asarray(bads), epoch, epoch_start_iter)
-            else:
-                # ONE device→host transfer for the whole burst
-                vals_arr = np.asarray(losses_cat)
+            with _tracer.span("flush", cat="train", steps=len(iters)):
+                losses_cat = jnp.concatenate([lv for _, _, lv in pending])
+                if pending_bads:
+                    # losses + sentinel verdicts in ONE device→host
+                    # transfer; poisoned windows must not feed listeners/
+                    # checkpoints, so verdicts are checked (and may
+                    # raise) before the burst is delivered
+                    from deeplearning4j_tpu.faults.sentinels import \
+                        check_bad_steps
+                    vals_arr, bads = jax.device_get(
+                        (losses_cat, jnp.stack(pending_bads)))
+                    pending_bads.clear()
+                    check_bad_steps(np.asarray(bads), epoch,
+                                    epoch_start_iter)
+                else:
+                    # ONE device→host transfer for the whole burst
+                    vals_arr = np.asarray(losses_cat)
             vals = [float(v) for v in vals_arr]
             epoch_losses.extend(vals)
             if sync_params_on_flush:
@@ -350,9 +365,18 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                             f"non-finite loss {v} at iteration {it} "
                             f"(nan_panic); localize the producing op with "
                             f"sd.exec_debug(placeholders)")
+            pending.clear()
+            return iters, vals
+
+        def _deliver(flushed):
+            if flushed is None:
+                return
+            iters, vals = flushed
             for l in listeners:
                 l.iterations_done(sd, epoch, iters, vals)
-            pending.clear()
+
+        def _flush():
+            _deliver(_fetch_flush())
 
         for l in listeners:
             l.on_epoch_start(sd, epoch)
@@ -364,55 +388,79 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             stager = WindowStager(map(_name_batch, iter(dataset_iterator)),
                                   K, finalize=_finalize)
             source = stager
+        _END_OF_DATA = object()
+        src_iter = iter(source)
         try:
-            for k, win in source:
-                for l in listeners:
-                    if getattr(l, "batch_size", -1) is None:
-                        l.batch_size = next(iter(win.values())).shape[1]
-                # jit retraces per full placeholder shape set (a ragged
-                # final BATCH recompiles even at an already-seen k)
-                trace_sig = tuple(sorted((n, tuple(v.shape))
-                                         for n, v in win.items()))
-                if trace_sig not in seen_sizes:
-                    seen_sizes.add(trace_sig)
-                    compiles += 1
-                    sd._verbose_log(f"fit: compiling window length {k}")
-                bad = None
-                if A > 1 and use_sentinel:
-                    (params, svars, state, accum, it_dev, losses,
-                     bad) = window_fn(params, svars, state, accum, it_dev,
-                                      constants, win, base_key)
-                elif A > 1:
-                    params, svars, state, accum, it_dev, losses = window_fn(
-                        params, svars, state, accum, it_dev, constants, win,
-                        base_key)
-                elif use_sentinel:
-                    params, svars, state, it_dev, losses, bad = window_fn(
-                        params, svars, state, it_dev, constants, win,
-                        base_key)
-                else:
-                    params, svars, state, it_dev, losses = window_fn(
-                        params, svars, state, it_dev, constants, win,
-                        base_key)
-                dispatches += 1
-                sizes[k] = sizes.get(k, 0) + 1
-                if bad is not None:
-                    (pending_bads if listeners else epoch_bads).append(bad)
-                if listeners:
-                    pending.append((iteration, k, losses))
-                    iteration += k
-                    # flush at the FIRST window boundary at-or-after each
-                    # multiple of the listener cadence (absolute
-                    # iterations), so an every-N listener sees its burst
-                    # as soon as a boundary crosses N — not only when a
-                    # full N steps have buffered (docs/checkpointing.md)
-                    if iteration >= next_flush:
-                        _flush()
-                        next_flush = (iteration // flush_every + 1) \
-                            * flush_every
-                else:
-                    epoch_loss_bufs.append(losses)
-                    iteration += k
+            while True:
+                # one "window" span per dispatch unit, with data_wait /
+                # dispatch (and, when this window crosses a listener
+                # cadence, flush) children — the trace rows ui/report's
+                # step-time breakdown and monitor/steptime.py attribute
+                flushed = None
+                with _tracer.span("window", cat="train") as wspan:
+                    with _tracer.span("data_wait", cat="train"):
+                        item = next(src_iter, _END_OF_DATA)
+                    if item is _END_OF_DATA:
+                        wspan.discard()
+                        break
+                    k, win = item
+                    wspan.set(k=k, iteration=iteration)
+                    for l in listeners:
+                        if getattr(l, "batch_size", -1) is None:
+                            l.batch_size = next(iter(win.values())).shape[1]
+                    # jit retraces per full placeholder shape set (a
+                    # ragged final BATCH recompiles even at an
+                    # already-seen k)
+                    trace_sig = tuple(sorted((n, tuple(v.shape))
+                                             for n, v in win.items()))
+                    if trace_sig not in seen_sizes:
+                        seen_sizes.add(trace_sig)
+                        compiles += 1
+                        sd._verbose_log(f"fit: compiling window length {k}")
+                    bad = None
+                    with _tracer.span("dispatch", cat="train", k=k):
+                        if A > 1 and use_sentinel:
+                            (params, svars, state, accum, it_dev, losses,
+                             bad) = window_fn(params, svars, state, accum,
+                                              it_dev, constants, win,
+                                              base_key)
+                        elif A > 1:
+                            (params, svars, state, accum, it_dev,
+                             losses) = window_fn(params, svars, state,
+                                                 accum, it_dev, constants,
+                                                 win, base_key)
+                        elif use_sentinel:
+                            (params, svars, state, it_dev, losses,
+                             bad) = window_fn(params, svars, state, it_dev,
+                                              constants, win, base_key)
+                        else:
+                            params, svars, state, it_dev, losses = \
+                                window_fn(params, svars, state, it_dev,
+                                          constants, win, base_key)
+                    dispatches += 1
+                    sizes[k] = sizes.get(k, 0) + 1
+                    if bad is not None:
+                        (pending_bads if listeners
+                         else epoch_bads).append(bad)
+                    if listeners:
+                        pending.append((iteration, k, losses))
+                        iteration += k
+                        # flush at the FIRST window boundary at-or-after
+                        # each multiple of the listener cadence (absolute
+                        # iterations), so an every-N listener sees its
+                        # burst as soon as a boundary crosses N — not
+                        # only when a full N steps have buffered
+                        # (docs/checkpointing.md)
+                        if iteration >= next_flush:
+                            flushed = _fetch_flush()
+                            next_flush = (iteration // flush_every + 1) \
+                                * flush_every
+                    else:
+                        epoch_loss_bufs.append(losses)
+                        iteration += k
+                # listener callbacks run OUTSIDE the window span: their
+                # cost is user code, not executor time
+                _deliver(flushed)
         finally:
             if stager is not None:
                 stager.close()

@@ -37,12 +37,14 @@ import os
 import queue
 import re
 import shutil
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.checkpoint import manifest as _manifest
 from deeplearning4j_tpu.checkpoint.atomic import fsync_dir
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.checkpoint.state import (
     TrainingState, capture_training_state, read_state_files,
     restore_training_state, write_state_files)
@@ -213,8 +215,12 @@ class CheckpointManager:
         if state is None:
             if model is None:
                 raise ValueError("save() needs state= or model=")
-            state = capture_training_state(model, epoch=epoch,
-                                           normalizer=normalizer)
+            # the only part of an async save the training thread stalls
+            # for: the device→host copy of the full training state
+            with _tracer.span("checkpoint.capture", cat="checkpoint",
+                              step=int(step)):
+                state = capture_training_state(model, epoch=epoch,
+                                               normalizer=normalizer)
         if metrics:
             state.metadata.setdefault("metrics", {}).update(
                 {k: float(v) for k, v in metrics.items()})
@@ -263,6 +269,11 @@ class CheckpointManager:
                 f"another commit is stuck")
         try:
             t0 = time.perf_counter()
+            commit_span = _tracer.span(
+                "checkpoint.commit", cat="checkpoint", step=int(step),
+                asynchronous=bool(was_async),
+                queue_s=round(max(0.0, t0 - enq_t), 6))
+            commit_span.__enter__()
             tmp = self._tmp_dir(step)
             final = self.step_dir(step)
             if self.process_index == 0:
@@ -275,8 +286,11 @@ class CheckpointManager:
                 # fast peer's shard write)
                 self._barrier(f"checkpoint_step_{step}_staged")
             os.makedirs(tmp, exist_ok=True)
-            write_state_files(tmp, state, shard_index=self.process_index,
-                              shard_count=self.process_count)
+            with _tracer.span("checkpoint.serialize", cat="checkpoint",
+                              step=int(step)):
+                write_state_files(tmp, state,
+                                  shard_index=self.process_index,
+                                  shard_count=self.process_count)
             t_serialize = time.perf_counter() - t0
             if self._barrier is not None:
                 # every process's shard is durable before the commit
@@ -315,6 +329,7 @@ class CheckpointManager:
                     "queue_seconds": max(0.0, t0 - enq_t),
                     "async": bool(was_async), "t": time.time()})
         finally:
+            commit_span.__exit__(*sys.exc_info())
             self._commit_lock.release()
 
     # ------------------------------------------------------------------

@@ -39,6 +39,7 @@ import numpy as np
 
 from deeplearning4j_tpu.dataset.iterators import DataSetIterator
 from deeplearning4j_tpu.faults.errors import DataPipelineError
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
 
 def _batch_arrays(batch) -> list:
@@ -130,17 +131,18 @@ class RetryingIterator(DataSetIterator):
         at batch index ``skip``. A source that shrank below ``skip``
         between attempts is a pipeline fault, not a clean end-of-pass —
         silent truncation is exactly what this rail exists to prevent."""
-        self.reset()
-        it = iter(self._wrapped)
-        for i in range(skip):
-            try:
-                next(it)
-            except StopIteration:
-                raise DataPipelineError(
-                    f"data source shrank during retry: expected at least "
-                    f"{skip} batches, ended at {i}", batch_index=i,
-                    cause="source_shrank") from None
-        return it
+        with _tracer.span("data.loader_retry", cat="data", skip=skip):
+            self.reset()
+            it = iter(self._wrapped)
+            for i in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    raise DataPipelineError(
+                        f"data source shrank during retry: expected at "
+                        f"least {skip} batches, ended at {i}",
+                        batch_index=i, cause="source_shrank") from None
+            return it
 
     def __iter__(self):
         self.reset()

@@ -34,6 +34,7 @@ to the optional ``stats_storage`` (ui/stats.py) and kept in ``events``.
 from __future__ import annotations
 
 import dataclasses
+import sys
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -44,6 +45,7 @@ from deeplearning4j_tpu.faults.errors import (FaultBudgetExhaustedError,
                                               FaultError,
                                               retryable_errors)
 from deeplearning4j_tpu.faults.iterators import RetryingIterator
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
 
 @dataclasses.dataclass
@@ -136,6 +138,9 @@ class FaultTolerantFit:
 
     def _rollback(self, cause: BaseException):
         t0 = time.perf_counter()
+        rb_span = _tracer.span("faults.rollback", cat="faults",
+                               cause=type(cause).__name__)
+        rb_span.__enter__()
         try:
             self.manager.wait_until_finished(timeout=60.0)
         except Exception:
@@ -145,12 +150,16 @@ class FaultTolerantFit:
         except CheckpointError:
             pass               # a failed async write IS the fault here
         removed = self.manager.gc_uncommitted()
-        res = self._restore_latest()
-        if res is None:
-            raise FaultBudgetExhaustedError(
-                "no committed checkpoint to roll back to",
-                cause="no_checkpoint") from cause
-        step, _state = res
+        try:
+            res = self._restore_latest()
+            if res is None:
+                raise FaultBudgetExhaustedError(
+                    "no committed checkpoint to roll back to",
+                    cause="no_checkpoint") from cause
+            step, _state = res
+            rb_span.set(restored_step=int(step))
+        finally:
+            rb_span.__exit__(*sys.exc_info())
         if self.policy.lr_rescale != 1.0:
             upd = self._tc().updater
             lr = getattr(upd, "learning_rate", None)
@@ -271,7 +280,10 @@ class FaultTolerantFit:
                               backoff_s=round(backoff, 6),
                               resume_step=int(step))
                 if backoff > 0:
-                    self._sleep(backoff)
+                    with _tracer.span("faults.backoff", cat="faults",
+                                      attempt=attempts,
+                                      backoff_s=round(backoff, 6)):
+                        self._sleep(backoff)
         self.manager.wait_until_finished()
         if self.rollbacks:
             self._publish("recovered", rollbacks=self.rollbacks,

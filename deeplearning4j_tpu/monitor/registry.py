@@ -1,0 +1,343 @@
+"""MetricsRegistry — one labeled namespace over every subsystem's signals.
+
+Serving counters (``serving/metrics.ServingMetrics``), the fit tiers'
+dispatch accounting (``sd.last_fit_stats``), checkpoint commit timings,
+fault-rail events and step-time breakdowns each grew up with their own
+record shape. This registry folds them into ONE namespace of labeled
+counters / gauges / histograms so a scrape endpoint, a dashboard, or a
+test can ask "how is this process doing" without knowing five schemas:
+
+    reg = MetricsRegistry()
+    reg.fold_serving(server.metrics)
+    reg.fold_dispatch(sd.last_fit_stats)
+    reg.fold_storage(stats_storage)        # checkpoint/faults/steptime
+    print(reg.to_prometheus_text())        # standard exposition format
+    reg.publish(stats_storage)             # {"type": "metrics"} record
+
+Metric identity is ``name + sorted(labels)``; all operations are
+thread-safe behind one registry lock (recording is dict math — no I/O).
+Naming follows the Prometheus conventions: ``<namespace>_<subsystem>_
+<metric>_<unit>``, counters end in ``_total``, histograms expose
+``_bucket``/``_sum``/``_count`` series.
+
+The reference has no analogue — deeplearning4j-ui charts families
+straight off StatsStorage; the registry is what lets the SAME numbers
+feed StatsStorage records (ui/report.py), a Prometheus scrape, and
+assertions in tests without three collection paths.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+# log-spaced seconds buckets: 100 µs .. 100 s (checkpoint commits and
+# window flushes live at opposite ends of this range)
+_DEFAULT_BUCKETS = tuple(
+    round(b, 6) for e in range(-4, 3) for b in (10.0 ** e, 2.5 * 10.0 ** e,
+                                                5.0 * 10.0 ** e))
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: LabelKey, extra: Sequence[Tuple[str, str]] = ()) -> str:
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+class _Histogram:
+    """Cumulative-bucket histogram (prometheus ``le`` semantics)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)   # + the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                idx = i
+                break
+        self.counts[idx] += 1
+        self.sum += v
+        self.count += 1
+
+
+class _Family:
+    """One metric name: type, help text, per-label-set values."""
+
+    __slots__ = ("name", "kind", "help", "values", "buckets")
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind = kind                  # counter | gauge | histogram
+        self.help = help_
+        self.values: Dict[LabelKey, object] = {}
+        self.buckets = buckets
+
+
+class MetricsRegistry:
+    """Thread-safe labeled counters / gauges / histograms with
+    Prometheus text export and ui/stats publication."""
+
+    def __init__(self, namespace: str = "dl4j"):
+        import weakref
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        # per-storage fold high-water marks: fold_storage() must be
+        # idempotent over a growing storage (a scrape endpoint re-folds
+        # on every scrape; counters would otherwise double-count)
+        self._fold_marks: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+
+    # -- core recording -------------------------------------------------
+    def _family(self, name: str, kind: str, help_: str,
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = _Family(name, kind, help_, buckets)
+        elif fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind}, "
+                f"not {kind}")
+        if help_ and not fam.help:
+            fam.help = help_
+        return fam
+
+    def inc(self, name: str, value: float = 1.0, help: str = "",
+            **labels) -> None:
+        """Add ``value`` to a counter (monotonic; use gauges for
+        levels)."""
+        if value < 0:
+            raise ValueError(f"counter {name!r} cannot decrease")
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "counter", help)
+            fam.values[key] = float(fam.values.get(key, 0.0)) + float(value)
+
+    def set_gauge(self, name: str, value: float, help: str = "",
+                  **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "gauge", help)
+            fam.values[key] = float(value)
+
+    def observe(self, name: str, value: float, help: str = "",
+                buckets: Optional[Sequence[float]] = None,
+                **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "histogram", help,
+                               buckets or _DEFAULT_BUCKETS)
+            h = fam.values.get(key)
+            if h is None:
+                h = fam.values[key] = _Histogram(fam.buckets)
+            h.observe(value)
+
+    # -- readout --------------------------------------------------------
+    def get(self, name: str, **labels):
+        """Current value of a counter/gauge (None if absent)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam.values.get(_label_key(labels))
+
+    def collect(self) -> Dict[str, object]:
+        """Flat ``{"name{label=\"v\"}": value}`` snapshot (histograms
+        contribute ``_sum``/``_count``)."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            for fam in self._families.values():
+                full = f"{self.namespace}_{fam.name}"
+                for key, val in fam.values.items():
+                    if isinstance(val, _Histogram):
+                        out[f"{full}_sum{_fmt_labels(key)}"] = \
+                            round(val.sum, 9)
+                        out[f"{full}_count{_fmt_labels(key)}"] = val.count
+                    else:
+                        out[f"{full}{_fmt_labels(key)}"] = val
+        return out
+
+    def to_prometheus_text(self) -> str:
+        """The Prometheus text exposition format (0.0.4): HELP/TYPE
+        headers + one sample per line, histograms with cumulative
+        ``le`` buckets."""
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                full = f"{self.namespace}_{fam.name}"
+                if fam.help:
+                    lines.append(f"# HELP {full} {_escape(fam.help)}")
+                lines.append(f"# TYPE {full} {fam.kind}")
+                for key in sorted(fam.values):
+                    val = fam.values[key]
+                    if isinstance(val, _Histogram):
+                        cum = 0
+                        for b, c in zip(val.buckets, val.counts):
+                            cum += c
+                            lines.append(
+                                f"{full}_bucket"
+                                f"{_fmt_labels(key, [('le', repr(b))])} "
+                                f"{cum}")
+                        lines.append(
+                            f"{full}_bucket"
+                            f"{_fmt_labels(key, [('le', '+Inf')])} "
+                            f"{val.count}")
+                        lines.append(f"{full}_sum{_fmt_labels(key)} "
+                                     f"{val.sum!r}")
+                        lines.append(f"{full}_count{_fmt_labels(key)} "
+                                     f"{val.count}")
+                    else:
+                        lines.append(f"{full}{_fmt_labels(key)} {val!r}")
+        return "\n".join(lines) + "\n"
+
+    def to_record(self) -> dict:
+        """One ``{"type": "metrics"}`` record in the ui/stats JSON-lines
+        convention (ui/stats.py module docstring)."""
+        return {"type": "metrics", "t": time.time(),
+                "namespace": self.namespace, "metrics": self.collect()}
+
+    def publish(self, storage) -> dict:
+        """Append the current snapshot to a ui.stats.StatsStorage."""
+        rec = self.to_record()
+        storage.put(rec)
+        return rec
+
+    # -- adapters: fold the existing per-subsystem shapes ---------------
+    def fold_serving(self, metrics_or_record) -> None:
+        """Fold a ``serving.ServingMetrics`` (or its ``to_record()``
+        dict / a stored ``{"type": "serving"}`` record) into
+        ``serving_*`` metrics."""
+        rec = metrics_or_record
+        if hasattr(rec, "to_record"):
+            rec = rec.to_record()
+        for name, v in rec.get("counters", {}).items():
+            self.set_gauge(f"serving_{name}_total", v,
+                           help="serving lifetime counter")
+        for cause, n in rec.get("failure_causes", {}).items():
+            self.set_gauge("serving_failures_by_cause_total", n,
+                           help="failed requests by cause", cause=cause)
+        for cause, n in rec.get("timeout_causes", {}).items():
+            self.set_gauge("serving_timeouts_by_cause_total", n,
+                           help="timed-out requests by cause", cause=cause)
+        for lane, summ in rec.get("latency_ms", {}).items():
+            for stat in ("mean", "p50", "p95", "p99", "max"):
+                if stat in summ:
+                    self.set_gauge(
+                        "serving_latency_ms", summ[stat],
+                        help="serving latency summary", lane=lane,
+                        stat=stat)
+        batch = rec.get("batch", {})
+        if batch:
+            self.set_gauge("serving_batch_mean_size",
+                           batch.get("mean_size", 0.0))
+            self.set_gauge("serving_batch_padding_waste_ratio",
+                           batch.get("padding_waste", 0.0))
+
+    def fold_dispatch(self, stats: Optional[dict],
+                      epoch: Optional[int] = None) -> None:
+        """Fold a fit tier's dispatch accounting (``sd.last_fit_stats``
+        or a stored ``{"type": "dispatch"}`` record)."""
+        if not stats:
+            return
+        labels = {"tier": stats.get("tier", "unknown")}
+        for key in ("steps_per_epoch", "dispatches_per_epoch",
+                    "window_compiles", "fused_steps", "accum_steps"):
+            if key in stats:
+                self.set_gauge(f"fit_{key}", stats[key],
+                               help="fit dispatch accounting", **labels)
+        if epoch is not None:
+            self.set_gauge("fit_epoch", epoch, help="last observed epoch")
+
+    def fold_checkpoint(self, record: dict) -> None:
+        """Fold one ``{"type": "checkpoint"}`` commit record."""
+        self.inc("checkpoint_commits_total",
+                 help="committed checkpoints")
+        self.inc("checkpoint_bytes_total", record.get("bytes", 0),
+                 help="bytes committed to checkpoints")
+        for key, metric in (("serialize_seconds", "serialize"),
+                            ("commit_seconds", "commit"),
+                            ("queue_seconds", "queue")):
+            if key in record:
+                self.observe("checkpoint_stage_seconds", record[key],
+                             help="checkpoint stage wall time",
+                             stage=metric)
+        self.set_gauge("checkpoint_last_step", record.get("step", 0))
+
+    def fold_faults(self, events: Iterable[dict]) -> None:
+        """Fold fault-rail events (``{"type": "faults"}`` records or
+        ``FaultTolerantFit.events``)."""
+        for ev in events:
+            self.inc("faults_events_total",
+                     help="fault-rail decisions by event",
+                     event=ev.get("event", "unknown"))
+            if ev.get("event") == "rollback":
+                self.observe("faults_rollback_seconds",
+                             ev.get("overhead_s", 0.0),
+                             help="rollback wall time")
+
+    def fold_steptime(self, record: dict) -> None:
+        """Fold one ``{"type": "steptime"}`` breakdown record
+        (monitor/steptime.py)."""
+        steps = record.get("steps", 0)
+        if not steps:
+            return
+        self.inc("steptime_steps_total", steps, help="attributed steps")
+        for stage in ("data_wait_s", "dispatch_s", "flush_s", "other_s"):
+            if stage in record:
+                self.inc(f"steptime_{stage[:-2]}_seconds_total",
+                         record[stage],
+                         help="per-stage wall time attributed to steps")
+        for stat in ("p50", "p95", "max"):
+            key = f"step_ms_{stat}"
+            if key in record:
+                self.set_gauge("steptime_step_ms", record[key],
+                               help="rolling step-time percentiles",
+                               stat=stat)
+
+    def fold_storage(self, storage) -> None:
+        """Fold everything recognizable a StatsStorage holds (serving /
+        dispatch / checkpoint / faults / steptime records). Incremental
+        per storage: repeated calls fold only records appended since
+        the last call, so re-folding on every scrape is safe. (The
+        record-level adapters above are NOT idempotent for
+        counter-typed metrics — fold each record/event stream once.)"""
+        start = self._fold_marks.get(storage, 0)
+        records = list(storage.records)
+        self._fold_marks[storage] = len(records)
+        for rec in records[start:]:
+            t = rec.get("type")
+            if t == "serving":
+                self.fold_serving(rec)
+            elif t == "dispatch":
+                self.fold_dispatch(rec, epoch=rec.get("epoch"))
+            elif t == "checkpoint":
+                self.fold_checkpoint(rec)
+            elif t == "faults":
+                self.fold_faults([rec])
+            elif t == "steptime":
+                self.fold_steptime(rec)
+
+
+__all__ = ["MetricsRegistry"]

@@ -25,6 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.serving.queue import InferenceRequest, RequestQueue
 
 
@@ -163,9 +164,11 @@ class DynamicBatcher:
             bucket = self.spec.bucket_for(rows)
             # req.x is the per-input list built by submit(); batching is
             # single-input, so the first (only) entry is the feature array
-            features = pad_to_bucket(
-                [np.asarray(r.x[0] if isinstance(r.x, (list, tuple))
-                            else r.x) for r in reqs], bucket)
+            with _tracer.span("serving.pad", cat="serving", rows=rows,
+                              bucket=bucket):
+                features = pad_to_bucket(
+                    [np.asarray(r.x[0] if isinstance(r.x, (list, tuple))
+                                else r.x) for r in reqs], bucket)
         except Exception as e:
             # never strand popped requests: a malformed batch (e.g.
             # mismatched feature widths) fails ITS requests, not the

@@ -32,6 +32,7 @@ from typing import Callable, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.serving.batching import Batch, DynamicBatcher
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.queue import (
@@ -202,7 +203,9 @@ class ParallelInference:
         real = rows if real_rows is None else real_rows
         ph = dict(zip(self._spec.input_names, features))
         t0 = time.perf_counter()
-        with self._exec_lock:
+        with self._exec_lock, \
+                _tracer.span("serving.exec", cat="serving", rows=real,
+                             padding=rows - real):
             if sig not in self._shapes_seen:
                 self._shapes_seen.add(sig)
                 self.metrics.inc("compiles")
@@ -251,16 +254,24 @@ class ParallelInference:
                 return
 
     def _batched_step(self) -> bool:
-        batch = self._batcher.next_batch(poll_timeout=0.05)
-        if batch is None:
-            return False
+        # the span is discarded on an empty poll — an idle server must
+        # not fill the trace ring with 50 ms waits
+        with _tracer.span("serving.batch", cat="serving") as bsp:
+            batch = self._batcher.next_batch(poll_timeout=0.05)
+            if batch is None:
+                bsp.discard()
+                return False
+            bsp.set(rows=batch.rows, bucket=batch.bucket,
+                    requests=len(batch.requests))
         try:
             outs = self._execute([batch.features], real_rows=batch.rows)
         except Exception as e:
             self.metrics.record_failure(e, n=len(batch.requests))
             batch.fail(e)
             return True
-        batch.resolve(outs)
+        with _tracer.span("serving.reply", cat="serving",
+                          requests=len(batch.requests)):
+            batch.resolve(outs)
         done = time.monotonic()
         for req in batch.requests:
             self.metrics.observe_request(
@@ -280,7 +291,8 @@ class ParallelInference:
             self.metrics.record_failure(e)
             req.fail(e)
             return True
-        req.complete(outs)
+        with _tracer.span("serving.reply", cat="serving", requests=1):
+            req.complete(outs)
         done = time.monotonic()
         self.metrics.observe_request(
             queue_wait_ms=(t_pop - req.enqueue_t) * 1000.0,
@@ -318,11 +330,13 @@ class ParallelInference:
         req = InferenceRequest(x=features, future=fut,
                                rows=features[0].shape[0], deadline=deadline,
                                squeeze=squeeze, id=self._next_id())
-        try:
-            self._queue.put(req)
-        except ServerOverloadedError:
-            self.metrics.inc("requests_rejected")
-            raise
+        with _tracer.span("serving.enqueue", cat="serving", id=req.id,
+                          rows=req.rows):
+            try:
+                self._queue.put(req)
+            except ServerOverloadedError:
+                self.metrics.inc("requests_rejected")
+                raise
         return fut
 
     def _inplace(self, features: List[np.ndarray], squeeze: bool) -> Future:

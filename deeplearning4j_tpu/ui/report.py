@@ -54,6 +54,121 @@ def _svg_hist(hist: List[int], edges: List[float], w=220, h=90,
  [{edges[0]:.3g}, {edges[1]:.3g}]</text></svg>"""
 
 
+_STAGE_COLORS = (("data_wait_s", "#1f77b4", "data wait"),
+                 ("dispatch_s", "#ff7f0e", "dispatch"),
+                 ("flush_s", "#2ca02c", "flush"),
+                 ("other_s", "#9467bd", "other"))
+
+
+def _svg_stack(rows: List[dict], w=640, h=200, label="") -> str:
+    """Stacked per-flush bars of the step-time breakdown (one bar per
+    {"type": "steptime"} record, stages stacked bottom-up)."""
+    rows = [r for r in rows if r.get("steps")]
+    if not rows:
+        return f"<p>(no data for {_html.escape(label)})</p>"
+    totals = [sum(r.get(k, 0.0) for k, _, _ in _STAGE_COLORS)
+              for r in rows]
+    mx = max(totals) or 1.0
+    n = len(rows)
+    bw = (w - 60) / n
+    parts = [f'<svg width="{w}" height="{h}" style="background:#fafafa">',
+             f'<text x="5" y="14" font-size="12" fill="#444">'
+             f'{_html.escape(label)}</text>']
+    for i, r in enumerate(rows):
+        y = h - 22
+        for key, color, _ in _STAGE_COLORS:
+            v = r.get(key, 0.0)
+            bh = (h - 45) * v / mx
+            y -= bh
+            parts.append(
+                f'<rect x="{50 + i * bw:.1f}" y="{y:.1f}" '
+                f'width="{max(bw - 1, 1):.1f}" height="{bh:.1f}" '
+                f'fill="{color}"><title>{key[:-2]}: {v:.4f}s</title>'
+                f'</rect>')
+    parts.append(f'<text x="5" y="{h - 26}" font-size="10" fill="#888">'
+                 f'0</text>')
+    parts.append(f'<text x="5" y="30" font-size="10" fill="#888">'
+                 f'{mx:.3g}s</text>')
+    lx = 50
+    for key, color, name in _STAGE_COLORS:
+        parts.append(f'<rect x="{lx}" y="{h - 14}" width="10" height="10" '
+                     f'fill="{color}"/>')
+        parts.append(f'<text x="{lx + 13}" y="{h - 5}" font-size="10" '
+                     f'fill="#444">{name}</text>')
+        lx += 13 + 8 * len(name) + 14
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
+def _span_color(name: str) -> str:
+    # crc32, NOT builtin hash(): the name→color mapping must be stable
+    # across processes (hash() is salted per run; reports rendered from
+    # the same storage twice would recolor every lane)
+    import zlib
+    palette = ("#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+               "#8c564b", "#e377c2", "#17becf", "#bcbd22", "#7f7f7f")
+    return palette[zlib.crc32(name.encode("utf-8")) % len(palette)]
+
+
+def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
+                  max_spans=2000) -> str:
+    """Span-timeline swimlane: one lane per thread, one rect per span
+    (nesting shown by depth shading), hover for name/duration."""
+    spans = [s for s in spans if s.get("dur", 0) > 0][:max_spans]
+    if not spans:
+        return f"<p>(no data for {_html.escape(label)})</p>"
+    t0 = min(s["ts"] for s in spans)
+    t1 = max(s["ts"] + s["dur"] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    lanes: List[int] = []
+    lane_names = {}
+    for s in spans:
+        if s["tid"] not in lanes:
+            lanes.append(s["tid"])
+            lane_names[s["tid"]] = s.get("thread") or str(s["tid"])
+    # nesting depth per span (parent chain within the dump)
+    by_sid = {s.get("sid"): s for s in spans if s.get("sid")}
+    def depth(s):
+        d, p = 0, s.get("parent")
+        while p and p in by_sid and d < 8:
+            d += 1
+            p = by_sid[p].get("parent")
+        return d
+    h = 20 + h_lane * len(lanes) + 16
+    px = lambda t: 120 + (t - t0) / total * (w - 130)
+    parts = [f'<svg width="{w}" height="{h}" style="background:#fafafa">',
+             f'<text x="5" y="14" font-size="12" fill="#444">'
+             f'{_html.escape(label)} ({total:.3f}s)</text>']
+    for li, tid in enumerate(lanes):
+        y = 20 + li * h_lane
+        nm = lane_names[tid][:16]
+        parts.append(f'<text x="5" y="{y + 16}" font-size="10" '
+                     f'fill="#666">{_html.escape(nm)}</text>')
+        parts.append(f'<line x1="120" y1="{y + h_lane - 2}" x2="{w - 10}" '
+                     f'y2="{y + h_lane - 2}" stroke="#eee"/>')
+    for s in spans:
+        li = lanes.index(s["tid"])
+        d = depth(s)
+        y = 20 + li * h_lane + 2 + d * 4
+        x, bw = px(s["ts"]), max(0.6, s["dur"] / total * (w - 130))
+        bh = max(3, h_lane - 8 - d * 4)
+        tip = (f'{s["name"]} {1e3 * s["dur"]:.3f}ms'
+               + (f' {s["args"]}' if s.get("args") else ""))
+        parts.append(
+            f'<rect x="{x:.1f}" y="{y}" width="{bw:.1f}" height="{bh}" '
+            f'fill="{_span_color(s["name"])}" fill-opacity="0.8">'
+            f'<title>{_html.escape(tip)}</title></rect>')
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
+#: record types render_report knows how to draw; everything else lands
+#: in the forward-compatibility footer instead of being dropped
+_KNOWN_TYPES = frozenset({
+    "meta", "score", "perf", "params", "memory", "end", "serving",
+    "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace"})
+
+
 def render_report(storage: StatsStorage, title: str = "Training report"
                   ) -> str:
     scores = storage.of_type("score")
@@ -61,6 +176,12 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     params = storage.of_type("params")
     memory = storage.of_type("memory")
     end = storage.of_type("end")
+    steptime = [r for r in storage.of_type("steptime")
+                if r.get("event") != "straggler"]
+    stragglers = [r for r in storage.of_type("steptime")
+                  if r.get("event") == "straggler"]
+    traces = storage.of_type("trace")
+    metrics = storage.of_type("metrics")
 
     parts = [f"""<!doctype html><html><head><meta charset="utf-8">
 <title>{_html.escape(title)}</title>
@@ -126,6 +247,67 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
             [(r["epoch"], r["peak_bytes"] / 2**20) for r in memory],
             label="HBM peak (MiB)", color="#8c564b"))
         parts.append("</div>")
+
+    # -- observability: step-time breakdown + span timeline --------------
+    if steptime:
+        parts.append("<h2>Step-time breakdown</h2>")
+        parts.append(_svg_stack(
+            steptime, label="wall time per flush (stacked by stage)"))
+        tot = {k: sum(r.get(k, 0.0) for r in steptime)
+               for k, _, _ in _STAGE_COLORS}
+        wall = sum(tot.values()) or 1.0
+        last = steptime[-1]
+        parts.append(
+            "<p>" + ", ".join(
+                f"{k[:-2].replace('_', ' ')} {100 * v / wall:.1f}%"
+                for k, v in tot.items())
+            + f" — step ms p50 {last.get('step_ms_p50', 0):.3f} / "
+              f"p95 {last.get('step_ms_p95', 0):.3f} over "
+              f"{sum(r.get('steps', 0) for r in steptime)} steps</p>")
+    if stragglers:
+        parts.append(f"<h2>Stragglers ({len(stragglers)})</h2><table>"
+                     "<tr><th>iteration</th><th>step (s)</th>"
+                     "<th>EMA (s)</th><th>ratio</th></tr>")
+        for r in stragglers[-20:]:
+            parts.append(
+                f"<tr><td>{r.get('iteration', '?')}</td>"
+                f"<td>{r.get('step_s', 0):.4f}</td>"
+                f"<td>{r.get('ema_s', 0):.4f}</td>"
+                f"<td>{r.get('ratio', 0):.2f}x</td></tr>")
+        parts.append("</table>")
+    if traces:
+        parts.append("<h2>Span timeline</h2>")
+        parts.append(_svg_swimlane(traces[-1].get("spans", []),
+                                   label="trace spans (tail)"))
+
+    # -- observability: unified metrics snapshot -------------------------
+    if metrics:
+        flat = metrics[-1].get("metrics", {})
+        parts.append(f"<h2>Metrics (last snapshot, {len(flat)} series)"
+                     f"</h2><table><tr><th>metric</th><th>value</th>"
+                     f"</tr>")
+        for name in sorted(flat):
+            v = flat[name]
+            vs = f"{v:.6g}" if isinstance(v, float) else str(v)
+            parts.append(f"<tr><td>{_html.escape(str(name))}</td>"
+                         f"<td>{_html.escape(vs)}</td></tr>")
+        parts.append("</table>")
+
+    # -- forward compatibility: record types this renderer predates ------
+    unknown: dict = {}
+    for r in storage.records:
+        t = r.get("type")
+        if t not in _KNOWN_TYPES:
+            key = str(t)
+            unknown[key] = unknown.get(key, 0) + 1
+    if unknown:
+        listing = ", ".join(f"{_html.escape(k)} ({n})"
+                            for k, n in sorted(unknown.items()))
+        parts.append(
+            f"<p style='color:#888;border-top:1px solid #ddd;"
+            f"padding-top:6px'>unrendered record types: {listing} — "
+            f"this report predates them; the records are intact in the "
+            f"storage</p>")
 
     parts.append("</body></html>")
     return "\n".join(parts)

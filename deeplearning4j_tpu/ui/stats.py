@@ -42,6 +42,26 @@ Storage format: JSON-lines, one record per event
         (written by faults/recovery.FaultTolerantFit and
         faults/iterators.RetryingIterator when given a stats storage —
         the recovery rail's observable, docs/fault_tolerance.md)
+    {"type": "metrics", "t": wall, "namespace": "dl4j",
+        "metrics": {"<ns>_<name>{label=\"v\"}": value, ...}}
+        (a monitor/registry.MetricsRegistry snapshot — the unified
+        counters/gauges/histograms namespace, docs/observability.md)
+    {"type": "steptime", "epoch": e, "iteration": i, "windows": n,
+        "steps": n, "wall_s": s, "data_wait_s": s, "dispatch_s": s,
+        "flush_s": s, "other_s": s, "step_ms_p50"/"p95"/"max": ms}
+        and straggler flags {"type": "steptime", "event": "straggler",
+        "step_s": s, "ema_s": s, "ratio": r}
+        (monitor/steptime.MonitorListener's per-flush wall-time
+        attribution — rendered as the report's stacked breakdown)
+    {"type": "trace", "t": wall, "spans_total": n, "spans": [{name,
+        cat, ts, dur, tid, thread, sid, parent, args}]}
+        (a bounded monitor/trace span dump at training end — rendered
+        as the report's swimlane timeline)
+
+Unknown record types must DEGRADE GRACEFULLY in consumers: ui/report
+renders the sections it knows and lists unrecognized types in a footer
+(forward compatibility — an old report reading a new storage must not
+silently drop data).
 """
 from __future__ import annotations
 
@@ -56,36 +76,54 @@ from deeplearning4j_tpu.autodiff.training import Listener
 
 class StatsStorage:
     """In-memory + optional JSONL-file event store (reference:
-    api/storage/StatsStorage.java; FileStatsStorage)."""
+    api/storage/StatsStorage.java; FileStatsStorage).
+
+    ``put`` is thread-safe: the async checkpoint writer, serving worker
+    threads and the window stager all publish concurrently into one
+    storage, so the record append and the JSONL line write happen under
+    a lock (otherwise lines can interleave mid-record and the in-memory
+    list can drop appends on list reallocation)."""
 
     def __init__(self, path: Optional[str] = None):
+        import threading
         self.path = str(path) if path is not None else None
         self.records: List[dict] = []
+        self._lock = threading.Lock()
         self._fh = open(self.path, "a", encoding="utf-8") if self.path \
             else None
 
     def put(self, record: dict) -> None:
-        self.records.append(record)
-        if self._fh is not None:
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
+        with self._lock:
+            self.records.append(record)
+            if self._fh is not None:
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
 
     def of_type(self, rtype: str) -> List[dict]:
-        return [r for r in self.records if r.get("type") == rtype]
+        with self._lock:
+            return [r for r in self.records if r.get("type") == rtype]
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     @staticmethod
-    def load(path: str) -> "StatsStorage":
-        st = StatsStorage()
+    def load(path: str, persist: bool = True) -> "StatsStorage":
+        """Load a JSONL storage from disk. By default the loaded
+        storage KEEPS ``path`` (open in append mode), so subsequent
+        ``put``s continue persisting to the same file — a loaded
+        storage must not silently become memory-only (round-trip
+        tested). Pass ``persist=False`` for a read-only snapshot."""
+        records = []
         with open(path, "r", encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
                 if line:
-                    st.records.append(json.loads(line))
+                    records.append(json.loads(line))
+        st = StatsStorage(path if persist else None)
+        st.records.extend(records)
         return st
 
 

@@ -14,7 +14,8 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            "keras_import_finetune.py", "word2vec_text.py",
            "multi_device_training.py", "moe_expert_parallel.py",
            "early_stopping_holdout.py", "serving_mnist.py",
-           "checkpoint_resume.py", "self_healing_fit.py"]
+           "checkpoint_resume.py", "self_healing_fit.py",
+           "observability_demo.py"]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)

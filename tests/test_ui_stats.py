@@ -86,6 +86,53 @@ class TestStatsPipeline:
         html = render_report(StatsStorage())
         assert "no data" in html
 
+    def test_concurrent_writers_do_not_tear(self, tmp_path):
+        """ISSUE-5 satellite: the async checkpoint writer, serving
+        workers and the window stager publish concurrently — records
+        must not drop and JSONL lines must not interleave."""
+        import threading
+        path = str(tmp_path / "concurrent.jsonl")
+        st = StatsStorage(path)
+        n_threads, n_puts = 8, 250
+
+        def writer(tid):
+            for i in range(n_puts):
+                st.put({"type": "x", "writer": tid, "i": i,
+                        "pad": "p" * 50})
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st.close()
+        assert len(st.records) == n_threads * n_puts
+        lines = [l for l in open(path, encoding="utf-8") if l.strip()]
+        assert len(lines) == n_threads * n_puts
+        seen = set()
+        for line in lines:
+            rec = json.loads(line)          # a torn line would not parse
+            assert rec["pad"] == "p" * 50
+            seen.add((rec["writer"], rec["i"]))
+        assert len(seen) == n_threads * n_puts   # no record lost
+
+    def test_load_keeps_persisting(self, tmp_path):
+        """ISSUE-5 satellite: a loaded storage must keep appending to
+        its source file — load() used to drop the path, silently
+        turning persistence off after a restart."""
+        path = str(tmp_path / "s.jsonl")
+        st = StatsStorage(path)
+        st.put({"type": "score", "iter": 0, "loss": 1.0})
+        st.close()
+        loaded = StatsStorage.load(path)
+        assert loaded.path == path
+        loaded.put({"type": "score", "iter": 1, "loss": 0.5})
+        loaded.close()
+        again = StatsStorage.load(path, persist=False)
+        assert again.path is None           # explicit read-only opt-out
+        assert [r["iter"] for r in again.of_type("score")] == [0, 1]
+
 
 class TestZooModelReport:
     def test_lenet_training_produces_browsable_report(self, tmp_path):
