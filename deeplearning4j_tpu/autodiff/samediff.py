@@ -31,6 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
+from deeplearning4j_tpu.compilecache.aot import (AOTDispatch,
+                                                 AOTOutput as _AOTOutput,
+                                                 ph_shape_sig)
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.ndarray.dtype import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
@@ -549,6 +552,15 @@ class SameDiff:
         return tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in placeholders.items()))
 
+    def _output_cache_key(self, out_names, ph):
+        """The execution-cache key for an inference program — shared by
+        output() and precompile_output() so an AOT executable installed
+        by serving warmup is found by the exact lazy lookup (a drift
+        between the two would silently reintroduce the first-request
+        compile warmup exists to kill)."""
+        return ("output", self._version, tuple(out_names),
+                self._ph_sig(ph))
+
     def _prep_placeholders(self, placeholders) -> Dict[str, jax.Array]:
         out = {}
         for k, v in (placeholders or {}).items():
@@ -566,7 +578,7 @@ class SameDiff:
         out_names = tuple(o.name if isinstance(o, SDVariable) else o
                           for o in outputs)
         ph = self._prep_placeholders(placeholders)
-        cache_key = ("output", self._version, out_names, self._ph_sig(ph))
+        cache_key = self._output_cache_key(out_names, ph)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             fn = self._trace_fn(out_names)
@@ -791,6 +803,18 @@ class SameDiff:
         else:
             loss_scale = None
             _cast = None
+        # CE-tail precision policy (MixedPrecision.softmax_dtype): the
+        # scope is consulted by the loss ops at TRACE time, so it wraps
+        # the graph fn's execution inside loss_fn below
+        _ce_dt = getattr(mp, "softmax_dtype", None) if mp is not None \
+            else None
+
+        def _ce_scope():
+            if _ce_dt is None:
+                import contextlib
+                return contextlib.nullcontext()
+            from deeplearning4j_tpu.ops.loss import softmax_dtype_scope
+            return softmax_dtype_scope(_ce_dt)
 
         def grad_fn(params, svars, iteration, constants, phv, base_key):
             # per-step key derived ON DEVICE (a host-side jax.random.key per
@@ -798,16 +822,19 @@ class SameDiff:
             key = jax.random.fold_in(base_key, iteration)
 
             def loss_fn(p):
-                if _cast is not None:
-                    # bf16 compute: params/inputs/constants cast at the top
-                    # of the trace (XLA fuses the casts); state vars (BN
-                    # running stats) stay f32 — the norm ops keep their
-                    # statistics math in f32 and emit x-dtype activations
-                    outs = fn({**_cast(p), **jax.lax.stop_gradient(svars)},
-                              _cast(constants), _cast(phv), key)
-                else:
-                    outs = fn({**p, **jax.lax.stop_gradient(svars)},
-                              constants, phv, key)
+                with _ce_scope():
+                    if _cast is not None:
+                        # bf16 compute: params/inputs/constants cast at
+                        # the top of the trace (XLA fuses the casts);
+                        # state vars (BN running stats) stay f32 — the
+                        # norm ops keep their statistics math in f32 and
+                        # emit x-dtype activations
+                        outs = fn({**_cast(p),
+                                   **jax.lax.stop_gradient(svars)},
+                                  _cast(constants), _cast(phv), key)
+                    else:
+                        outs = fn({**p, **jax.lax.stop_gradient(svars)},
+                                  constants, phv, key)
                 loss = sum(jnp.sum(outs[ln]).astype(jnp.float32)
                            for ln in loss_names)
                 if loss_scale is not None:
@@ -890,8 +917,10 @@ class SameDiff:
         if compiled is None:
             self._verbose_log(f"compiling train step (graph v{self._version}, "
                               f"{len(self._ops)} ops, donate={donate})")
-            compiled = jax.jit(step_body,
-                               donate_argnums=(0, 1, 2, 3) if donate else ())
+            compiled = AOTDispatch(
+                jax.jit(step_body,
+                        donate_argnums=(0, 1, 2, 3) if donate else ()),
+                ph_arg=5)
             self._fn_cache[cache_key] = compiled
         return compiled
 
@@ -1066,9 +1095,231 @@ class SameDiff:
             self._verbose_log(
                 f"compiling fused-window step (graph v{self._version}, "
                 f"accum_steps={accum_steps}, donate={donate})")
-            compiled = jax.jit(window_fn,
-                               donate_argnums=donate_args if donate else ())
+            compiled = AOTDispatch(
+                jax.jit(window_fn,
+                        donate_argnums=donate_args if donate else ()),
+                ph_arg=6 if accum_steps > 1 else 5)
             self._fn_cache[cache_key] = compiled
+        return compiled
+
+    # ------------------------------------------------------------------
+    # AOT precompilation (compilecache/ — docs/cold_start.md)
+    def _placeholder_specs(self, names=None, batch_size=None,
+                           batch_shapes=None) -> Dict[str, Any]:
+        """Abstract ``ShapeDtypeStruct``s for placeholders: declared
+        shapes with ``-1`` batch dims resolved from ``batch_size``, or
+        overridden wholesale per name via ``batch_shapes``."""
+        specs = {}
+        for pn in (names if names else self.placeholders()):
+            v = self._vars[pn]
+            shape = v._shape
+            if batch_shapes and pn in batch_shapes:
+                shape = tuple(int(d) for d in batch_shapes[pn])
+            if shape is None:
+                raise ValueError(
+                    f"placeholder {pn!r} has no declared shape; pass "
+                    f"batch_shapes={{{pn!r}: (...)}} to precompile")
+            if any(d == -1 for d in shape):
+                if batch_size is None:
+                    raise ValueError(
+                        f"placeholder {pn!r} has batch dims {shape}; pass "
+                        f"batch_size= (or batch_shapes=) to precompile")
+                shape = tuple(int(batch_size) if d == -1 else int(d)
+                              for d in shape)
+            specs[pn] = jax.ShapeDtypeStruct(
+                tuple(shape), DataType.from_any(v.dtype).jnp)
+        return specs
+
+    def precompile(self, batch_size: Optional[int] = None,
+                   batch_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                   epoch_steps: Optional[int] = None,
+                   tiers: Optional[Sequence[str]] = None) -> dict:
+        """AOT-compile the training programs from ABSTRACT shapes, before
+        the first batch exists — ``fit()`` then dispatches straight into
+        the prebuilt executables instead of paying XLA inside its first
+        window (compilecache/, docs/cold_start.md).
+
+        What gets built follows ``training_config``: with
+        ``fused_steps``/``accum_steps`` > 1 the fused-window fn at the
+        full window length K **plus every pow2 ragged-tail bucket**
+        (all powers of two ≤ K-1 — the complete set the window executor
+        can ever dispatch for full-size batches; log2(K)+1 shapes for a
+        pow2 K); otherwise the per-step
+        train fn, plus — when ``epoch_steps`` is given — the scanned
+        whole-epoch fn. Placeholder batch dims resolve from
+        ``batch_size``/``batch_shapes``. With a persistent compilation
+        cache configured (``Environment compilation_cache_dir``), the
+        builds themselves become cache hits on a warm restart, so
+        restart-to-first-step approaches data-loading time.
+
+        Returns a summary dict (targets built/reused, wall seconds, and
+        the process-wide backend-compile / cache-hit / cache-miss deltas
+        this call produced). Precompiled executables live in the same
+        version-keyed cache as lazy compiles: any graph mutation
+        invalidates them, and unpredicted shapes (a ragged final BATCH)
+        still compile lazily exactly as before — outputs are
+        bit-identical either way (tests/test_cold_start.py).
+        """
+        import time as _time
+        from deeplearning4j_tpu.compilecache import (COMPILE_STATS,
+                                                     install_compile_watcher)
+        from deeplearning4j_tpu.environment import environment
+        tc = self.training_config
+        if tc is None:
+            raise ValueError("precompile() needs sd.training_config "
+                             "(use precompile_output() for inference "
+                             "graphs)")
+        environment().apply_compilation_cache()
+        install_compile_watcher()
+        K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
+        A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
+        sentinel = bool(getattr(tc, "sentinel", False))
+        names = list(tc.data_set_feature_mapping) + \
+            list(tc.data_set_label_mapping)
+        ph = self._placeholder_specs(names or None, batch_size,
+                                     batch_shapes)
+        if tiers is None:
+            tiers = ["window"] if (K > 1 or A > 1) else ["step"]
+            if epoch_steps and K <= 1 and A <= 1:
+                tiers.append("epoch")
+        params_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for n, a in self.trainable_params().items()}
+        svars_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                     for n, a in self.state_vars_map().items()}
+        consts_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for n, a in self.constants_map().items()}
+        state_abs = jax.eval_shape(tc.updater.init, params_abs)
+        it_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        key = jax.random.key(0)   # concrete — only its aval reaches lower()
+
+        mark = COMPILE_STATS.mark()
+        t0 = _time.perf_counter()
+        built = reused = 0
+
+        def _build(disp, args, sig, label, seen=None):
+            nonlocal built, reused
+            if sig in disp.aot:
+                reused += 1
+                return
+            with _tracer.span("compile.precompile", cat="compile",
+                              target=label):
+                disp.aot[sig] = disp.lower(*args).compile()
+            if seen is not None:
+                # pre-register the trace signature so the window
+                # executor's compile accounting reports 0 for shapes
+                # precompiled here
+                seen.add(sig)
+            built += 1
+            self._verbose_log(f"precompiled {label}")
+
+        def _window_args(k, with_accum):
+            sphv = {n: jax.ShapeDtypeStruct((k,) + tuple(s.shape), s.dtype)
+                    for n, s in ph.items()}
+            base = (params_abs, svars_abs, state_abs)
+            if with_accum:
+                base = base + (params_abs,)   # accum carry ≅ zeros_like
+            return base + (it_abs, consts_abs, sphv, key), \
+                ph_shape_sig(sphv)
+
+        # donation is NOT a parameter here: fit() always builds its
+        # dispatchers with the donate=True default, and the _fn_cache
+        # key includes donate — a divergent value would AOT-compile
+        # executables fit() never consults (silently useless work)
+        if "step" in tiers:
+            disp = self.make_train_step(sentinel=sentinel)
+            _build(disp, (params_abs, svars_abs, state_abs, it_abs,
+                          consts_abs, ph, key),
+                   ph_shape_sig(ph), "train_step")
+        if "window" in tiers:
+            disp = self.make_train_window(accum_steps=A, sentinel=sentinel)
+            from deeplearning4j_tpu.autodiff.window import window_trace_set
+            seen = window_trace_set(self, A, sentinel)
+            # every pow2 the tail decomposition can emit: a ragged tail
+            # of r < K steps uses buckets up to the largest pow2 ≤ r,
+            # so cover all powers of two ≤ K-1 (for pow2 K this is the
+            # log2(K)+1-shape set; a non-pow2 K needs one more)
+            sizes = {K} | {1 << i for i in range((K - 1).bit_length())}
+            for k in sorted(sizes, reverse=True):
+                args, sig = _window_args(k, with_accum=A > 1)
+                _build(disp, args, sig, f"window_k{k}", seen=seen)
+        if "epoch" in tiers:
+            if not epoch_steps:
+                raise ValueError("the scanned-epoch tier needs "
+                                 "epoch_steps= (batches per epoch)")
+            unroll = int(getattr(tc, "scan_unroll", 1) or 1)
+            disp = self.make_train_epoch(unroll=unroll, sentinel=sentinel)
+            args, sig = _window_args(int(epoch_steps), with_accum=False)
+            _build(disp, args, sig, f"epoch_{epoch_steps}")
+        delta = COMPILE_STATS.delta(mark)
+        info = {"compiled": built, "reused": reused,
+                "seconds": round(_time.perf_counter() - t0, 4),
+                "backend_compiles": delta["backend_compiles"],
+                "cache_hits": delta["cache_hits"],
+                "cache_misses": delta["cache_misses"]}
+        # remembered so FaultTolerantFit can re-AOT after a retrace
+        # (lr_rescale) instead of paying the compile inside the first
+        # retry window (faults/recovery.py)
+        self._precompile_spec = {"batch_size": batch_size,
+                                 "batch_shapes": batch_shapes,
+                                 "epoch_steps": epoch_steps,
+                                 "tiers": tuple(tiers)}
+        self.last_precompile = info
+        self._verbose_log(f"precompile: {info}")
+        return info
+
+    def precompile_output(self, placeholders, outputs=None):
+        """AOT-compile an inference program for the given placeholder
+        shapes (``{name: shape tuple | ShapeDtypeStruct | array}``) and
+        install it in the execution cache, so the matching ``output()``
+        call runs without compiling — the serving warmup path
+        (``ParallelInference(warmup_buckets=...)``). Idempotent per
+        shape set; bit-identical to the lazily-compiled path."""
+        from deeplearning4j_tpu.compilecache import install_compile_watcher
+        from deeplearning4j_tpu.environment import environment
+        environment().apply_compilation_cache()
+        install_compile_watcher()
+        if outputs is None:
+            outputs = self.outputs()
+        out_names = tuple(o.name if isinstance(o, SDVariable) else o
+                          for o in outputs)
+        ph_specs = {}
+        for k, v in placeholders.items():
+            name = k.name if isinstance(k, SDVariable) else k
+            shape = tuple(int(d) for d in
+                          (v.shape if hasattr(v, "shape") else v))
+            # dtype from the DECLARED placeholder — the dtype
+            # _prep_placeholders casts live inputs to — NOT from a
+            # sample array: a float64 numpy sample would install the
+            # executable under a cache key output()'s float32-cast
+            # lookup never finds (warmup compiles, first request
+            # compiles AGAIN)
+            var = self._vars.get(name)
+            if var is not None and var.dtype is not None:
+                dt = DataType.from_any(var.dtype).jnp
+            elif hasattr(v, "dtype"):
+                dt = v.dtype
+            else:
+                raise KeyError(f"unknown placeholder {name!r} and no "
+                               f"dtype on its sample value")
+            ph_specs[name] = jax.ShapeDtypeStruct(shape, dt)
+        cache_key = self._output_cache_key(out_names, ph_specs)
+        existing = self._fn_cache.get(cache_key)
+        if isinstance(existing, _AOTOutput):
+            return existing       # already an AOT executable
+        fn = self._trace_fn(out_names)
+        params_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for n, a in {**self.trainable_params(),
+                                   **self.state_vars_map()}.items()}
+        consts_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for n, a in self.constants_map().items()}
+        jit_fn = jax.jit(fn)
+        with _tracer.span("compile.precompile", cat="compile",
+                          target="output"):
+            compiled = _AOTOutput(
+                jit_fn,
+                jit_fn.lower(params_abs, consts_abs, ph_specs,
+                             jax.random.key(0)).compile())
+        self._fn_cache[cache_key] = compiled
         return compiled
 
     def fit(self, dataset_iterator, epochs: int = 1, listeners=()):

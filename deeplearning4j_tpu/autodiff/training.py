@@ -42,20 +42,52 @@ class MixedPrecision:
     updater, and loss-sensitive reductions (loss ops, BN statistics)
     stay float32 internally. ``loss_scale`` is optional static loss
     scaling (rarely needed with bf16 — same exponent range as f32).
+
+    ``softmax_dtype`` (alias ``ce_tail_dtype``) relaxes the one upcast
+    that dominates LM steps: by default the softmax-CE losses run their
+    log-softmax tail in f32 even under bf16 compute, which on a 32k
+    vocab materializes the largest f32 tensor in the step (PROFILE.md
+    round 5 names it the top delta to hand-written JAX). Setting
+    ``softmax_dtype="bfloat16"`` keeps that [batch..., vocab] tail in
+    bf16 — the per-example losses still reduce to the scalar loss in
+    f32, so the training signal accumulates at full precision. Default
+    ``None`` preserves the f32 tail bit-exactly
+    (docs/training_performance.md).
     """
     compute_dtype: str = "bfloat16"
     loss_scale: Optional[float] = None
+    softmax_dtype: Optional[str] = None
+    ce_tail_dtype: dataclasses.InitVar[Optional[str]] = None
+
+    def __post_init__(self, ce_tail_dtype: Optional[str]) -> None:
+        if ce_tail_dtype is not None:
+            if (self.softmax_dtype is not None
+                    and self.softmax_dtype != ce_tail_dtype):
+                raise ValueError(
+                    f"softmax_dtype={self.softmax_dtype!r} and its alias "
+                    f"ce_tail_dtype={ce_tail_dtype!r} disagree — pass one")
+            self.softmax_dtype = ce_tail_dtype
 
     def to_json(self) -> dict:
         return {"compute_dtype": self.compute_dtype,
-                "loss_scale": self.loss_scale}
+                "loss_scale": self.loss_scale,
+                "softmax_dtype": self.softmax_dtype}
 
     @staticmethod
     def from_json(d) -> "Optional[MixedPrecision]":
         if d is None:
             return None
         return MixedPrecision(compute_dtype=d.get("compute_dtype", "bfloat16"),
-                              loss_scale=d.get("loss_scale"))
+                              loss_scale=d.get("loss_scale"),
+                              softmax_dtype=d.get("softmax_dtype",
+                                                  d.get("ce_tail_dtype")))
+
+
+# ce_tail_dtype is BOTH a constructor alias (the InitVar above) and a
+# read alias of softmax_dtype; the property is attached after class
+# creation because defining it in the body would shadow the InitVar's
+# class-attribute default and feed the property object to __post_init__
+MixedPrecision.ce_tail_dtype = property(lambda self: self.softmax_dtype)
 
 
 @dataclasses.dataclass

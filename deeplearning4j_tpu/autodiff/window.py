@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.compilecache.aot import ph_shape_sig
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
 
@@ -193,6 +194,19 @@ class WindowStager:
         self._thread.join(timeout=5)
 
 
+def window_trace_set(sd, accum_steps: int, sentinel: bool) -> set:
+    """The per-(graph version, accum, sentinel) set of window trace
+    signatures already compiled. This is the ONE key construction,
+    shared by the executor's compile accounting below and
+    ``SameDiff.precompile()``'s pre-registration — if the key shape
+    changed in only one place, precompiled sigs would land in a set fit
+    never reads and ``window_compiles`` would silently report nonzero
+    after a precompile (the same drift ``ph_shape_sig`` was unified to
+    prevent for the signature itself)."""
+    return sd.__dict__.setdefault("_window_traces", {}) \
+        .setdefault((sd._version, accum_steps, sentinel), set())
+
+
 def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     """The fused-window fit tier (``TrainingConfig.fused_steps`` /
     ``accum_steps``). Called by ``SameDiff.fit`` — see its docstring for
@@ -250,8 +264,7 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                                for l in listeners)
     # compiled window lengths (jit retraces per leading-dim K): tracked
     # per (graph version, accum) so stats report real compile counts
-    seen_sizes = sd.__dict__.setdefault("_window_traces", {}) \
-        .setdefault((sd._version, A, use_sentinel), set())
+    seen_sizes = window_trace_set(sd, A, use_sentinel)
 
     def _name_batch(batch):
         if isinstance(batch, dict):
@@ -266,11 +279,23 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         return ph
 
     window_sharding = getattr(dataset_iterator, "window_sharding", None)
+    # sharding specs are a pure function of rank: build each ONCE here
+    # (stager setup) instead of per window per tensor — at post-fusion
+    # window times the repeated PartitionSpec/NamedSharding construction
+    # was measurable host work between dispatches (monitor/ steptime
+    # attributes it to data_wait)
+    _sharding_by_rank: Dict[int, object] = {}
+
+    def _window_spec(ndim):
+        spec = _sharding_by_rank.get(ndim)
+        if spec is None:
+            spec = _sharding_by_rank[ndim] = window_sharding(ndim)
+        return spec
 
     def _finalize(stacked):
         ph = sd._prep_placeholders(stacked)
         if window_sharding is not None:
-            ph = {k: jax.device_put(v, window_sharding(v.ndim))
+            ph = {k: jax.device_put(v, _window_spec(v.ndim))
                   for k, v in ph.items()}
         return ph
 
@@ -410,9 +435,10 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                             l.batch_size = next(iter(win.values())).shape[1]
                     # jit retraces per full placeholder shape set (a
                     # ragged final BATCH recompiles even at an
-                    # already-seen k)
-                    trace_sig = tuple(sorted((n, tuple(v.shape))
-                                             for n, v in win.items()))
+                    # already-seen k); the signature is the same key
+                    # AOT dispatch uses, so shapes prebuilt by
+                    # sd.precompile() count as already-seen
+                    trace_sig = ph_shape_sig(win)
                     if trace_sig not in seen_sizes:
                         seen_sizes.add(trace_sig)
                         compiles += 1

@@ -1,0 +1,247 @@
+"""Persistent compilation cache wiring + process-wide compile accounting.
+
+The reference JVM stack has no analogue: DL4J pays per-op JNI dispatch
+and never compiles, so a restarted server is as fast as a warm one.
+Under whole-graph XLA compilation the FIRST execution of every distinct
+program shape pays seconds of compiler time — a production restart
+replays all of it, and a serving process compiles each batch bucket on
+the first live request that needs it. JAX ships the fix (a persistent,
+content-addressed on-disk executable cache) but it is opt-in and
+invisible; this module makes it a wired, observable part of the runtime:
+
+- :func:`configure_cache` applies the cache directory and admission
+  knobs to the LIVE process through ``jax.config`` (the
+  ``Environment`` property ``compilation_cache_dir`` routes here, so
+  ``Environment.set()`` after import actually works — previously the
+  property was declared startup-only and a late ``set()`` silently did
+  nothing).
+- :class:`CompileStats` (singleton :data:`COMPILE_STATS`) counts every
+  compile in the process via ``jax.monitoring`` events and splits them
+  into persistent-cache HITS (cheap deserialization) vs MISSES (real
+  backend compiles), with cumulative wall time per phase. Tests and the
+  ``cold_start`` bench assert against deltas of these counters;
+  ``MetricsRegistry.fold_compile`` exports them as ``dl4j_compile_*``.
+- Each compile phase also lands in the monitor/ tracer ring as a
+  synthetic span — ``compile.trace`` (jaxpr tracing), ``compile.lower``
+  (StableHLO emission), ``compile.backend`` (XLA compile OR cache
+  retrieval, with a ``cache_hit`` arg) — so a Perfetto trace of a cold
+  start shows exactly where the seconds went.
+
+What is cacheable: the persistent cache keys on the serialized HLO +
+compile options + backend/runtime version, so entries survive process
+restarts and machine reboots but NOT jax/jaxlib/libtpu upgrades (the
+key changes and the entry is recompiled — stale entries are harmless
+disk). Donation, sharding and remat structure are all part of the HLO,
+so they cache fine. See docs/cold_start.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
+
+_STAT_KEYS = ("backend_compiles", "cache_hits", "cache_misses",
+              "backend_compile_seconds", "trace_seconds", "lower_seconds",
+              "saved_seconds")
+
+
+class CompileStats:
+    """Process-wide XLA compile counters fed by ``jax.monitoring``.
+
+    ``backend_compiles`` counts every compile request that reached the
+    backend-compile layer — on a persistent-cache HIT that layer only
+    deserializes, so the number of *expensive* compiles is
+    ``miss_compiles()`` (= ``backend_compiles - cache_hits``; with the
+    cache disabled no hit/miss events fire and every backend compile is
+    a real one).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.backend_compiles = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.backend_compile_seconds = 0.0
+        self.trace_seconds = 0.0
+        self.lower_seconds = 0.0
+        self.saved_seconds = 0.0    # compile time the cache saved (jax est.)
+
+    # -- recording (called from jax.monitoring listeners) ---------------
+    def _add(self, **fields) -> None:
+        with self._lock:
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    # -- readout ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: getattr(self, k) for k in _STAT_KEYS}
+
+    # a mark IS a snapshot; the split exists so call sites read as
+    # mark()/delta() bracketing, like Tracer.mark()/drain()
+    mark = snapshot
+
+    def delta(self, mark: Dict[str, float]) -> Dict[str, float]:
+        """Counters accumulated since ``mark`` (a prior snapshot)."""
+        now = self.snapshot()
+        out = {k: now[k] - mark.get(k, 0) for k in _STAT_KEYS}
+        for k in ("backend_compiles", "cache_hits", "cache_misses"):
+            out[k] = int(out[k])
+        return out
+
+    def miss_compiles(self) -> int:
+        """Expensive (non-cache-hit) compiles so far."""
+        with self._lock:
+            return max(0, self.backend_compiles - self.cache_hits)
+
+    def to_record(self) -> dict:
+        """One ``{"type": "compile"}`` record in the ui/stats JSON-lines
+        convention (rendered by ui/report.py, folded by
+        ``MetricsRegistry.fold_compile``)."""
+        snap = self.snapshot()
+        snap["miss_compiles"] = max(0, snap["backend_compiles"]
+                                    - snap["cache_hits"])
+        return {"type": "compile", "t": time.time(), **snap}
+
+    def publish(self, storage) -> dict:
+        rec = self.to_record()
+        storage.put(rec)
+        return rec
+
+
+#: The process-wide instance every listener records into.
+COMPILE_STATS = CompileStats()
+
+_install_lock = threading.Lock()
+_installed = False
+_install_failed = False
+_tls = threading.local()
+
+
+def _on_event(event: str, **kw) -> None:
+    if event.endswith("/compilation_cache/cache_hits"):
+        COMPILE_STATS._add(cache_hits=1)
+        # the matching backend_compile duration event (which fires for
+        # hits too — it wraps retrieval) marks its span via this flag;
+        # compiles are synchronous on the calling thread, so
+        # thread-local pairing is race-free
+        _tls.pending_hit = True
+    elif event.endswith("/compilation_cache/cache_misses"):
+        COMPILE_STATS._add(cache_misses=1)
+        # a hit whose backend_compile duration event never arrived
+        # (aborted compile) must not mislabel THIS compile as a hit
+        _tls.pending_hit = False
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if event.endswith("backend_compile_duration") or \
+            event.endswith("backend_compile_time_sec"):
+        hit = bool(getattr(_tls, "pending_hit", False))
+        _tls.pending_hit = False
+        COMPILE_STATS._add(backend_compiles=1,
+                           backend_compile_seconds=float(duration))
+        _tracer.record_completed("compile.backend", cat="compile",
+                                 dur=float(duration), cache_hit=hit)
+    elif event.endswith("jaxpr_trace_duration"):
+        COMPILE_STATS._add(trace_seconds=float(duration))
+        _tracer.record_completed("compile.trace", cat="compile",
+                                 dur=float(duration))
+    elif event.endswith("jaxpr_to_mlir_module_duration"):
+        COMPILE_STATS._add(lower_seconds=float(duration))
+        _tracer.record_completed("compile.lower", cat="compile",
+                                 dur=float(duration))
+    elif event.endswith("compile_time_saved_sec"):
+        # jax reports compile_time - retrieval_time; can be negative for
+        # programs that compile faster than they deserialize
+        COMPILE_STATS._add(saved_seconds=float(duration))
+
+
+def install_compile_watcher() -> CompileStats:
+    """Register the ``jax.monitoring`` listeners feeding
+    :data:`COMPILE_STATS` (idempotent; listeners are process-lifetime).
+    Called automatically by cache configuration, ``precompile()`` and
+    serving warmup — call it directly only to observe purely-lazy
+    compilation."""
+    global _installed, _install_failed
+    with _install_lock:
+        if _installed or _install_failed:
+            return COMPILE_STATS
+        try:
+            from jax import monitoring as _mon
+            _mon.register_event_listener(_on_event)
+            _mon.register_event_duration_secs_listener(_on_duration)
+            _installed = True
+        except Exception as exc:
+            # an all-zero COMPILE_STATS is indistinguishable from a
+            # perfectly warm cache downstream (bench warm_cache_hits,
+            # ui/report's Compilation section) — warn ONCE instead of
+            # silently reporting success-shaped zeros
+            _install_failed = True
+            import warnings
+            warnings.warn(
+                f"compile-watcher registration failed ({exc!r}); "
+                f"compile accounting is disabled and COMPILE_STATS "
+                f"will read zero", stacklevel=2)
+    return COMPILE_STATS
+
+
+def configure_cache(cache_dir: Optional[str],
+                    min_entry_size: Optional[int] = None,
+                    min_compile_time: Optional[float] = None) -> None:
+    """Apply persistent-cache settings to the LIVE jax process.
+
+    ``cache_dir=None``/``""`` disables the cache. ``min_entry_size``
+    (bytes; -1 = cache everything) and ``min_compile_time`` (seconds;
+    0 = cache everything) gate which executables are worth persisting —
+    production defaults skip sub-second compiles, tests set both to the
+    cache-everything values. Installs the compile watcher whenever a
+    cache is enabled, so hit/miss accounting is always live alongside.
+    """
+    import jax
+    target = cache_dir or None
+    dir_changed = jax.config.jax_compilation_cache_dir != target
+    jax.config.update("jax_compilation_cache_dir", target)
+    if min_entry_size is not None:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          int(min_entry_size))
+    if min_compile_time is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time))
+    if dir_changed:
+        try:
+            # jax initializes its cache object AT MOST ONCE, on the
+            # first compile — if anything compiled before this call
+            # (importing the framework compiles a few eager helpers),
+            # the cache latched "disabled" and the config update above
+            # would silently never take effect. Reset to pristine so the
+            # next compile re-reads the config — this is what makes a
+            # LATE set() actually work. Skipped when the dir is already
+            # the live value (the admission knobs are read per-put), so
+            # repeated applies — serving warmup calls this once per
+            # bucket — don't tear down and re-create the cache backend.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception as exc:
+            # without the reset, a cache object latched "disabled" by a
+            # pre-config compile stays disabled — the exact late-set()
+            # bug this module exists to fix — so say so instead of
+            # silently recompiling everything on every restart
+            import warnings
+            warnings.warn(
+                f"compilation-cache reset failed ({exc!r}); if anything "
+                f"compiled before this call the persistent cache may "
+                f"remain disabled for this process", stacklevel=2)
+    if cache_dir:
+        install_compile_watcher()
+
+
+def cache_dir() -> Optional[str]:
+    """The live process's persistent cache directory (None = disabled)."""
+    import jax
+    return jax.config.jax_compilation_cache_dir
+
+
+__all__ = ["CompileStats", "COMPILE_STATS", "install_compile_watcher",
+           "configure_cache", "cache_dir"]

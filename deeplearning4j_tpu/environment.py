@@ -72,13 +72,35 @@ PROPERTIES: Dict[str, PropertySpec] = {
     "compilation_cache_dir": PropertySpec(
         "JAX_COMPILATION_CACHE_DIR", str, "",
         "Persistent XLA compilation cache directory (first-compile "
-        "latency amortization across processes).", startup_only=True),
+        "latency amortization across process restarts). Applied LIVE "
+        "through jax.config — set() works after import, '' disables "
+        "(docs/cold_start.md)."),
+    "compilation_cache_min_entry_size": PropertySpec(
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", int, 0,
+        "Smallest executable (bytes) worth persisting to the "
+        "compilation cache; -1 caches everything. Applied live."),
+    "compilation_cache_min_compile_time": PropertySpec(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", float, 1.0,
+        "Shortest compile (seconds) worth persisting to the "
+        "compilation cache; 0 caches everything. Applied live."),
     "host_device_count": PropertySpec(
         "DL4J_TPU_HOST_DEVICES", int, 0,
         "Virtual CPU device count for mesh testing (0 = leave XLA_FLAGS "
         "alone); mirrors --xla_force_host_platform_device_count.",
         startup_only=True),
 }
+
+
+# properties whose set()/reset() must touch live jax.config state
+_SIDE_EFFECT_PROPS = ("log_compiles", "compilation_cache_dir",
+                      "compilation_cache_min_entry_size",
+                      "compilation_cache_min_compile_time")
+
+# cache properties additionally export their env var on set() so child
+# processes (bench probes, multihost workers) inherit the cache
+_CACHE_PROPS = ("compilation_cache_dir",
+                "compilation_cache_min_entry_size",
+                "compilation_cache_min_compile_time")
 
 
 class Environment:
@@ -118,32 +140,47 @@ class Environment:
         except (TypeError, ValueError):
             return spec.default
 
-    def set(self, name: str, value) -> "Environment":
+    def set(self, name: str, value, for_restart: bool = False
+            ) -> "Environment":
         if name not in PROPERTIES:
             raise KeyError(f"unknown property {name!r}")
         spec = PROPERTIES[name]
+        coerced = spec.type(value)     # validate before any write
         if spec.startup_only:
-            # startup-only properties are read by JAX/XLA at backend init:
-            # write the env var (effective before init and for child
-            # processes), and refuse to pretend it changed a live backend.
-            # Validate/coerce through spec.type like every other property.
-            if spec.key not in self._env_saved:
-                self._env_saved[spec.key] = os.environ.get(spec.key)
-            os.environ[spec.key] = str(spec.type(value))
+            # startup-only properties are read by JAX/XLA at backend
+            # init: once the backend is up a set() CANNOT affect the
+            # running process, so it raises instead of silently
+            # accepting the write. ``for_restart=True`` opts into the
+            # write-the-env-var behavior for child processes / the next
+            # start.
             try:
                 import jax._src.xla_bridge as _xb
                 backend_up = bool(getattr(_xb, "_backends", None))
             except Exception:
-                backend_up = True      # unknown -> assume live, warn
-            if backend_up:
-                import warnings
-                warnings.warn(
-                    f"property {name!r} (${spec.key}) is read at backend "
-                    f"initialization; the running process keeps its "
-                    f"current value — the setting applies to child "
-                    f"processes / the next start", stacklevel=2)
+                backend_up = True      # unknown -> assume live
+            if backend_up and not for_restart:
+                raise RuntimeError(
+                    f"property {name!r} (${spec.key}) is read once at "
+                    f"backend initialization and the backend is already "
+                    f"up — setting it now cannot affect this process. "
+                    f"Set the env var before importing jax, or pass "
+                    f"for_restart=True to write it for child processes "
+                    f"/ the next start.")
+            if spec.key not in self._env_saved:
+                self._env_saved[spec.key] = os.environ.get(spec.key)
+            os.environ[spec.key] = str(coerced)
             return self
-        self._overrides[name] = spec.type(value)
+        self._overrides[name] = coerced
+        # the compilation-cache properties also export their env var
+        # (original saved for reset()) so child processes inherit the
+        # cache — matching what the old startup_only declaration of
+        # compilation_cache_dir provided. Ordinary toggles stay
+        # process-local: set("debug", True) must not leak into every
+        # subprocess spawned afterwards.
+        if name in _CACHE_PROPS:
+            if spec.key not in self._env_saved:
+                self._env_saved[spec.key] = os.environ.get(spec.key)
+            os.environ[spec.key] = str(coerced)
         self._apply_side_effects(name)
         return self
 
@@ -156,20 +193,67 @@ class Environment:
                 else:
                     os.environ[key] = old
 
+        # only properties that were actually set() have live jax.config
+        # side effects to undo — re-applying a never-touched one would
+        # clobber state the user configured directly via jax.config
+        # (e.g. a cache dir enabled the standard JAX way)
         if name is None:
+            touched = [n for n in _SIDE_EFFECT_PROPS
+                       if n in self._overrides]
             self._overrides.clear()
             for key in list(self._env_saved):
                 _restore_env(key)
+            for n in touched:
+                self._apply_side_effects(n)
         else:
+            was_set = name in self._overrides
             self._overrides.pop(name, None)
             if name in PROPERTIES:
                 _restore_env(PROPERTIES[name].key)
+            if name in _SIDE_EFFECT_PROPS and was_set:
+                # re-apply from the now-resolved env/default value, so a
+                # reset() actually undoes the live jax.config change
+                self._apply_side_effects(name)
         return self
+
+    def _source(self, name: str) -> str:
+        if name in self._overrides:
+            return "set"
+        return "env" if os.environ.get(PROPERTIES[name].key) else "default"
 
     def _apply_side_effects(self, name: str) -> None:
         if name == "log_compiles":
             import jax
             jax.config.update("jax_log_compiles", bool(self.get(name)))
+        elif name == "compilation_cache_dir":
+            from deeplearning4j_tpu.compilecache import configure_cache
+            configure_cache(str(self.get(name)) or None)
+        elif name == "compilation_cache_min_entry_size":
+            import jax
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              int(self.get(name)))
+        elif name == "compilation_cache_min_compile_time":
+            import jax
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(self.get(name)))
+
+    def apply_compilation_cache(self) -> "Environment":
+        """Push the resolved compilation-cache properties into the live
+        JAX config. Properties still at their catalog default are left
+        alone (a direct ``jax.config.update`` by the user wins), so this
+        is safe to call from every startup path — ``SameDiff
+        .precompile()``, serving warmup and the ``cold_start`` bench all
+        do, making ``$JAX_COMPILATION_CACHE_DIR`` set after import (or a
+        programmatic ``set()``) take effect at the next compile."""
+        for n in ("compilation_cache_dir",
+                  "compilation_cache_min_entry_size",
+                  "compilation_cache_min_compile_time"):
+            if self._source(n) != "default":
+                self._apply_side_effects(n)
+        return self
+
+    def compilation_cache_dir(self) -> str:
+        return str(self.get("compilation_cache_dir"))
 
     # -- named accessors (Environment.h style) -----------------------------
     def is_verbose(self) -> bool:

@@ -166,6 +166,25 @@ class FaultTolerantFit:
             if isinstance(lr, (int, float)):
                 upd.learning_rate = lr * self.policy.lr_rescale
                 self.sd._mutated()     # the LR is baked into the program
+                # the mutation dropped every compiled program (including
+                # AOT-precompiled ones). If the graph was precompiled,
+                # re-AOT NOW — during recovery, where the compile is
+                # observable (compile.* spans) and expected — instead of
+                # paying it silently inside the first retry window. With
+                # a persistent cache, a retry at a previously-seen LR is
+                # a cache hit.
+                spec = getattr(self.sd, "_precompile_spec", None)
+                if spec is not None:
+                    try:
+                        info = self.sd.precompile(**spec)
+                    except Exception as e:
+                        # fall back to lazy compiles in the retry — but
+                        # say so: a silent fallback would put the compile
+                        # back inside the first retry window with zero
+                        # observability, the exact condition the
+                        # precompile event exists to surface
+                        info = {"failed": f"{type(e).__name__}: {e}"}
+                    self._publish("precompile", **info)
         dt = time.perf_counter() - t0
         self.recovery_seconds += dt
         self.rollbacks += 1

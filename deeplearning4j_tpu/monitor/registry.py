@@ -297,6 +297,26 @@ class MetricsRegistry:
                              ev.get("overhead_s", 0.0),
                              help="rollback wall time")
 
+    def fold_compile(self, stats_or_record) -> None:
+        """Fold XLA compile accounting (``compilecache.COMPILE_STATS``
+        or a stored ``{"type": "compile"}`` record) into ``compile_*``
+        gauges — the cache-hit vs miss split that tells a dashboard
+        whether a restart was warm."""
+        rec = stats_or_record
+        if hasattr(rec, "to_record"):
+            rec = rec.to_record()
+        for key in ("backend_compiles", "cache_hits", "cache_misses",
+                    "miss_compiles"):
+            if key in rec:
+                self.set_gauge(f"compile_{key}_total", rec[key],
+                               help="XLA compiles by persistent-cache "
+                                    "outcome (compilecache/)")
+        for key in ("backend_compile_seconds", "trace_seconds",
+                    "lower_seconds", "saved_seconds"):
+            if key in rec:
+                self.set_gauge(f"compile_{key}", rec[key],
+                               help="cumulative compile-phase wall time")
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -338,6 +358,8 @@ class MetricsRegistry:
                 self.fold_faults([rec])
             elif t == "steptime":
                 self.fold_steptime(rec)
+            elif t == "compile":
+                self.fold_compile(rec)
 
 
 __all__ = ["MetricsRegistry"]

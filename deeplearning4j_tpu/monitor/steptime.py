@@ -192,6 +192,7 @@ class MonitorListener:
         self.trace_record_spans = int(trace_record_spans)
         self._mark = 0
         self._dropped = 0
+        self._compile_snap: Optional[dict] = None
 
     def reset(self) -> None:
         """Rollback hook (faults/recovery.py resets stateful listeners):
@@ -254,6 +255,19 @@ class MonitorListener:
     def on_epoch_end(self, sd, epoch: int, mean_loss) -> None:
         self.registry.fold_dispatch(getattr(sd, "last_fit_stats", None),
                                     epoch=epoch)
+        # compile accounting rides the same cadence: whenever the
+        # process-wide counters moved since the last publish (first
+        # epoch covers compiles that predate the fit, e.g. precompile),
+        # fold them and emit the {"type": "compile"} record — without
+        # this a monitored run never surfaces the cache-hit/miss split
+        # and ui/report's Compilation section only exists for callers
+        # that publish COMPILE_STATS by hand
+        from deeplearning4j_tpu.compilecache import COMPILE_STATS
+        snap = COMPILE_STATS.snapshot()
+        if any(snap.values()) and snap != self._compile_snap:
+            self._compile_snap = snap
+            self.registry.fold_compile(COMPILE_STATS)
+            COMPILE_STATS.publish(self.storage)
         self.registry.publish(self.storage)
 
     def on_training_end(self, sd) -> None:

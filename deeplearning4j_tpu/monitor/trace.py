@@ -183,6 +183,27 @@ class Tracer:
             return wrapper
         return deco
 
+    def record_completed(self, name: str, cat: str = "", dur: float = 0.0,
+                         **args) -> None:
+        """Record an already-measured span — a duration reported by a
+        callback (e.g. a ``jax.monitoring`` compile event) that was
+        never entered as a context manager. The span ends NOW and
+        started ``dur`` seconds ago, lands in the current thread's lane,
+        and nests under whatever span is open on this thread."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, cat, args)
+        t = threading.current_thread()
+        sp.tid = t.ident or 0
+        sp.thread_name = t.name
+        sp.sid = next(Span._ids)
+        stack = self._stack()
+        if stack:
+            sp.parent = stack[-1].sid
+        sp.dur = float(dur)
+        sp.t0 = time.perf_counter() - sp.dur
+        self._record(sp)
+
     def _stack(self) -> list:
         stack = getattr(self._tls, "stack", None)
         if stack is None:

@@ -9,12 +9,41 @@ NONE/SUM/MEAN_BY_WEIGHT/MEAN_BY_NONZERO_WEIGHT_COUNT).
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.registry import op
 
 _L = "loss"
+
+#: Active softmax/CE tail dtype policy (None = upcast to f32, the safe
+#: default). Set via :func:`softmax_dtype_scope`; consulted at TRACE
+#: time, so the scope must wrap the jitted function's execution — the
+#: train step builder (SameDiff._build_step_parts) does this when
+#: ``MixedPrecision.softmax_dtype`` is set.
+_SOFTMAX_DTYPE: contextvars.ContextVar = contextvars.ContextVar(
+    "dl4j_softmax_dtype", default=None)
+
+
+@contextlib.contextmanager
+def softmax_dtype_scope(dtype):
+    """While active, the softmax-CE losses keep their log-softmax tail
+    in ``dtype`` instead of upcasting to float32. The per-example
+    losses are STILL reduced to the scalar loss in f32 (the accumulation
+    is where bf16 actually loses training signal); what changes is the
+    [batch..., vocab]-shaped exp/log/normalize tail — on a 32k vocab
+    that tail is the single largest f32 tensor in a bf16 LM step
+    (PROFILE.md round 5) and the MXU/VPU runs it at twice the rate in
+    bf16. Routed from ``MixedPrecision.softmax_dtype``
+    (docs/training_performance.md)."""
+    token = _SOFTMAX_DTYPE.set(None if dtype is None else jnp.dtype(dtype))
+    try:
+        yield
+    finally:
+        _SOFTMAX_DTYPE.reset(token)
 
 
 def _f32(x):
@@ -23,6 +52,15 @@ def _f32(x):
     training signal lives in. XLA fuses the cast into the producer."""
     return x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) \
         else x
+
+
+def _tail(x):
+    """Softmax-CE tail dtype: the policy dtype when a
+    :func:`softmax_dtype_scope` is active, else the f32 upcast."""
+    dt = _SOFTMAX_DTYPE.get()
+    if dt is None:
+        return _f32(x)
+    return x.astype(dt)
 
 
 def _reduce_loss(per_ex, weights, reduction: str):
@@ -60,23 +98,31 @@ def absolute_difference_loss(predictions, labels, weights=None, reduction: str =
 def softmax_cross_entropy(logits, labels, weights=None, reduction: str = "mean",
                           label_smoothing: float = 0.0):
     """(reference: generic/loss/softmaxCrossEntropy.cpp) labels are
-    one-hot/probability distributions."""
-    logits, labels = _f32(logits), _f32(labels)
+    one-hot/probability distributions. The log-softmax tail honors
+    :func:`softmax_dtype_scope`; the per-example reduction to the
+    scalar loss is always f32."""
+    logits, labels = _tail(logits), _tail(labels)
     if label_smoothing > 0.0:
         n = labels.shape[-1]
         labels = labels * (1.0 - label_smoothing) + label_smoothing / n
     logp = jax.nn.log_softmax(logits, axis=-1)
-    per = -jnp.sum(labels * logp, axis=-1)
+    # the vocab-axis accumulation is where bf16 actually loses signal:
+    # force an f32 accumulator even when the tail runs in bf16
+    per = -jnp.sum(labels * logp, axis=-1, dtype=jnp.float32)
     return _reduce_loss(per, weights, reduction)
 
 
 @op("sparse_softmax_cross_entropy", _L)
 def sparse_softmax_cross_entropy(logits, labels, weights=None, reduction: str = "mean"):
     """labels are integer class ids (reference:
-    sparseSoftmaxCrossEntropyWithLogits.cpp)."""
-    logits = _f32(logits)
+    sparseSoftmaxCrossEntropyWithLogits.cpp). The log-softmax tail over
+    the vocab axis honors :func:`softmax_dtype_scope` — the lever that
+    shrinks the [B, S, 32k] f32 tail of a bf16 LM step; the gathered
+    per-token losses are reduced in f32 regardless."""
+    logits = _tail(logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    per = _f32(-jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0])
     return _reduce_loss(per, weights, reduction)
 
 

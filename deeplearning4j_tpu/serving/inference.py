@@ -89,6 +89,15 @@ class ParallelInference:
     multi-output graphs). Overload raises
     :class:`ServerOverloadedError` at submit; expired deadlines surface
     as :class:`RequestTimeoutError` from the future.
+
+    ``warmup_buckets`` kills the serving cold-start: ``True`` AOT-
+    precompiles every batching bucket shape at construction (before any
+    worker serves), a sequence of ints precompiles exactly those row
+    counts — so the first live request of each bucket never waits
+    seconds on XLA (the p99 cliff a lazy bucket miss causes). Warmed
+    shapes are bit-identical to lazily-compiled ones and the
+    ``compiles`` metric stays 0 for them (``warmup_compiles`` counts
+    the prebuilt set). See docs/cold_start.md.
     """
 
     def __init__(self, model,
@@ -100,7 +109,8 @@ class ParallelInference:
                  buckets: Optional[Sequence[int]] = None,
                  default_timeout_ms: Optional[float] = None,
                  stats_storage=None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 warmup_buckets=None):
         self.model = model
         self.mode = InferenceMode(mode)
         self.max_batch_size = int(max_batch_size)
@@ -137,6 +147,11 @@ class ParallelInference:
             self._queue, max_batch_size=self.max_batch_size,
             max_delay_ms=max_delay_ms, buckets=buckets) \
             if self.mode is InferenceMode.BATCHED else None
+        self.warmup_report: Optional[dict] = None
+        if warmup_buckets:
+            # before any worker thread exists: warmed shapes must be in
+            # the execution cache before the first request can race them
+            self.warmup(None if warmup_buckets is True else warmup_buckets)
         self._workers: List[threading.Thread] = []
         if self.mode is not InferenceMode.INPLACE:
             for i in range(max(1, int(workers))):
@@ -158,6 +173,73 @@ class ParallelInference:
         with self._id_lock:
             self._req_id += 1
             return self._req_id
+
+    # -- AOT warmup (compilecache/, docs/cold_start.md) -----------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """AOT-precompile the serving program for each bucket row count
+        so live traffic never waits on XLA.
+
+        ``buckets=None`` takes the batching tier's bucket spec (BATCHED
+        mode) or the default pow2 ladder up to ``max_batch_size``
+        (SEQUENTIAL/INPLACE — where requests execute at their own row
+        count, so only warmed sizes are covered; off-ladder sizes still
+        compile lazily). Requires static feature dims on every input.
+        Returns (and stores as ``warmup_report``) the bucket list, wall
+        seconds, and the compile/cache-hit accounting — on a warm
+        restart with a persistent cache configured, every entry is a
+        cache hit and warmup is ~free."""
+        import time as _time
+        from deeplearning4j_tpu.compilecache import (COMPILE_STATS,
+                                                     install_compile_watcher)
+        install_compile_watcher()
+        if buckets is None:
+            if self._batcher is not None:
+                buckets = self._batcher.spec.buckets
+            else:
+                from deeplearning4j_tpu.serving.batching import pow2_buckets
+                # single-example requests are the common case and run at
+                # their own row count in these modes — always include
+                # bucket 1 (the pow2 ladder stops halving above it for
+                # large max_batch_size)
+                buckets = (1,) + tuple(pow2_buckets(self.max_batch_size))
+        bucket_list = sorted({int(b) for b in buckets})
+        if not bucket_list or bucket_list[0] <= 0:
+            raise ValueError(f"invalid warmup buckets {buckets!r}")
+        for name, shp in zip(self._spec.input_names, self._ph_shapes):
+            if shp is None or any(d is None or d == -1 for d in shp[1:]):
+                raise ValueError(
+                    f"cannot warm up input {name!r}: feature dims {shp} "
+                    f"are not static — pass concrete shapes to the "
+                    f"model, or skip warmup for this graph")
+        mark = COMPILE_STATS.mark()
+        t0 = _time.perf_counter()
+        for b in bucket_list:
+            ph = {name: (b,) + tuple(int(d) for d in shp[1:])
+                  for name, shp in zip(self._spec.input_names,
+                                       self._ph_shapes)}
+            # _exec_lock: warmup() is public and may be called on a LIVE
+            # server (pre-warming a new bucket) — the graph's compile
+            # caches are only safe under the same lock _execute holds
+            with self._exec_lock, \
+                    _tracer.span("serving.warmup", cat="serving", bucket=b):
+                self._spec.sd.precompile_output(ph,
+                                                self._spec.output_names)
+                # mark the shape as seen (under the SAME lock hold — a
+                # worker dispatching this bucket between compile and
+                # mark would count a spurious lazy `compiles`) so the
+                # metric counts only genuinely-unwarmed traffic
+                # compiles; already-seen buckets (a repeat warmup() on
+                # a live server) must not re-count
+                sig = tuple(tuple(ph[n]) for n in self._spec.input_names)
+                if sig not in self._shapes_seen:
+                    self._shapes_seen.add(sig)
+                    self.metrics.inc("warmup_compiles")
+        self.warmup_report = {
+            "buckets": bucket_list,
+            "seconds": round(_time.perf_counter() - t0, 4),
+            **{k: v for k, v in COMPILE_STATS.delta(mark).items()
+               if k in ("backend_compiles", "cache_hits", "cache_misses")}}
+        return self.warmup_report
 
     def _prepare(self, x) -> tuple:
         """-> (list of per-input arrays with a batch dim, squeeze flag)."""

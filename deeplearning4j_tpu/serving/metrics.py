@@ -74,7 +74,7 @@ class LatencyHistogram:
 
 _COUNTERS = ("requests_submitted", "requests_served", "requests_rejected",
              "requests_timed_out", "requests_failed", "batches_dispatched",
-             "rows_served", "rows_padded", "compiles")
+             "rows_served", "rows_padded", "compiles", "warmup_compiles")
 
 
 class ServingMetrics:
@@ -197,7 +197,8 @@ class ServingMetrics:
                  f"  batches: {c['batches_dispatched']} dispatched, "
                  f"mean size {rec['batch']['mean_size']}, padding waste "
                  f"{rec['batch']['padding_waste']:.1%}, "
-                 f"{c['compiles']} compiled shapes"]
+                 f"{c['compiles']} compiled shapes "
+                 f"({c['warmup_compiles']} prewarmed)"]
         for name in ("queue_wait", "e2e", "exec"):
             s = rec["latency_ms"][name]
             lines.append(f"  {name:<10} p50 {s['p50']:.3f} ms  "

@@ -166,7 +166,8 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 #: in the forward-compatibility footer instead of being dropped
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
-    "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace"})
+    "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
+    "compile"})
 
 
 def render_report(storage: StatsStorage, title: str = "Training report"
@@ -182,6 +183,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
                   if r.get("event") == "straggler"]
     traces = storage.of_type("trace")
     metrics = storage.of_type("metrics")
+    compiles = storage.of_type("compile")
 
     parts = [f"""<!doctype html><html><head><meta charset="utf-8">
 <title>{_html.escape(title)}</title>
@@ -279,6 +281,21 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
         parts.append("<h2>Span timeline</h2>")
         parts.append(_svg_swimlane(traces[-1].get("spans", []),
                                    label="trace spans (tail)"))
+
+    # -- compile latency: persistent-cache hit/miss accounting -----------
+    if compiles:
+        c = compiles[-1]
+        misses = c.get("miss_compiles",
+                       max(0, c.get("backend_compiles", 0)
+                           - c.get("cache_hits", 0)))
+        parts.append(
+            f"<h2>Compilation</h2><p>{c.get('backend_compiles', 0)} XLA "
+            f"compiles — {c.get('cache_hits', 0)} persistent-cache hits, "
+            f"{misses} real (miss) compiles; "
+            f"{c.get('backend_compile_seconds', 0.0):.2f}s in the "
+            f"backend, {c.get('trace_seconds', 0.0):.2f}s tracing, "
+            f"{c.get('saved_seconds', 0.0):.2f}s saved by the cache "
+            f"(compilecache/, docs/cold_start.md)</p>")
 
     # -- observability: unified metrics snapshot -------------------------
     if metrics:

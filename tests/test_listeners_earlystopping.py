@@ -274,19 +274,23 @@ def test_score_improvement_min_threshold_and_reuse():
     assert cond.terminate(3, 3.99, True) is False   # +1 again
 
 
-def test_startup_only_env_property_warns_and_sets_envvar():
+def test_startup_only_env_property_raises_late_unless_for_restart():
+    """A startup-only property set after backend init cannot affect the
+    running process: set() must REFUSE (not silently accept the write);
+    for_restart=True opts into writing the env var for child
+    processes."""
     import os
-    import warnings
     from deeplearning4j_tpu import environment
     env = environment()
     saved = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
     try:
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
+        with pytest.raises(RuntimeError, match="backend initialization"):
             env.set("mem_fraction", 0.5)     # backend already initialized
-        assert any("backend initialization" in str(x.message) for x in w)
+        assert os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION") == saved
+        env.set("mem_fraction", 0.5, for_restart=True)
         assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
     finally:
+        env.reset("mem_fraction")
         if saved is None:
             os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
         else:
@@ -317,16 +321,15 @@ def test_best_score_condition_never_judges_trainloss_standin():
 
 def test_environment_reset_restores_startup_only_envvar():
     import os
-    import warnings
     from deeplearning4j_tpu import environment
     env = environment()
     saved = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
     try:
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            env.set("mem_fraction", 0.5)
-            with pytest.raises(ValueError):
-                env.set("mem_fraction", "abc")   # validated like others
+        env.set("mem_fraction", 0.5, for_restart=True)
+        with pytest.raises(ValueError):
+            # validated like others — and BEFORE the env-var write
+            env.set("mem_fraction", "abc", for_restart=True)
+        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
         env.reset("mem_fraction")
         assert os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION") == saved
     finally:

@@ -189,3 +189,58 @@ def test_unknown_gradient_normalization_raises():
     sd = _mlp_sd(gradient_normalization="bogus")
     with pytest.raises(ValueError, match="bogus"):
         sd.fit(DeviceCachedIterator(X, Y, 32), epochs=1)
+
+
+# ----------------------------------------------------------------------
+# CE-tail precision policy (MixedPrecision.softmax_dtype / ce_tail_dtype)
+
+def _fit_params_losses(mp):
+    X, Y = _data(n=64)
+    sd = _mlp_sd(mp=mp)
+    h = sd.fit(DeviceCachedIterator(X, Y, 32), epochs=3)
+    return ({n: np.asarray(a) for n, a in sd.trainable_params().items()},
+            h.loss_curve.losses)
+
+
+def test_ce_tail_default_stays_f32_bit_exact():
+    """softmax_dtype=None and an explicit "float32" are the SAME
+    program: the knob's default must not perturb existing runs."""
+    p_none, l_none = _fit_params_losses(MixedPrecision())
+    p_f32, l_f32 = _fit_params_losses(
+        MixedPrecision(softmax_dtype="float32"))
+    assert l_none == l_f32
+    for n in p_none:
+        assert np.array_equal(p_none[n], p_f32[n]), n
+
+
+def test_ce_tail_bf16_trains_close_to_f32():
+    """The bf16 log-softmax tail changes rounding, not training: losses
+    track the f32 tail closely and keep decreasing."""
+    _, l_f32 = _fit_params_losses(MixedPrecision())
+    _, l_bf16 = _fit_params_losses(
+        MixedPrecision(softmax_dtype="bfloat16"))
+    np.testing.assert_allclose(l_bf16, l_f32, rtol=3e-2)
+    assert l_bf16[-1] < l_bf16[0]
+
+
+def test_ce_tail_alias_and_serde_roundtrip():
+    mp = MixedPrecision(softmax_dtype="bfloat16")
+    assert mp.ce_tail_dtype == "bfloat16"
+    rt = MixedPrecision.from_json(mp.to_json())
+    assert rt.softmax_dtype == "bfloat16"
+    # legacy/alias key accepted on the way in
+    assert MixedPrecision.from_json(
+        {"ce_tail_dtype": "bfloat16"}).softmax_dtype == "bfloat16"
+    assert MixedPrecision.from_json({"compute_dtype": "bfloat16"}) \
+        .softmax_dtype is None
+
+
+def test_ce_tail_scope_composes_with_fused_windows():
+    """The policy is traced into the fused-window program too (the
+    scope wraps the step body the scan re-uses)."""
+    X, Y = _data(n=64)
+    sd = _mlp_sd(mp=MixedPrecision(softmax_dtype="bfloat16"),
+                 fused_steps=4)
+    h = sd.fit(DeviceCachedIterator(X, Y, 16), epochs=2)
+    assert all(np.isfinite(v) for v in h.loss_curve.losses)
+    assert h.loss_curve.losses[-1] < h.loss_curve.losses[0]
