@@ -1350,6 +1350,21 @@ class SameDiff:
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
+        if getattr(tc, "sharding", None) is not None:
+            # declarative mesh sharding: place params/state on the
+            # spec's mesh and pre-shard batches BEFORE tier selection,
+            # so every tier below (scanned / fused windows / per-step)
+            # trains under the mesh. A ParallelTrainer front end arrives
+            # here with an already-sharded iterator (its explicit
+            # strategy wins) and this is a no-op.
+            from deeplearning4j_tpu.parallel.trainer import ensure_sharded
+            wrapped = ensure_sharded(self, tc.sharding, dataset_iterator)
+            if wrapped is not dataset_iterator:
+                self._verbose_log(
+                    f"fit: sharded over mesh "
+                    f"{dict(wrapped._strategy.mesh.mesh.shape)} "
+                    f"(TrainingConfig.sharding)")
+            dataset_iterator = wrapped
         fused = max(1, int(getattr(tc, "fused_steps", 1) or 1))
         accum = max(1, int(getattr(tc, "accum_steps", 1) or 1))
         if not listeners and hasattr(dataset_iterator, "stacked_batches") \

@@ -140,6 +140,16 @@ class TrainingConfig:
     # step/epoch/batch. Parameter math is untouched — sentinel-on
     # training is bit-identical.
     sentinel: bool = False
+    # declarative mesh sharding (parallel.ShardingSpec, serde'd like
+    # every other field): when set, SameDiff.fit places params/state on
+    # the spec's device mesh and shards input batches before tier
+    # selection, so DP/TP training composes with fused windows, the
+    # sentinel carry and AOT precompile without the ParallelTrainer
+    # front end. The spec carries INTENT (axis sizes with one -1 fill,
+    # rule preset, per-layer rules); the strategy binds to whatever
+    # devices the process has — the elastic-resume contract
+    # (docs/elastic_training.md).
+    sharding: Optional[Any] = None
 
     def clip_gradients(self, grads):
         """Apply elementwise clip + the configured normalization mode to a
@@ -190,10 +200,20 @@ class TrainingConfig:
             "fused_steps": self.fused_steps,
             "accum_steps": self.accum_steps,
             "sentinel": self.sentinel,
+            # the fit path also accepts a live ShardingStrategy here;
+            # serialize it through its declarative to_spec() form
+            "sharding": (None if self.sharding is None
+                         else (self.sharding
+                               if hasattr(self.sharding, "to_json")
+                               else self.sharding.to_spec()).to_json()),
         }
 
     @staticmethod
     def from_json(d: dict) -> "TrainingConfig":
+        sharding = None
+        if d.get("sharding") is not None:
+            from deeplearning4j_tpu.parallel.sharding import ShardingSpec
+            sharding = ShardingSpec.from_json(d["sharding"])
         return TrainingConfig(
             updater=IUpdater.from_json(d["updater"]),
             data_set_feature_mapping=d.get("data_set_feature_mapping", []),
@@ -211,6 +231,7 @@ class TrainingConfig:
             fused_steps=d.get("fused_steps", 1),
             accum_steps=d.get("accum_steps", 1),
             sentinel=d.get("sentinel", False),
+            sharding=sharding,
         )
 
     class Builder:
@@ -241,6 +262,8 @@ class TrainingConfig:
             self._kw["accum_steps"] = int(n); return self
         def sentinel(self, on: bool = True):
             self._kw["sentinel"] = bool(on); return self
+        def sharding(self, spec):
+            self._kw["sharding"] = spec; return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 

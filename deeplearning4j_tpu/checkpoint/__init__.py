@@ -12,6 +12,10 @@ The persistence layer the elastic-training roadmap builds on:
 - ``manager``     — ``CheckpointManager``: async background writer,
   atomic commits, retention (keep-last-N / keep-every-N-epochs /
   pin-best), multihost per-process shards with a pre-commit barrier;
+- ``reshard``     — elastic resharded restore: reassemble global
+  arrays from ANY committed shard set and re-slice them for the
+  CURRENT mesh (save on N hosts, restore on M;
+  docs/elastic_training.md);
 - ``listener``    — DL4J-parity ``CheckpointListener`` (every N
   iterations / epochs / seconds) for any ``fit(listeners=...)`` path;
 - ``savers``      — early-stopping model saver routed through the
@@ -27,19 +31,25 @@ from deeplearning4j_tpu.checkpoint.atomic import (
     fsync_dir)
 from deeplearning4j_tpu.checkpoint.listener import CheckpointListener
 from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
-                                                   CheckpointManager)
+                                                   CheckpointManager,
+                                                   ShardCountMismatchError,
+                                                   TopologyChangedError)
 from deeplearning4j_tpu.checkpoint.manifest import (is_committed, sha256_file,
                                                     verify_dir)
 from deeplearning4j_tpu.checkpoint.preemption import Preempted, PreemptionHook
+from deeplearning4j_tpu.checkpoint.reshard import restore_resharded
 from deeplearning4j_tpu.checkpoint.savers import CheckpointModelSaver
 from deeplearning4j_tpu.checkpoint.state import (TrainingState,
+                                                 capture_topology,
                                                  capture_training_state,
                                                  restore_training_state)
 
 __all__ = [
     "CheckpointError", "CheckpointListener", "CheckpointManager",
-    "CheckpointModelSaver", "Preempted", "PreemptionHook", "TrainingState",
+    "CheckpointModelSaver", "Preempted", "PreemptionHook",
+    "ShardCountMismatchError", "TopologyChangedError", "TrainingState",
     "atomic_copy", "atomic_output_file", "atomic_write_bytes",
-    "atomic_write_via", "capture_training_state", "fsync_dir",
-    "is_committed", "restore_training_state", "sha256_file", "verify_dir",
+    "atomic_write_via", "capture_topology", "capture_training_state",
+    "fsync_dir", "is_committed", "restore_resharded",
+    "restore_training_state", "sha256_file", "verify_dir",
 ]

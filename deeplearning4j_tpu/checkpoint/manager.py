@@ -60,6 +60,47 @@ class CheckpointError(RuntimeError):
     thread at the next save()/wait_until_finished())."""
 
 
+class TopologyChangedError(CheckpointError):
+    """The topology at restore differs from the checkpoint's manifest:
+    the job lost or gained hosts/devices since the snapshot committed
+    (preemption, elastic rescale). Structured and RETRYABLE —
+    ``faults.FaultTolerantFit`` routes it through the resharded restore
+    path (``checkpoint.reshard.restore_resharded``), which reassembles
+    global arrays from any committed shard set and re-slices them for
+    the current mesh."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 manifest: Optional[Dict[str, Any]] = None,
+                 runtime: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.step = step
+        self.manifest = dict(manifest or {})
+        self.runtime = dict(runtime or {})
+
+
+class ShardCountMismatchError(TopologyChangedError):
+    """A committed checkpoint was written by ``manifest_count``
+    processes but this runtime has ``runtime_count`` — the exact
+    condition a preempted host leaves behind. Raised INSTEAD of the
+    bare missing-shard-file failure a naive reader would hit, so the
+    recovery rail can key on it."""
+
+    def __init__(self, step: int, manifest_count: int, runtime_count: int,
+                 detail: str = ""):
+        self.manifest_count = int(manifest_count)
+        self.runtime_count = int(runtime_count)
+        super().__init__(
+            f"checkpoint step {step} was committed by "
+            f"{manifest_count} process(es) but this runtime has "
+            f"{runtime_count}{': ' + detail if detail else ''} — the "
+            f"topology changed since the save; restore through "
+            f"checkpoint.reshard.restore_resharded() (or "
+            f"faults.FaultTolerantFit, which does so automatically)",
+            step=int(step),
+            manifest={"process_count": int(manifest_count)},
+            runtime={"process_count": int(runtime_count)})
+
+
 class CheckpointManager:
     """Atomic, retained, optionally-async checkpoint directory manager.
 
@@ -371,28 +412,62 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------
     # restore
-    def restore(self, step: int, model=None, strict: bool = True
-                ) -> TrainingState:
+    def _check_shard_topology(self, step: int) -> None:
+        """Raise :class:`ShardCountMismatchError` when the committed
+        step's shard layout does not match this runtime's process count
+        (the recorded ``shard_count`` is authoritative — it is what the
+        save-time manager actually wrote)."""
+        meta = self._step_meta(step)
+        manifest_count = int(meta.get("shard_count", 1))
+        if manifest_count != self.process_count:
+            raise ShardCountMismatchError(step, manifest_count,
+                                          self.process_count)
+
+    def restore(self, step: int, model=None, strict: bool = True,
+                allow_reshard: bool = False) -> TrainingState:
         """Load (and verify) step ``step``; optionally restore into
         ``model``. Raises CheckpointError if the step is missing or
-        fails integrity verification."""
+        fails integrity verification, and ShardCountMismatchError when
+        the step was committed by a different process count than this
+        runtime has (``allow_reshard=True`` bypasses the check and
+        merges every shard regardless — the reshard path)."""
         d = self.step_dir(step)
         problems = _manifest.verify_dir(d, full=True)
         if problems:
             raise CheckpointError(
                 f"checkpoint step {step} at {d} is not committed/intact: "
                 f"{problems}")
-        state = read_state_files(d)
+        if not allow_reshard:
+            self._check_shard_topology(step)
+        try:
+            state = read_state_files(d)
+        except FileNotFoundError as e:
+            # counts already matched (or the caller bypassed the check)
+            # — a file gone AFTER verification is loss/corruption (e.g.
+            # retention racing this read), not a topology change
+            raise CheckpointError(
+                f"checkpoint step {step} lost files after verification "
+                f"({e})") from e
         if model is not None:
             restore_training_state(model, state, strict=strict)
         return state
 
-    def restore_latest(self, model=None, strict: bool = True
+    def restore_latest(self, model=None, strict: bool = True,
+                       allow_reshard: bool = False
                        ) -> Optional[Tuple[int, TrainingState]]:
         """Restore the newest COMMITTED checkpoint, skipping torn,
         uncommitted, or corrupted directories (missing COMMIT, bad
         manifest, truncated/bit-flipped payloads). Returns
-        ``(step, state)`` or None when nothing restorable exists."""
+        ``(step, state)`` or None when nothing restorable exists.
+
+        A committed checkpoint whose shard count differs from this
+        runtime's process count raises a structured
+        :class:`ShardCountMismatchError` (manifest vs runtime counts)
+        instead of crashing on a missing shard file — the signal
+        ``faults.FaultTolerantFit`` keys elastic recovery on.
+        ``allow_reshard=True`` merges all shards regardless of writer
+        count (``checkpoint.reshard.restore_resharded`` is the blessed
+        cross-topology restore built on the same contract)."""
         if self.process_index == 0:
             self._recover_aside()
         candidates = []
@@ -404,7 +479,16 @@ class CheckpointManager:
             d = self.step_dir(step)
             if _manifest.verify_dir(d, full=True):
                 continue                       # torn/corrupt: skip
-            state = read_state_files(d)
+            if not allow_reshard:
+                self._check_shard_topology(step)
+            try:
+                state = read_state_files(d)
+            except FileNotFoundError as e:
+                # counts matched or check was bypassed: loss/corruption
+                # after verification, not a topology change
+                raise CheckpointError(
+                    f"checkpoint step {step} lost files after "
+                    f"verification ({e})") from e
             if model is not None:
                 restore_training_state(model, state, strict=strict)
             return step, state

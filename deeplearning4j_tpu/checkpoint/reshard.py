@@ -1,0 +1,172 @@
+"""Elastic resharded restore: resume a checkpoint on a DIFFERENT topology.
+
+The commit protocol (manager.py) guarantees a committed step holds a
+complete, integrity-verified shard set — written by however many
+processes the job had THEN. This module is the restore path for a job
+that comes back with a different shape (a host preempted away, a slice
+grown back, a sharding strategy changed):
+
+1. **reassemble** — merge every committed shard into the full global
+   array set (shard layout is name-partitioned, ``state.shard_names``,
+   so the union is total regardless of how many processes wrote it);
+2. **restore** — pour the global state into the live model (params,
+   updater leaves, iteration/epoch, RNG base seed — the same bit-exact
+   contract as a same-topology restore);
+3. **re-slice** — commit the arrays to the CURRENT mesh via the target
+   ``ShardingStrategy`` (the trainer's, or one built from the model's
+   declarative ``TrainingConfig.sharding`` spec), so the next step runs
+   sharded on the surviving topology.
+
+What is and is not bit-exact across a topology change is documented in
+docs/elastic_training.md: the restored GLOBAL state is bit-exact; the
+continued trajectory matches an uninterrupted run up to collective
+reduction order on the new mesh (bit-exact when the topology is in fact
+unchanged).
+
+Every reshard is observable: a ``checkpoint.reshard`` span, a
+``{"type": "reshard"}`` stats record (arrays resliced, bytes gathered,
+wall time, from/to topology) folded to ``dl4j_reshard_*`` metrics by
+``monitor.MetricsRegistry.fold_reshard`` and rendered by ``ui/report``.
+
+Reference parity: none — the reference's elastic story was "restart the
+whole job from a zip on the same cluster shape" (SURVEY §5). This is
+the scaling-book model: topology change is a recoverable event.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.checkpoint import manifest as _manifest
+from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
+                                                   CheckpointManager,
+                                                   ShardCountMismatchError,
+                                                   TopologyChangedError)
+from deeplearning4j_tpu.checkpoint.state import (TrainingState,
+                                                 read_state_files,
+                                                 restore_training_state)
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
+
+
+def _as_sd(model_or_sd):
+    return getattr(model_or_sd, "samediff", model_or_sd)
+
+
+def _split_trainer(model):
+    """(restore_target, trainer_or_None) — accepts a ParallelTrainer-
+    like wrapper (has ``.model`` + ``.shard_params``) or a bare
+    model/SameDiff."""
+    if hasattr(model, "shard_params") and hasattr(model, "model"):
+        return model.model, model
+    return model, None
+
+
+def _resolve_target_strategy(model, strategy):
+    """The sharding the restored state should be re-sliced into:
+    an explicit ``strategy=``, else a ParallelTrainer's, else one built
+    from the model's declarative ``TrainingConfig.sharding`` spec, else
+    None (host-resident restore — still a valid shrink-to-one)."""
+    if strategy is not None:
+        return strategy
+    if model is None:
+        return None
+    trainer_strategy = getattr(model, "strategy", None)
+    if trainer_strategy is not None:
+        return trainer_strategy
+    sd = _as_sd(model)
+    spec = getattr(getattr(sd, "training_config", None), "sharding", None)
+    if spec is not None:
+        from deeplearning4j_tpu.parallel.trainer import resolve_strategy
+        return resolve_strategy(sd, spec)
+    return None
+
+
+def restore_resharded(manager: CheckpointManager, model=None,
+                      strategy=None, step: Optional[int] = None,
+                      strict: bool = True, stats_storage=None
+                      ) -> Optional[Tuple[int, TrainingState]]:
+    """Restore a committed checkpoint across a topology change.
+
+    Reads the newest committed step (or ``step=``) REGARDLESS of how
+    many processes wrote it, reassembles the global arrays, restores
+    them into ``model``, and re-slices everything for the current mesh
+    (see module docstring). Returns ``(step, state)`` or None when no
+    committed checkpoint exists; the reshard summary is left in
+    ``state.metadata["reshard_info"]`` and published as a
+    ``{"type": "reshard"}`` record to ``stats_storage``.
+    """
+    if step is None:
+        # like restore_latest: salvage any fully-staged .tmp left by a
+        # crash between re-save renames, then walk committed steps
+        # newest-first skipping torn/corrupted dirs — a bit-flipped
+        # newest step must not kill a recovery that an older intact
+        # checkpoint could serve
+        if manager.process_index == 0:
+            manager._recover_aside()
+        for cand in reversed(manager.all_steps()):
+            if not _manifest.verify_dir(manager.step_dir(cand), full=True):
+                step = cand
+                break
+        if step is None:
+            return None
+        d = manager.step_dir(step)
+    else:
+        d = manager.step_dir(step)
+        problems = _manifest.verify_dir(d, full=True)
+        if problems:
+            raise CheckpointError(
+                f"checkpoint step {step} at {d} is not committed/intact: "
+                f"{problems}")
+    t0 = time.perf_counter()
+    span = _tracer.span("checkpoint.reshard", cat="checkpoint",
+                        step=int(step))
+    span.__enter__()
+    try:
+        try:
+            state = read_state_files(d)  # merges ALL shards, any count
+        except FileNotFoundError as e:
+            # retention racing this read: loss after verification, not
+            # a topology change — keep it on the retryable
+            # CheckpointError rail (same hardening as manager.restore)
+            raise CheckpointError(
+                f"checkpoint step {step} lost files after verification "
+                f"({e})") from e
+        from_topo = (state.metadata or {}).get("topology") or {}
+        target = _resolve_target_strategy(model, strategy)
+        if model is not None:
+            target_model, trainer = _split_trainer(model)
+            restore_training_state(target_model, state, strict=strict)
+            if target is not None:
+                from deeplearning4j_tpu.parallel.trainer import shard_model
+                if trainer is not None:
+                    trainer.strategy = target    # trainer adopts the mesh
+                shard_model(_as_sd(target_model), target)
+        to_mesh = ({str(k): int(v)
+                    for k, v in target.mesh.mesh.shape.items()}
+                   if target is not None else None)
+        info = {
+            "step": int(step),
+            "arrays": len(state.arrays),
+            "bytes": int(state.nbytes()),
+            "seconds": round(time.perf_counter() - t0, 6),
+            "from_shards": None,      # filled from state.json below
+            "from_mesh": from_topo.get("mesh_axes"),
+            "to_mesh": to_mesh,
+            "from_processes": from_topo.get("process_count"),
+            "to_processes": int(manager.process_count)}
+        span.set(arrays=info["arrays"], bytes=info["bytes"])
+    finally:
+        span.__exit__(*sys.exc_info())
+    # the shard count the step was actually written with
+    meta = manager._step_meta(step)
+    info["from_shards"] = (int(meta["shard_count"])
+                           if "shard_count" in meta else None)
+    state.metadata["reshard_info"] = info
+    if stats_storage is not None:
+        stats_storage.put({"type": "reshard", "t": time.time(), **info})
+    return step, state
+
+
+__all__ = ["ShardCountMismatchError", "TopologyChangedError",
+           "restore_resharded"]

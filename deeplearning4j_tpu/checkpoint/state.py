@@ -76,6 +76,42 @@ class TrainingState:
         return obj
 
 
+def capture_topology(model_or_sd) -> Dict[str, Any]:
+    """The mesh topology a snapshot was captured under — recorded into
+    ``TrainingState.metadata["topology"]`` so a restore can tell whether
+    the world changed shape since the save (checkpoint/reshard.py):
+
+    - ``process_count`` / ``device_count``: the runtime's extent;
+    - ``mesh_axes``: ``{axis: size}`` of the NamedSharding mesh the
+      live arrays are committed to (None when single-device);
+    - ``partition_specs``: per-array PartitionSpec entries for every
+      mesh-resident array (how each GLOBAL array was sliced);
+    - ``global_shapes``: per-array global shapes (what a resharded
+      restore must reassemble to, whatever the new mesh looks like).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    sd = _as_sd(model_or_sd)
+    mesh_axes = None
+    specs: Dict[str, list] = {}
+    shapes: Dict[str, list] = {}
+    for n, a in {**sd.trainable_params(), **sd.state_vars_map()}.items():
+        shapes[n] = [int(s) for s in np.shape(a)]
+        sh = getattr(a, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            if mesh_axes is None:
+                mesh_axes = {str(k): int(v) for k, v in sh.mesh.shape.items()}
+            specs[n] = [list(e) if isinstance(e, tuple) else e
+                        for e in sh.spec]
+    try:
+        pc, dc = int(jax.process_count()), int(jax.device_count())
+    except Exception:          # pragma: no cover - jax not initialized
+        pc, dc = 1, 1
+    return {"process_count": pc, "device_count": dc,
+            "mesh_axes": mesh_axes, "partition_specs": specs,
+            "global_shapes": shapes}
+
+
 def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
                            metadata: Optional[Dict[str, Any]] = None
                            ) -> TrainingState:
@@ -84,6 +120,10 @@ def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
     This is the device→host copy — the only blocking step of an async
     save. Arrays are materialized with ``np.asarray`` so later training
     steps (which DONATE device buffers) cannot alias the snapshot.
+    Sharded arrays gather to their GLOBAL value here, and the mesh
+    topology they were sliced under is recorded in
+    ``metadata["topology"]`` — the manifest half of the elastic-resume
+    contract (save on N hosts, restore on M; docs/elastic_training.md).
     """
     import jax
     sd = _as_sd(model_or_sd)
@@ -105,11 +145,13 @@ def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
         norm_state = {"__class__": np.asarray(type(normalizer).__name__),
                       **{k: np.asarray(v)
                          for k, v in normalizer._state().items()}}
+    meta = dict(metadata or {})
+    meta.setdefault("topology", capture_topology(sd))
     return TrainingState(arrays=arrays, updater_leaves=updater_leaves,
                          iteration=iteration, epoch=int(epoch),
                          rng_seed=int(rng_seed),
                          normalizer_state=norm_state,
-                         metadata=dict(metadata or {}))
+                         metadata=meta)
 
 
 def restore_training_state(model_or_sd, state: TrainingState,

@@ -8,11 +8,15 @@
 - ``iterators`` : RetryingIterator — loader retry + corrupt-batch
   quarantine for the data pipeline
 - ``chaos``     : deterministic seed-driven fault injection (NaN grads,
-  loader exceptions, torn checkpoint commits, SIGTERM mid-window)
+  loader exceptions, torn checkpoint commits, SIGTERM mid-window,
+  host loss / topology shrink for elastic-resume drills)
 
-See docs/fault_tolerance.md.
+See docs/fault_tolerance.md and docs/elastic_training.md.
 """
-from deeplearning4j_tpu.faults.chaos import ChaosMonkey
+from deeplearning4j_tpu.checkpoint.manager import (ShardCountMismatchError,
+                                                   TopologyChangedError)
+from deeplearning4j_tpu.faults.chaos import (ChaosMonkey, FileBarrier,
+                                             HostKiller, HostLossInjector)
 from deeplearning4j_tpu.faults.errors import (DataPipelineError,
                                               FaultBudgetExhaustedError,
                                               FaultError,
@@ -25,7 +29,8 @@ from deeplearning4j_tpu.faults.sentinels import (LossSpikeWatcher,
                                                  PlateauWatcher)
 
 __all__ = ["ChaosMonkey", "DataPipelineError", "FaultBudgetExhaustedError",
-           "FaultError", "FaultTolerantFit", "LossSpikeWatcher",
-           "PlateauWatcher", "RetryPolicy", "RetryingIterator",
-           "TrainingDivergedError", "TransientDeviceError",
-           "retryable_errors"]
+           "FaultError", "FaultTolerantFit", "FileBarrier", "HostKiller",
+           "HostLossInjector", "LossSpikeWatcher", "PlateauWatcher",
+           "RetryPolicy", "RetryingIterator", "ShardCountMismatchError",
+           "TopologyChangedError", "TrainingDivergedError",
+           "TransientDeviceError", "retryable_errors"]

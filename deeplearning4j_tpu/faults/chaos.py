@@ -22,6 +22,15 @@ generator. Faults on offer (the ones the recovery rail must survive):
   writer leaves.
 - ``sigterm_listener(at_iteration)`` — delivers SIGTERM to this process
   at a training iteration, mid-window (drives PreemptionHook drills).
+- ``host_loss(trainer, surviving_strategy, at_iteration)`` — elastic
+  topology drill: the trainer's mesh shrinks mid-fit and a retryable
+  ``host_loss`` fault fires; FaultTolerantFit resumes RESHARDED on the
+  surviving devices (docs/elastic_training.md).
+- ``host_killer(at_iteration)`` / ``FileBarrier`` — multi-process
+  host-death drills: one process of a multihost dryrun ``os._exit``s
+  mid-window (no cleanup, no barrier release); peers see a barrier
+  timeout, the job dies, and the relaunched smaller job restores
+  through ``checkpoint.reshard`` (ShardCountMismatchError).
 
 Reference parity: optimize/listeners/FailureTestingListener.java
 injected OOM/exit/exception at listener trigger points; this harness
@@ -130,6 +139,120 @@ class BatchPoisoner(DataSetIterator):
                     batch = (self._poison(f), l)
             self._step += 1
             yield batch
+
+
+class HostLossInjector(Listener):
+    """Deterministic in-process host-loss drill: at training iteration
+    ``at_iteration`` the trainer's world shrinks to
+    ``surviving_strategy`` (the mesh a preemption would leave behind)
+    and a structured :class:`TransientDeviceError` (cause
+    ``"host_loss"``) aborts the fit — exactly what a lost slice looks
+    like from the training loop. ``faults.FaultTolerantFit``'s rollback
+    then restores the last committed checkpoint RESHARDED onto the
+    surviving mesh (ParallelTrainer records ``last_reshard``) and the
+    run continues on the shrunken topology.
+
+    One-shot; the strategy swap persists (the host stays dead)."""
+
+    frequency = 1
+
+    def __init__(self, trainer, surviving_strategy, at_iteration: int,
+                 log: Optional[List] = None):
+        self.trainer = trainer
+        self.surviving_strategy = surviving_strategy
+        self.at_iteration = int(at_iteration)
+        self.fired = False
+        self._log = log if log is not None else []
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if not self.fired and iteration >= self.at_iteration:
+            self.fired = True
+            lost = (self.trainer.strategy.mesh.n_devices
+                    - self.surviving_strategy.mesh.n_devices)
+            self._log.append({"event": "host_loss", "iteration": iteration,
+                              "devices_lost": lost, "t": time.time()})
+            self.trainer.strategy = self.surviving_strategy
+            raise TransientDeviceError(
+                f"chaos: injected host loss at iteration {iteration} "
+                f"({lost} device(s) gone; surviving mesh "
+                f"{dict(self.surviving_strategy.mesh.mesh.shape)})",
+                step=int(iteration), epoch=int(epoch), cause="host_loss")
+
+
+class HostKiller(Listener):
+    """SIGKILL-grade host death for multi-process drills: at training
+    iteration ``at_iteration`` the process exits immediately via
+    ``os._exit`` — no atexit hooks, no final checkpoint, no barrier
+    release; surviving peers discover the death as a barrier timeout.
+    The piece :class:`SigtermListener` (graceful preemption) cannot
+    simulate."""
+
+    frequency = 1
+
+    def __init__(self, at_iteration: int, exit_code: int = 137):
+        self.at_iteration = int(at_iteration)
+        self.exit_code = int(exit_code)
+
+    def iteration_done(self, sd, epoch, iteration, loss):
+        if iteration >= self.at_iteration:
+            os._exit(self.exit_code)
+
+
+class FileBarrier:
+    """Cross-process barrier over a shared directory (marker files) —
+    the CheckpointManager ``barrier=`` hook for multi-process chaos
+    drills without ``jax.distributed``. Each arrival writes
+    ``<run_id>.<tag>.g<generation>.<index>`` and spins until all
+    ``count`` markers exist; a peer that dies mid-protocol surfaces as
+    a ``TimeoutError`` here, which fails the save — the whole job dies,
+    and the relaunched job recovers through the elastic restore path.
+
+    Markers persist on disk, so a RELAUNCHED job reusing the same
+    barrier directory must pass a fresh ``run_id`` (every peer of a
+    launch the same one — e.g. an attempt counter from the launcher):
+    otherwise the dead job's markers would satisfy the new job's waits
+    instantly, letting a commit race an in-flight shard."""
+
+    def __init__(self, directory: str, index: int, count: int,
+                 timeout: float = 60.0, poll: float = 0.01,
+                 run_id: str = "r0"):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.index = int(index)
+        self.count = int(count)
+        self.timeout = float(timeout)
+        self.poll = float(poll)
+        self.run_id = "".join(c if c.isalnum() or c in "._-" else "_"
+                              for c in str(run_id))
+        self._generations: dict = {}
+
+    def __call__(self, tag: str) -> None:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in str(tag))
+        # a tag recurs when the same step is re-saved (rollback-retry);
+        # stale markers from the earlier arrival would satisfy the wait
+        # instantly and let a commit race an in-flight shard, so each
+        # recurrence gets its own generation (peers agree because
+        # multihost cadences are deterministic across processes)
+        gen = self._generations.get(safe, 0)
+        self._generations[safe] = gen + 1
+        safe = f"{self.run_id}.{safe}.g{gen}"
+        mine = os.path.join(self.directory, f"{safe}.{self.index}")
+        with open(mine, "w", encoding="utf-8") as fh:
+            fh.write("here\n")
+        deadline = time.monotonic() + self.timeout
+        want = [os.path.join(self.directory, f"{safe}.{i}")
+                for i in range(self.count)]
+        while True:
+            if all(os.path.exists(p) for p in want):
+                return
+            if time.monotonic() > deadline:
+                missing = [p for p in want if not os.path.exists(p)]
+                raise TimeoutError(
+                    f"chaos barrier {tag!r}: peer(s) never arrived "
+                    f"within {self.timeout}s ({missing}) — a host is "
+                    f"dead; the job should abort and relaunch elastic")
+            time.sleep(self.poll)
 
 
 class SigtermListener(Listener):
@@ -292,3 +415,26 @@ class ChaosMonkey:
     # -- process faults -------------------------------------------------
     def sigterm_listener(self, at_iteration: int) -> SigtermListener:
         return SigtermListener(at_iteration, log=self.log)
+
+    # -- topology faults ------------------------------------------------
+    def host_loss(self, trainer, surviving_strategy,
+                  at_iteration: Optional[int] = None,
+                  n_steps: Optional[int] = None) -> HostLossInjector:
+        """In-process host-loss drill (see :class:`HostLossInjector`):
+        mid-fit, the trainer's mesh shrinks to ``surviving_strategy``
+        and a retryable ``host_loss`` fault fires — the elastic e2e's
+        fault of choice. Draws the iteration from the seed when only
+        ``n_steps`` is given."""
+        if at_iteration is None:
+            if n_steps is None:
+                raise ValueError("pass at_iteration= or n_steps= to draw "
+                                 "one from the seed")
+            at_iteration = self.draw_step(1, n_steps)
+        return HostLossInjector(trainer, surviving_strategy, at_iteration,
+                                log=self.log)
+
+    def host_killer(self, at_iteration: int, exit_code: int = 137
+                    ) -> HostKiller:
+        """SIGKILL-grade process death at an iteration (multi-process
+        dryrun drills; see :class:`HostKiller`)."""
+        return HostKiller(at_iteration, exit_code=exit_code)

@@ -83,8 +83,11 @@ class FaultBudgetExhaustedError(FaultError):
 def retryable_errors() -> tuple:
     """Exception classes the recovery driver treats as recoverable:
     the structured fault taxonomy, numerics panics from the fit tiers,
-    checkpoint-write failures, and the backend's runtime errors
-    (preemption / transient device loss surface there)."""
+    checkpoint-write failures (``CheckpointError`` — which covers
+    ``TopologyChangedError``/``ShardCountMismatchError``, the elastic
+    topology-change signals routed through resharded restore), and the
+    backend's runtime errors (preemption / transient device loss
+    surface there)."""
     types = [TrainingDivergedError, DataPipelineError, TransientDeviceError]
     from deeplearning4j_tpu.autodiff.samediff import NumericsException
     types.append(NumericsException)

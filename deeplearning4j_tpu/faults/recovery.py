@@ -40,7 +40,8 @@ from typing import Callable, List, Optional, Sequence
 
 from deeplearning4j_tpu.checkpoint.listener import CheckpointListener
 from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
-                                                   CheckpointManager)
+                                                   CheckpointManager,
+                                                   TopologyChangedError)
 from deeplearning4j_tpu.faults.errors import (FaultBudgetExhaustedError,
                                               FaultError,
                                               retryable_errors)
@@ -136,6 +137,86 @@ class FaultTolerantFit:
             return self.model.restore_latest(self.manager)
         return self.manager.restore_latest(model=self.model)
 
+    def _maybe_precompile(self) -> None:
+        """Re-run AOT precompilation from the remembered spec after a
+        recovery that dropped or invalidated compiled programs (LR
+        rescale retraces; a topology change reshards every input). With
+        a persistent cache a previously-seen program is a cache hit;
+        either way the compile lands HERE, observable (compile.* spans,
+        the ``precompile`` event), not silently inside the first retry
+        window."""
+        spec = getattr(self.sd, "_precompile_spec", None)
+        if spec is None:
+            return
+        try:
+            info = self.sd.precompile(**spec)
+        except Exception as e:
+            # fall back to lazy compiles in the retry — but say so: a
+            # silent fallback would put the compile back inside the
+            # first retry window with zero observability, the exact
+            # condition the precompile event exists to surface
+            info = {"failed": f"{type(e).__name__}: {e}"}
+        self._publish("precompile", **info)
+
+    def _reshard_restore(self, cause: Optional[BaseException] = None,
+                         precompile: bool = True):
+        """Topology-change recovery: the committed shard set was
+        written by a different process/mesh count than this runtime
+        has. Reassemble the global state from ALL shards, re-slice it
+        for the current mesh (checkpoint/reshard.py), publish the
+        decision, and re-AOT if the graph was precompiled
+        (``precompile=False`` when the caller is about to mutate the
+        graph again — e.g. an LR rescale — and will re-AOT itself)."""
+        from deeplearning4j_tpu.checkpoint.reshard import restore_resharded
+        res = restore_resharded(self.manager, model=self.model,
+                                stats_storage=self.stats_storage)
+        if res is None:
+            raise FaultBudgetExhaustedError(
+                "no committed checkpoint to reshard from",
+                cause="no_checkpoint") from cause
+        step, state = res
+        info = dict(state.metadata.get("reshard_info") or {})
+        self._publish("reshard",
+                      **({"error": type(cause).__name__} if cause else {}),
+                      **info)
+        if precompile:
+            self._maybe_precompile()
+        return res
+
+    def resume_latest(self):
+        """Restore the newest committed checkpoint into the model —
+        the restart half of elastic training (call before ``fit`` in a
+        relaunched job). A same-topology restore goes through the
+        model's own hook; a :class:`TopologyChangedError` (the job came
+        back with a different process count after a host loss/rescale)
+        routes through the resharded restore and is published as a
+        ``reshard`` event. Returns ``(step, state)`` or None when no
+        committed checkpoint exists."""
+        try:
+            res = self._restore_latest()
+        except TopologyChangedError as e:
+            self._publish("topology_changed", error=type(e).__name__,
+                          step=e.step, manifest=e.manifest,
+                          runtime=e.runtime)
+            return self._reshard_restore(cause=e)
+        self._publish_trainer_reshard()
+        return res
+
+    def _publish_trainer_reshard(self, precompile: bool = True) -> None:
+        """A ParallelTrainer restore that crossed a MESH change (same
+        process count, different device mesh — e.g. resuming on a
+        shrunken sub-mesh) records the reshard on the trainer; surface
+        it on the fault rail too."""
+        lr = getattr(self.model, "last_reshard", None)
+        if lr:
+            self._publish("reshard", **lr)
+            if self.stats_storage is not None and \
+                    getattr(self.model, "stats_storage", None) is None:
+                self.stats_storage.put({"type": "reshard",
+                                        "t": time.time(), **lr})
+            if precompile:
+                self._maybe_precompile()
+
     def _rollback(self, cause: BaseException):
         t0 = time.perf_counter()
         rb_span = _tracer.span("faults.rollback", cat="faults",
@@ -150,8 +231,25 @@ class FaultTolerantFit:
         except CheckpointError:
             pass               # a failed async write IS the fault here
         removed = self.manager.gc_uncommitted()
+        # an LR rescale below mutates the graph (dropping every
+        # compiled program) and re-AOTs itself — precompiling in the
+        # reshard branch first would be compiled-then-discarded waste
+        will_rescale = self.policy.lr_rescale != 1.0 and isinstance(
+            getattr(self._tc().updater, "learning_rate", None),
+            (int, float))
         try:
-            res = self._restore_latest()
+            try:
+                res = self._restore_latest()
+                self._publish_trainer_reshard(precompile=not will_rescale)
+            except TopologyChangedError as e:
+                # the world changed shape between the snapshot and this
+                # rollback (host loss, elastic rescale): reassemble from
+                # ALL committed shards and re-slice for the current mesh
+                self._publish("topology_changed", error=type(e).__name__,
+                              step=e.step, manifest=e.manifest,
+                              runtime=e.runtime)
+                res = self._reshard_restore(cause=e,
+                                            precompile=not will_rescale)
             if res is None:
                 raise FaultBudgetExhaustedError(
                     "no committed checkpoint to roll back to",
@@ -173,18 +271,7 @@ class FaultTolerantFit:
                 # paying it silently inside the first retry window. With
                 # a persistent cache, a retry at a previously-seen LR is
                 # a cache hit.
-                spec = getattr(self.sd, "_precompile_spec", None)
-                if spec is not None:
-                    try:
-                        info = self.sd.precompile(**spec)
-                    except Exception as e:
-                        # fall back to lazy compiles in the retry — but
-                        # say so: a silent fallback would put the compile
-                        # back inside the first retry window with zero
-                        # observability, the exact condition the
-                        # precompile event exists to surface
-                        info = {"failed": f"{type(e).__name__}: {e}"}
-                    self._publish("precompile", **info)
+                self._maybe_precompile()
         dt = time.perf_counter() - t0
         self.recovery_seconds += dt
         self.rollbacks += 1

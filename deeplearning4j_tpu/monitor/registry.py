@@ -297,6 +297,32 @@ class MetricsRegistry:
                              ev.get("overhead_s", 0.0),
                              help="rollback wall time")
 
+    def fold_reshard(self, record: dict) -> None:
+        """Fold one ``{"type": "reshard"}`` record (checkpoint/
+        reshard.py / ParallelTrainer.restore_latest) into ``reshard_*``
+        metrics — how often elastic restores cross topology changes,
+        how much global state they reassemble, and how long the
+        re-slice costs."""
+        self.inc("reshard_events_total",
+                 help="elastic resharded restores (topology changes "
+                      "survived)")
+        self.inc("reshard_arrays_resliced_total", record.get("arrays", 0),
+                 help="arrays re-sliced onto a new mesh by resharded "
+                      "restores")
+        self.inc("reshard_bytes_gathered_total", record.get("bytes", 0),
+                 help="global-state bytes reassembled by resharded "
+                      "restores")
+        self.observe("reshard_seconds", record.get("seconds", 0.0),
+                     help="resharded-restore wall time")
+        if record.get("step") is not None:
+            self.set_gauge("reshard_last_step", record["step"],
+                           help="step of the last resharded restore")
+        if record.get("from_shards") is not None:
+            self.set_gauge("reshard_last_from_shards",
+                           record["from_shards"],
+                           help="shard count of the last resharded "
+                                "checkpoint")
+
     def fold_compile(self, stats_or_record) -> None:
         """Fold XLA compile accounting (``compilecache.COMPILE_STATS``
         or a stored ``{"type": "compile"}`` record) into ``compile_*``
@@ -360,6 +386,8 @@ class MetricsRegistry:
                 self.fold_steptime(rec)
             elif t == "compile":
                 self.fold_compile(rec)
+            elif t == "reshard":
+                self.fold_reshard(rec)
 
 
 __all__ = ["MetricsRegistry"]

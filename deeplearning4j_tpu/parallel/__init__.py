@@ -7,11 +7,13 @@ named mesh → sharding annotations → XLA inserts ICI/DCN collectives.
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, DeviceMesh)
 from deeplearning4j_tpu.parallel.sharding import (
-    ShardingRule, ShardingStrategy, data_and_tensor_parallel, data_parallel,
-    megatron_data_and_tensor_parallel, megatron_tensor_parallel_rules,
-    tensor_parallel_rules, transformer_tensor_parallel_rules)
+    ShardingRule, ShardingSpec, ShardingStrategy, data_and_tensor_parallel,
+    data_parallel, megatron_data_and_tensor_parallel,
+    megatron_tensor_parallel_rules, tensor_parallel_rules,
+    transformer_tensor_parallel_rules)
 from deeplearning4j_tpu.parallel.trainer import (
-    BatchedParallelInference, ParallelInference, ParallelTrainer)
+    BatchedParallelInference, ParallelInference, ParallelTrainer,
+    ensure_sharded, resolve_strategy, shard_model)
 from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention, ulysses_attention)
 from deeplearning4j_tpu.parallel.pipeline import (
@@ -24,7 +26,8 @@ from deeplearning4j_tpu.parallel import collectives, multihost
 
 __all__ = [
     "DeviceMesh", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
-    "ShardingRule", "ShardingStrategy", "data_parallel",
+    "ShardingRule", "ShardingSpec", "ShardingStrategy", "data_parallel",
+    "ensure_sharded", "resolve_strategy", "shard_model",
     "data_and_tensor_parallel", "tensor_parallel_rules",
     "ParallelTrainer", "ParallelInference", "BatchedParallelInference",
     "megatron_data_and_tensor_parallel", "megatron_tensor_parallel_rules",

@@ -32,6 +32,20 @@ class ShardingRule:
     def matches(self, name: str) -> bool:
         return re.search(self.pattern, name) is not None
 
+    def to_json(self) -> dict:
+        # PartitionSpec entries are None | axis name | tuple of axis
+        # names; tuples serialize as lists and round-trip back below
+        return {"pattern": self.pattern,
+                "spec": [list(e) if isinstance(e, tuple) else e
+                         for e in self.spec]}
+
+    @staticmethod
+    def from_json(d: dict) -> "ShardingRule":
+        return ShardingRule(
+            pattern=d["pattern"],
+            spec=tuple(tuple(e) if isinstance(e, list) else e
+                       for e in d["spec"]))
+
 
 class ShardingStrategy:
     """Resolves shardings for params and batch over a mesh."""
@@ -41,6 +55,33 @@ class ShardingStrategy:
         self.mesh = mesh
         self.param_rules = list(param_rules)
         self.batch_axes = batch_axes
+
+    def to_spec(self) -> "ShardingSpec":
+        """The declarative, serializable form of this live strategy:
+        axis sizes from the mesh, the explicit rule list (presets were
+        already expanded into rules at build time), and the batch
+        PartitionSpec — what ``TrainingConfig.to_json`` emits when
+        ``tc.sharding`` holds a strategy rather than a spec.
+
+        The batch (data) axis is emitted as ``-1`` ("fill with the
+        remaining devices") rather than its current concrete size:
+        a serialized config must rebind elastically when the relaunched
+        job has fewer devices — freezing the data extent at save time
+        would make ``build()`` fail on exactly the shrunken topology
+        the sharding field exists to survive. Model/pipe axes keep
+        their concrete sizes (they encode the layout of the rules)."""
+        axes = {str(k): int(v) for k, v in self.mesh.mesh.shape.items()}
+        fill = next((a for a in self.batch_axes
+                     if isinstance(a, str) and a in axes), None)
+        if fill is None and len(axes) == 1:
+            fill = next(iter(axes))
+        if fill is not None:
+            axes[fill] = -1
+        return ShardingSpec(
+            axes=axes,
+            preset="data_parallel",        # no preset rules to re-add
+            rules=list(self.param_rules),
+            batch_axes=tuple(self.batch_axes))
 
     def param_spec(self, name: str, ndim: int) -> PartitionSpec:
         for rule in self.param_rules:
@@ -210,3 +251,118 @@ def megatron_data_and_tensor_parallel(mesh: DeviceMesh,
                                             warn_empty=not covered)
     return ShardingStrategy(mesh, param_rules=rules,
                             batch_axes=(DATA_AXIS,))
+
+
+# ---------------------------------------------------------------------------
+# declarative strategy specs — the TrainingConfig-citizen form
+
+#: preset name → rule factory taking (model_or_None). ``data_parallel``
+#: keeps params replicated; the others produce TP rules over 'model'.
+_SPEC_PRESETS = {
+    "data_parallel": lambda model: [],
+    "tensor_parallel": lambda model: tensor_parallel_rules(),
+    "transformer": lambda model: transformer_tensor_parallel_rules(),
+}
+
+
+@dataclasses.dataclass
+class ShardingSpec:
+    """Declarative, serializable description of a ShardingStrategy —
+    the form that lives on ``TrainingConfig.sharding`` and round-trips
+    through config serde like every other training knob.
+
+    A ``ShardingStrategy`` holds live objects (a ``jax.sharding.Mesh``
+    over concrete devices); this spec holds only *intent* — axis sizes,
+    a rule preset, explicit per-layer rules — and ``build()`` binds it
+    to whatever devices the restoring process actually has. That split
+    is what makes elastic resume possible: a checkpoint records the
+    topology it was SAVED under, the spec rebuilds the strategy for the
+    topology it is RESTORED under (checkpoint/reshard.py).
+
+    - ``axes``: ordered ``{axis_name: size}``; ONE size may be ``-1``
+      ("fill with the remaining devices"), so ``{"data": -1}`` is pure
+      DP over however many chips exist and ``{"data": -1, "model": 2}``
+      is DP×TP that survives the data axis shrinking after a host loss.
+    - ``preset``: named rule set ("data_parallel" | "tensor_parallel" |
+      "transformer" | "megatron" — megatron derives column→row
+      alternation from the model's parameter names at build time).
+    - ``rules``: explicit ShardingRules, matched FIRST (before the
+      preset's), for per-layer overrides.
+    - ``batch_axes``: PartitionSpec entries for input batches (leading
+      dims); the fused-window form derives from it (window_sharding).
+    """
+    axes: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {DATA_AXIS: -1})
+    preset: str = "data_parallel"
+    rules: Sequence[ShardingRule] = ()
+    batch_axes: Tuple[Optional[str], ...] = (DATA_AXIS,)
+
+    def resolve_axes(self, n_devices: int) -> Dict[str, int]:
+        """Concrete axis sizes for ``n_devices`` (the one ``-1`` fills
+        with whatever the fixed axes leave)."""
+        sizes = {str(k): int(v) for k, v in self.axes.items()}
+        fills = [k for k, v in sizes.items() if v == -1]
+        if len(fills) > 1:
+            raise ValueError(f"at most one -1 (fill) axis allowed, "
+                             f"got {fills}")
+        fixed = 1
+        for k, v in sizes.items():
+            if v != -1:
+                if v <= 0:
+                    raise ValueError(f"axis {k!r} size must be positive "
+                                     f"or -1, got {v}")
+                fixed *= v
+        if fills:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"fixed axes {sizes} need a multiple of {fixed} "
+                    f"devices, have {n_devices}")
+            sizes[fills[0]] = max(1, n_devices // fixed)
+        return sizes
+
+    def build(self, model=None,
+              devices: Optional[Sequence] = None) -> ShardingStrategy:
+        """Bind this spec to concrete devices (default: all visible).
+        ``model`` is consulted only by the "megatron" preset (its rule
+        derivation reads the built network's parameter names)."""
+        import jax
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve_axes(len(devices))
+        n = 1
+        for v in sizes.values():
+            n *= v
+        mesh = DeviceMesh.create(devices=devices[:n], **sizes)
+        rules = list(self.rules)
+        if self.preset == "megatron":
+            if model is not None:
+                strat = megatron_data_and_tensor_parallel(mesh, model)
+                rules += strat.param_rules
+            else:
+                rules += tensor_parallel_rules()
+        elif self.preset in _SPEC_PRESETS:
+            rules += _SPEC_PRESETS[self.preset](model)
+        else:
+            raise ValueError(
+                f"unknown sharding preset {self.preset!r}; expected one "
+                f"of {sorted(_SPEC_PRESETS) + ['megatron']} (use rules= "
+                f"for custom layouts)")
+        return ShardingStrategy(mesh, param_rules=rules,
+                                batch_axes=tuple(self.batch_axes))
+
+    # -- serde (rides TrainingConfig.to_json/from_json) -----------------
+    def to_json(self) -> dict:
+        return {"axes": {str(k): int(v) for k, v in self.axes.items()},
+                "preset": self.preset,
+                "rules": [r.to_json() for r in self.rules],
+                "batch_axes": list(self.batch_axes)}
+
+    @staticmethod
+    def from_json(d) -> "Optional[ShardingSpec]":
+        if d is None:
+            return None
+        return ShardingSpec(
+            axes={str(k): int(v)
+                  for k, v in d.get("axes", {DATA_AXIS: -1}).items()},
+            preset=d.get("preset", "data_parallel"),
+            rules=[ShardingRule.from_json(r) for r in d.get("rules", [])],
+            batch_axes=tuple(d.get("batch_axes", [DATA_AXIS])))

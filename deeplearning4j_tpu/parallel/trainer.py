@@ -14,14 +14,69 @@ Reference parity:
 """
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.parallel.sharding import (
-    ShardingStrategy, data_parallel)
+    ShardingSpec, ShardingStrategy, data_parallel)
+
+
+def shard_model(model_or_sd, strategy: ShardingStrategy) -> None:
+    """Commit a model's parameter/state/constant arrays to the
+    strategy's mesh shardings (the placement half of ParallelTrainer,
+    shared with the ``TrainingConfig.sharding`` fit path and the
+    resharded-restore path in checkpoint/reshard.py)."""
+    sd = getattr(model_or_sd, "samediff", model_or_sd)
+    st = strategy
+    for n, v in sd.trainable_params().items():
+        sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
+    for n, v in sd.state_vars_map().items():
+        sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
+    for n, v in sd.constants_map().items():
+        sd._arrays[n] = jax.device_put(v, st.replicated())
+    if sd._updater_state is not None:
+        # updater state leaves mirror their parameter's sharding
+        new_state = {}
+        for pname, leaves in sd._updater_state.items():
+            sh = st.param_sharding(pname, np.ndim(
+                sd._arrays[pname]) if pname in sd._arrays else 0)
+            new_state[pname] = tuple(jax.device_put(l, sh) for l in leaves) \
+                if isinstance(leaves, tuple) else jax.device_put(leaves, sh)
+        sd._updater_state = new_state
+
+
+def resolve_strategy(sd, spec_or_strategy) -> ShardingStrategy:
+    """A live ShardingStrategy from either a strategy (as-is) or a
+    declarative ShardingSpec, cached on the SameDiff per (spec json,
+    device count) so repeated fits reuse one mesh."""
+    if isinstance(spec_or_strategy, ShardingStrategy):
+        return spec_or_strategy
+    spec: ShardingSpec = spec_or_strategy
+    import json
+    key = (json.dumps(spec.to_json(), sort_keys=True), len(jax.devices()))
+    cache = sd.__dict__.setdefault("_sharding_strategies", {})
+    strat = cache.get(key)
+    if strat is None:
+        strat = cache[key] = spec.build(model=sd)
+    return strat
+
+
+def ensure_sharded(sd, spec_or_strategy, dataset_iterator):
+    """The ``TrainingConfig.sharding`` fit hook: place the model on the
+    spec's mesh and wrap the input iterator so batches land pre-sharded.
+    A no-op when the iterator is already a _ShardedIterator (e.g. the
+    fit was routed through ParallelTrainer, whose explicit strategy
+    wins over the config spec)."""
+    if isinstance(dataset_iterator, _ShardedIterator):
+        return dataset_iterator
+    strategy = resolve_strategy(sd, spec_or_strategy)
+    shard_model(sd, strategy)
+    return _ShardedIterator(dataset_iterator, strategy)
 
 
 class _ShardedIterator:
@@ -32,6 +87,14 @@ class _ShardedIterator:
     def __init__(self, it, strategy: ShardingStrategy):
         self._it = it
         self._strategy = strategy
+        # expose stacked_batches ONLY when the wrapped source has it, so
+        # the scanned/cached-window fast tiers (which route on a hasattr
+        # probe) survive the wrap: stacked (steps, batch, ...) arrays
+        # land with the steps axis replicated and batch axes sharded —
+        # wrapping a device-cached source no longer demotes the fit to
+        # the streaming tier
+        if callable(getattr(it, "stacked_batches", None)):
+            self.stacked_batches = self._stacked_batches
 
     def reset(self):
         if hasattr(self._it, "reset"):
@@ -40,6 +103,16 @@ class _ShardedIterator:
     def _place(self, a):
         a = np.asarray(a)
         return jax.device_put(a, self._strategy.batch_sharding(a.ndim))
+
+    def _place_stacked(self, a):
+        import jax.numpy as jnp
+        a = jnp.asarray(a)
+        return jax.device_put(a, self._strategy.window_sharding(a.ndim))
+
+    def _stacked_batches(self):
+        feats, labels = self._it.stacked_batches()
+        return ([self._place_stacked(f) for f in feats],
+                [self._place_stacked(l) for l in labels])
 
     def window_sharding(self, ndim: int):
         """Fused-window placement hook (autodiff/window.py probes for
@@ -72,32 +145,29 @@ class ParallelTrainer:
     """
 
     def __init__(self, model, strategy: Optional[ShardingStrategy] = None,
-                 mesh: Optional[DeviceMesh] = None):
+                 mesh: Optional[DeviceMesh] = None,
+                 stats_storage=None):
         # accept MultiLayerNetwork or SameDiff
         self.sd = getattr(model, "samediff", model)
         self.model = model
         if strategy is None:
-            strategy = data_parallel(mesh or DeviceMesh.create())
+            # a declarative TrainingConfig.sharding spec is the next
+            # most specific intent; fall back to pure DP over the mesh
+            spec = getattr(getattr(self.sd, "training_config", None),
+                           "sharding", None)
+            if spec is not None and mesh is None:
+                strategy = resolve_strategy(self.sd, spec)
+            else:
+                strategy = data_parallel(mesh or DeviceMesh.create())
         self.strategy = strategy
+        self.stats_storage = stats_storage
+        #: info dict of the last restore that crossed a topology change
+        #: (None when the last restore matched the manifest topology)
+        self.last_reshard: Optional[dict] = None
 
     def shard_params(self) -> None:
         """Commit parameter/state arrays to their mesh shardings."""
-        sd, st = self.sd, self.strategy
-        for n, v in sd.trainable_params().items():
-            sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
-        for n, v in sd.state_vars_map().items():
-            sd._arrays[n] = jax.device_put(v, st.param_sharding(n, v.ndim))
-        for n, v in sd.constants_map().items():
-            sd._arrays[n] = jax.device_put(v, st.replicated())
-        if sd._updater_state is not None:
-            # updater state leaves mirror their parameter's sharding
-            new_state = {}
-            for pname, leaves in sd._updater_state.items():
-                sh = st.param_sharding(pname, np.ndim(
-                    sd._arrays[pname]) if pname in sd._arrays else 0)
-                new_state[pname] = tuple(jax.device_put(l, sh) for l in leaves) \
-                    if isinstance(leaves, tuple) else jax.device_put(leaves, sh)
-            sd._updater_state = new_state
+        shard_model(self.sd, self.strategy)
 
     def fit(self, dataset_iterator, epochs: int = 1, listeners: Sequence = ()):
         """Listeners pass through to the underlying SameDiff fit — a
@@ -107,14 +177,59 @@ class ParallelTrainer:
         return self.sd.fit(_ShardedIterator(dataset_iterator, self.strategy),
                            epochs=epochs, listeners=listeners)
 
-    def restore_latest(self, manager, strict: bool = True):
+    def restore_latest(self, manager, strict: bool = True,
+                       strategy: Optional[ShardingStrategy] = None):
         """Resume from a checkpoint.CheckpointManager: restore the newest
         committed step into the model (host arrays), then re-commit the
         arrays to their mesh shardings. Returns (step, TrainingState) or
-        None when no committed checkpoint exists."""
+        None when no committed checkpoint exists.
+
+        ``strategy=`` reshards the restored state into a DIFFERENT
+        sharding than the trainer was constructed with (elastic resume
+        onto a changed mesh; the override becomes the trainer's
+        strategy). When the checkpoint's recorded topology differs from
+        the target mesh the re-placement is surfaced as a
+        ``checkpoint.reshard`` span plus a ``{"type": "reshard"}``
+        record, and ``self.last_reshard`` holds the summary."""
         res = manager.restore_latest(model=self.model, strict=strict)
+        self.last_reshard = None
         if res is not None:
-            self.shard_params()
+            # adopt the override only once a restore actually landed —
+            # swapping before a None/raising restore would leave the
+            # trainer's strategy pointing at a mesh its params (still
+            # placed under the old one) have never been committed to
+            if strategy is not None:
+                self.strategy = strategy
+            step, state = res
+            from_topo = (state.metadata or {}).get("topology") or {}
+            to_axes = {str(k): int(v)
+                       for k, v in self.strategy.mesh.mesh.shape.items()}
+            # compare the SAVED mesh extent against the target mesh —
+            # not the process-wide device_count, which stays at e.g. 8
+            # while a sub-mesh trainer legitimately runs on 4 of them
+            # (an unsharded save has mesh_axes None, which != any mesh)
+            changed = bool(from_topo) and \
+                from_topo.get("mesh_axes") != to_axes
+            if changed:
+                t0 = time.perf_counter()
+                with _tracer.span("checkpoint.reshard", cat="checkpoint",
+                                  step=int(step)):
+                    self.shard_params()
+                self.last_reshard = {
+                    "step": int(step),
+                    "arrays": len(state.arrays),
+                    "bytes": int(state.nbytes()),
+                    "seconds": round(time.perf_counter() - t0, 6),
+                    "from_mesh": from_topo.get("mesh_axes"),
+                    "to_mesh": to_axes,
+                    "from_devices": from_topo.get("device_count"),
+                    "to_devices": self.strategy.mesh.n_devices}
+                if self.stats_storage is not None:
+                    self.stats_storage.put({"type": "reshard",
+                                            "t": time.time(),
+                                            **self.last_reshard})
+            else:
+                self.shard_params()
         return res
 
 

@@ -167,7 +167,7 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
-    "compile"})
+    "compile", "reshard"})
 
 
 def render_report(storage: StatsStorage, title: str = "Training report"
@@ -184,6 +184,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     traces = storage.of_type("trace")
     metrics = storage.of_type("metrics")
     compiles = storage.of_type("compile")
+    reshards = storage.of_type("reshard")
 
     parts = [f"""<!doctype html><html><head><meta charset="utf-8">
 <title>{_html.escape(title)}</title>
@@ -296,6 +297,36 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
             f"backend, {c.get('trace_seconds', 0.0):.2f}s tracing, "
             f"{c.get('saved_seconds', 0.0):.2f}s saved by the cache "
             f"(compilecache/, docs/cold_start.md)</p>")
+
+    # -- elasticity: resharded restores across topology changes ----------
+    if reshards:
+        parts.append(
+            f"<h2>Elastic reshards ({len(reshards)})</h2><table>"
+            f"<tr><th>step</th><th>shards</th><th>mesh</th>"
+            f"<th>arrays</th><th>MiB gathered</th><th>seconds</th></tr>")
+        for r in reshards[-20:]:
+            fm = r.get("from_mesh")
+            tm = r.get("to_mesh")
+            mesh = (f"{fm} → {tm}" if fm or tm else "—")
+            if r.get("from_shards") is not None or \
+                    r.get("to_processes") is not None:
+                shards = (f"{r.get('from_shards', '?')} → "
+                          f"{r.get('to_processes', '?')}")
+            else:
+                # trainer-origin records (in-process mesh change, no
+                # shard-count crossing) carry device counts instead
+                shards = (f"{r.get('from_devices', '?')} → "
+                          f"{r.get('to_devices', '?')} dev")
+            parts.append(
+                f"<tr><td>{r.get('step', '?')}</td>"
+                f"<td>{_html.escape(shards)}</td>"
+                f"<td>{_html.escape(str(mesh))}</td>"
+                f"<td>{r.get('arrays', 0)}</td>"
+                f"<td>{r.get('bytes', 0) / 2**20:.2f}</td>"
+                f"<td>{r.get('seconds', 0.0):.4f}</td></tr>")
+        parts.append("</table><p>save-on-N / restore-on-M elastic "
+                     "restores (checkpoint/reshard.py, "
+                     "docs/elastic_training.md)</p>")
 
     # -- observability: unified metrics snapshot -------------------------
     if metrics:

@@ -1,0 +1,758 @@
+"""Elastic distributed training: declarative sharding strategies +
+resharded resume across topology changes.
+
+Covers PR 7's rail end-to-end on the virtual 8-device CPU mesh:
+
+- ``ShardingSpec`` as a ``TrainingConfig`` citizen (serde round-trip,
+  -1 fill-axis resolution, presets) driving sharded fits through every
+  tier (scanned / fused windows / per-step) bit-exactly vs unsharded;
+- checkpoint manifests recording mesh topology + per-array
+  PartitionSpecs/global shapes, and the structured
+  ``ShardCountMismatchError``/``TopologyChangedError`` restore raises
+  when the runtime's process count differs from the manifest's;
+- ``checkpoint.reshard.restore_resharded``: save on N processes,
+  restore on M (N→M→N round-trip bit-exact), re-slice for the current
+  mesh, ``{"type": "reshard"}`` observability;
+- ``faults.FaultTolerantFit`` topology-change recovery: a chaos
+  host-loss mid-fit resumes RESHARDED on the surviving mesh with the
+  same loss trajectory; with topology unchanged, resume is bit-exact
+  (params + losses) with the sentinel armed;
+- the multi-process host-death drill (slow tier): one process of a
+  2-host job dies via ``os._exit`` mid-run, the peer times out on the
+  commit barrier, and the relaunched 1-process job resumes resharded.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.autodiff import (SameDiff, ScoreIterationListener,
+                                         TrainingConfig)
+from deeplearning4j_tpu.autodiff.training import Listener
+from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                           ShardCountMismatchError,
+                                           TopologyChangedError,
+                                           capture_training_state,
+                                           restore_resharded)
+from deeplearning4j_tpu.dataset.iterators import (ArrayDataSetIterator,
+                                                  DeviceCachedIterator)
+from deeplearning4j_tpu.faults import (ChaosMonkey, FaultTolerantFit,
+                                       RetryPolicy, TransientDeviceError,
+                                       retryable_errors)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.parallel import (DeviceMesh, ParallelTrainer,
+                                         ShardingRule, ShardingSpec,
+                                         data_parallel)
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _mlp(sharding=None, fused_steps=1, sentinel=False, lr=1e-2):
+    rng = np.random.default_rng(0)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 8))
+    w0 = sd.var("w0", value=rng.normal(0, .1, (8, 16)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(16, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    w1 = sd.var("w1", value=rng.normal(0, .1, (16, 2)).astype(np.float32))
+    logits = h.mmul(w1)
+    labels = sd.placeholder("labels", shape=(-1, 2))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = TrainingConfig(
+        updater=Adam(lr), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"], fused_steps=fused_steps,
+        sentinel=sentinel, sharding=sharding)
+    return sd
+
+
+def _data(n=128, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return X, Y
+
+
+def _quiet():
+    return ScoreIterationListener(print_every=10 ** 9,
+                                  print_fn=lambda *a: None)
+
+
+def _full_mesh_strategy():
+    return data_parallel(DeviceMesh.create(devices=jax.devices()))
+
+
+def _sub_mesh_strategy(n=4):
+    return data_parallel(DeviceMesh.create(devices=jax.devices()[:n]))
+
+
+# ---------------------------------------------------------------------------
+# ShardingSpec: the declarative TrainingConfig citizen
+
+class TestShardingSpec:
+    def test_serde_roundtrip(self):
+        spec = ShardingSpec(
+            axes={"data": -1, "model": 2}, preset="tensor_parallel",
+            rules=[ShardingRule(r"_special_W$", (None, "model")),
+                   ShardingRule(r"_mixed$", (("data", "model"), None))],
+            batch_axes=("data",))
+        d = spec.to_json()
+        back = ShardingSpec.from_json(d)
+        assert back.to_json() == d
+        # tuple-valued PartitionSpec entries survive the list round-trip
+        assert back.rules[1].spec == (("data", "model"), None)
+
+    def test_rides_training_config_serde(self):
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        d = sd.training_config.to_json()
+        tc2 = TrainingConfig.from_json(d)
+        assert tc2.sharding is not None
+        assert tc2.sharding.to_json() == sd.training_config.sharding.to_json()
+        # absent stays absent
+        assert TrainingConfig.from_json(_mlp().training_config.to_json()) \
+            .sharding is None
+
+    def test_fill_axis_resolution(self):
+        spec = ShardingSpec(axes={"data": -1, "model": 2})
+        assert spec.resolve_axes(8) == {"data": 4, "model": 2}
+        assert ShardingSpec(axes={"data": -1}).resolve_axes(8) == {"data": 8}
+        with pytest.raises(ValueError, match="one -1"):
+            ShardingSpec(axes={"data": -1, "model": -1}).resolve_axes(8)
+        with pytest.raises(ValueError, match="multiple"):
+            ShardingSpec(axes={"data": -1, "model": 3}).resolve_axes(8)
+
+    def test_build_binds_to_devices(self):
+        st = ShardingSpec(axes={"data": -1, "model": 2},
+                          preset="tensor_parallel").build()
+        assert dict(st.mesh.mesh.shape) == {"data": 4, "model": 2}
+        # unknown preset is a loud error, not silent replication
+        with pytest.raises(ValueError, match="preset"):
+            ShardingSpec(preset="nope").build()
+
+    def test_builder_hook(self):
+        tc = (TrainingConfig.builder().updater(Adam(1e-3))
+              .sharding(ShardingSpec(axes={"data": -1})).build())
+        assert tc.sharding.axes == {"data": -1}
+
+
+# ---------------------------------------------------------------------------
+# sharded fit through every tier
+
+class TestShardedFit:
+    def test_fit_places_params_and_matches_unsharded(self):
+        X, Y = _data()
+        sharded = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        h = sharded.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        plain = _mlp()
+        h2 = plain.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+        np.testing.assert_allclose(h.loss_curve.losses,
+                                   h2.loss_curve.losses, rtol=1e-5)
+        w0 = sharded.trainable_params()["w0"]
+        assert len(w0.sharding.device_set) == len(jax.devices())
+
+    def test_composes_with_fused_windows_and_sentinel(self):
+        X, Y = _data()
+        on = _mlp(sharding=ShardingSpec(axes={"data": -1}),
+                  fused_steps=4, sentinel=True)
+        h_on = on.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2,
+                      listeners=[_quiet()])
+        assert on.last_fit_stats["tier"] == "windowed"
+        off = _mlp(sharding=ShardingSpec(axes={"data": -1}), fused_steps=4)
+        h_off = off.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2,
+                        listeners=[_quiet()])
+        # sentinel on vs off stays bit-identical under the mesh
+        np.testing.assert_array_equal(h_on.loss_curve.losses,
+                                      h_off.loss_curve.losses)
+        for n, a in on.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(off.trainable_params()[n]), n)
+
+    def test_scanned_tier_survives_the_wrap(self):
+        """A device-cached source keeps the one-dispatch-per-epoch tier
+        under TrainingConfig.sharding (the stacked_batches passthrough
+        places (steps, batch, ...) stacks with the window sharding)."""
+        X, Y = _data(n=64)
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        h = sd.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=1)
+        assert sd.last_fit_stats["tier"] == "scanned_epoch"
+        plain = _mlp()
+        h2 = plain.fit(DeviceCachedIterator(X, Y, batch_size=16), epochs=1)
+        np.testing.assert_allclose(h.final_loss(), h2.final_loss(),
+                                   rtol=1e-5)
+
+    def test_parallel_trainer_adopts_config_spec(self):
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1, "model": 2},
+                                        preset="tensor_parallel"))
+        trainer = ParallelTrainer(sd)
+        assert dict(trainer.strategy.mesh.mesh.shape) == \
+            {"data": 4, "model": 2}
+
+
+# ---------------------------------------------------------------------------
+# topology manifests + structured restore errors
+
+class TestTopologyManifest:
+    def test_capture_records_mesh_and_specs(self):
+        X, Y = _data(n=64)
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        topo = capture_training_state(sd, epoch=1).metadata["topology"]
+        assert topo["mesh_axes"] == {"data": len(jax.devices())}
+        assert topo["device_count"] == len(jax.devices())
+        assert set(topo["global_shapes"]) == set(sd.trainable_params())
+        assert topo["global_shapes"]["w0"] == [8, 16]
+        # every mesh-resident array records how it was sliced
+        assert set(topo["partition_specs"]) == set(sd.trainable_params())
+
+    def test_topology_roundtrips_through_commit(self, tmp_path):
+        X, Y = _data(n=64)
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(3, model=sd, epoch=1)
+            _, state = mgr.restore_latest()
+        topo = state.metadata["topology"]
+        assert topo["mesh_axes"] == {"data": len(jax.devices())}
+        assert topo["global_shapes"]["w1"] == [16, 2]
+
+    def test_shard_count_mismatch_is_structured(self, tmp_path):
+        sd = _mlp()
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(7, model=sd, epoch=0)
+        mgr2 = CheckpointManager(tmp_path, process_index=0, process_count=2,
+                                 barrier=lambda tag: None,
+                                 async_write=False)
+        with pytest.raises(ShardCountMismatchError) as ei:
+            mgr2.restore_latest()
+        err = ei.value
+        assert err.manifest_count == 1 and err.runtime_count == 2
+        assert err.step == 7
+        assert isinstance(err, TopologyChangedError)
+        # the rail treats it as retryable (CheckpointError family)
+        assert isinstance(err, retryable_errors())
+        with pytest.raises(ShardCountMismatchError):
+            mgr2.restore(7)
+        # the reshard path bypasses the check
+        assert mgr2.restore_latest(allow_reshard=True)[0] == 7
+
+
+# ---------------------------------------------------------------------------
+# resharded restore: save on N, restore on M
+
+def _save_two_process(tmp_path, sd, step=5, epoch=1):
+    barrier = threading.Barrier(2, timeout=30)
+    mgrs = [CheckpointManager(tmp_path, process_index=i, process_count=2,
+                              barrier=lambda tag: barrier.wait(),
+                              async_write=False)
+            for i in range(2)]
+    state = capture_training_state(sd, epoch=epoch)
+    errs = []
+
+    def run(i):
+        try:
+            mgrs[i].save(step, state=state)
+        except BaseException as e:     # surfaced via the assert below
+            errs.append(e)
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert not errs, errs
+    return mgrs
+
+
+class TestReshardedRestore:
+    @pytest.mark.slow
+    def test_n_to_m_to_n_roundtrip_bit_exact(self, tmp_path):
+        """Save on 2 processes → restore on 1 (resharded onto a
+        4-device mesh) → save on 1 → restore on 2 (resharded again):
+        the global params stay bit-exact through both crossings."""
+        X, Y = _data(n=64)
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        _save_two_process(tmp_path, sd)
+
+        mgr1 = CheckpointManager(tmp_path, process_index=0,
+                                 process_count=1, async_write=False)
+        storage = StatsStorage()
+        sd2 = _mlp()
+        trainer = ParallelTrainer(sd2, strategy=_sub_mesh_strategy(4))
+        step, state = restore_resharded(mgr1, model=trainer,
+                                        stats_storage=storage)
+        assert step == 5
+        info = state.metadata["reshard_info"]
+        assert info["from_shards"] == 2 and info["to_processes"] == 1
+        assert info["from_mesh"] == {"data": 8}
+        assert info["to_mesh"] == {"data": 4}
+        assert info["arrays"] == len(state.arrays) > 0
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(sd2.trainable_params()[n]), n)
+        assert len(sd2.trainable_params()["w0"].sharding.device_set) == 4
+        [rec] = storage.of_type("reshard")
+        assert rec["bytes"] > 0
+
+        # ... and back: 1-shard save, 2-process runtime reshards again
+        mgr1.save(6, model=sd2, epoch=1, blocking=True)
+        mgr2 = CheckpointManager(tmp_path, process_index=0,
+                                 process_count=2,
+                                 barrier=lambda tag: None,
+                                 async_write=False)
+        with pytest.raises(ShardCountMismatchError):
+            mgr2.restore_latest()
+        sd3 = _mlp()
+        step, _ = restore_resharded(mgr2, model=sd3)
+        assert step == 6
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(sd3.trainable_params()[n]), n)
+
+    def test_restore_resharded_none_when_empty(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, async_write=False)
+        assert restore_resharded(mgr, model=_mlp()) is None
+
+    def test_trainer_restore_honors_strategy_override(self, tmp_path):
+        """ParallelTrainer.restore_latest(strategy=...) reshards the
+        restored state into a DIFFERENT sharding than construction
+        time — restore-into-a-new-mesh works standalone."""
+        X, Y = _data(n=64)
+        sd = _mlp()
+        trainer = ParallelTrainer(sd, strategy=_full_mesh_strategy())
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        storage = StatsStorage()
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(4, model=sd, epoch=1)
+            sd2 = _mlp()
+            t2 = ParallelTrainer(sd2, strategy=_full_mesh_strategy(),
+                                 stats_storage=storage)
+            res = t2.restore_latest(mgr, strategy=_sub_mesh_strategy(2))
+        assert res is not None and res[0] == 4
+        assert t2.strategy.mesh.n_devices == 2
+        assert len(sd2.trainable_params()["w0"].sharding.device_set) == 2
+        assert t2.last_reshard["from_mesh"] == {"data": 8}
+        assert t2.last_reshard["to_mesh"] == {"data": 2}
+        [rec] = storage.of_type("reshard")
+        assert rec["to_devices"] == 2
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(sd2.trainable_params()[n]), n)
+
+    def test_trainer_restore_same_topology_records_no_reshard(
+            self, tmp_path):
+        X, Y = _data(n=64)
+        sd = _mlp()
+        trainer = ParallelTrainer(sd, strategy=_full_mesh_strategy())
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(4, model=sd, epoch=1)
+            t2 = ParallelTrainer(_mlp(), strategy=_full_mesh_strategy())
+            assert t2.restore_latest(mgr) is not None
+        assert t2.last_reshard is None
+
+
+# ---------------------------------------------------------------------------
+# FaultTolerantFit: topology-change recovery
+
+class TestElasticRecovery:
+    @pytest.mark.chaos
+    def test_host_loss_resumes_resharded_same_trajectory(self, tmp_path):
+        """Acceptance e2e: a sharded fit survives a chaos host loss
+        (mesh 8 → 4 mid-fit) by resuming RESHARDED on the surviving
+        topology; the continued loss trajectory matches the
+        uninterrupted full-mesh run."""
+        X, Y = _data()
+        ref = _mlp(fused_steps=4, sentinel=True)
+        rt = ParallelTrainer(ref, strategy=_full_mesh_strategy())
+        h_ref = rt.fit(ArrayDataSetIterator(X, Y, batch_size=16),
+                       epochs=4, listeners=[_quiet()])
+
+        sd = _mlp(fused_steps=4, sentinel=True)
+        trainer = ParallelTrainer(sd, strategy=_full_mesh_strategy())
+        chaos = ChaosMonkey(seed=7)
+        injector = chaos.host_loss(trainer, _sub_mesh_strategy(4),
+                                   at_iteration=17)
+        storage = StatsStorage()
+        mgr = CheckpointManager(tmp_path, keep_last_n=5)
+        ftf = FaultTolerantFit(
+            trainer, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0),
+            checkpoint_every_n_epochs=1, stats_storage=storage,
+            sleep=lambda s: None)
+        h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=4,
+                    listeners=[injector, _quiet()])
+        mgr.close()
+        assert injector.fired
+        assert ftf.rollbacks == 1
+        # resumed on the shrunken mesh
+        assert len(sd.trainable_params()["w0"].sharding.device_set) == 4
+        events = [r["event"] for r in storage.of_type("faults")]
+        assert "fault" in events and "rollback" in events
+        assert "reshard" in events and "recovered" in events
+        reshard_ev = next(r for r in storage.of_type("faults")
+                          if r["event"] == "reshard")
+        assert reshard_ev["from_mesh"] == {"data": 8}
+        assert reshard_ev["to_mesh"] == {"data": 4}
+        assert chaos.log[0]["event"] == "host_loss"
+        # trajectory: the final attempt's epochs match the uninterrupted
+        # run's tail (rounding may differ across collective orders)
+        tail = h_ref.loss_curve.losses[-len(h.loss_curve.losses):]
+        np.testing.assert_allclose(h.loss_curve.losses, tail, rtol=1e-4)
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(ref.trainable_params()[n]),
+                rtol=1e-4, atol=1e-6, err_msg=n)
+
+    @pytest.mark.chaos
+    def test_unchanged_topology_resume_bit_exact_sentinel_on(
+            self, tmp_path):
+        """With the topology unchanged, a fault-and-rollback resume is
+        BIT-exact vs the uninterrupted run (params + losses), device
+        sentinel armed throughout."""
+        X, Y = _data()
+        ref = _mlp(fused_steps=4, sentinel=True)
+        rt = ParallelTrainer(ref, strategy=_full_mesh_strategy())
+        h_ref = rt.fit(ArrayDataSetIterator(X, Y, batch_size=16),
+                       epochs=4, listeners=[_quiet()])
+
+        class Bomb(Listener):
+            frequency = 1
+            fired = False
+
+            def iteration_done(self, s, e, it, loss):
+                if not self.fired and it >= 17:
+                    self.fired = True
+                    raise TransientDeviceError("chaos: transient",
+                                               step=it, cause="device")
+
+        sd = _mlp(fused_steps=4, sentinel=True)
+        trainer = ParallelTrainer(sd, strategy=_full_mesh_strategy())
+        storage = StatsStorage()
+        mgr = CheckpointManager(tmp_path, keep_last_n=5)
+        ftf = FaultTolerantFit(
+            trainer, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.0),
+            checkpoint_every_n_epochs=1, stats_storage=storage,
+            sleep=lambda s: None)
+        h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=4,
+                    listeners=[Bomb(), _quiet()])
+        mgr.close()
+        assert ftf.rollbacks == 1
+        assert sd.training_config.sentinel
+        # no topology change → no reshard event
+        events = [r["event"] for r in storage.of_type("faults")]
+        assert "reshard" not in events
+        np.testing.assert_array_equal(
+            h.loss_curve.losses,
+            h_ref.loss_curve.losses[-len(h.loss_curve.losses):])
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(ref.trainable_params()[n]), n)
+
+    @pytest.mark.chaos
+    def test_resume_latest_reshards_on_mismatch(self, tmp_path):
+        """The restart half: a relaunched job with a different process
+        count resumes through ftf.resume_latest() — plain restore
+        raises ShardCountMismatchError, the rail reshards."""
+        X, Y = _data(n=64)
+        sd = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        _save_two_process(tmp_path, sd)
+        mgr = CheckpointManager(tmp_path, process_count=1,
+                                async_write=False)
+        storage = StatsStorage()
+        sd2 = _mlp(sharding=ShardingSpec(axes={"data": -1}))
+        ftf = FaultTolerantFit(sd2, mgr, stats_storage=storage,
+                               sleep=lambda s: None)
+        res = ftf.resume_latest()
+        assert res is not None and res[0] == 5
+        events = [r["event"] for r in storage.of_type("faults")]
+        assert "topology_changed" in events and "reshard" in events
+        for n, a in sd.trainable_params().items():
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(sd2.trainable_params()[n]), n)
+        # continue training on the current (1-process) topology
+        h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        assert np.isfinite(h.final_loss())
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+class TestReshardObservability:
+    def _record(self):
+        return {"type": "reshard", "step": 5, "arrays": 4,
+                "bytes": 2048, "seconds": 0.01, "from_shards": 2,
+                "from_mesh": {"data": 8}, "to_mesh": {"data": 4},
+                "from_processes": 2, "to_processes": 1, "t": 0.0}
+
+    def test_fold_reshard_metrics(self):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        storage = StatsStorage()
+        storage.put(self._record())
+        reg.fold_storage(storage)
+        assert reg.get("reshard_events_total") == 1
+        assert reg.get("reshard_arrays_resliced_total") == 4
+        assert reg.get("reshard_bytes_gathered_total") == 2048
+        assert reg.get("reshard_last_from_shards") == 2
+        text = reg.to_prometheus_text()
+        assert "dl4j_reshard_seconds" in text
+        # idempotent over a growing storage
+        reg.fold_storage(storage)
+        assert reg.get("reshard_events_total") == 1
+
+    def test_report_renders_reshards(self):
+        from deeplearning4j_tpu.ui.report import render_report
+        storage = StatsStorage()
+        storage.put(self._record())
+        html = render_report(storage)
+        assert "Elastic reshards" in html
+        assert "unrendered record types" not in html
+
+    def test_reshard_emits_span(self, tmp_path):
+        from deeplearning4j_tpu.monitor.trace import TRACER
+        X, Y = _data(n=64)
+        sd = _mlp()
+        trainer = ParallelTrainer(sd, strategy=_full_mesh_strategy())
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(2, model=sd, epoch=1)
+            TRACER.enable()
+            try:
+                t2 = ParallelTrainer(_mlp(),
+                                     strategy=_full_mesh_strategy())
+                t2.restore_latest(mgr, strategy=_sub_mesh_strategy(2))
+                spans, _, _ = TRACER.drain()
+            finally:
+                TRACER.disable()
+        assert any(s.name == "checkpoint.reshard" for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# multi-process host-death drill (slow tier: real processes, file barrier)
+
+_WORKER_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + \
+        " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.checkpoint import CheckpointListener, \
+    CheckpointManager
+from deeplearning4j_tpu.faults import FileBarrier, HostKiller
+from deeplearning4j_tpu.learning.updaters import Adam
+
+idx = int(sys.argv[1]); ckpt = sys.argv[2]; bdir = sys.argv[3]
+
+rng = np.random.default_rng(0)
+sd = SameDiff()
+x = sd.placeholder("x", shape=(-1, 8))
+w0 = sd.var("w0", value=rng.normal(0, .1, (8, 16)).astype(np.float32))
+b0 = sd.var("b0", value=np.zeros(16, np.float32))
+h = sd.nn.relu(x.mmul(w0).add(b0))
+w1 = sd.var("w1", value=rng.normal(0, .1, (16, 2)).astype(np.float32))
+labels = sd.placeholder("labels", shape=(-1, 2))
+sd.loss.softmax_cross_entropy(h.mmul(w1), labels, name="loss")
+sd.set_loss_variables(["loss"])
+sd.training_config = TrainingConfig(
+    updater=Adam(1e-2), data_set_feature_mapping=["x"],
+    data_set_label_mapping=["labels"], fused_steps=2, sentinel=True)
+
+drng = np.random.default_rng(1)
+X = drng.normal(size=(64, 8)).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[drng.integers(0, 2, 64)]
+
+# each "host" trains the identical replica (pure DP, shared seed/data)
+# and writes its name-shard of every checkpoint into the shared dir
+mgr = CheckpointManager(ckpt, process_index=idx, process_count=2,
+                        barrier=FileBarrier(bdir, idx, 2, timeout=20),
+                        async_write=False)
+listeners = [CheckpointListener(mgr, every_n_epochs=1)]
+if idx == 1:
+    listeners.append(HostKiller(at_iteration=9))   # dies inside epoch 2
+
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+sd.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=4,
+       listeners=listeners)
+print("worker", idx, "finished")
+"""
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+
+class TestReviewRegressions:
+    def test_sub_mesh_trainer_restore_is_not_a_spurious_reshard(
+            self, tmp_path):
+        """A trainer on a SUB-mesh of the process's devices (4 of 8)
+        restores a checkpoint saved on that same sub-mesh without
+        flagging a reshard — the detector compares the saved mesh
+        extent, not the process-wide device_count (which stays 8)."""
+        X, Y = _data(n=64)
+        sd = _mlp()
+        trainer = ParallelTrainer(sd, strategy=_sub_mesh_strategy(4))
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=1)
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(3, model=sd, epoch=1)
+            t2 = ParallelTrainer(_mlp(), strategy=_sub_mesh_strategy(4))
+            assert t2.restore_latest(mgr) is not None
+        assert t2.last_reshard is None
+
+    def test_file_barrier_tag_reuse_requires_fresh_arrivals(self,
+                                                            tmp_path):
+        """Re-saving the same step re-uses barrier tags; stale markers
+        from the first crossing must NOT satisfy the second (each
+        recurrence gets its own generation)."""
+        from deeplearning4j_tpu.faults import FileBarrier
+        b0 = FileBarrier(tmp_path, 0, 2, timeout=0.3, poll=0.01)
+        b1 = FileBarrier(tmp_path, 1, 2, timeout=5.0, poll=0.01)
+        t = threading.Thread(target=b1, args=("step_5_staged",))
+        t.start()
+        b0("step_5_staged")            # first crossing completes
+        t.join(timeout=10)
+        assert not t.is_alive()
+        with pytest.raises(TimeoutError):
+            b0("step_5_staged")        # second: peer never re-arrives
+        # a relaunched job (fresh run_id, same dir) must not be fed by
+        # the dead job's markers either
+        b_new = FileBarrier(tmp_path, 0, 2, timeout=0.3, poll=0.01,
+                            run_id="r1")
+        with pytest.raises(TimeoutError):
+            b_new("step_5_staged")
+
+    def test_restore_resharded_skips_corrupt_newest_step(self, tmp_path):
+        """A bit-flipped newest step must not kill the reshard path —
+        it falls back to the older intact checkpoint like
+        restore_latest does."""
+        sd = _mlp()
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(1, model=sd, epoch=0)
+            mgr.save(2, model=sd, epoch=0)
+            d = mgr.step_dir(2)
+            victim = next(os.path.join(d, f) for f in sorted(os.listdir(d))
+                          if f.endswith(".npz"))
+            data = bytearray(open(victim, "rb").read())
+            data[len(data) // 2] ^= 0xFF        # same size, bad hash
+            with open(victim, "wb") as fh:
+                fh.write(data)
+            res = restore_resharded(mgr, model=_mlp())
+        assert res is not None and res[0] == 1
+
+    def test_config_serde_accepts_live_strategy(self):
+        """The fit path accepts a live ShardingStrategy on
+        tc.sharding; to_json must serialize it (via its declarative
+        spec) instead of crashing — and the emitted spec stays ELASTIC:
+        the data axis round-trips as -1 so a relaunched job with fewer
+        devices rebinds instead of failing on the frozen extent."""
+        sd = _mlp()
+        sd.training_config.sharding = _sub_mesh_strategy(4)
+        d = sd.training_config.to_json()
+        assert d["sharding"]["axes"] == {"data": -1}
+        back = TrainingConfig.from_json(d)
+        assert isinstance(back.sharding, ShardingSpec)
+        # rebinds to whatever the relaunched process has
+        assert back.sharding.build().mesh.n_devices == len(jax.devices())
+        assert back.sharding.build(
+            devices=jax.devices()[:2]).mesh.n_devices == 2
+
+    def test_strategy_override_not_adopted_without_a_restore(
+            self, tmp_path):
+        """restore_latest(strategy=) on an empty manager returns None
+        and must NOT swap the trainer's strategy — params are still
+        placed under the old mesh, and a half-adopted override would
+        make the next fit dispatch with incompatible devices."""
+        t = ParallelTrainer(_mlp(), strategy=_full_mesh_strategy())
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            assert t.restore_latest(mgr,
+                                    strategy=_sub_mesh_strategy(2)) is None
+        assert t.strategy.mesh.n_devices == len(jax.devices())
+
+    def test_restore_resharded_lost_file_is_retryable(self, tmp_path,
+                                                      monkeypatch):
+        """A file vanishing between verification and read (retention
+        race) surfaces as a retryable CheckpointError, not a raw
+        FileNotFoundError that would abort the recovery rail."""
+        from deeplearning4j_tpu.checkpoint import manager as mgr_mod
+        from deeplearning4j_tpu.checkpoint import reshard as reshard_mod
+        sd = _mlp()
+        with CheckpointManager(tmp_path, async_write=False) as mgr:
+            mgr.save(1, model=sd, epoch=0)
+            def gone(d):
+                raise FileNotFoundError("races with retention")
+            monkeypatch.setattr(reshard_mod, "read_state_files", gone)
+            with pytest.raises(mgr_mod.CheckpointError) as ei:
+                restore_resharded(mgr, model=_mlp())
+        assert not isinstance(ei.value, TopologyChangedError)
+        assert isinstance(ei.value, retryable_errors())
+
+    def test_report_renders_trainer_origin_reshards(self):
+        """Trainer-origin reshard records carry device counts, not
+        shard counts; the report must not render them as '? → ?'."""
+        from deeplearning4j_tpu.ui.report import render_report
+        storage = StatsStorage()
+        storage.put({"type": "reshard", "step": 4, "arrays": 4,
+                     "bytes": 1024, "seconds": 0.01,
+                     "from_mesh": {"data": 8}, "to_mesh": {"data": 2},
+                     "from_devices": 8, "to_devices": 2, "t": 0.0})
+        html = render_report(storage)
+        assert "Elastic reshards" in html
+        assert "? → ?" not in html
+        assert "8 → 2 dev" in html
+
+
+@pytest.mark.slow
+@pytest.mark.chaos(timeout=300)
+def test_multihost_host_death_elastic_resume(tmp_path):
+    """The full drill: a 2-process job (shared checkpoint dir, file
+    barrier) loses one host to os._exit mid-window; the survivor times
+    out on the commit barrier and the job dies. The relaunched
+    1-process job restores RESHARDED from the 2-shard checkpoint and
+    trains to completion."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = str(tmp_path / "ckpt")
+    bdir = str(tmp_path / "barrier")
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SCRIPT.format(repo=repo))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), ckpt, bdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    rcs = [p.wait(timeout=240) for p in procs]
+    outs = [p.stdout.read().decode() for p in procs]
+    # host 1 was killed (137); host 0 died on the barrier timeout — the
+    # job did NOT complete
+    assert rcs[1] == 137, outs[1]
+    assert rcs[0] != 0, outs[0]
+    assert "finished" not in outs[0]
+
+    # the relaunched single-process job: ShardCountMismatch → reshard
+    mgr = CheckpointManager(ckpt, process_count=1, async_write=False)
+    assert mgr.latest_step() is not None
+    with pytest.raises(ShardCountMismatchError):
+        mgr.restore_latest()
+    X, Y = _data(n=64)
+    sd = _mlp(fused_steps=2, sentinel=True)
+    storage = StatsStorage()
+    ftf = FaultTolerantFit(sd, mgr, stats_storage=storage,
+                           sleep=lambda s: None)
+    res = ftf.resume_latest()
+    assert res is not None
+    step, state = res
+    assert state.metadata["reshard_info"]["from_shards"] == 2
+    h = ftf.fit(ArrayDataSetIterator(X, Y, batch_size=16),
+                epochs=4 - sd.training_config.epoch_count)
+    assert np.isfinite(h.final_loss())
+    assert sd.training_config.epoch_count == 4
+    events = [r["event"] for r in storage.of_type("faults")]
+    assert "topology_changed" in events and "reshard" in events
+    mgr.close()
