@@ -90,7 +90,7 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
 
 
 def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
-                  seed=0):
+                  seed=0, tensorstats=None):
     """The BASELINE config-2 MLP graph (784 -> hidden -> 10, softmax CE,
     Adam 1e-3) — shared by bench_samediff_mlp and the cold-start child
     probe so the restart metric measures the same program the throughput
@@ -113,29 +113,34 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
     labels = sd.placeholder("labels", shape=(-1, 10))
     sd.loss.softmax_cross_entropy(logits, labels, name="loss")
     sd.set_loss_variables(["loss"])
-    sd.training_config = (TrainingConfig.builder()
-                          .updater(Adam(learning_rate=1e-3))
-                          .data_set_feature_mapping("x")
-                          .data_set_label_mapping("labels")
-                          .fused_steps(fused_steps)
-                          .sentinel(sentinel).build())
+    builder = (TrainingConfig.builder()
+               .updater(Adam(learning_rate=1e-3))
+               .data_set_feature_mapping("x")
+               .data_set_label_mapping("labels")
+               .fused_steps(fused_steps)
+               .sentinel(sentinel))
+    if tensorstats is not None:
+        builder.tensorstats(tensorstats)
+    sd.training_config = builder.build()
     return sd
 
 
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                        fused_steps=1, sentinel=False,
-                       monitor_storage=None):
+                       monitor_storage=None, tensorstats=None):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
     give the listener-path variant (see bench_lenet); ``sentinel`` arms
     the device-side divergence sentinel (docs/fault_tolerance.md);
     ``monitor_storage`` attaches a monitor.MonitorListener publishing
-    steptime/metrics records into it (docs/observability.md)."""
+    steptime/metrics records into it; ``tensorstats`` (True or a
+    TensorStatsConfig) arms the in-graph per-layer statistics
+    (docs/observability.md)."""
     from deeplearning4j_tpu.autodiff import ScoreIterationListener
 
     rng = np.random.default_rng(0)
     sd = _build_mlp_sd(hidden=hidden, fused_steps=fused_steps,
-                       sentinel=sentinel)
+                       sentinel=sentinel, tensorstats=tensorstats)
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     n = 2048
@@ -188,6 +193,37 @@ def bench_sentinel_overhead(batch=128, fused_steps=8, repeats=2):
             "step_time_ms": round(1000.0 * batch / best[True], 3)
             if best[True] else 0.0,
             "sentinel_overhead_pct": round(overhead, 2),
+            "batch": batch, "fused_steps": fused_steps}
+
+
+def bench_tensorstats_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the in-graph tensor-statistics rail (monitor/
+    tensorstats.py, docs/observability.md): the fused-window listener
+    config with per-layer grad/update/param summaries off vs on at the
+    default sampling cadence. The stats compute under a lax.cond only
+    on sampled steps (1-in-every_n), plus two small extra carry
+    outputs per window and their share of the flush's device_get —
+    the acceptance bar is ≤3% steps/s. Same best-of-``repeats``
+    interleaved estimator as sentinel_overhead (run-to-run tunnel
+    jitter exceeds the effect size)."""
+    from deeplearning4j_tpu.monitor import TensorStatsConfig
+
+    cfg = TensorStatsConfig()          # the default cadence under test
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            r = bench_samediff_mlp(batch=batch, listener=True,
+                                   fused_steps=fused_steps,
+                                   tensorstats=cfg if flag else None)
+            best[flag] = max(best[flag], r["samples_per_sec"])
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_tensorstats_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "tensorstats_overhead_pct": round(overhead, 2),
+            "every_n": cfg.every_n, "families": list(cfg.families),
             "batch": batch, "fused_steps": fused_steps}
 
 
@@ -534,6 +570,10 @@ def main():
                      # the fault rail's cost stays visible: fused-window
                      # steps/s with divergence sentinels on vs off
                      ("sentinel_overhead", bench_sentinel_overhead),
+                     # the tensorstats rail's cost (in-graph per-layer
+                     # grad/update/param summaries at default cadence,
+                     # ≤3% bar) for BENCH_r07
+                     ("tensorstats_overhead", bench_tensorstats_overhead),
                      # the observability rail's cost + the step-time
                      # breakdown (where fused listener-path wall time
                      # goes), emitted into BENCH_r*.json going forward

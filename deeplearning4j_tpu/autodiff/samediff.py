@@ -882,7 +882,36 @@ class SameDiff:
 
         return grad_fn, apply_fn, loss_names
 
-    def _build_step_body(self, sentinel: bool = False):
+    def _ts_stats_fn(self, tensorstats):
+        """The traced tensorstats sampler (monitor/tensorstats.py):
+        ``stats_fn(iteration, params, new_params, grads) -> stats`` —
+        the configured per-layer summaries under a ``lax.cond`` that
+        fires only on sampled steps (zeros otherwise; shape-stable).
+        Layer order is the sorted trainable-param names, the SAME order
+        the host-side record builder uses."""
+        from deeplearning4j_tpu.monitor.tensorstats import (compute_stats,
+                                                            layer_names,
+                                                            zeros_stats)
+        ts = tensorstats
+        names = layer_names(self.trainable_params())
+
+        def stats_fn(take, params, new_params, grads):
+            def _sampled():
+                updates = jax.tree_util.tree_map(
+                    lambda a, b: a - b, params, new_params) \
+                    if "updates" in ts.families else None
+                return compute_stats(
+                    ts, names,
+                    grads=grads if "grads" in ts.families else None,
+                    updates=updates,
+                    params=new_params if "params" in ts.families else None)
+
+            return jax.lax.cond(take, _sampled,
+                                lambda: zeros_stats(len(names), ts))
+
+        return stats_fn, names
+
+    def _build_step_body(self, sentinel: bool = False, tensorstats=None):
         """One full train step (forward + backward + updater + param
         update) composed from _build_step_parts — shared by the per-batch
         step, the fused-window step and the scanned whole-epoch step.
@@ -890,29 +919,41 @@ class SameDiff:
         ``sentinel=True`` (TrainingConfig.sentinel, faults/sentinels.py)
         makes the body additionally emit one boolean from
         ``_sentinel_ok``: finite loss AND finite global gradient norm.
-        The flag is computed from values the step already produces;
-        parameter math is untouched (sentinel-on training is
-        bit-identical to sentinel-off)."""
+        ``tensorstats`` (TrainingConfig.tensorstats, monitor/
+        tensorstats.py) appends the sampled per-layer stats pytree
+        (zeros on unsampled steps — the host keeps only sampled ones).
+        Both are computed from values the step already produces;
+        parameter math is untouched (training with either rail on is
+        bit-identical to off)."""
         grad_fn, apply_fn, loss_names = self._build_step_parts()
+        if tensorstats is not None:
+            from deeplearning4j_tpu.monitor.tensorstats import sample_mask
+            stats_fn, _ = self._ts_stats_fn(tensorstats)
 
         def step_body(params, svars, state, iteration, constants, phv,
                       base_key):
             grads, new_svars, data_loss = grad_fn(params, svars, iteration,
                                                   constants, phv, base_key)
             new_params, new_state = apply_fn(params, grads, state, iteration)
-            if not sentinel:
-                # iteration advances on device — no per-step int transfer
-                return (new_params, new_svars, new_state, iteration + 1,
-                        data_loss)
-            return (new_params, new_svars, new_state, iteration + 1,
-                    data_loss, self._sentinel_ok(data_loss, grads))
+            # iteration advances on device — no per-step int transfer
+            out = [new_params, new_svars, new_state, iteration + 1,
+                   data_loss]
+            if sentinel:
+                out.append(self._sentinel_ok(data_loss, grads))
+            if tensorstats is not None:
+                out.append(stats_fn(sample_mask(iteration, tensorstats),
+                                    params, new_params, grads))
+            return tuple(out)
 
         return step_body, loss_names
 
-    def make_train_step(self, donate: bool = True, sentinel: bool = False):
-        step_body, loss_names = self._build_step_body(sentinel=sentinel)
+    def make_train_step(self, donate: bool = True, sentinel: bool = False,
+                        tensorstats=None):
+        step_body, loss_names = self._build_step_body(
+            sentinel=sentinel, tensorstats=tensorstats)
         cache_key = ("train_step", self._version, loss_names, donate,
-                     bool(sentinel))
+                     bool(sentinel),
+                     tensorstats.key() if tensorstats is not None else None)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             self._verbose_log(f"compiling train step (graph v{self._version}, "
@@ -978,7 +1019,8 @@ class SameDiff:
                                       sentinel=sentinel)
 
     def make_train_window(self, accum_steps: int = 1, donate: bool = True,
-                          unroll: int = 1, sentinel: bool = False):
+                          unroll: int = 1, sentinel: bool = False,
+                          tensorstats=None):
         """Fused-window train step: K consecutive steps in ONE compiled
         dispatch — a lax.scan of the step body over a (K, batch, ...)
         stacked window of placeholders. Per-step losses come back as a
@@ -1004,51 +1046,88 @@ class SameDiff:
         whose loss or gradients went non-finite (-1 = clean). The
         flag folds into the scan carry, so the window still syncs with
         the host only at its boundaries (faults/sentinels.py).
+
+        ``tensorstats`` (TrainingConfig.tensorstats, monitor/
+        tensorstats.py) folds the sampled per-layer stats into the scan
+        carry the same way: TWO extra outputs — the stats pytree of the
+        LAST sampled step in the window (zeros when none) and the int32
+        iteration it was sampled at (-1 = no sample point). The host
+        fetches both at flush boundaries in the same device_get burst
+        as losses and sentinel verdicts; no per-step sync.
         """
+        ts = tensorstats
+        if ts is not None:
+            from deeplearning4j_tpu.monitor.tensorstats import (sample_mask,
+                                                                zeros_stats)
+            ts_n_layers = len(self.trainable_params())
         if accum_steps <= 1:
-            step_body, loss_names = self._build_step_body(sentinel=sentinel)
+            step_body, loss_names = self._build_step_body(
+                sentinel=sentinel, tensorstats=ts)
 
             def window_fn(params, svars, state, iteration, constants,
                           stacked_phv, base_key):
                 def body(carry, phv):
+                    # carry layout: p, sv, st, it [, bad] [, stats, at]
+                    p, sv, st, it = carry[:4]
+                    i = 4
                     if sentinel:
-                        p, sv, st, it, bad = carry
-                        p, sv, st, it2, loss, ok = step_body(
-                            p, sv, st, it, constants, phv, base_key)
-                        # absolute iteration of the FIRST bad step in the
-                        # window; -1 = clean (faults/sentinels.py)
+                        bad = carry[i]; i += 1
+                    if ts is not None:
+                        stats_c, stats_at = carry[i], carry[i + 1]
+                    res = step_body(p, sv, st, it, constants, phv,
+                                    base_key)
+                    p, sv, st, it2, loss = res[:5]
+                    out = [p, sv, st, it2]
+                    r = 5
+                    if sentinel:
+                        ok = res[r]; r += 1
+                        # absolute iteration of the FIRST bad step in
+                        # the window; -1 = clean (faults/sentinels.py)
                         bad = jnp.where((bad < 0) & jnp.logical_not(ok),
                                         it, bad)
-                        return (p, sv, st, it2, bad), loss
-                    p, sv, st, it = carry
-                    p, sv, st, it, loss = step_body(
-                        p, sv, st, it, constants, phv, base_key)
-                    return (p, sv, st, it), loss
+                        out.append(bad)
+                    if ts is not None:
+                        # keep the LAST sampled step's stats (step_body
+                        # already gated the compute under lax.cond; the
+                        # selects below touch only the small stat
+                        # arrays)
+                        take = sample_mask(it, ts)
+                        stats_c = jax.tree_util.tree_map(
+                            lambda n, o: jnp.where(take, n, o), res[r],
+                            stats_c)
+                        out.extend([stats_c,
+                                    jnp.where(take, it, stats_at)])
+                    return tuple(out), loss
 
+                carry0 = [params, svars, state, iteration]
                 if sentinel:
-                    carry0 = (params, svars, state, iteration,
-                              jnp.asarray(-1, jnp.int32))
-                    (params, svars, state, iteration, bad), losses = \
-                        jax.lax.scan(body, carry0, stacked_phv,
-                                     unroll=unroll)
-                    return params, svars, state, iteration, losses, bad
-                (params, svars, state, iteration), losses = jax.lax.scan(
-                    body, (params, svars, state, iteration), stacked_phv,
-                    unroll=unroll)
-                return params, svars, state, iteration, losses
+                    carry0.append(jnp.asarray(-1, jnp.int32))
+                if ts is not None:
+                    carry0.extend([zeros_stats(ts_n_layers, ts),
+                                   jnp.asarray(-1, jnp.int32)])
+                carry, losses = jax.lax.scan(body, tuple(carry0),
+                                             stacked_phv, unroll=unroll)
+                out = list(carry[:4]) + [losses] + list(carry[4:])
+                return tuple(out)
 
             donate_args = (0, 1, 2, 3)
         else:
             grad_fn, apply_fn, loss_names = self._build_step_parts()
             n_accum = int(accum_steps)
+            if ts is not None:
+                stats_fn, _ = self._ts_stats_fn(ts)
 
             def window_fn(params, svars, state, accum, iteration, constants,
                           stacked_phv, base_key):
                 def body(carry, phv):
+                    # carry layout: p, sv, st, acc, it [, bad] [, stats,
+                    # at]
+                    p, sv, st, acc, it = carry[:5]
+                    i = 5
                     if sentinel:
-                        p, sv, st, acc, it, bad = carry
-                    else:
-                        p, sv, st, acc, it = carry
+                        bad = carry[i]; i += 1
+                    if ts is not None:
+                        stats_c, stats_at = carry[i], carry[i + 1]
                     grads, sv, loss = grad_fn(p, sv, it, constants, phv,
                                               base_key)
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -1061,35 +1140,46 @@ class SameDiff:
                         return (p_, st_, jax.tree_util.tree_map(
                             jnp.zeros_like, acc_))
 
+                    p_pre = p
                     p, st, acc = jax.lax.cond(
                         (it + 1) % n_accum == 0, do_apply, lambda a: a,
                         (p, st, acc))
+                    out = [p, sv, st, acc, it + 1]
                     if sentinel:
                         # the MICRO-step grads, pre-accumulation: the bad
                         # step is named, not its whole cycle
                         ok = self._sentinel_ok(loss, grads)
                         bad = jnp.where((bad < 0) & jnp.logical_not(ok),
                                         it, bad)
-                        return (p, sv, st, acc, it + 1, bad), loss
-                    return (p, sv, st, acc, it + 1), loss
+                        out.append(bad)
+                    if ts is not None:
+                        # sampling aligns to apply boundaries
+                        # (sample_mask with accum_steps): the updates
+                        # family always describes a real parameter
+                        # delta, never a mid-cycle zero
+                        take = sample_mask(it, ts, accum_steps=n_accum)
+                        stats_c = jax.tree_util.tree_map(
+                            lambda n, o: jnp.where(take, n, o),
+                            stats_fn(take, p_pre, p, grads), stats_c)
+                        out.extend([stats_c,
+                                    jnp.where(take, it, stats_at)])
+                    return tuple(out), loss
 
+                carry0 = [params, svars, state, accum, iteration]
                 if sentinel:
-                    carry0 = (params, svars, state, accum, iteration,
-                              jnp.asarray(-1, jnp.int32))
-                    (params, svars, state, accum, iteration, bad), losses = \
-                        jax.lax.scan(body, carry0, stacked_phv,
-                                     unroll=unroll)
-                    return (params, svars, state, accum, iteration, losses,
-                            bad)
-                (params, svars, state, accum, iteration), losses = \
-                    jax.lax.scan(body, (params, svars, state, accum,
-                                        iteration), stacked_phv,
-                                 unroll=unroll)
-                return params, svars, state, accum, iteration, losses
+                    carry0.append(jnp.asarray(-1, jnp.int32))
+                if ts is not None:
+                    carry0.extend([zeros_stats(ts_n_layers, ts),
+                                   jnp.asarray(-1, jnp.int32)])
+                carry, losses = jax.lax.scan(body, tuple(carry0),
+                                             stacked_phv, unroll=unroll)
+                out = list(carry[:5]) + [losses] + list(carry[5:])
+                return tuple(out)
 
             donate_args = (0, 1, 2, 3, 4)
         cache_key = ("train_window", self._version, loss_names,
-                     int(accum_steps), donate, int(unroll), bool(sentinel))
+                     int(accum_steps), donate, int(unroll), bool(sentinel),
+                     ts.key() if ts is not None else None)
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             self._verbose_log(
@@ -1174,6 +1264,11 @@ class SameDiff:
         K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
         A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
         sentinel = bool(getattr(tc, "sentinel", False))
+        # tensorstats rides the listener rail; precompile builds the
+        # stats-enabled signature fit() will dispatch when listeners are
+        # attached (a listener-free fused fit compiles the stats-free
+        # variant lazily — docs/observability.md)
+        ts = getattr(tc, "tensorstats", None)
         names = list(tc.data_set_feature_mapping) + \
             list(tc.data_set_label_mapping)
         ph = self._placeholder_specs(names or None, batch_size,
@@ -1226,14 +1321,16 @@ class SameDiff:
         # key includes donate — a divergent value would AOT-compile
         # executables fit() never consults (silently useless work)
         if "step" in tiers:
-            disp = self.make_train_step(sentinel=sentinel)
+            disp = self.make_train_step(sentinel=sentinel, tensorstats=ts)
             _build(disp, (params_abs, svars_abs, state_abs, it_abs,
                           consts_abs, ph, key),
                    ph_shape_sig(ph), "train_step")
         if "window" in tiers:
-            disp = self.make_train_window(accum_steps=A, sentinel=sentinel)
+            disp = self.make_train_window(accum_steps=A, sentinel=sentinel,
+                                          tensorstats=ts)
             from deeplearning4j_tpu.autodiff.window import window_trace_set
-            seen = window_trace_set(self, A, sentinel)
+            seen = window_trace_set(self, A, sentinel,
+                                    ts.key() if ts is not None else None)
             # every pow2 the tail decomposition can emit: a ragged tail
             # of r < K steps uses buckets up to the largest pow2 ≤ r,
             # so cover all powers of two ≤ K-1 (for pow2 K this is the
@@ -1387,7 +1484,12 @@ class SameDiff:
                           f"(set TrainingConfig.fused_steps>1 for fused "
                           f"windows)")
         use_sentinel = bool(getattr(tc, "sentinel", False))
-        step = self.make_train_step(sentinel=use_sentinel)
+        # in-graph tensor statistics need the listener rail to deliver
+        # their records; a listener-free fit builds the stats-free step
+        # (monitor/tensorstats.py)
+        ts_cfg = getattr(tc, "tensorstats", None) if listeners else None
+        step = self.make_train_step(sentinel=use_sentinel,
+                                    tensorstats=ts_cfg)
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
@@ -1435,34 +1537,50 @@ class SameDiff:
         sync_params_on_flush = any(getattr(l, "needs_params", False)
                                    for l in listeners)
 
+        if ts_cfg is not None:
+            from deeplearning4j_tpu.monitor.tensorstats import (
+                layer_names, sample_mask)
+            ts_names = layer_names(params)
+        else:
+            ts_names = ()
         for epoch in range(epochs):
             epoch_losses = []
             epoch_oks: List[jax.Array] = []   # sentinel flags, device-side
             epoch_start_iter = iteration
             pending: List[Tuple[int, jax.Array]] = []
             pending_oks: List[Tuple[int, jax.Array]] = []
+            pending_stats: List[Tuple[int, Any]] = []  # sampled stats
 
             def _flush(pending):
                 if not pending:
                     return
                 iters = [it for it, _ in pending]
+                ts_recs: List[dict] = []
                 with _tracer.span("flush", cat="train", steps=len(iters)):
-                    if pending_oks:
-                        # losses + sentinel verdicts in ONE device->host
-                        # transfer; verdicts are checked (and may raise)
-                        # BEFORE the burst reaches listeners
+                    # losses + sentinel verdicts + sampled tensorstats in
+                    # ONE device->host transfer; verdicts are checked
+                    # (and may raise) BEFORE the burst reaches listeners
+                    oks_stack = jnp.stack([o for _, o in pending_oks]) \
+                        if pending_oks else None
+                    stats_burst = list(pending_stats)
+                    pending_stats.clear()
+                    vals_arr, oks, stats_host = jax.device_get(
+                        (jnp.stack([lv for _, lv in pending]), oks_stack,
+                         [s for _, s in stats_burst]))
+                    if oks is not None:
                         from deeplearning4j_tpu.faults.sentinels import \
                             check_ok_flags
                         ok_iters = [it for it, _ in pending_oks]
-                        vals_arr, oks = jax.device_get(
-                            (jnp.stack([lv for _, lv in pending]),
-                             jnp.stack([o for _, o in pending_oks])))
                         pending_oks.clear()
                         check_ok_flags(np.asarray(oks), ok_iters, epoch,
                                        epoch_start_iter)
-                    else:
-                        vals_arr = np.asarray(
-                            jnp.stack([lv for _, lv in pending]))
+                    if stats_burst:
+                        from deeplearning4j_tpu.monitor.tensorstats import \
+                            build_record
+                        ts_recs = [
+                            build_record(ts_names, s, it_, epoch, ts_cfg)
+                            for (it_, _), s in zip(stats_burst,
+                                                   stats_host)]
                 vals = [float(v) for v in vals_arr]
                 epoch_losses.extend(vals)
                 if sync_params_on_flush:
@@ -1484,6 +1602,11 @@ class SameDiff:
                                 f"with sd.exec_debug(placeholders)")
                 for l in listeners:
                     l.iterations_done(self, epoch, iters, vals)
+                if ts_recs:
+                    for l in listeners:
+                        hook = getattr(l, "tensorstats_done", None)
+                        if hook is not None:
+                            hook(self, epoch, ts_recs)
                 pending.clear()
 
             for l in listeners:
@@ -1509,18 +1632,24 @@ class SameDiff:
                         if getattr(l, "batch_size", -1) is None:
                             l.batch_size = next(iter(ph.values())).shape[0]
                     with _tracer.span("dispatch", cat="train"):
+                        res = step(params, svars, state, it_dev,
+                                   constants, ph, base_key)
+                        params, svars, state, it_dev, loss_val = res[:5]
+                        r = 5
                         if use_sentinel:
-                            params, svars, state, it_dev, loss_val, ok = \
-                                step(params, svars, state, it_dev,
-                                     constants, ph, base_key)
+                            ok = res[r]; r += 1
                             if listeners:
                                 pending_oks.append((iteration, ok))
                             else:
                                 epoch_oks.append(ok)
-                        else:
-                            params, svars, state, it_dev, loss_val = step(
-                                params, svars, state, it_dev, constants,
-                                ph, base_key)
+                        if ts_cfg is not None and \
+                                sample_mask(iteration, ts_cfg):
+                            # host-side gate is THE traced predicate on
+                            # a host int — the same construction, so it
+                            # can never disagree with the in-graph
+                            # lax.cond (unsampled steps return zeros
+                            # that are simply never retained)
+                            pending_stats.append((iteration, res[r]))
                     # without listeners, never force a device sync: losses
                     # stay async device scalars (a scalar fetch = tunnel
                     # round-trip)

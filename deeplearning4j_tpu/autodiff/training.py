@@ -150,6 +150,21 @@ class TrainingConfig:
     # devices the process has — the elastic-resume contract
     # (docs/elastic_training.md).
     sharding: Optional[Any] = None
+    # in-graph per-layer tensor statistics (monitor/tensorstats.py):
+    # True (defaults) or a TensorStatsConfig. The compiled step
+    # additionally summarizes gradients/updates/params per layer (L2,
+    # mean|x|, min/max, nonfinite count, fixed log2-magnitude
+    # histogram) every Nth step, folded into the scan carry like the
+    # sentinel and fetched at the flush boundaries the host already
+    # syncs on. Requires the listener rail (per-step or fused-window
+    # tier with listeners) to deliver {"type": "tensorstats"} records;
+    # parameter math is untouched — stats-on training is bit-identical.
+    tensorstats: Optional[Any] = None
+
+    def __post_init__(self):
+        if self.tensorstats is not None:
+            from deeplearning4j_tpu.monitor.tensorstats import normalize
+            self.tensorstats = normalize(self.tensorstats)
 
     def clip_gradients(self, grads):
         """Apply elementwise clip + the configured normalization mode to a
@@ -206,6 +221,8 @@ class TrainingConfig:
                          else (self.sharding
                                if hasattr(self.sharding, "to_json")
                                else self.sharding.to_spec()).to_json()),
+            "tensorstats": (None if self.tensorstats is None
+                            else self.tensorstats.to_json()),
         }
 
     @staticmethod
@@ -214,6 +231,11 @@ class TrainingConfig:
         if d.get("sharding") is not None:
             from deeplearning4j_tpu.parallel.sharding import ShardingSpec
             sharding = ShardingSpec.from_json(d["sharding"])
+        tensorstats = None
+        if d.get("tensorstats") is not None:
+            from deeplearning4j_tpu.monitor.tensorstats import \
+                TensorStatsConfig
+            tensorstats = TensorStatsConfig.from_json(d["tensorstats"])
         return TrainingConfig(
             updater=IUpdater.from_json(d["updater"]),
             data_set_feature_mapping=d.get("data_set_feature_mapping", []),
@@ -232,6 +254,7 @@ class TrainingConfig:
             accum_steps=d.get("accum_steps", 1),
             sentinel=d.get("sentinel", False),
             sharding=sharding,
+            tensorstats=tensorstats,
         )
 
     class Builder:
@@ -264,6 +287,8 @@ class TrainingConfig:
             self._kw["sentinel"] = bool(on); return self
         def sharding(self, spec):
             self._kw["sharding"] = spec; return self
+        def tensorstats(self, cfg=True):
+            self._kw["tensorstats"] = cfg; return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 
@@ -327,6 +352,14 @@ class Listener:
                         losses: Sequence[float]):
         for it, lo in zip(iterations, losses):
             self.iteration_done(sd, epoch, it, lo)
+
+    def tensorstats_done(self, sd, epoch: int,
+                         records: Sequence[dict]):
+        """Per-layer tensor-statistics delivery (``TrainingConfig.
+        tensorstats``, monitor/tensorstats.py): fit() calls this right
+        after ``iterations_done`` at each flush whose burst contained
+        sampled stats, with the fetched ``{"type": "tensorstats"}``
+        records. Default: ignore."""
 
 
 class ScoreIterationListener(Listener):

@@ -194,17 +194,19 @@ class WindowStager:
         self._thread.join(timeout=5)
 
 
-def window_trace_set(sd, accum_steps: int, sentinel: bool) -> set:
-    """The per-(graph version, accum, sentinel) set of window trace
-    signatures already compiled. This is the ONE key construction,
-    shared by the executor's compile accounting below and
+def window_trace_set(sd, accum_steps: int, sentinel: bool,
+                     ts_key=None) -> set:
+    """The per-(graph version, accum, sentinel, tensorstats) set of
+    window trace signatures already compiled. This is the ONE key
+    construction, shared by the executor's compile accounting below and
     ``SameDiff.precompile()``'s pre-registration — if the key shape
     changed in only one place, precompiled sigs would land in a set fit
     never reads and ``window_compiles`` would silently report nonzero
     after a precompile (the same drift ``ph_shape_sig`` was unified to
-    prevent for the signature itself)."""
+    prevent for the signature itself). ``ts_key`` is
+    ``TensorStatsConfig.key()`` or None (stats-free)."""
     return sd.__dict__.setdefault("_window_traces", {}) \
-        .setdefault((sd._version, accum_steps, sentinel), set())
+        .setdefault((sd._version, accum_steps, sentinel, ts_key), set())
 
 
 def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
@@ -220,7 +222,12 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
     A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
     use_sentinel = bool(getattr(tc, "sentinel", False))
-    window_fn = sd.make_train_window(accum_steps=A, sentinel=use_sentinel)
+    # in-graph tensor statistics (monitor/tensorstats.py): only with
+    # listeners — the records ride the listener rail; a listener-free
+    # fit dispatches the stats-free window
+    ts_cfg = getattr(tc, "tensorstats", None) if listeners else None
+    window_fn = sd.make_train_window(accum_steps=A, sentinel=use_sentinel,
+                                     tensorstats=ts_cfg)
     # window_fn donates param/state buffers; work on copies so the
     # graph's stored arrays stay valid for output()/save() mid-fit
     params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
@@ -264,7 +271,13 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                                for l in listeners)
     # compiled window lengths (jit retraces per leading-dim K): tracked
     # per (graph version, accum) so stats report real compile counts
-    seen_sizes = window_trace_set(sd, A, use_sentinel)
+    seen_sizes = window_trace_set(
+        sd, A, use_sentinel, ts_cfg.key() if ts_cfg is not None else None)
+    if ts_cfg is not None:
+        from deeplearning4j_tpu.monitor.tensorstats import layer_names
+        ts_names = layer_names(params)
+    else:
+        ts_names = ()
 
     def _name_batch(batch):
         if isinstance(batch, dict):
@@ -327,6 +340,7 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         pending = []                         # (start_iter, k, (k,) losses)
         pending_bads: List[jax.Array] = []   # sentinel scalars, device
         epoch_bads: List[jax.Array] = []     # ... for the listener-free path
+        pending_stats: List[tuple] = []      # (stats pytree, at) device
         epoch_start_iter = iteration
         dispatches = 0
         compiles = 0
@@ -355,23 +369,33 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             iters: List[int] = []
             for start, k, _ in pending:
                 iters.extend(range(start, start + k))
+            ts_recs: List[dict] = []
             with _tracer.span("flush", cat="train", steps=len(iters)):
                 losses_cat = jnp.concatenate([lv for _, _, lv in pending])
-                if pending_bads:
-                    # losses + sentinel verdicts in ONE device→host
-                    # transfer; poisoned windows must not feed listeners/
-                    # checkpoints, so verdicts are checked (and may
-                    # raise) before the burst is delivered
+                # losses + sentinel verdicts + sampled tensorstats in
+                # ONE device→host transfer; poisoned windows must not
+                # feed listeners/checkpoints, so verdicts are checked
+                # (and may raise) before the burst is delivered
+                bads_stack = jnp.stack(pending_bads) if pending_bads \
+                    else None
+                stats_burst = list(pending_stats)
+                pending_stats.clear()
+                vals_arr, bads, stats_host = jax.device_get(
+                    (losses_cat, bads_stack, stats_burst))
+                if bads is not None:
                     from deeplearning4j_tpu.faults.sentinels import \
                         check_bad_steps
-                    vals_arr, bads = jax.device_get(
-                        (losses_cat, jnp.stack(pending_bads)))
                     pending_bads.clear()
                     check_bad_steps(np.asarray(bads), epoch,
                                     epoch_start_iter)
-                else:
-                    # ONE device→host transfer for the whole burst
-                    vals_arr = np.asarray(losses_cat)
+                if stats_burst:
+                    # windows with no sample point carry at = -1 (zeros
+                    # payload) and are dropped here
+                    from deeplearning4j_tpu.monitor.tensorstats import \
+                        build_record
+                    ts_recs = [build_record(ts_names, s, int(at), epoch,
+                                            ts_cfg)
+                               for s, at in stats_host if int(at) >= 0]
             vals = [float(v) for v in vals_arr]
             epoch_losses.extend(vals)
             if sync_params_on_flush:
@@ -391,14 +415,19 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                             f"(nan_panic); localize the producing op with "
                             f"sd.exec_debug(placeholders)")
             pending.clear()
-            return iters, vals
+            return iters, vals, ts_recs
 
         def _deliver(flushed):
             if flushed is None:
                 return
-            iters, vals = flushed
+            iters, vals, ts_recs = flushed
             for l in listeners:
                 l.iterations_done(sd, epoch, iters, vals)
+            if ts_recs:
+                for l in listeners:
+                    hook = getattr(l, "tensorstats_done", None)
+                    if hook is not None:
+                        hook(sd, epoch, ts_recs)
 
         def _flush():
             _deliver(_fetch_flush())
@@ -445,24 +474,28 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                         sd._verbose_log(f"fit: compiling window length {k}")
                     bad = None
                     with _tracer.span("dispatch", cat="train", k=k):
-                        if A > 1 and use_sentinel:
-                            (params, svars, state, accum, it_dev, losses,
-                             bad) = window_fn(params, svars, state, accum,
-                                              it_dev, constants, win,
-                                              base_key)
-                        elif A > 1:
-                            (params, svars, state, accum, it_dev,
-                             losses) = window_fn(params, svars, state,
-                                                 accum, it_dev, constants,
-                                                 win, base_key)
-                        elif use_sentinel:
-                            (params, svars, state, it_dev, losses,
-                             bad) = window_fn(params, svars, state, it_dev,
-                                              constants, win, base_key)
+                        # positional output layout (make_train_window):
+                        # p, sv, st, [accum], it, losses, [bad],
+                        # [stats, at]
+                        if A > 1:
+                            out = window_fn(params, svars, state, accum,
+                                            it_dev, constants, win,
+                                            base_key)
+                            params, svars, state, accum = out[:4]
+                            i = 4
                         else:
-                            params, svars, state, it_dev, losses = \
-                                window_fn(params, svars, state, it_dev,
-                                          constants, win, base_key)
+                            out = window_fn(params, svars, state, it_dev,
+                                            constants, win, base_key)
+                            params, svars, state = out[:3]
+                            i = 3
+                        it_dev = out[i]
+                        losses = out[i + 1]
+                        i += 2
+                        if use_sentinel:
+                            bad = out[i]
+                            i += 1
+                        if ts_cfg is not None:
+                            pending_stats.append((out[i], out[i + 1]))
                     dispatches += 1
                     sizes[k] = sizes.get(k, 0) + 1
                     if bad is not None:

@@ -2,7 +2,9 @@
 
 - ``errors``    : structured fault taxonomy (step/epoch/batch provenance)
 - ``sentinels`` : device-side divergence sentinel semantics + host-side
-  loss-spike / plateau watchers
+  loss-spike / plateau watchers (the per-layer ``LayerHealthWatcher``
+  lives in monitor/tensorstats.py — it rides the in-graph tensor
+  statistics — and is re-exported here next to its siblings)
 - ``recovery``  : FaultTolerantFit — rollback-and-retry training over
   the checkpoint/ manager, bounded backoff, clean abort
 - ``iterators`` : RetryingIterator — loader retry + corrupt-batch
@@ -27,10 +29,12 @@ from deeplearning4j_tpu.faults.iterators import RetryingIterator
 from deeplearning4j_tpu.faults.recovery import FaultTolerantFit, RetryPolicy
 from deeplearning4j_tpu.faults.sentinels import (LossSpikeWatcher,
                                                  PlateauWatcher)
+from deeplearning4j_tpu.monitor.tensorstats import LayerHealthWatcher
 
 __all__ = ["ChaosMonkey", "DataPipelineError", "FaultBudgetExhaustedError",
            "FaultError", "FaultTolerantFit", "FileBarrier", "HostKiller",
-           "HostLossInjector", "LossSpikeWatcher", "PlateauWatcher",
-           "RetryPolicy", "RetryingIterator", "ShardCountMismatchError",
-           "TopologyChangedError", "TrainingDivergedError",
-           "TransientDeviceError", "retryable_errors"]
+           "HostLossInjector", "LayerHealthWatcher", "LossSpikeWatcher",
+           "PlateauWatcher", "RetryPolicy", "RetryingIterator",
+           "ShardCountMismatchError", "TopologyChangedError",
+           "TrainingDivergedError", "TransientDeviceError",
+           "retryable_errors"]

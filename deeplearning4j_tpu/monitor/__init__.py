@@ -17,18 +17,31 @@ per-step tier), serving, checkpointing and the fault rail:
   breakdowns computed from spans at existing flush boundaries (no
   extra device syncs; clean runs stay bit-identical), rolling
   percentiles, and a straggler watcher.
+- :mod:`monitor.tensorstats` — in-graph per-layer gradient/update/
+  param summaries (norms, nonfinite counts, log2-magnitude histograms)
+  sampled inside the compiled step, folded into the scan carry like
+  the divergence sentinel; plus the dead/exploding-layer watcher.
+- :mod:`monitor.server` — the live telemetry HTTP endpoint
+  (``monitor.serve(port=0)``): /metrics, /healthz, /readyz, /report,
+  /trace, /stats over a stdlib ThreadingHTTPServer, loopback-bound.
 
 See docs/observability.md.
 """
 from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.monitor.server import (TelemetryServer,
+                                               health_snapshot, serve)
 from deeplearning4j_tpu.monitor.steptime import (MonitorListener,
                                                  RollingPercentiles,
                                                  StragglerWatcher,
                                                  window_rows)
+from deeplearning4j_tpu.monitor.tensorstats import (LayerHealthWatcher,
+                                                    TensorStatsConfig)
 from deeplearning4j_tpu.monitor.trace import (TRACER, Span, Tracer,
                                               disable_tracing,
                                               enable_tracing, get_tracer)
 
 __all__ = ["TRACER", "Span", "Tracer", "get_tracer", "enable_tracing",
            "disable_tracing", "MetricsRegistry", "MonitorListener",
-           "RollingPercentiles", "StragglerWatcher", "window_rows"]
+           "RollingPercentiles", "StragglerWatcher", "window_rows",
+           "TensorStatsConfig", "LayerHealthWatcher", "TelemetryServer",
+           "serve", "health_snapshot"]

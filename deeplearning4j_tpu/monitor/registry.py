@@ -39,6 +39,31 @@ _DEFAULT_BUCKETS = tuple(
     round(b, 6) for e in range(-4, 3) for b in (10.0 ** e, 2.5 * 10.0 ** e,
                                                 5.0 * 10.0 ** e))
 
+# log-spaced dimensionless buckets for update:param ratios (healthy
+# training sits around 1e-4..1e-2; the edges are the dead/exploding
+# regimes LayerHealthWatcher flags)
+_RATIO_BUCKETS = tuple(10.0 ** e for e in range(-9, 2))
+
+#: wall-clock process start, for dl4j_process_uptime_seconds
+_PROCESS_START_T = time.time()
+
+
+def _process_self_metrics() -> Dict[str, float]:
+    """Process self-telemetry exported with every scrape: uptime, and
+    resident-set bytes where the platform exposes them (/proc — Linux;
+    silently absent elsewhere)."""
+    out = {"process_uptime_seconds":
+           round(max(0.0, time.time() - _PROCESS_START_T), 3)}
+    try:
+        import os
+        with open("/proc/self/statm", "r", encoding="ascii") as fh:
+            pages = int(fh.read().split()[1])
+        out["process_rss_bytes"] = float(
+            pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
 
 def _label_key(labels: Dict[str, object]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -105,9 +130,15 @@ class MetricsRegistry:
         self._families: Dict[str, _Family] = {}
         # per-storage fold high-water marks: fold_storage() must be
         # idempotent over a growing storage (a scrape endpoint re-folds
-        # on every scrape; counters would otherwise double-count)
+        # on every scrape; counters would otherwise double-count).
+        # _fold_lock serializes whole folds — a /metrics scrape thread
+        # and the MonitorListener's flush thread fold the SAME storage
+        # into the same registry, and racing on the mark would fold the
+        # same records twice (a separate lock: the fold body takes
+        # self._lock per metric op)
         self._fold_marks: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
+        self._fold_lock = threading.Lock()
 
     # -- core recording -------------------------------------------------
     def _family(self, name: str, kind: str, help_: str,
@@ -210,6 +241,13 @@ class MetricsRegistry:
                                      f"{val.count}")
                     else:
                         lines.append(f"{full}{_fmt_labels(key)} {val!r}")
+            # process self-telemetry: synthesized at scrape time, never
+            # stored (uptime/RSS are instantaneous reads, not state)
+            for name, val in sorted(_process_self_metrics().items()):
+                full = f"{self.namespace}_{name}"
+                lines.append(f"# HELP {full} process self-telemetry")
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {val!r}")
         return "\n".join(lines) + "\n"
 
     def to_record(self) -> dict:
@@ -343,6 +381,35 @@ class MetricsRegistry:
                 self.set_gauge(f"compile_{key}", rec[key],
                                help="cumulative compile-phase wall time")
 
+    def fold_tensorstats(self, record: dict) -> None:
+        """Fold one ``{"type": "tensorstats"}`` record (monitor/
+        tensorstats.py) into per-layer ``layer_*`` gauges — grad/update/
+        param L2 norms, nonfinite counts, the update:param ratio — plus
+        a ``layer_update_ratio_dist`` histogram over all layers/samples
+        (the dead↔exploding spectrum a dashboard alerts on). Histogram
+        bin lists stay record-only: L layers x 3 families x B bins as
+        label sets would swamp the namespace."""
+        for layer, ent in record.get("layers", {}).items():
+            for k, v in ent.items():
+                if k.endswith("_hist") or v is None:
+                    # None = poisoned stat (build_record sanitizes
+                    # non-finite floats); the *_nonfinite counts carry
+                    # the signal
+                    continue
+                self.set_gauge(f"layer_{k}", v,
+                               help="per-layer tensor statistics "
+                                    "(tensorstats)", layer=layer)
+            ratio = ent.get("update_ratio")
+            if ratio is not None:
+                self.observe("layer_update_ratio_dist", ratio,
+                             help="update:param ratio distribution over "
+                                  "layers and samples",
+                             buckets=_RATIO_BUCKETS)
+        if record.get("iter") is not None:
+            self.set_gauge("layer_stats_last_iteration", record["iter"],
+                           help="iteration of the last tensorstats "
+                                "sample")
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -369,25 +436,36 @@ class MetricsRegistry:
         the last call, so re-folding on every scrape is safe. (The
         record-level adapters above are NOT idempotent for
         counter-typed metrics — fold each record/event stream once.)"""
-        start = self._fold_marks.get(storage, 0)
-        records = list(storage.records)
-        self._fold_marks[storage] = len(records)
-        for rec in records[start:]:
-            t = rec.get("type")
-            if t == "serving":
-                self.fold_serving(rec)
-            elif t == "dispatch":
-                self.fold_dispatch(rec, epoch=rec.get("epoch"))
-            elif t == "checkpoint":
-                self.fold_checkpoint(rec)
-            elif t == "faults":
-                self.fold_faults([rec])
-            elif t == "steptime":
-                self.fold_steptime(rec)
-            elif t == "compile":
-                self.fold_compile(rec)
-            elif t == "reshard":
-                self.fold_reshard(rec)
+        with self._fold_lock:
+            # held across the fold, not just the mark update: gauges are
+            # last-write-wins, so two racing folders must apply their
+            # slices in order (the per-metric ops take self._lock — a
+            # different lock — so no deadlock)
+            start = self._fold_marks.get(storage, 0)
+            records = list(storage.records)
+            self._fold_marks[storage] = len(records)
+            new = records[start:]
+            for rec in new:
+                self._fold_one(rec)
+
+    def _fold_one(self, rec: dict) -> None:
+        t = rec.get("type")
+        if t == "serving":
+            self.fold_serving(rec)
+        elif t == "dispatch":
+            self.fold_dispatch(rec, epoch=rec.get("epoch"))
+        elif t == "checkpoint":
+            self.fold_checkpoint(rec)
+        elif t == "faults":
+            self.fold_faults([rec])
+        elif t == "steptime":
+            self.fold_steptime(rec)
+        elif t == "tensorstats":
+            self.fold_tensorstats(rec)
+        elif t == "compile":
+            self.fold_compile(rec)
+        elif t == "reshard":
+            self.fold_reshard(rec)
 
 
 __all__ = ["MetricsRegistry"]

@@ -1,0 +1,362 @@
+"""Live telemetry HTTP endpoint — the runtime half of the reference's
+``deeplearning4j-ui-parent`` web dashboard.
+
+Until now every observable this codebase produces (metrics registry,
+steptime/tensorstats records, trace spans, the HTML report) was only
+reachable by reading files after the run. This module serves them LIVE
+from a stdlib :class:`ThreadingHTTPServer` — no new dependencies, safe
+to run inside a training job or an inference server:
+
+====================  =====================================================
+route                 payload
+====================  =====================================================
+``GET /metrics``      Prometheus text exposition from the
+                      :class:`~deeplearning4j_tpu.monitor.registry.
+                      MetricsRegistry` (the attached storage is folded
+                      incrementally on every scrape, so ``dl4j_*`` series
+                      track the run without a publisher thread)
+``GET /healthz``      liveness: 200 while the fault rail is clean, 503
+                      from the first ``fault``/``rollback`` record until
+                      the run publishes ``recovered`` (sticky 503 on
+                      ``retry_exhausted``) — JSON body with the fault
+                      state, last-step age and provider snapshots
+``GET /readyz``       readiness: 200 while healthy AND fresh (last-step
+                      age within ``stale_after_s`` when set) AND no
+                      provider reports ``ready: False`` (the serving
+                      queue-depth hook — the SLO shed-load signal)
+``GET /report``       the self-contained ui/report HTML, rendered from
+                      the live storage
+``GET /trace``        Chrome/Perfetto trace JSON from the shared tracer
+                      (load at ui.perfetto.dev)
+``GET /stats``        recent storage records as JSON lines
+                      (``?n=500&type=tensorstats``)
+``GET /``             a minimal index linking the routes
+====================  =====================================================
+
+**Security note**: the server binds loopback (``127.0.0.1``) by default
+and serves everything unauthenticated — training internals, parameter
+statistics, trace timelines. Bind a routable interface only behind
+infrastructure you trust (a pod-local sidecar, an authenticated proxy).
+
+Start it three ways:
+
+- ``monitor.serve(port=0, storage=st)`` — standalone, port 0 picks a
+  free port;
+- ``MonitorListener(storage, serve_port=0)`` — the training listener
+  brings the endpoint up at ``on_training_start`` sharing its storage/
+  registry/tracer (and a last-flush heartbeat provider);
+- ``ParallelInference(model, telemetry_port=0)`` — the inference server
+  exposes its ``ServingMetrics`` and queue depth.
+
+See docs/observability.md ("The live telemetry endpoint").
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+#: fault-rail events that flip /healthz to 503 (a recovery in progress)
+_DEGRADING_EVENTS = frozenset({"fault", "rollback", "retry",
+                               "topology_changed"})
+#: ... and the event that clears it
+_RECOVERED_EVENTS = frozenset({"recovered"})
+#: sticky failure: the retry budget is spent, the job is aborting
+_FATAL_EVENTS = frozenset({"retry_exhausted"})
+
+#: record types whose ``t`` field is wall-clock (time.time()) — the
+#: last-step-age fallback when no heartbeat provider is registered
+#: ("score"/"perf" use perf_counter timestamps and must NOT mix in)
+_WALL_T_TYPES = ("steptime", "tensorstats", "metrics", "checkpoint",
+                 "faults")
+
+
+def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
+                    stale_after_s: Optional[float] = None,
+                    now: Optional[float] = None,
+                    cache: Optional[dict] = None) -> dict:
+    """Pure health evaluation over a StatsStorage + provider callbacks
+    (separated from the HTTP layer so tests and supervisors can call it
+    directly).
+
+    Returns ``{"healthy", "ready", "fault_state", "last_step_age_s",
+    "rollbacks", "providers", ...}``. Fault state walks the storage's
+    ``{"type": "faults"}`` records in order: any degrading event flips
+    to ``recovering`` until a ``recovered`` lands; ``retry_exhausted``
+    is sticky ``failed``. Providers are ``name -> fn()`` returning a
+    dict; a provider raising is reported (and makes the snapshot
+    unhealthy — a dead introspection hook is itself a symptom);
+    ``healthy: False`` / ``ready: False`` keys gate the aggregate.
+
+    ``cache``: an opaque dict the caller keeps between calls — only
+    records appended since the last call are walked, so a per-second
+    kubernetes probe stays O(new records) instead of re-scanning a
+    long run's whole history per probe (the TelemetryServer passes a
+    persistent cache; the sticky-``failed`` semantics make the fold
+    order-safe). Omit it for the stateless full walk.
+    """
+    now = time.time() if now is None else now
+    if cache is None:
+        cache = {}
+    state = cache.get("state", "ok")
+    rollbacks = cache.get("rollbacks", 0)
+    last_event = cache.get("last_event")
+    rec_last_t = cache.get("last_wall_t")
+    if storage is not None:
+        records = storage.records        # append-only; slicing is safe
+        n = len(records)
+        for rec in list(records[cache.get("mark", 0):n]):
+            t = rec.get("type")
+            if t == "faults":
+                ev = rec.get("event")
+                if ev == "rollback":
+                    rollbacks += 1
+                if ev in _FATAL_EVENTS:
+                    state = "failed"
+                    last_event = ev
+                elif state != "failed" and ev in _DEGRADING_EVENTS:
+                    state = "recovering"
+                    last_event = ev
+                elif state != "failed" and ev in _RECOVERED_EVENTS:
+                    state = "ok"
+                    last_event = ev
+            if t in _WALL_T_TYPES:
+                tv = rec.get("t")
+                if tv is not None and (rec_last_t is None
+                                       or tv > rec_last_t):
+                    rec_last_t = float(tv)
+        cache.update(mark=n, state=state, rollbacks=rollbacks,
+                     last_event=last_event, last_wall_t=rec_last_t)
+    prov_out: Dict[str, dict] = {}
+    healthy = state == "ok"
+    ready = True
+    last_step_t: Optional[float] = None
+    for name, fn in (providers or {}).items():
+        try:
+            p = dict(fn() or {})
+        except Exception as e:           # noqa: BLE001 — reported, not fatal
+            p = {"error": f"{type(e).__name__}: {e}", "healthy": False}
+        prov_out[name] = p
+        if p.get("healthy") is False:
+            healthy = False
+        if p.get("ready") is False:
+            ready = False
+        t = p.get("last_step_t")
+        if t is not None and (last_step_t is None or t > last_step_t):
+            last_step_t = float(t)
+    if last_step_t is None:
+        last_step_t = rec_last_t
+    age = None if last_step_t is None else max(0.0, now - last_step_t)
+    if stale_after_s is not None and age is not None \
+            and age > stale_after_s:
+        ready = False
+    snap = {"t": now, "fault_state": state, "healthy": healthy,
+            "ready": healthy and ready, "rollbacks": rollbacks,
+            "last_step_age_s": None if age is None else round(age, 3),
+            "providers": prov_out}
+    if last_event is not None:
+        snap["last_fault_event"] = last_event
+    if stale_after_s is not None:
+        snap["stale_after_s"] = stale_after_s
+    return snap
+
+
+class TelemetryServer:
+    """The live telemetry endpoint (module docstring). Thread-per-
+    request (``ThreadingHTTPServer`` with daemon threads) over shared
+    thread-safe state: the registry locks internally, the storage locks
+    ``put``/``of_type``/``tail``, the tracer locks its ring — a scrape
+    never blocks training for more than one lock hold."""
+
+    def __init__(self, storage=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None, host: str = "127.0.0.1", port: int = 0,
+                 stale_after_s: Optional[float] = None,
+                 title: str = "deeplearning4j_tpu telemetry"):
+        if tracer is None:
+            from deeplearning4j_tpu.monitor.trace import TRACER
+            tracer = TRACER
+        self.storage = storage
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer
+        self.stale_after_s = stale_after_s
+        self.title = title
+        self._providers: Dict[str, Callable] = {}
+        self._scrape_hooks: List[Callable] = []
+        # incremental health-state fold (health_snapshot cache=): one
+        # persistent cache + a lock so concurrent probes don't race the
+        # mark and double-count rollbacks
+        self._health_cache: dict = {}
+        self._health_lock = threading.Lock()
+        self._closed = False
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # quiet: request logging through the monitor rail, not stderr
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+            def do_GET(self):                   # noqa: N802 (http.server)
+                try:
+                    status, ctype, body = outer._route(self.path)
+                except Exception as e:          # noqa: BLE001
+                    status, ctype = 500, "application/json"
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="TelemetryServer",
+            daemon=True)
+        self._thread.start()
+
+    # -- addressing -----------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- wiring ---------------------------------------------------------
+    def add_health_provider(self, name: str, fn: Callable) -> None:
+        """Register a ``fn() -> dict`` merged into /healthz and
+        /readyz. Recognized keys: ``healthy``/``ready`` (False gates
+        the aggregate), ``last_step_t`` (wall clock of the last unit of
+        progress — feeds last-step age); everything else is reported
+        verbatim (queue depths, iteration counters, ...)."""
+        self._providers[str(name)] = fn
+
+    def add_scrape_hook(self, fn: Callable) -> None:
+        """Register ``fn(registry)`` run at the top of every /metrics
+        scrape — the pull-model adapter for sources without records
+        (e.g. ``reg.fold_serving(pi.metrics)``)."""
+        self._scrape_hooks.append(fn)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # -- routes ---------------------------------------------------------
+    def _route(self, path: str):
+        url = urlparse(path)
+        route = url.path.rstrip("/") or "/"
+        qs = parse_qs(url.query)
+        if route == "/metrics":
+            return self._metrics()
+        if route == "/healthz":
+            return self._health(ready_probe=False)
+        if route == "/readyz":
+            return self._health(ready_probe=True)
+        if route == "/report":
+            return self._report()
+        if route == "/trace":
+            return self._trace()
+        if route == "/stats":
+            return self._stats(qs)
+        if route == "/":
+            return self._index()
+        return 404, "application/json", \
+            json.dumps({"error": f"no route {route!r}"}).encode()
+
+    def _metrics(self):
+        for hook in self._scrape_hooks:
+            hook(self.registry)
+        if self.storage is not None:
+            # incremental: fold_storage keeps a per-storage high-water
+            # mark, so scraping in a loop never double-counts
+            self.registry.fold_storage(self.storage)
+        text = self.registry.to_prometheus_text()
+        return 200, "text/plain; version=0.0.4; charset=utf-8", \
+            text.encode("utf-8")
+
+    def _health(self, ready_probe: bool):
+        with self._health_lock:
+            snap = health_snapshot(self.storage, self._providers,
+                                   stale_after_s=self.stale_after_s,
+                                   cache=self._health_cache)
+        ok = snap["ready"] if ready_probe else snap["healthy"]
+        return (200 if ok else 503), "application/json", \
+            json.dumps(snap, default=str).encode("utf-8")
+
+    def _report(self):
+        if self.storage is None:
+            return 404, "application/json", \
+                json.dumps({"error": "no storage attached"}).encode()
+        from deeplearning4j_tpu.ui.report import render_report
+        html = render_report(self.storage, title=self.title)
+        return 200, "text/html; charset=utf-8", html.encode("utf-8")
+
+    def _trace(self):
+        return 200, "application/json", \
+            json.dumps(self.tracer.to_chrome_trace()).encode("utf-8")
+
+    def _stats(self, qs):
+        if self.storage is None:
+            return 404, "application/json", \
+                json.dumps({"error": "no storage attached"}).encode()
+        try:
+            n = int(qs.get("n", ["200"])[0])
+        except ValueError:
+            n = 200
+        rtype = qs.get("type", [None])[0]
+        recs = self.storage.tail(n, rtype)
+        body = "\n".join(json.dumps(r, default=str) for r in recs)
+        return 200, "application/x-ndjson; charset=utf-8", \
+            body.encode("utf-8")
+
+    def _index(self):
+        import html as _html
+        rows = "".join(
+            f'<li><a href="{r}">{r}</a> — {_html.escape(d)}</li>'
+            for r, d in (
+                ("/metrics", "Prometheus exposition"),
+                ("/healthz", "liveness (fault/rollback state)"),
+                ("/readyz", "readiness (staleness + queue depth)"),
+                ("/report", "training report HTML"),
+                ("/trace", "Chrome/Perfetto trace JSON"),
+                ("/stats", "recent records (?n=500&type=...)")))
+        body = (f"<!doctype html><html><head><meta charset='utf-8'>"
+                f"<title>{_html.escape(self.title)}</title></head>"
+                f"<body><h1>{_html.escape(self.title)}</h1>"
+                f"<ul>{rows}</ul></body></html>")
+        return 200, "text/html; charset=utf-8", body.encode("utf-8")
+
+
+def serve(port: int = 0, host: str = "127.0.0.1", storage=None,
+          registry: Optional[MetricsRegistry] = None, tracer=None,
+          stale_after_s: Optional[float] = None) -> TelemetryServer:
+    """Start a :class:`TelemetryServer` (module docstring). ``port=0``
+    binds a free loopback port; read it back from ``server.port`` /
+    ``server.url``. The server runs on daemon threads — it dies with
+    the process, or earlier via ``server.close()``."""
+    return TelemetryServer(storage=storage, registry=registry,
+                           tracer=tracer, host=host, port=port,
+                           stale_after_s=stale_after_s)
+
+
+__all__ = ["TelemetryServer", "serve", "health_snapshot"]

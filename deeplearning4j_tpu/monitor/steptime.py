@@ -179,7 +179,9 @@ class MonitorListener:
     def __init__(self, storage, registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[Tracer] = None, frequency: int = 10,
                  straggler: Optional[StragglerWatcher] = None,
-                 rolling_window: int = 512, trace_record_spans: int = 400):
+                 rolling_window: int = 512, trace_record_spans: int = 400,
+                 serve_port: Optional[int] = None,
+                 serve_host: str = "127.0.0.1"):
         self.storage = storage
         self.registry = registry if registry is not None else \
             MetricsRegistry()
@@ -193,6 +195,15 @@ class MonitorListener:
         self._mark = 0
         self._dropped = 0
         self._compile_snap: Optional[dict] = None
+        # live telemetry endpoint (monitor/server.py): serve_port=0
+        # picks a free port; the server shares this listener's storage,
+        # registry and tracer, and stays up after training ends (a
+        # dashboard scraping between fits must not 404). None = off.
+        self._serve_port = serve_port
+        self._serve_host = serve_host
+        self.server = None
+        self._last_flush_t: Optional[float] = None
+        self._last_iteration: Optional[int] = None
 
     def reset(self) -> None:
         """Rollback hook (faults/recovery.py resets stateful listeners):
@@ -204,11 +215,42 @@ class MonitorListener:
     # -- listener protocol ----------------------------------------------
     def on_training_start(self, sd) -> None:
         self._mark = self.tracer.mark()
+        if self._serve_port is not None and self.server is None:
+            from deeplearning4j_tpu.monitor.server import TelemetryServer
+            self.server = TelemetryServer(
+                storage=self.storage, registry=self.registry,
+                tracer=self.tracer, host=self._serve_host,
+                port=self._serve_port)
+            self.server.add_health_provider("training", self._heartbeat)
 
     def on_epoch_start(self, sd, epoch: int) -> None:
         pass
 
+    def _heartbeat(self) -> dict:
+        """Health-provider payload for the telemetry server: the wall
+        time and iteration of the last listener flush — /healthz's
+        last-step-age source that works even before any record with a
+        wall timestamp lands in the storage."""
+        out = {}
+        if self._last_flush_t is not None:
+            out["last_step_t"] = self._last_flush_t
+        if self._last_iteration is not None:
+            out["last_iteration"] = self._last_iteration
+        return out
+
+    def tensorstats_done(self, sd, epoch: int, records) -> None:
+        """The tensorstats rail (monitor/tensorstats.py): persist every
+        fetched per-layer record and fold it into ``dl4j_layer_*`` —
+        through the storage's incremental fold mark (see
+        ``iterations_done``), never per-record."""
+        for rec in records:
+            self.storage.put(rec)
+        self.registry.fold_storage(self.storage)
+
     def iterations_done(self, sd, epoch: int, iterations, losses) -> None:
+        self._last_flush_t = time.time()
+        if iterations:
+            self._last_iteration = int(iterations[-1])
         spans, self._mark, dropped = self.tracer.drain(self._mark)
         self._dropped += dropped
         rows = window_rows(spans)
@@ -250,7 +292,14 @@ class MonitorListener:
         if self._dropped:
             rec["spans_dropped"] = self._dropped
         self.storage.put(rec)
-        self.registry.fold_steptime(rec)
+        # fold through the storage's incremental per-(registry, storage)
+        # high-water mark, NOT per-record: a TelemetryServer sharing
+        # this registry folds the same storage on every /metrics scrape,
+        # and the shared mark is what keeps counter-typed series (the
+        # fold adapters are not idempotent) from reading 2x. This also
+        # picks up records other writers (checkpoint manager, fault
+        # rail, serving) put into the same storage between flushes.
+        self.registry.fold_storage(self.storage)
 
     def on_epoch_end(self, sd, epoch: int, mean_loss) -> None:
         self.registry.fold_dispatch(getattr(sd, "last_fit_stats", None),

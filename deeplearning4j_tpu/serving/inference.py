@@ -110,7 +110,8 @@ class ParallelInference:
                  default_timeout_ms: Optional[float] = None,
                  stats_storage=None,
                  profile_dir: Optional[str] = None,
-                 warmup_buckets=None):
+                 warmup_buckets=None,
+                 telemetry_port: Optional[int] = None):
         self.model = model
         self.mode = InferenceMode(mode)
         self.max_batch_size = int(max_batch_size)
@@ -147,6 +148,21 @@ class ParallelInference:
             self._queue, max_batch_size=self.max_batch_size,
             max_delay_ms=max_delay_ms, buckets=buckets) \
             if self.mode is InferenceMode.BATCHED else None
+        self.max_queue_len = int(max_queue_len)
+        # live telemetry endpoint (monitor/server.py): /metrics serves
+        # the serving counters/latency lanes via a scrape hook (pull
+        # model — no publisher thread), /readyz reports queue depth and
+        # goes 503 on overload or shutdown (the SLO shed-load signal).
+        # None = off; 0 = pick a free loopback port (telemetry.url).
+        self.telemetry = None
+        if telemetry_port is not None:
+            from deeplearning4j_tpu.monitor.server import TelemetryServer
+            self.telemetry = TelemetryServer(storage=stats_storage,
+                                             port=telemetry_port)
+            self.telemetry.add_scrape_hook(
+                lambda reg: reg.fold_serving(self.metrics))
+            self.telemetry.add_health_provider("serving",
+                                               self._telemetry_health)
         self.warmup_report: Optional[dict] = None
         if warmup_buckets:
             # before any worker thread exists: warmed shapes must be in
@@ -446,12 +462,26 @@ class ParallelInference:
         with self._exec_lock:
             self._spec.sync()
 
+    def _telemetry_health(self) -> dict:
+        """Health-provider payload for the telemetry endpoint: serving
+        queue depth vs capacity. Not-ready when closed or the queue is
+        full (admission would raise ServerOverloadedError — the signal
+        an SLO-aware load balancer sheds on)."""
+        depth = self._queue.pending()
+        return {"queue_depth": depth,
+                "queue_capacity": self.max_queue_len,
+                "ready": not self._closed and depth < self.max_queue_len,
+                "healthy": not self._closed}
+
     # -- lifecycle ------------------------------------------------------
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop intake; with ``drain`` (default) serve what is queued,
         otherwise fail pending futures with ServerClosedError. Further
-        submits raise :class:`ServerClosedError`. Idempotent."""
+        submits raise :class:`ServerClosedError`. Idempotent. The
+        telemetry endpoint (``telemetry_port=``) stays up through the
+        drain — /readyz reports not-ready immediately — and closes
+        last."""
         if self._closed:
             return
         self._closed = True
@@ -460,6 +490,8 @@ class ParallelInference:
             t.join(timeout=timeout)
         if self.stats_storage is not None:
             self.metrics.publish(self.stats_storage)
+        if self.telemetry is not None:
+            self.telemetry.close()
 
     def __enter__(self) -> "ParallelInference":
         return self

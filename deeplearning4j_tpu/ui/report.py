@@ -100,6 +100,62 @@ def _svg_stack(rows: List[dict], w=640, h=200, label="") -> str:
     return "\n".join(parts)
 
 
+def _svg_heatmap(matrix: List[List[float]], row_labels: List[str],
+                 w=640, cell_h=18, label="", log10: bool = True) -> str:
+    """Rows × columns heatmap (layers × samples), light→dark by value
+    (log10 by default — grad norms span decades). NaN/zero cells render
+    grey."""
+    import math
+    rows = [r for r in matrix if r]
+    if not rows or not row_labels:
+        return f"<p>(no data for {_html.escape(label)})</p>"
+    vals = []
+    for r in rows:
+        for v in r:
+            if v and v > 0 and math.isfinite(v):
+                vals.append(math.log10(v) if log10 else v)
+    if not vals:
+        return f"<p>(no finite data for {_html.escape(label)})</p>"
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        lo, hi = lo - 1, hi + 1
+    ncols = max(len(r) for r in rows)
+    x0 = 130
+    cw = (w - x0 - 10) / ncols
+    h = 24 + cell_h * len(rows) + 18
+
+    def color(v):
+        if not v or v <= 0 or not math.isfinite(v):
+            return "#ddd"
+        t = ((math.log10(v) if log10 else v) - lo) / (hi - lo)
+        # light blue -> dark navy ramp
+        r0, g0, b0 = 0xdb, 0xe9, 0xf6
+        r1, g1, b1 = 0x08, 0x30, 0x6b
+        return "#%02x%02x%02x" % (round(r0 + t * (r1 - r0)),
+                                  round(g0 + t * (g1 - g0)),
+                                  round(b0 + t * (b1 - b0)))
+
+    parts = [f'<svg width="{w}" height="{h}" style="background:#fafafa">',
+             f'<text x="5" y="14" font-size="12" fill="#444">'
+             f'{_html.escape(label)}</text>']
+    for ri, (name, row) in enumerate(zip(row_labels, rows)):
+        y = 22 + ri * cell_h
+        parts.append(f'<text x="5" y="{y + cell_h - 5}" font-size="10" '
+                     f'fill="#666">{_html.escape(str(name)[:18])}</text>')
+        for ci, v in enumerate(row):
+            parts.append(
+                f'<rect x="{x0 + ci * cw:.1f}" y="{y}" '
+                f'width="{max(cw - 1, 1):.1f}" height="{cell_h - 2}" '
+                f'fill="{color(v)}"><title>{_html.escape(str(name))}'
+                f'[{ci}]: {v:.4g}</title></rect>')
+    lo10 = f"1e{lo:.1f}" if log10 else f"{lo:.3g}"
+    hi10 = f"1e{hi:.1f}" if log10 else f"{hi:.3g}"
+    parts.append(f'<text x="{x0}" y="{h - 4}" font-size="10" fill="#888">'
+                 f'{lo10} (light) → {hi10} (dark)</text>')
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
 def _span_color(name: str) -> str:
     # crc32, NOT builtin hash(): the name→color mapping must be stable
     # across processes (hash() is salted per run; reports rendered from
@@ -167,7 +223,7 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
-    "compile", "reshard"})
+    "compile", "reshard", "tensorstats"})
 
 
 def render_report(storage: StatsStorage, title: str = "Training report"
@@ -177,6 +233,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     params = storage.of_type("params")
     memory = storage.of_type("memory")
     end = storage.of_type("end")
+    tensorstats = storage.of_type("tensorstats")
     steptime = [r for r in storage.of_type("steptime")
                 if r.get("event") != "straggler"]
     stragglers = [r for r in storage.of_type("steptime")
@@ -250,6 +307,75 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
             [(r["epoch"], r["peak_bytes"] / 2**20) for r in memory],
             label="HBM peak (MiB)", color="#8c564b"))
         parts.append("</div>")
+
+    # -- layer health: in-graph tensorstats (monitor/tensorstats.py) -----
+    if tensorstats:
+        # bounded like the trace dump: a long monitored run holds tens
+        # of thousands of samples, and /report renders this LIVE per
+        # request — stride-downsample to a readable column budget
+        # (always keeping the newest record, which feeds the table)
+        ts_total = len(tensorstats)
+        max_cols = 160
+        if ts_total > max_cols:
+            stride = -(-ts_total // max_cols)
+            tensorstats = tensorstats[::-stride][::-1]
+        layer_names = sorted({n for r in tensorstats
+                              for n in r.get("layers", {})})
+        parts.append("<h2>Layer health (device-side tensorstats)</h2>"
+                     "<div class='row'>")
+        # update:param ratio over time, one chart per layer (the
+        # dead↔exploding spectrum LayerHealthWatcher polices)
+        for name in layer_names:
+            pts = [(r["iter"], r["layers"][name]["update_ratio"])
+                   for r in tensorstats if name in r.get("layers", {})
+                   and r["layers"][name].get("update_ratio") is not None]
+            if pts:
+                parts.append(_svg_line(
+                    pts, w=320, h=120, color="#d62728",
+                    label=f"{name} update:param (in-graph)", ylog=True))
+        parts.append("</div>")
+        # grad-norm heatmap: layers x sampled steps, log color scale
+        # (None = poisoned/absent stats -> NaN -> grey cell)
+        def _fnum(v):
+            return float("nan") if v is None else float(v)
+
+        matrix = [[_fnum(r["layers"].get(name, {}).get("grad_l2"))
+                   for r in tensorstats] for name in layer_names]
+        if any("grad_l2" in r["layers"].get(n, {}) for r in tensorstats
+               for n in layer_names):
+            parts.append(_svg_heatmap(
+                matrix, layer_names,
+                label="gradient L2 norm per layer over sampled steps"))
+        last = tensorstats[-1]["layers"]
+        parts.append(
+            "<table><tr><th>layer</th><th>grad L2</th>"
+            "<th>update:param</th><th>nonfinite</th><th>zeros</th>"
+            "<th>|x| range (log2)</th></tr>")
+        for name in layer_names:
+            ent = last.get(name, {})
+            nonf = sum(ent.get(f"{p}_nonfinite", 0)
+                       for p in ("grad", "update", "param"))
+            rng = "—"
+            if ent.get("grad_hist"):
+                lo = tensorstats[-1].get("hist_min_exp", 0)
+                nz = [i for i, c in enumerate(ent["grad_hist"]) if c]
+                if nz:
+                    rng = f"[{lo + nz[0]}, {lo + nz[-1]}]"
+            ur = ent.get("update_ratio")
+            parts.append(
+                f"<tr><td>{_html.escape(name)}</td>"
+                f"<td>{_fnum(ent.get('grad_l2')):.4g}</td>"
+                f"<td>{'—' if ur is None else format(ur, '.4g')}</td>"
+                f"<td>{nonf}</td>"
+                f"<td>{ent.get('grad_zeros', 0)}</td>"
+                f"<td>{rng}</td></tr>")
+        shown = "" if ts_total == len(tensorstats) \
+            else f" ({len(tensorstats)} shown)"
+        parts.append(
+            f"</table><p>{ts_total} in-graph samples{shown} (every "
+            f"{tensorstats[-1].get('every_n', '?')} steps) — gradients/"
+            f"updates summarized inside the compiled step, fetched at "
+            f"flush boundaries (docs/observability.md)</p>")
 
     # -- observability: step-time breakdown + span timeline --------------
     if steptime:

@@ -57,6 +57,16 @@ Storage format: JSON-lines, one record per event
         cat, ts, dur, tid, thread, sid, parent, args}]}
         (a bounded monitor/trace span dump at training end — rendered
         as the report's swimlane timeline)
+    {"type": "tensorstats", "iter": i, "epoch": e, "t": wall,
+        "every_n": n, "hist_min_exp": m, "layers": {name:
+        {"grad_l2"|"grad_mean_abs"|"grad_min"|"grad_max":,
+         "grad_nonfinite"|"grad_zeros": n, "grad_hist": [counts],
+         ...same families with "update_"/"param_" prefixes...,
+         "update_ratio": r}}}
+        (in-graph per-layer gradient/update/param summaries sampled
+        inside the compiled step — monitor/tensorstats.py, delivered
+        through the Listener.tensorstats_done rail and rendered as the
+        report's layer-health panel, docs/observability.md)
 
 Unknown record types must DEGRADE GRACEFULLY in consumers: ui/report
 renders the sections it knows and lists unrecognized types in a footer
@@ -102,6 +112,19 @@ class StatsStorage:
     def of_type(self, rtype: str) -> List[dict]:
         with self._lock:
             return [r for r in self.records if r.get("type") == rtype]
+
+    def tail(self, n: int = 200, rtype: Optional[str] = None) -> List[dict]:
+        """The most recent ``n`` records (optionally one type only) —
+        the /stats endpoint's read path (monitor/server.py). ``n <= 0``
+        returns nothing (``recs[-0:]`` would silently mean ALL —
+        exactly the unbounded dump a tail API exists to prevent)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            recs = self.records if rtype is None else \
+                [r for r in self.records if r.get("type") == rtype]
+            return list(recs[-n:])
 
     def close(self) -> None:
         with self._lock:
@@ -182,7 +205,14 @@ class StatsListener(Listener):
     def on_epoch_end(self, sd, epoch: int, mean_loss: float):
         stats = {}
         for name, arr in sd.trainable_params().items():
-            a = np.asarray(arr, np.float64)
+            # ONE device→host transfer per param, computed in float32:
+            # the old float64 upcast doubled peak host memory and the
+            # epoch-boundary stall for zero statistical benefit (the
+            # params are float32 on device; the record schema's Python
+            # floats are unchanged)
+            a = np.asarray(arr)
+            if a.dtype not in (np.float32, np.float64):
+                a = a.astype(np.float32)    # bf16/f16/int -> numpy-native
             hist, edges = _histogram(a, self.histogram_bins)
             ent = {"mean": float(a.mean()), "std": float(a.std()),
                    "norm": float(np.linalg.norm(a)),

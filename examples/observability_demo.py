@@ -14,12 +14,19 @@ producing every observability artifact in one go:
   timings and fault events fold in the same way), exported as
   Prometheus text;
 - the static HTML report grown a span-timeline swimlane and a stacked
-  step-time-breakdown chart.
+  step-time-breakdown chart;
+- ``{"type": "tensorstats"}`` records: per-layer gradient/update/param
+  summaries computed INSIDE the compiled step (``TrainingConfig.
+  tensorstats``) — the DL4J BaseStatsListener signal, device-side;
+- the live telemetry HTTP endpoint (``MonitorListener(serve_port=0)``):
+  /metrics, /healthz, /report served from the running process.
 
 See docs/observability.md.
 """
+import json
 import os
 import tempfile
+import urllib.request
 
 import numpy as np
 
@@ -27,7 +34,8 @@ from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
 from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
 from deeplearning4j_tpu.learning.updaters import Adam
 from deeplearning4j_tpu.monitor import (MetricsRegistry, MonitorListener,
-                                        StragglerWatcher, TRACER,
+                                        StragglerWatcher,
+                                        TensorStatsConfig, TRACER,
                                         enable_tracing)
 from deeplearning4j_tpu.ui import StatsStorage, write_report
 
@@ -47,7 +55,9 @@ def build_mlp():
     sd.training_config = TrainingConfig(
         updater=Adam(1e-2), data_set_feature_mapping=["x"],
         data_set_label_mapping=["labels"],
-        fused_steps=8)               # the production fused-window tier
+        fused_steps=8,               # the production fused-window tier
+        sentinel=True,               # divergence rail shares the carry
+        tensorstats=TensorStatsConfig(every_n=8))  # in-graph layer stats
     return sd
 
 
@@ -62,7 +72,8 @@ def main():
     storage = StatsStorage(os.path.join(out_dir, "stats.jsonl"))
     registry = MetricsRegistry()
     monitor = MonitorListener(storage, registry=registry, frequency=16,
-                              straggler=StragglerWatcher(threshold=3.0))
+                              straggler=StragglerWatcher(threshold=3.0),
+                              serve_port=0)   # live telemetry endpoint
 
     sd = build_mlp()
     it = ArrayDataSetIterator(X, Y, batch_size=16)   # 32 steps/epoch
@@ -82,6 +93,16 @@ def main():
               f"flush {rec['flush_s'] * 1e3:.1f} ms "
               f"(step p50 {rec['step_ms_p50']:.2f} ms)")
 
+    # -- per-layer training health, computed on device -----------------
+    ts = storage.of_type("tensorstats")
+    last = ts[-1]
+    print(f"tensorstats: {len(ts)} in-graph samples; at iteration "
+          f"{last['iter']}:")
+    for layer, ent in sorted(last["layers"].items()):
+        print(f"  {layer}: grad L2 {ent['grad_l2']:.4g}, "
+              f"update:param {ent['update_ratio']:.3g}, "
+              f"nonfinite {ent['grad_nonfinite']}")
+
     # -- one namespace over every subsystem ----------------------------
     prom = registry.to_prometheus_text()
     print("metrics (prometheus text, excerpt):")
@@ -89,6 +110,19 @@ def main():
         if line.startswith("dl4j_fit_") or \
                 line.startswith("dl4j_steptime_steps"):
             print(f"  {line}")
+
+    # -- the live endpoint: scrape the running process ------------------
+    server = monitor.server
+    with urllib.request.urlopen(server.url + "/metrics", timeout=10) as r:
+        live = r.read().decode()
+    layer_series = [l for l in live.splitlines()
+                    if l.startswith("dl4j_layer_grad_l2")]
+    print(f"live {server.url}/metrics: {len(layer_series)} "
+          f"dl4j_layer_grad_l2 series")
+    with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    print(f"live /healthz: fault_state={health['fault_state']}, "
+          f"last step age {health['last_step_age_s']}s")
 
     # -- artifacts ------------------------------------------------------
     trace_path = TRACER.write_chrome_trace(
@@ -104,8 +138,11 @@ def main():
           f"step-time breakdown)")
 
     assert storage.of_type("steptime") and storage.of_type("metrics")
+    assert storage.of_type("tensorstats") and layer_series
+    assert health["healthy"] is True
     assert any(s.name == "window" for s in TRACER.spans())
     assert np.isfinite(history.final_loss())
+    server.close()
     print("observability demo complete")
 
 

@@ -1,0 +1,398 @@
+"""Live telemetry HTTP endpoint (monitor/server.py).
+
+Covers the route surface (/metrics /healthz /readyz /report /trace
+/stats), health-state transitions driven by the fault rail, the
+MonitorListener- and ParallelInference-hosted servers, and the
+acceptance criteria: while a fit runs, /metrics serves parse-valid
+Prometheus text containing ``dl4j_layer_*`` series, and /healthz goes
+unhealthy during a chaos-injected rollback then recovers.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.checkpoint import CheckpointManager
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.faults import (ChaosMonkey, FaultTolerantFit,
+                                       RetryPolicy)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.monitor import (MetricsRegistry, MonitorListener,
+                                        TensorStatsConfig, serve)
+from deeplearning4j_tpu.monitor.server import health_snapshot
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _get(url, timeout=10):
+    """(status, body) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _parse_prometheus(text):
+    """Strict-enough exposition parse: {name{labels}: float}. Raises on
+    malformed sample lines — the /metrics contract is machine-read."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name and value, f"malformed sample line: {line!r}"
+        out[name] = float(value)
+    return out
+
+
+def _mlp(**tc_kw):
+    rng = np.random.default_rng(0)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 8))
+    w0 = sd.var("w0", value=rng.normal(0, .1, (8, 16)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(16, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    w1 = sd.var("w1", value=rng.normal(0, .1, (16, 2)).astype(np.float32))
+    logits = h.mmul(w1)
+    labels = sd.placeholder("labels", shape=(-1, 2))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"], **tc_kw)
+    return sd
+
+
+def _it(batch=8, n=64, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return ArrayDataSetIterator(X, Y, batch_size=batch)
+
+
+@pytest.fixture
+def server():
+    st = StatsStorage()
+    srv = serve(port=0, storage=st)
+    try:
+        yield srv, st
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# routes
+
+class TestRoutes:
+    def test_index_and_404(self, server):
+        srv, _ = server
+        code, body = _get(srv.url + "/")
+        assert code == 200 and "/metrics" in body
+        code, body = _get(srv.url + "/nope")
+        assert code == 404 and "no route" in body
+
+    def test_metrics_parse_valid_with_process_telemetry(self, server):
+        srv, st = server
+        st.put({"type": "checkpoint", "step": 3, "bytes": 100,
+                "serialize_seconds": 0.01, "t": time.time()})
+        code, text = _get(srv.url + "/metrics")
+        assert code == 200
+        samples = _parse_prometheus(text)
+        assert samples["dl4j_process_uptime_seconds"] > 0
+        assert samples["dl4j_checkpoint_commits_total"] == 1.0
+        # Linux: RSS is available; elsewhere the series is absent
+        rss = samples.get("dl4j_process_rss_bytes")
+        if rss is not None:
+            assert rss > 1 << 20
+
+    def test_shared_registry_scrape_does_not_double_count(self):
+        """Review regression: MonitorListener folds its own records AND
+        a TelemetryServer sharing its registry folds the same storage
+        on every scrape — counter-typed series must read 1x, not 2x
+        (both paths go through the storage's shared fold mark)."""
+        storage = StatsStorage()
+        reg = MetricsRegistry()
+        mon = MonitorListener(storage, registry=reg, frequency=4,
+                              serve_port=0)
+        sd = _mlp(fused_steps=4,
+                  tensorstats=TensorStatsConfig(every_n=4))
+        from deeplearning4j_tpu.monitor import enable_tracing, \
+            disable_tracing
+        enable_tracing(reset=True)
+        try:
+            sd.fit(_it(), epochs=1, listeners=[mon])
+        finally:
+            disable_tracing()
+        try:
+            samples = _parse_prometheus(
+                _get(mon.server.url + "/metrics")[1])
+            # scrape twice more — still no growth without new records
+            samples2 = _parse_prometheus(
+                _get(mon.server.url + "/metrics")[1])
+            true_steps = sum(r["steps"]
+                             for r in storage.of_type("steptime"))
+            assert samples["dl4j_steptime_steps_total"] == true_steps
+            assert samples2["dl4j_steptime_steps_total"] == true_steps
+            n_ratio_obs = sum(
+                len(r["layers"]) for r in storage.of_type("tensorstats"))
+            assert samples2[
+                'dl4j_layer_update_ratio_dist_bucket{le="+Inf"}'] \
+                == n_ratio_obs
+        finally:
+            mon.server.close()
+
+    def test_stats_nonpositive_n_returns_nothing(self, server):
+        """Review regression: /stats?n=0 must not dump the whole
+        storage (recs[-0:] would mean ALL)."""
+        srv, st = server
+        for i in range(5):
+            st.put({"type": "score", "iter": i, "loss": 0.1})
+        assert _get(srv.url + "/stats?n=0")[1] == ""
+        assert _get(srv.url + "/stats?n=-3")[1] == ""
+        assert st.tail(0) == [] and st.tail(-3) == []
+
+    def test_metrics_scrape_is_incremental(self, server):
+        srv, st = server
+        st.put({"type": "checkpoint", "step": 1, "bytes": 10,
+                "t": time.time()})
+        _get(srv.url + "/metrics")
+        _get(srv.url + "/metrics")           # re-scrape: no double count
+        samples = _parse_prometheus(_get(srv.url + "/metrics")[1])
+        assert samples["dl4j_checkpoint_commits_total"] == 1.0
+
+    def test_stats_tail_and_type_filter(self, server):
+        srv, st = server
+        for i in range(5):
+            st.put({"type": "score", "iter": i, "loss": 0.1})
+        st.put({"type": "faults", "event": "fault", "t": 1.0})
+        code, body = _get(srv.url + "/stats?n=2&type=score")
+        lines = [json.loads(l) for l in body.splitlines()]
+        assert [r["iter"] for r in lines] == [3, 4]
+        code, body = _get(srv.url + "/stats?type=faults")
+        assert len(body.splitlines()) == 1
+
+    def test_report_and_trace(self, server):
+        srv, st = server
+        st.put({"type": "score", "iter": 0, "epoch": 0, "loss": 1.0,
+                "t": 0.0})
+        code, html = _get(srv.url + "/report")
+        assert code == 200 and html.startswith("<!doctype html>")
+        code, body = _get(srv.url + "/trace")
+        assert code == 200 and "traceEvents" in json.loads(body)
+
+    def test_no_storage_routes(self):
+        srv = serve(port=0)
+        try:
+            assert _get(srv.url + "/report")[0] == 404
+            assert _get(srv.url + "/stats")[0] == 404
+            assert _get(srv.url + "/metrics")[0] == 200
+            assert _get(srv.url + "/healthz")[0] == 200
+        finally:
+            srv.close()
+
+    def test_close_stops_serving(self):
+        srv = serve(port=0)
+        url = srv.url
+        srv.close()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# health semantics
+
+class TestHealth:
+    def test_fault_rollback_recover_transitions(self, server):
+        srv, st = server
+        assert _get(srv.url + "/healthz")[0] == 200
+        st.put({"type": "faults", "event": "fault", "t": time.time()})
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["fault_state"] == "recovering"
+        st.put({"type": "faults", "event": "rollback", "t": time.time()})
+        assert _get(srv.url + "/healthz")[0] == 503
+        assert _get(srv.url + "/readyz")[0] == 503   # unhealthy => unready
+        st.put({"type": "faults", "event": "recovered", "t": time.time()})
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["fault_state"] == "ok" and snap["rollbacks"] == 1
+
+    def test_retry_exhausted_is_sticky(self, server):
+        srv, st = server
+        st.put({"type": "faults", "event": "retry_exhausted", "t": 1.0})
+        st.put({"type": "faults", "event": "recovered", "t": 2.0})
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503 and json.loads(body)["fault_state"] == "failed"
+
+    def test_readyz_staleness(self):
+        st = StatsStorage()
+        srv = serve(port=0, storage=st, stale_after_s=0.05)
+        try:
+            srv.add_health_provider(
+                "train", lambda: {"last_step_t": time.time() - 10.0})
+            code, body = _get(srv.url + "/readyz")
+            assert code == 503
+            snap = json.loads(body)
+            assert snap["last_step_age_s"] >= 10.0
+            assert snap["healthy"] is True       # stale != faulted
+            assert _get(srv.url + "/healthz")[0] == 200
+            srv.add_health_provider(
+                "train", lambda: {"last_step_t": time.time()})
+            assert _get(srv.url + "/readyz")[0] == 200
+        finally:
+            srv.close()
+
+    def test_provider_error_reported_unhealthy(self, server):
+        srv, _ = server
+
+        def boom():
+            raise RuntimeError("dead hook")
+
+        srv.add_health_provider("broken", boom)
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        assert "dead hook" in body
+
+    def test_provider_ready_gate(self, server):
+        srv, _ = server
+        srv.add_health_provider("q", lambda: {"ready": False,
+                                              "queue_depth": 9})
+        assert _get(srv.url + "/healthz")[0] == 200
+        code, body = _get(srv.url + "/readyz")
+        assert code == 503
+        assert json.loads(body)["providers"]["q"]["queue_depth"] == 9
+
+    def test_snapshot_pure_function(self):
+        st = StatsStorage()
+        st.put({"type": "faults", "event": "rollback", "t": 1.0})
+        snap = health_snapshot(st)
+        assert snap["healthy"] is False and snap["rollbacks"] == 1
+        st.put({"type": "faults", "event": "recovered", "t": 2.0})
+        assert health_snapshot(st)["healthy"] is True
+
+
+# ---------------------------------------------------------------------------
+# hosted servers: MonitorListener + ParallelInference
+
+class TestHostedServers:
+    def test_live_metrics_during_fit(self):
+        """Acceptance: while a fit is running, GET /metrics returns
+        parse-valid Prometheus text containing dl4j_layer_* series."""
+        storage = StatsStorage()
+        mon = MonitorListener(storage, frequency=4, serve_port=0)
+        sd = _mlp(fused_steps=4, sentinel=True,
+                  tensorstats=TensorStatsConfig(every_n=2))
+        seen = {}
+
+        class MidFitProbe:
+            frequency = 1_000_000_000
+            def on_training_start(self, sd): ...
+            def on_training_end(self, sd): ...
+            def on_epoch_start(self, sd, epoch): ...
+            def iterations_done(self, sd, epoch, iterations, losses): ...
+
+            def on_epoch_end(self, probe_self, epoch, mean_loss=None):
+                # mid-fit (between epochs): the server is live
+                if epoch == 0 and mon.server is not None:
+                    code, text = _get(mon.server.url + "/metrics")
+                    seen["code"] = code
+                    seen["samples"] = _parse_prometheus(text)
+                    seen["health"] = _get(mon.server.url + "/healthz")[0]
+
+        sd.fit(_it(), epochs=2, listeners=[mon, MidFitProbe()])
+        try:
+            assert seen["code"] == 200
+            layer_series = [k for k in seen["samples"]
+                            if k.startswith("dl4j_layer_")]
+            assert any('dl4j_layer_grad_l2{layer="w0"}' == k
+                       for k in layer_series)
+            assert any("dl4j_layer_update_ratio" in k
+                       for k in layer_series)
+            assert seen["health"] == 200
+            # heartbeat provider: last-step age tracked from flushes
+            snap = json.loads(_get(mon.server.url + "/healthz")[1])
+            assert snap["providers"]["training"]["last_iteration"] >= 8
+            assert snap["last_step_age_s"] is not None
+            # the report renders live too
+            assert "Layer health" in _get(mon.server.url + "/report")[1]
+        finally:
+            mon.server.close()
+
+    @pytest.mark.chaos
+    def test_healthz_unhealthy_during_rollback_then_recovers(self,
+                                                             tmp_path):
+        """Acceptance: /healthz transitions to unhealthy during a
+        chaos-injected rollback and recovers afterwards. The probe
+        rides FaultTolerantFit's backoff sleep — a point strictly
+        between the rollback record and the recovery."""
+        storage = StatsStorage()
+        srv = serve(port=0, storage=storage)
+        codes = []
+
+        def probing_sleep(_s):
+            codes.append(_get(srv.url + "/healthz")[0])
+
+        sd = _mlp(fused_steps=4, sentinel=True)
+        chaos = ChaosMonkey(seed=0)
+        it = chaos.poison_batches(_it(batch=16), at_step=5)
+        mgr = CheckpointManager(tmp_path, keep_last_n=3)
+        ftf = FaultTolerantFit(
+            sd, mgr,
+            policy=RetryPolicy(max_retries=2, backoff_base=0.01,
+                               quarantine_corrupt=False),
+            checkpoint_every_n_iterations=4, stats_storage=storage,
+            sleep=probing_sleep)
+        try:
+            h = ftf.fit(it, epochs=3)
+            assert np.isfinite(h.final_loss())
+            assert ftf.rollbacks >= 1
+            # mid-recovery: every backoff probe saw 503
+            assert codes and all(c == 503 for c in codes)
+            # recovered: healthy again, with the rollback on record
+            code, body = _get(srv.url + "/healthz")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["fault_state"] == "ok"
+            assert snap["rollbacks"] >= 1
+        finally:
+            srv.close()
+            mgr.close()
+
+    def test_parallel_inference_telemetry(self):
+        from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                           MultiLayerNetwork,
+                                           NeuralNetConfiguration,
+                                           OutputLayer)
+        from deeplearning4j_tpu.serving import (InferenceMode,
+                                                ParallelInference)
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, loss_function="MCXENT"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        pi = ParallelInference(net, mode=InferenceMode.INPLACE,
+                               telemetry_port=0)
+        try:
+            x = np.random.default_rng(0).normal(size=(4, 8)) \
+                .astype(np.float32)
+            pi.output(x)
+            code, text = _get(pi.telemetry.url + "/metrics")
+            samples = _parse_prometheus(text)
+            assert samples["dl4j_serving_requests_served_total"] >= 1
+            code, body = _get(pi.telemetry.url + "/readyz")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["providers"]["serving"]["queue_depth"] == 0
+            assert snap["providers"]["serving"]["queue_capacity"] > 0
+        finally:
+            url = pi.telemetry.url
+            pi.shutdown()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(url + "/readyz", timeout=2)

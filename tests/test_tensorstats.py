@@ -1,0 +1,489 @@
+"""In-graph per-layer tensor statistics (monitor/tensorstats.py).
+
+The DL4J ``BaseStatsListener`` parity rail computed inside the compiled
+step: per-layer grad/update/param summaries sampled in-graph, folded
+into the scan carry like the divergence sentinel, fetched at flush
+boundaries, and published as ``{"type": "tensorstats"}`` records.
+
+Composition coverage (the PR's satellite contract):
+- a clean fused run with tensorstats AND the sentinel sharing the carry
+  is bit-identical (params + losses) to both off;
+- tensorstats under a ``ShardingSpec`` mesh reports the same norms as
+  the unsharded run;
+- ``SameDiff.precompile()`` covers the stats-enabled window signature
+  (0 lazy window compiles).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff.training import Listener
+from deeplearning4j_tpu.dataset.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.faults.errors import TrainingDivergedError
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.monitor import (LayerHealthWatcher, MetricsRegistry,
+                                        MonitorListener, TensorStatsConfig)
+from deeplearning4j_tpu.monitor.tensorstats import (FAMILY_PREFIX,
+                                                    SCALAR_FIELDS,
+                                                    build_record, normalize,
+                                                    summarize_leaf)
+from deeplearning4j_tpu.parallel import ShardingSpec
+from deeplearning4j_tpu.ui.report import render_report
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _mlp(tensorstats=None, fused_steps=1, accum_steps=1, sentinel=False,
+         sharding=None, lr=1e-2, updater=None):
+    rng = np.random.default_rng(0)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 8))
+    w0 = sd.var("w0", value=rng.normal(0, .1, (8, 16)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(16, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    w1 = sd.var("w1", value=rng.normal(0, .1, (16, 2)).astype(np.float32))
+    logits = h.mmul(w1)
+    labels = sd.placeholder("labels", shape=(-1, 2))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = TrainingConfig(
+        updater=updater or Adam(lr), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"], fused_steps=fused_steps,
+        accum_steps=accum_steps, sentinel=sentinel, sharding=sharding,
+        tensorstats=tensorstats)
+    return sd
+
+
+def _data(n=64, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return X, Y
+
+
+def _it(batch=8, n=64, seed=1):
+    X, Y = _data(n, seed)
+    return ArrayDataSetIterator(X, Y, batch_size=batch)
+
+
+class Collector(Listener):
+    """Burst + tensorstats collector with a configurable cadence ask."""
+
+    def __init__(self, frequency=8):
+        self.frequency = frequency
+        self.losses = []
+        self.records = []
+
+    def iterations_done(self, sd, epoch, iterations, losses):
+        self.losses.extend(float(v) for v in losses)
+
+    def tensorstats_done(self, sd, epoch, records):
+        self.records.extend(records)
+
+
+# ---------------------------------------------------------------------------
+# config
+
+class TestConfig:
+    def test_serde_roundtrip(self):
+        cfg = TensorStatsConfig(every_n=7, families=("params", "grads"),
+                                hist_bins=12, hist_min_exp=-8)
+        back = TensorStatsConfig.from_json(cfg.to_json())
+        assert back == cfg
+        # families canonicalize to the fixed order regardless of input
+        assert back.families == ("grads", "params")
+
+    def test_rides_training_config_serde(self):
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=3))
+        tc2 = TrainingConfig.from_json(sd.training_config.to_json())
+        assert tc2.tensorstats == sd.training_config.tensorstats
+        assert TrainingConfig.from_json(
+            _mlp().training_config.to_json()).tensorstats is None
+
+    def test_true_means_defaults(self):
+        sd = _mlp(tensorstats=True)
+        assert sd.training_config.tensorstats == TensorStatsConfig()
+        assert normalize(True) == TensorStatsConfig()
+        assert normalize(None) is None
+
+    def test_builder(self):
+        tc = (TrainingConfig.builder().updater(Adam(1e-3))
+              .tensorstats(TensorStatsConfig(every_n=2)).build())
+        assert tc.tensorstats.every_n == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TensorStatsConfig(every_n=0)
+        with pytest.raises(ValueError):
+            TensorStatsConfig(families=("grads", "nope"))
+        with pytest.raises(ValueError):
+            TensorStatsConfig(families=())
+        with pytest.raises(ValueError):
+            TensorStatsConfig(hist_bins=0)
+        with pytest.raises(TypeError):
+            normalize("yes")
+
+    def test_key_is_stable_identity(self):
+        a = TensorStatsConfig(families=("params", "grads"))
+        b = TensorStatsConfig(families=("grads", "params"))
+        assert a.key() == b.key()
+        assert a.key() != TensorStatsConfig(every_n=2).key()
+
+
+# ---------------------------------------------------------------------------
+# the traced summaries
+
+class TestSummaries:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(0, 0.3, (9, 5)).astype(np.float32)
+        x[0, 0] = 0.0
+        cfg = TensorStatsConfig(hist_bins=24, hist_min_exp=-20)
+        scalars, hist = jax.jit(
+            lambda a: summarize_leaf(a, cfg))(x)
+        scalars = np.asarray(scalars)
+        got = dict(zip(SCALAR_FIELDS, scalars))
+        assert got["l2"] == pytest.approx(np.linalg.norm(x), rel=1e-5)
+        assert got["mean_abs"] == pytest.approx(np.abs(x).mean(), rel=1e-5)
+        assert got["min"] == pytest.approx(x.min())
+        assert got["max"] == pytest.approx(x.max())
+        assert got["nonfinite"] == 0
+        assert got["zeros"] == 1
+        # histogram counts every finite nonzero entry exactly once
+        hist = np.asarray(hist)
+        assert hist.sum() == x.size - 1
+        exps = np.floor(np.log2(np.abs(x[x != 0]))).astype(int)
+        bins = np.clip(exps - cfg.hist_min_exp, 0, cfg.hist_bins - 1)
+        expect = np.bincount(bins, minlength=cfg.hist_bins)
+        np.testing.assert_array_equal(hist, expect)
+
+    def test_nonfinite_counted_and_masked_from_moments(self):
+        x = np.array([1.0, np.nan, np.inf, -2.0, 0.0], np.float32)
+        cfg = TensorStatsConfig()
+        scalars, hist = jax.jit(lambda a: summarize_leaf(a, cfg))(x)
+        got = dict(zip(SCALAR_FIELDS, np.asarray(scalars)))
+        assert got["nonfinite"] == 2
+        assert got["zeros"] == 1
+        # the exact norm accumulator propagates the poison — a NaN l2
+        # IS the diagnostic for a poisoned layer
+        assert np.isnan(got["l2"])
+        # ... while the sampled moments mask nonfinites out
+        assert got["min"] == -2.0 and got["max"] == 1.0
+        assert got["mean_abs"] == pytest.approx(3.0 / 5, rel=1e-6)
+        assert np.asarray(hist).sum() == 2          # 1.0 and -2.0
+
+    def test_sample_cap_strided_subsample(self):
+        # 1000 elements, cap 100 -> stride 10: sampled stats describe
+        # x[::10]; l2 stays exact; a NaN at an UNSAMPLED index is still
+        # detected through the norm accumulator (lower bound 1)
+        x = np.linspace(0.1, 1.0, 1000).astype(np.float32)
+        cfg = TensorStatsConfig(sample_cap=100)
+        scalars, hist = jax.jit(lambda a: summarize_leaf(a, cfg))(x)
+        got = dict(zip(SCALAR_FIELDS, np.asarray(scalars)))
+        assert got["l2"] == pytest.approx(np.linalg.norm(x), rel=1e-5)
+        assert got["mean_abs"] == pytest.approx(np.abs(x[::10]).mean(),
+                                                rel=1e-5)
+        assert np.asarray(hist).sum() == 100
+        x[7] = np.nan                                # never sampled
+        scalars, _ = jax.jit(lambda a: summarize_leaf(a, cfg))(x)
+        got = dict(zip(SCALAR_FIELDS, np.asarray(scalars)))
+        assert got["nonfinite"] == 1 and np.isnan(got["l2"])
+
+    def test_sample_cap_zero_is_exact(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(3000,)).astype(np.float32)
+        cfg = TensorStatsConfig(sample_cap=0)
+        scalars, hist = jax.jit(lambda a: summarize_leaf(a, cfg))(x)
+        got = dict(zip(SCALAR_FIELDS, np.asarray(scalars)))
+        assert got["mean_abs"] == pytest.approx(np.abs(x).mean(),
+                                                rel=1e-5)
+        assert np.asarray(hist).sum() == np.count_nonzero(x)
+
+    def test_build_record_shape(self):
+        cfg = TensorStatsConfig(hist_bins=4)
+        stats = {"grads": (np.arange(12, dtype=np.float32).reshape(2, 6),
+                           np.ones((2, 4), np.int32)),
+                 "params": (np.ones((2, 6), np.float32),
+                            np.zeros((2, 4), np.int32)),
+                 "updates": (np.full((2, 6), 2.0, np.float32),
+                             np.zeros((2, 4), np.int32))}
+        rec = build_record(("a", "b"), stats, 40, 2, cfg)
+        assert rec["type"] == "tensorstats" and rec["iter"] == 40
+        ent = rec["layers"]["a"]
+        for fam, pfx in FAMILY_PREFIX.items():
+            assert f"{pfx}_l2" in ent and len(ent[f"{pfx}_hist"]) == 4
+        assert ent["update_ratio"] == pytest.approx(2.0, rel=1e-6)
+        assert isinstance(ent["grad_nonfinite"], int)
+        json.dumps(rec)                              # JSONL-serializable
+
+
+# ---------------------------------------------------------------------------
+# fit integration
+
+class TestFitIntegration:
+    def test_fused_tier_publishes_at_cadence(self):
+        col = Collector()
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=4), fused_steps=4)
+        sd.fit(_it(), epochs=2, listeners=[col])     # 8 steps/epoch
+        assert [r["iter"] for r in col.records] == [0, 4, 8, 12]
+        rec = col.records[-1]
+        assert set(rec["layers"]) == {"w0", "b0", "w1"}
+        ent = rec["layers"]["w0"]
+        assert ent["grad_l2"] > 0 and ent["update_ratio"] > 0
+        assert ent["grad_nonfinite"] == 0
+        assert sum(ent["grad_hist"]) == 8 * 16       # every finite nonzero
+
+    def test_per_step_tier_publishes(self):
+        col = Collector()
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=4), fused_steps=1)
+        sd.fit(_it(), epochs=1, listeners=[col])
+        assert [r["iter"] for r in col.records] == [0, 4]
+        assert col.records[0]["layers"]["w1"]["param_l2"] > 0
+
+    def test_listener_free_fit_skips_stats(self):
+        # no listener rail -> the stats-free window dispatches; nothing
+        # breaks, nothing is published
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=2), fused_steps=4)
+        h = sd.fit(_it(), epochs=1)
+        assert np.isfinite(h.final_loss())
+
+    def test_ragged_tail_windows_carry_stats(self):
+        # 10 steps with K=4 -> windows of 4, 4, 2; the carry keeps the
+        # LAST sampled step per window (one record per window at
+        # every_n=1), and the pow2 tail window carries stats too
+        col = Collector(frequency=1)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=1), fused_steps=4)
+        sd.fit(_it(batch=8, n=80), epochs=1, listeners=[col])
+        assert [r["iter"] for r in col.records] == [3, 7, 9]
+
+    def test_accum_samples_on_apply_boundaries(self):
+        # accum_steps=2, every_n=1: samples land where (it+1) % 2 == 0,
+        # so the updates family always describes a real apply
+        col = Collector(frequency=4)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=1), fused_steps=4,
+                  accum_steps=2)
+        sd.fit(_it(), epochs=1, listeners=[col])
+        iters = [r["iter"] for r in col.records]
+        # one record per window (last sample in the carry); every
+        # sampled iteration is an apply boundary
+        assert iters == [3, 7]
+        assert all((it + 1) % 2 == 0 for it in iters)
+        for r in col.records:
+            assert r["layers"]["w0"]["update_l2"] > 0
+
+    def test_bit_identical_with_sentinel_sharing_carry(self):
+        """Satellite: tensorstats + sentinel share the scan carry; a
+        clean fused run with BOTH on is bit-identical (params + losses)
+        to both off."""
+        on, off = Collector(), Collector()
+        a = _mlp(tensorstats=TensorStatsConfig(every_n=2), fused_steps=4,
+                 sentinel=True)
+        a.fit(_it(), epochs=2, listeners=[on])
+        b = _mlp(tensorstats=None, fused_steps=4, sentinel=False)
+        b.fit(_it(), epochs=2, listeners=[off])
+        assert on.losses == off.losses
+        assert len(on.records) > 0 and len(off.records) == 0
+        for n in a.trainable_params():
+            np.testing.assert_array_equal(
+                np.asarray(a.get_arr_for_var(n)),
+                np.asarray(b.get_arr_for_var(n)), err_msg=n)
+
+    def test_sharded_matches_unsharded_norms(self):
+        """Satellite: tensorstats under a ShardingSpec mesh reports the
+        same per-layer norms as the unsharded run."""
+        cfg = TensorStatsConfig(every_n=2)
+        sh, un = Collector(), Collector()
+        a = _mlp(tensorstats=cfg, fused_steps=4,
+                 sharding=ShardingSpec(axes={"data": -1}))
+        a.fit(_it(batch=16), epochs=1, listeners=[sh])
+        b = _mlp(tensorstats=cfg, fused_steps=4)
+        b.fit(_it(batch=16), epochs=1, listeners=[un])
+        assert [r["iter"] for r in sh.records] == \
+            [r["iter"] for r in un.records]
+        for ra, rb in zip(sh.records, un.records):
+            for layer in ra["layers"]:
+                for key in ("grad_l2", "update_l2", "param_l2",
+                            "update_ratio"):
+                    assert ra["layers"][layer][key] == pytest.approx(
+                        rb["layers"][layer][key], rel=1e-4, abs=1e-7), \
+                        (layer, key)
+
+    def test_precompile_covers_stats_window_signature(self):
+        """Satellite: precompile() with tensorstats configured builds
+        the stats-enabled window signature — the monitored fit then
+        reports 0 lazy window compiles."""
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=2), fused_steps=4,
+                  sentinel=True)
+        info = sd.precompile(batch_size=8)
+        assert info["compiled"] > 0
+        col = Collector()
+        sd.fit(_it(), epochs=1, listeners=[col])
+        assert sd.last_fit_stats["window_compiles"] == 0
+        assert len(col.records) > 0
+
+    def test_nan_grads_counted_nonfinite(self):
+        from deeplearning4j_tpu.faults import ChaosMonkey
+        col = Collector(frequency=1)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=1), fused_steps=4)
+        chaos = ChaosMonkey(seed=0)
+        # inject at the window's LAST step — the one whose sample the
+        # carry retains (every_n=1, K=4 -> records at iters 3, ...)
+        with chaos.nan_gradients(sd, at_step=3):
+            sd.fit(_it(batch=8, n=32), epochs=1, listeners=[col])
+        rec = next(r for r in col.records if r["iter"] == 3)
+        assert any(ent["grad_nonfinite"] > 0
+                   for ent in rec["layers"].values())
+
+
+# ---------------------------------------------------------------------------
+# the listener rail: MonitorListener persistence + LayerHealthWatcher
+
+class TestListenerRail:
+    def test_monitor_listener_persists_and_folds(self):
+        storage = StatsStorage()
+        reg = MetricsRegistry()
+        mon = MonitorListener(storage, registry=reg, frequency=4)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=4), fused_steps=4)
+        sd.fit(_it(), epochs=1, listeners=[mon])
+        recs = storage.of_type("tensorstats")
+        assert [r["iter"] for r in recs] == [0, 4]
+        text = reg.to_prometheus_text()
+        assert 'dl4j_layer_grad_l2{layer="w0"}' in text
+        assert 'dl4j_layer_update_ratio{layer="w1"}' in text
+        assert "dl4j_layer_update_ratio_dist_bucket" in text
+
+    def test_report_renders_layer_health_panel(self):
+        storage = StatsStorage()
+        mon = MonitorListener(storage, frequency=4)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=2), fused_steps=4)
+        sd.fit(_it(), epochs=2, listeners=[mon])
+        html = render_report(storage)
+        assert "Layer health (device-side tensorstats)" in html
+        assert "update:param (in-graph)" in html
+        assert "gradient L2 norm per layer" in html
+        # known type: must NOT appear in the forward-compat footer
+        assert "unrendered record types: tensorstats" not in html
+
+    def test_dead_layer_raises_after_patience(self):
+        # lr=0: every update is exactly zero -> ratio 0 -> dead after
+        # warmup + patience samples
+        watcher = LayerHealthWatcher(patience=2, warmup=1)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=1), fused_steps=4,
+                  updater=Sgd(0.0))
+        with pytest.raises(TrainingDivergedError) as ei:
+            sd.fit(_it(), epochs=2, listeners=[Collector(), watcher])
+        assert ei.value.cause == "dead_layer"
+        assert watcher.events and \
+            watcher.events[-1]["cause"] == "dead_layer"
+
+    def test_exploding_layer_raises(self):
+        storage = StatsStorage()
+        watcher = LayerHealthWatcher(explode_ratio=0.5, warmup=0,
+                                     storage=storage)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=1), fused_steps=4,
+                  updater=Sgd(500.0))
+        with pytest.raises(TrainingDivergedError) as ei:
+            sd.fit(_it(), epochs=1, listeners=[Collector(), watcher])
+        assert ei.value.cause == "exploding_layer"
+        evs = [r for r in storage.of_type("faults")
+               if r.get("event") == "layer_health"]
+        assert evs and evs[0]["cause"] == "exploding_layer"
+
+    def test_watcher_reset_forgets_streaks(self):
+        watcher = LayerHealthWatcher(patience=3, warmup=0)
+        # params row: l2=1, clean counts (slots 4/5 = nonfinite/zeros
+        # must be 0 or the poisoned-layer backstop fires first)
+        prow = np.array([[1, 1, -1, 1, 0, 0]], np.float32)
+        rec = build_record(
+            ("w",), {"updates": (np.zeros((1, 6), np.float32),
+                                 np.zeros((1, 4), np.int32)),
+                     "params": (prow, np.zeros((1, 4), np.int32))},
+            0, 0, TensorStatsConfig(hist_bins=4))
+        watcher.tensorstats_done(None, 0, [rec, rec])    # streak = 2
+        watcher.reset()
+        watcher.tensorstats_done(None, 0, [rec, rec])    # fresh streak
+        with pytest.raises(TrainingDivergedError):
+            watcher.tensorstats_done(None, 0, [rec])
+
+    def test_healthy_run_passes_watcher(self):
+        watcher = LayerHealthWatcher(warmup=0)
+        sd = _mlp(tensorstats=TensorStatsConfig(every_n=2), fused_steps=4)
+        h = sd.fit(_it(), epochs=2, listeners=[Collector(), watcher])
+        assert np.isfinite(h.final_loss())
+        assert watcher.events == []
+
+
+class TestReviewRegressions:
+    def test_false_disables_like_sentinel(self):
+        assert normalize(False) is None
+        sd = _mlp(tensorstats=False)
+        assert sd.training_config.tensorstats is None
+        tc = TrainingConfig.from_json(
+            {**sd.training_config.to_json(), "tensorstats": False})
+        assert tc.tensorstats is None
+
+    def test_report_panel_bounded_on_long_runs(self):
+        # /report renders live per request: 5000 records must
+        # downsample to a bounded column count, newest record kept
+        storage = StatsStorage()
+        cfg = TensorStatsConfig(hist_bins=4)
+        base = {"updates": (np.ones((1, 6), np.float32) * 0.1,
+                            np.zeros((1, 4), np.int32)),
+                "params": (np.ones((1, 6), np.float32),
+                           np.zeros((1, 4), np.int32)),
+                "grads": (np.ones((1, 6), np.float32),
+                          np.ones((1, 4), np.int32))}
+        for i in range(5000):
+            storage.put(build_record(("w",), base, i, 0, cfg))
+        # the newest record (the one the health table reads) carries a
+        # distinguishing grad L2 so its survival is observable
+        marked = {**base, "grads": (np.full((1, 6), 7.125, np.float32),
+                                    np.ones((1, 4), np.int32))}
+        storage.put(build_record(("w",), marked, 5000, 0, cfg))
+        html = render_report(storage)
+        assert html.count('title>w[') <= 200      # heatmap cells bounded
+        assert "5001 in-graph samples (" in html  # true total reported
+        assert "7.125" in html                    # newest record survives
+
+    def test_poisoned_layer_flagged_and_record_json_strict(self):
+        """Review round-3 regressions: (a) a poisoned layer (NaN
+        norms -> ratio None) must be FLAGGED by LayerHealthWatcher,
+        not sail past the threshold comparisons; (b) the record
+        serializes as strict RFC JSON (no NaN/Infinity tokens) — the
+        non-finite floats become None, with the *_nonfinite counts
+        carrying the signal."""
+        cfg = TensorStatsConfig(hist_bins=4)
+        # moments poisoned, counts finite (as in-graph: the count slots
+        # are sums of bools and stay finite even for poisoned tensors)
+        nanrow = np.full((1, 6), np.nan, np.float32)
+        nanrow[0, 4] = 3.0                       # nonfinite count
+        nanrow[0, 5] = 0.0                       # zeros count
+        prow = np.array([[1, 1, -1, 1, 0, 0]], np.float32)
+        stats = {"updates": (nanrow, np.zeros((1, 4), np.int32)),
+                 "params": (prow, np.zeros((1, 4), np.int32)),
+                 "grads": (nanrow, np.zeros((1, 4), np.int32))}
+        rec = build_record(("w",), stats, 12, 0, cfg)
+        ent = rec["layers"]["w"]
+        assert ent["grad_l2"] is None and ent["update_ratio"] is None
+        assert ent["grad_nonfinite"] == 3
+        json.loads(json.dumps(rec, allow_nan=False))     # strict JSON
+        # registry fold and report render tolerate the Nones
+        reg = MetricsRegistry()
+        reg.fold_tensorstats(rec)
+        assert reg.get("layer_grad_l2", layer="w") is None
+        assert reg.get("layer_param_l2", layer="w") == 1.0
+        st = StatsStorage()
+        st.put(rec)
+        assert "Layer health" in render_report(st)
+        # the watcher flags it immediately, warmup notwithstanding
+        watcher = LayerHealthWatcher(warmup=100, storage=st)
+        with pytest.raises(TrainingDivergedError) as ei:
+            watcher.tensorstats_done(None, 0, [rec])
+        assert ei.value.cause == "poisoned_layer"
+        ev = [r for r in st.of_type("faults")
+              if r.get("event") == "layer_health"][0]
+        assert ev["ratio"] is None
+        json.loads(json.dumps(ev, allow_nan=False))

@@ -156,3 +156,53 @@ class TestZooModelReport:
         assert "throughput" in html
         perf = st.of_type("perf")
         assert perf and perf[-1]["batches_per_sec"] > 0
+
+
+class TestEpochStatsSingleTransfer:
+    """Satellite (ISSUE 8): StatsListener.on_epoch_end computes its
+    histograms/moments in float32 with ONE device→host copy per param
+    — no float64 upcast doubling the epoch-boundary stall and peak
+    host memory. The record schema is unchanged."""
+
+    class _FakeSD:
+        def __init__(self, params):
+            self._params = params
+
+        def trainable_params(self):
+            return self._params
+
+    def test_no_float64_upcast(self, monkeypatch):
+        import jax.numpy as jnp
+
+        seen_dtypes = []
+        orig_hist = np.histogram
+
+        def spy_hist(a, *args, **kw):
+            seen_dtypes.append(np.asarray(a).dtype)
+            return orig_hist(a, *args, **kw)
+
+        monkeypatch.setattr(np, "histogram", spy_hist)
+        st = StatsStorage()
+        lst = StatsListener(st)
+        sd = self._FakeSD({"w": jnp.arange(12, dtype=jnp.float32)})
+        lst.on_epoch_end(sd, 0, 0.5)
+        lst.on_epoch_end(sd, 1, 0.4)
+        assert seen_dtypes and all(d == np.float32 for d in seen_dtypes)
+        rec = st.of_type("params")[-1]["params"]["w"]
+        # schema unchanged: plain floats + histogram + update stats
+        assert isinstance(rec["mean"], float) and isinstance(
+            rec["norm"], float)
+        assert rec["update_norm"] == 0.0
+        json.dumps(rec)
+
+    def test_bfloat16_params_histogram(self):
+        import jax.numpy as jnp
+
+        st = StatsStorage()
+        lst = StatsListener(st)
+        sd = self._FakeSD(
+            {"w": jnp.linspace(-1, 1, 64).astype(jnp.bfloat16)})
+        lst.on_epoch_end(sd, 0, 0.1)
+        ent = st.of_type("params")[-1]["params"]["w"]
+        assert sum(ent["hist"]) == 64
+        assert np.isfinite(ent["mean"])
