@@ -287,6 +287,62 @@ def bench_tracer_overhead(batch=128, fused_steps=8, repeats=2):
             "batch": batch, "fused_steps": fused_steps}
 
 
+def bench_serving_resilience_overhead(n_requests=768, concurrency=8,
+                                      repeats=2):
+    """Cost of the serving resilience rail (serving/resilience.py,
+    docs/serving.md "Resilience"): closed-loop throughput through the
+    BATCHED path with admission control + circuit breaker + supervised
+    workers on vs off. The healthy-path additions are one breaker
+    acquire per batch, one rolling-percentile insert per exec, one
+    admission estimate per submit, and the per-request finite-output
+    scan — the acceptance bar is ≤3% req/s. Same best-of-``repeats``
+    interleaved estimator as sentinel_overhead (run-to-run jitter
+    exceeds the effect size)."""
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import (InferenceMode, LoadGenerator,
+                                            ParallelInference)
+
+    n_in = 64
+
+    def build_server(flag):
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_out=256, activation="tanh"))
+                .layer(OutputLayer(n_out=10, loss_function="MCXENT"))
+                .set_input_type(InputType.feed_forward(n_in))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        return ParallelInference(net, mode=InferenceMode.BATCHED,
+                                 workers=2, max_batch_size=32,
+                                 max_delay_ms=1.0, max_queue_len=1024,
+                                 resilience=flag)
+
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            pi = build_server(flag)
+            try:
+                lg = LoadGenerator(
+                    pi, lambda rng, i: rng.normal(size=(2, n_in))
+                    .astype(np.float32), seed=3)
+                lg.run_closed(n_requests=max(64, n_requests // 4),
+                              concurrency=concurrency)   # warmup/compile
+                res = lg.run_closed(n_requests=n_requests,
+                                    concurrency=concurrency)
+            finally:
+                pi.shutdown()
+            best[flag] = max(best[flag], res.throughput_rps)
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    return {"throughput_rps": round(best[True], 1),
+            "throughput_rps_resilience_off": round(best[False], 1),
+            "resilience_overhead_pct": round(overhead, 2),
+            "n_requests": n_requests, "concurrency": concurrency}
+
+
 def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
     """BASELINE config 3: zoo ResNet-50 training step, ImageNet shapes,
     bf16 mixed precision (f32 master params) at MXU-saturating batch."""
@@ -578,6 +634,11 @@ def main():
                      # breakdown (where fused listener-path wall time
                      # goes), emitted into BENCH_r*.json going forward
                      ("tracer_overhead", bench_tracer_overhead),
+                     # the serving resilience rail's cost (admission +
+                     # breaker + supervision on the batched path, ≤3%
+                     # bar) for BENCH_r08
+                     ("serving_resilience_overhead",
+                      bench_serving_resilience_overhead),
                      # cold-start: fresh-process first-compile vs
                      # warm-cache restart per model (compilecache/)
                      ("cold_start", bench_cold_start),

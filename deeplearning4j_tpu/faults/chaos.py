@@ -22,6 +22,14 @@ generator. Faults on offer (the ones the recovery rail must survive):
   writer leaves.
 - ``sigterm_listener(at_iteration)`` — delivers SIGTERM to this process
   at a training iteration, mid-window (drives PreemptionHook drills).
+- ``failing_exec(server, n, every)`` — serving-side: every ``every``-th
+  ``ParallelInference`` exec raises a transient device error, ``n``
+  times total (counter-deterministic; bisection retries count too) —
+  drives the serving self-heal / circuit-breaker e2e tests.
+- ``poison_request(template)`` — a NaN-rows request payload shaped like
+  ``template``: the poisoned-batch-isolation e2e's fault of choice
+  (XLA does not raise on NaN; the resilient dispatcher must detect the
+  non-finite output rows and quarantine exactly this request).
 - ``host_loss(trainer, surviving_strategy, at_iteration)`` — elastic
   topology drill: the trainer's mesh shrinks mid-fit and a retryable
   ``host_loss`` fault fires; FaultTolerantFit resumes RESHARDED on the
@@ -411,6 +419,49 @@ class ChaosMonkey:
             yield
         finally:
             os.fsync = orig
+
+    # -- serving faults -------------------------------------------------
+    @contextlib.contextmanager
+    def failing_exec(self, server, n: int = 1, every: int = 1,
+                     exc_factory=None) -> Iterator[dict]:
+        """Deterministic transient exec failures on a
+        ``serving.ParallelInference``: every ``every``-th ``_execute``
+        call raises (default :class:`TransientDeviceError`, cause
+        ``"exec"``), ``n`` times total. The counter covers EVERY exec —
+        including the bisection/probe retries the resilience rail
+        issues — so a test can reason exactly about which dispatch
+        fails. Yields the mutable ``{"calls", "left"}`` state."""
+        state = {"calls": 0, "left": int(n)}
+        factory = exc_factory or (lambda i: TransientDeviceError(
+            f"chaos: injected exec failure (call {i})", cause="exec"))
+        orig = server._execute
+
+        def chaotic_execute(features, real_rows=None):
+            state["calls"] += 1
+            if state["left"] > 0 and state["calls"] % int(every) == 0:
+                state["left"] -= 1
+                self.log.append({"event": "exec_failed",
+                                 "call": state["calls"], "t": time.time()})
+                raise factory(state["calls"])
+            return orig(features, real_rows=real_rows)
+
+        server._execute = chaotic_execute
+        try:
+            yield state
+        finally:
+            server._execute = orig
+
+    def poison_request(self, template) -> np.ndarray:
+        """A request payload shaped like ``template`` with every
+        floating value replaced by NaN — the poisoned request the
+        bisecting dispatcher must quarantine while its co-batched
+        neighbours still serve bit-identically."""
+        a = np.array(template, copy=True)
+        if np.issubdtype(a.dtype, np.floating):
+            a[...] = np.nan
+        self.log.append({"event": "request_poisoned",
+                         "shape": list(a.shape), "t": time.time()})
+        return a
 
     # -- process faults -------------------------------------------------
     def sigterm_listener(self, at_iteration: int) -> SigtermListener:

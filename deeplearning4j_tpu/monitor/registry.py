@@ -292,6 +292,25 @@ class MetricsRegistry:
                            batch.get("mean_size", 0.0))
             self.set_gauge("serving_batch_padding_waste_ratio",
                            batch.get("padding_waste", 0.0))
+        # resilience rail (serving/resilience.py): breaker state as an
+        # enum gauge (0 closed / 1 half-open / 2 open — the /healthz
+        # 503 signal on a dashboard) + last hot-reload provenance; the
+        # shed/requeue/restart/quarantine/reload counters already
+        # export through the generic serving_<counter>_total loop above
+        res = rec.get("resilience") or {}
+        state = res.get("breaker_state")
+        if state is not None:
+            self.set_gauge(
+                "serving_breaker_state",
+                {"closed": 0, "half_open": 1, "open": 2}.get(state, -1),
+                help="circuit breaker: 0 closed, 1 half-open, 2 open")
+        if res.get("last_reload_step") is not None:
+            self.set_gauge("serving_last_reload_step",
+                           res["last_reload_step"],
+                           help="checkpoint step of the last hot reload")
+            self.set_gauge("serving_last_reload_failed",
+                           1 if res.get("last_reload_failed") else 0,
+                           help="1 when the last hot reload rolled back")
 
     def fold_dispatch(self, stats: Optional[dict],
                       epoch: Optional[int] = None) -> None:

@@ -73,7 +73,7 @@ _FATAL_EVENTS = frozenset({"retry_exhausted"})
 #: last-step-age fallback when no heartbeat provider is registered
 #: ("score"/"perf" use perf_counter timestamps and must NOT mix in)
 _WALL_T_TYPES = ("steptime", "tensorstats", "metrics", "checkpoint",
-                 "faults")
+                 "faults", "serving")
 
 
 def health_snapshot(storage=None, providers: Dict[str, Callable] = None,

@@ -13,8 +13,10 @@ This module computes those summaries *inside* the jitted train step:
 
 - **stat families** (``TensorStatsConfig.families``): ``grads`` (the raw
   per-step gradients, pre-clip — the diagnostic signal), ``updates``
-  (the applied parameter delta, post-clip/post-updater) and ``params``
-  (the post-update parameters);
+  (the post-clip/post-updater update tensor ``u`` the step SUBTRACTS,
+  ``new_params = params - u`` — the DL4J StatsListener convention, so
+  its sign follows the gradient, not the parameter movement; the
+  applied delta is ``-u``) and ``params`` (the post-update parameters);
 - **per-layer summary vector**: L2 norm, mean |x|, min, max, nonfinite
   count, zero count (``SCALAR_FIELDS`` order) — every leaf reduces to
   the same fixed-size vector regardless of its shape, so the per-family

@@ -16,6 +16,13 @@ layer (the L7 serving tier of the reference ecosystem's map, PAPER.md
   (:class:`RequestTimeoutError`), graceful drain on shutdown.
 - ``metrics``: counters + latency histograms exported through
   ``ui.stats.StatsStorage`` records (``{"type": "serving", ...}``).
+- ``resilience``: the serving resilience rail — SLO admission control
+  (shed doomed requests at ``submit()`` with
+  ``ServerOverloadedError(retry_after_s=...)``), a circuit breaker on
+  consecutive exec failures surfaced through /healthz, supervised
+  workers with exactly-once crash requeue, bisecting poisoned-batch
+  isolation (``PoisonedRequestError``), and checkpoint-driven hot
+  reload (``ParallelInference.reload_from`` with canary + rollback).
 - ``loadgen``: closed/open-loop load generator for tests and examples.
 
 See docs/serving.md for the full knob reference.
@@ -29,7 +36,10 @@ from deeplearning4j_tpu.serving.metrics import (
     LatencyHistogram, ServingMetrics)
 from deeplearning4j_tpu.serving.queue import (
     InferenceRequest, RequestQueue, RequestTimeoutError, ServerClosedError,
-    ServerOverloadedError, ServingError)
+    ServerOverloadedError, ServingError, ServingTimeoutError)
+from deeplearning4j_tpu.serving.resilience import (
+    AdmissionController, CircuitBreaker, PoisonedRequestError,
+    ReloadFailedError, ResilienceConfig, WorkerSupervisor)
 
 __all__ = [
     "ParallelInference", "InferenceMode", "ServingSpec",
@@ -37,7 +47,9 @@ __all__ = [
     "pad_to_bucket",
     "RequestQueue", "InferenceRequest",
     "ServingError", "ServerOverloadedError", "RequestTimeoutError",
-    "ServerClosedError",
+    "ServerClosedError", "ServingTimeoutError",
     "ServingMetrics", "LatencyHistogram",
+    "ResilienceConfig", "AdmissionController", "CircuitBreaker",
+    "WorkerSupervisor", "PoisonedRequestError", "ReloadFailedError",
     "LoadGenerator", "LoadResult",
 ]

@@ -74,6 +74,25 @@ class BucketSpec:
         return f"BucketSpec{self.buckets}"
 
 
+def scatter_rows(requests: Sequence[InferenceRequest],
+                 outputs: Sequence[np.ndarray]) -> List[InferenceRequest]:
+    """Scatter per-output row slices back to each request's future —
+    THE one implementation of the reply contract (used by Batch.resolve
+    and the resilient bisecting dispatcher). Each request's deadline is
+    re-checked by ``complete()``; the returned list holds the requests
+    whose deadline passed during exec (their futures got
+    ServingTimeoutError, not the stale result — the caller records the
+    timeouts)."""
+    off = 0
+    expired: List[InferenceRequest] = []
+    for req in requests:
+        if not req.complete([np.asarray(o[off:off + req.rows])
+                             for o in outputs]):
+            expired.append(req)
+        off += req.rows
+    return expired
+
+
 @dataclass
 class Batch:
     """One coalesced dispatch: padded features + the requests inside it."""
@@ -88,13 +107,9 @@ class Batch:
     def padding(self) -> int:
         return self.bucket - self.rows
 
-    def resolve(self, outputs: List[np.ndarray]) -> None:
-        """Scatter per-output row slices back to each request's future."""
-        off = 0
-        for req in self.requests:
-            req.complete([np.asarray(o[off:off + req.rows])
-                          for o in outputs])
-            off += req.rows
+    def resolve(self, outputs: List[np.ndarray]) -> List[InferenceRequest]:
+        """Scatter row slices to futures (see :func:`scatter_rows`)."""
+        return scatter_rows(self.requests, outputs)
 
     def fail(self, exc: BaseException) -> None:
         for req in self.requests:

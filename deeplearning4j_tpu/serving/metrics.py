@@ -74,7 +74,14 @@ class LatencyHistogram:
 
 _COUNTERS = ("requests_submitted", "requests_served", "requests_rejected",
              "requests_timed_out", "requests_failed", "batches_dispatched",
-             "rows_served", "rows_padded", "compiles", "warmup_compiles")
+             "rows_served", "rows_padded", "compiles", "warmup_compiles",
+             # resilience rail (serving/resilience.py): SLO sheds at
+             # admission, breaker trips, crash-recovery requeues/worker
+             # restarts, transient exec faults absorbed, bisection
+             # splits + quarantined poisoned requests, hot reloads
+             "requests_shed", "breaker_opens", "requests_requeued",
+             "worker_restarts", "exec_faults", "bisect_splits",
+             "poisoned_quarantined", "reloads", "reload_rollbacks")
 
 
 class ServingMetrics:
@@ -93,6 +100,9 @@ class ServingMetrics:
         self.failure_causes: Dict[str, int] = {}
         self.timeout_causes: Dict[str, int] = {}
         self.last_error: Optional[dict] = None
+        # resilience state snapshot (breaker state, last reload step,
+        # ...) — merged by the serving rail, exported in to_record()
+        self.resilience: Dict[str, object] = {}
         self._start_t = time.time()
 
     # -- recording ------------------------------------------------------
@@ -122,6 +132,12 @@ class ServingMetrics:
             self.last_error = {"kind": "timeout", "cause": cause,
                               "error": repr(error) if error else None,
                               "t": time.time()}
+
+    def set_resilience(self, **fields) -> None:
+        """Merge resilience-state fields (``breaker_state``,
+        ``last_reload_step``, ...) into the exported snapshot."""
+        with self._lock:
+            self.resilience.update(fields)
 
     def observe_batch(self, rows: int, padding: int, exec_ms: float) -> None:
         with self._lock:
@@ -162,6 +178,8 @@ class ServingMetrics:
                 "timeout_causes": dict(self.timeout_causes),
                 "last_error": dict(self.last_error)
                 if self.last_error else None,
+                "resilience": dict(self.resilience)
+                if self.resilience else None,
                 "latency_ms": {"queue_wait": self.queue_wait_ms.summary(),
                                "e2e": self.e2e_ms.summary(),
                                "exec": self.exec_ms.summary()},
@@ -213,4 +231,15 @@ class ServingMetrics:
         if rec["last_error"]:
             le = rec["last_error"]
             lines.append(f"  last_error: [{le['cause']}] {le['error']}")
+        res = rec.get("resilience")
+        resil_counts = {k: c[k] for k in
+                        ("requests_shed", "breaker_opens",
+                         "worker_restarts", "requests_requeued",
+                         "poisoned_quarantined", "reloads",
+                         "reload_rollbacks") if c.get(k)}
+        if res or resil_counts:
+            bits = [f"{k}={v}" for k, v in sorted(resil_counts.items())]
+            if res and res.get("breaker_state"):
+                bits.insert(0, f"breaker={res['breaker_state']}")
+            lines.append("  resilience: " + ", ".join(bits))
         return "\n".join(lines)

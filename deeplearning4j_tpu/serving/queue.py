@@ -32,11 +32,27 @@ class ServingError(RuntimeError):
 
 
 class ServerOverloadedError(ServingError):
-    """Admission rejected: the request queue is at ``max_queue_len``."""
+    """Admission rejected: the queue is at ``max_queue_len``, the SLO
+    admission controller estimates the request cannot meet its deadline,
+    or the circuit breaker is open (serving/resilience.py).
+
+    ``retry_after_s`` — when set — is the structured backoff hint: how
+    long the shedding condition is expected to persist (estimated queue
+    drain, or the breaker's time-to-probe)."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimeoutError(ServingError):
     """The request's deadline passed before it was dispatched."""
+
+
+class ServingTimeoutError(RequestTimeoutError):
+    """The request's deadline passed DURING execution: the result
+    arrived, but past the SLO — surfaced as a timeout instead of a
+    stale success (the reply-time deadline re-check)."""
 
 
 class ServerClosedError(ServingError):
@@ -68,6 +84,7 @@ class InferenceRequest:
     deadline: Optional[float] = None    # absolute time.monotonic(), or None
     squeeze: bool = False               # single-example submit: drop row dim
     id: int = 0
+    requeues: int = 0                   # crash-recovery requeues (max 1)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -83,10 +100,22 @@ class InferenceRequest:
         if not self.future.done():
             self.future.set_exception(exc)
 
-    def complete(self, outputs) -> None:
-        """Resolve with this request's row slices (see collapse_outputs)."""
+    def complete(self, outputs) -> bool:
+        """Resolve with this request's row slices (see collapse_outputs)
+        — unless the deadline passed while the batch executed: a request
+        that expires DURING exec must not complete as a stale success,
+        so its future gets :class:`ServingTimeoutError` instead and
+        this returns False (the caller records the timeout)."""
+        if self.expired():
+            if not self.future.done():
+                self.future.set_exception(ServingTimeoutError(
+                    f"request {self.id} missed its deadline by "
+                    f"{(_now() - self.deadline) * 1000:.1f} ms during "
+                    f"execution"))
+            return False
         if not self.future.done():
             self.future.set_result(collapse_outputs(outputs, self.squeeze))
+        return True
 
 
 class RequestQueue:
@@ -104,6 +133,7 @@ class RequestQueue:
             raise ValueError("max_queue_len must be positive")
         self.max_queue_len = int(max_queue_len)
         self._dq: deque = deque()
+        self._rows = 0                  # queued rows (admission estimates)
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
@@ -121,6 +151,23 @@ class RequestQueue:
                     f"queue full ({self.max_queue_len} pending); retry "
                     f"with backoff")
             self._dq.append(req)
+            self._rows += req.rows
+            self._not_empty.notify()
+
+    def requeue(self, req: InferenceRequest) -> None:
+        """Put an already-admitted request back at the FRONT of the
+        queue (crash recovery: it already waited its turn). Bypasses
+        the capacity check — the request was admitted once and its
+        future is outstanding; a bounds rejection here would drop it.
+        Allowed while a drain is in progress (queued work is still
+        being served); raises :class:`ServerClosedError` only after a
+        non-drain close."""
+        with self._lock:
+            if self._closed and not self._drain:
+                raise ServerClosedError(
+                    "request queue is closed without drain")
+            self._dq.appendleft(req)
+            self._rows += req.rows
             self._not_empty.notify()
 
     # -- consumer side --------------------------------------------------
@@ -177,12 +224,14 @@ class RequestQueue:
             head = self._dq[0]
             if head.expired(now):
                 self._dq.popleft()
+                self._rows -= head.rows
                 self._timed_out += 1
                 expired.append(head)     # completed by take(), post-lock
                 continue
             if (out or strict) and rows + head.rows > max_rows:
                 break
             self._dq.popleft()
+            self._rows -= head.rows
             out.append(head)
             rows += head.rows
             if rows >= max_rows:
@@ -202,6 +251,7 @@ class RequestQueue:
             if not drain:
                 aborted = list(self._dq)
                 self._dq.clear()
+                self._rows = 0
             self._not_empty.notify_all()
         for req in aborted:
             req.fail(ServerClosedError(
@@ -220,6 +270,12 @@ class RequestQueue:
     def pending(self) -> int:
         with self._lock:
             return len(self._dq)
+
+    def pending_rows(self) -> int:
+        """Total rows queued — the admission controller's backlog unit
+        (dispatches drain up to ``max_batch_size`` rows at a time)."""
+        with self._lock:
+            return self._rows
 
     def timed_out_count(self) -> int:
         return self._timed_out

@@ -242,6 +242,9 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     metrics = storage.of_type("metrics")
     compiles = storage.of_type("compile")
     reshards = storage.of_type("reshard")
+    serving = storage.of_type("serving")
+    serving_faults = [r for r in storage.of_type("faults")
+                      if r.get("origin") == "serving"]
 
     parts = [f"""<!doctype html><html><head><meta charset="utf-8">
 <title>{_html.escape(title)}</title>
@@ -453,6 +456,69 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
         parts.append("</table><p>save-on-N / restore-on-M elastic "
                      "restores (checkpoint/reshard.py, "
                      "docs/elastic_training.md)</p>")
+
+    # -- serving: traffic + the resilience rail --------------------------
+    if serving:
+        s = serving[-1]
+        c = s.get("counters", {})
+        parts.append(
+            f"<h2>Serving</h2><p>{c.get('requests_served', 0)} served / "
+            f"{c.get('requests_submitted', 0)} submitted — "
+            f"{c.get('requests_rejected', 0)} rejected (queue full), "
+            f"{c.get('requests_shed', 0)} shed (SLO admission/breaker), "
+            f"{c.get('requests_timed_out', 0)} timed out, "
+            f"{c.get('requests_failed', 0)} failed; "
+            f"{c.get('batches_dispatched', 0)} batches, "
+            f"{c.get('compiles', 0)} compiled shapes "
+            f"({c.get('warmup_compiles', 0)} prewarmed)</p>")
+        lat = s.get("latency_ms", {})
+        if lat:
+            parts.append("<table><tr><th>lane</th><th>count</th>"
+                         "<th>mean</th><th>p50</th><th>p95</th>"
+                         "<th>p99</th><th>max (ms)</th></tr>")
+            for lane in ("queue_wait", "e2e", "exec"):
+                v = lat.get(lane, {})
+                parts.append(
+                    f"<tr><td>{lane}</td><td>{v.get('count', 0)}</td>"
+                    + "".join(f"<td>{v.get(k, 0.0):.3f}</td>"
+                              for k in ("mean", "p50", "p95", "p99",
+                                        "max"))
+                    + "</tr>")
+            parts.append("</table>")
+        res = s.get("resilience") or {}
+        resil_bits = [f"{k.replace('_', ' ')} {c[k]}" for k in
+                      ("requests_shed", "breaker_opens", "worker_restarts",
+                       "requests_requeued", "poisoned_quarantined",
+                       "bisect_splits", "exec_faults", "reloads",
+                       "reload_rollbacks") if c.get(k)]
+        if res or resil_bits:
+            lead = (f"breaker <b>{res.get('breaker_state', '?')}</b>"
+                    if res.get("breaker_state") else "")
+            reload_note = ""
+            if res.get("last_reload_step") is not None:
+                reload_note = (
+                    f"; last hot reload: step {res['last_reload_step']}"
+                    + (" (rolled back)" if res.get("last_reload_failed")
+                       else ""))
+            parts.append(
+                "<p>resilience: " + "; ".join(
+                    b for b in ([lead] if lead else []) + resil_bits)
+                + reload_note + " (docs/serving.md \"Resilience\")</p>")
+    if serving_faults:
+        parts.append(
+            f"<h3>Serving fault-rail events ({len(serving_faults)})"
+            f"</h3><table><tr><th>event</th><th>cause</th>"
+            f"<th>detail</th></tr>")
+        for r in serving_faults[-20:]:
+            detail = {k: v for k, v in r.items()
+                      if k not in ("type", "event", "cause", "t",
+                                   "origin") and v is not None}
+            parts.append(
+                f"<tr><td>{_html.escape(str(r.get('event', '?')))}</td>"
+                f"<td>{_html.escape(str(r.get('cause', '—')))}</td>"
+                f"<td>{_html.escape(str(detail) if detail else '—')}"
+                f"</td></tr>")
+        parts.append("</table>")
 
     # -- observability: unified metrics snapshot -------------------------
     if metrics:
