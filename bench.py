@@ -56,6 +56,35 @@ def _dispatch_stats(sd):
     return out
 
 
+def _memory_stats():
+    """Per-model memory trajectory for BENCH_r08+: HBM peak after the
+    run (the watermark the run needed) plus the active compiled
+    program's plan bytes/flops when one was captured
+    (monitor/memstats.py) — so BENCH tracks memory next to throughput."""
+    from deeplearning4j_tpu import memory
+    from deeplearning4j_tpu.monitor import memstats
+    out = {}
+    try:
+        snap = memory.snapshot()
+        out["hbm_peak_bytes"] = max(
+            (s.peak_bytes or s.bytes_in_use) for s in snap) if snap else 0
+        head = memstats.projected_headroom(snap)
+        if head is not None:
+            out["hbm_headroom_bytes"] = int(head)
+    except Exception:
+        pass
+    plan = memstats.PLANS.active_plan()
+    if plan is not None:
+        out["plan_program"] = plan.label
+        out["plan_total_bytes"] = int(plan.total_bytes)
+        if plan.temp_bytes is not None:
+            out["plan_temp_bytes"] = int(plan.temp_bytes)
+        if plan.flops_per_step is not None:
+            out["plan_gflops_per_step"] = round(
+                plan.flops_per_step / 1e9, 3)
+    return out
+
+
 def bench_lenet(batch=128, listener=False, fused_steps=1):
     """BASELINE config 1 — plus the ``lenet_listener`` variant: a
     ScoreIterationListener attached (forcing off the scanned tier, as
@@ -86,7 +115,8 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
     return {"samples_per_sec": round(sps, 1),
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
-            "batch": batch, **_dispatch_stats(net.samediff)}
+            "batch": batch, **_dispatch_stats(net.samediff),
+            **_memory_stats()}
 
 
 def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
@@ -127,7 +157,8 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
 
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                        fused_steps=1, sentinel=False,
-                       monitor_storage=None, tensorstats=None):
+                       monitor_storage=None, tensorstats=None,
+                       monitor_memory=True):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
     give the listener-path variant (see bench_lenet); ``sentinel`` arms
@@ -158,7 +189,8 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
         # warmup windows' dispatch spans and must not inflate the
         # published dispatch share
         from deeplearning4j_tpu.monitor import MonitorListener
-        listeners = listeners + [MonitorListener(monitor_storage)]
+        listeners = listeners + [MonitorListener(monitor_storage,
+                                                 memory=monitor_memory)]
     epochs = 6
     sps = _median_rate(lambda: sd.fit(it, epochs=epochs,
                                       listeners=listeners), epochs * n)
@@ -167,7 +199,7 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
     return {"samples_per_sec": round(sps, 1),
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
-            "batch": batch, **_dispatch_stats(sd)}
+            "batch": batch, **_dispatch_stats(sd), **_memory_stats()}
 
 
 def bench_sentinel_overhead(batch=128, fused_steps=8, repeats=2):
@@ -225,6 +257,57 @@ def bench_tensorstats_overhead(batch=128, fused_steps=8, repeats=2):
             "tensorstats_overhead_pct": round(overhead, 2),
             "every_n": cfg.every_n, "families": list(cfg.families),
             "batch": batch, "fused_steps": fused_steps}
+
+
+def bench_memory_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the HBM telemetry rail (monitor/memstats.py,
+    docs/observability.md "Memory observability"): the fused-window
+    K=8 listener path with a MonitorListener whose memory telemetry
+    (per-flush {"type": "memory"} records + plan capture + the MFU
+    gauge) is on vs off. The on-path additions are pure host work at
+    flush boundaries the host already syncs on — one PJRT counter read
+    per device (or a live-array walk on CPU), a dict of tagged totals,
+    and one registry gauge set — the acceptance bar is ≤2% steps/s.
+    Same best-of-``repeats`` interleaved estimator as
+    sentinel_overhead (run-to-run tunnel jitter exceeds the effect
+    size). Clean runs are bit-identical on vs off
+    (tests/test_memory_obs.py)."""
+    from deeplearning4j_tpu.monitor import memstats
+    from deeplearning4j_tpu.ui.stats import StatsStorage
+
+    # the capture switch is process-global (main() arms it for the
+    # whole run; MonitorListener arms it too): the off leg must really
+    # run without it, and the ENTRY state must be restored afterwards —
+    # leaving it off would strip plan capture (and misattribute stale
+    # plans) from every config that runs after this one
+    was_enabled = memstats.plan_capture_enabled()
+    best = {False: 0.0, True: 0.0}
+    try:
+        for _ in range(repeats):
+            for flag in (False, True):
+                if flag:
+                    memstats.enable_plan_capture()
+                else:
+                    memstats.disable_plan_capture()
+                r = bench_samediff_mlp(batch=batch, listener=True,
+                                       fused_steps=fused_steps,
+                                       monitor_storage=StatsStorage(),
+                                       monitor_memory=flag)
+                best[flag] = max(best[flag], r["samples_per_sec"])
+    finally:
+        if was_enabled:
+            memstats.enable_plan_capture()
+        else:
+            memstats.disable_plan_capture()
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_memory_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "memory_overhead_pct": round(overhead, 2),
+            "batch": batch, "fused_steps": fused_steps,
+            **_memory_stats()}
 
 
 def bench_tracer_overhead(batch=128, fused_steps=8, repeats=2):
@@ -370,7 +453,8 @@ def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
             "batch": batch,
-            "precision": "bf16_mixed" if mixed_precision else "f32"}
+            "precision": "bf16_mixed" if mixed_precision else "f32",
+            **_memory_stats()}
 
 
 def bench_bert_base(batch=16, seq_len=128, steps=16, mixed_precision=True):
@@ -409,7 +493,8 @@ def bench_bert_base(batch=16, seq_len=128, steps=16, mixed_precision=True):
             "step_time_ms": round(1000.0 * batch / sps, 3),
             "mfu_est": round(3 * fwd_flops * sps / V5E_PEAK_FLOPS, 5),
             "batch": batch, "seq_len": seq_len,
-            "precision": "bf16_mixed" if mixed_precision else "f32"}
+            "precision": "bf16_mixed" if mixed_precision else "f32",
+            **_memory_stats()}
 
 
 def bench_gpt_medium(batch=16, seq_len=512, steps=8, mixed_precision=True,
@@ -458,7 +543,8 @@ def bench_gpt_medium(batch=16, seq_len=512, steps=8, mixed_precision=True,
             # the CE-tail knob rides MixedPrecision; without it the tail
             # is plain f32 regardless of what was requested
             "ce_tail_dtype": (ce_tail_dtype or "float32")
-            if mixed_precision else "float32"}
+            if mixed_precision else "float32",
+            **_memory_stats()}
 
 
 # -- cold start: fresh-process first-compile vs warm-restart ------------
@@ -610,6 +696,12 @@ def main():
     if argv and argv[0] == "_cold_start_child":
         _cold_start_child_main(argv[1], argv[2])
         return
+    # capture a memory plan for every compiled train program so the
+    # per-model hbm/plan trajectory lands in BENCH_r08+ (same lowering,
+    # one compile either way — the child cold-start probes stay
+    # untouched so their numbers remain comparable across rounds)
+    from deeplearning4j_tpu.monitor import memstats
+    memstats.enable_plan_capture()
     only = set(argv) or None     # `bench.py cold_start` runs a subset
     configs = {}
     registry = (("lenet_mnist", bench_lenet),
@@ -630,6 +722,10 @@ def main():
                      # grad/update/param summaries at default cadence,
                      # ≤3% bar) for BENCH_r07
                      ("tensorstats_overhead", bench_tensorstats_overhead),
+                     # the HBM telemetry rail's cost (per-flush memory
+                     # records + plan capture + MFU gauge, ≤2% bar) +
+                     # the hbm_peak/plan-bytes trajectory for BENCH_r08+
+                     ("memory_overhead", bench_memory_overhead),
                      # the observability rail's cost + the step-time
                      # breakdown (where fused listener-path wall time
                      # goes), emitted into BENCH_r*.json going forward
@@ -660,6 +756,9 @@ def main():
     for name, fn in registry:
         if only and name not in only:
             continue
+        # per-config plan attribution: _memory_stats() reads the ACTIVE
+        # plan, which must not be a stale one from the previous config
+        memstats.PLANS.reset()
         try:
             configs[name] = fn()
         except Exception:

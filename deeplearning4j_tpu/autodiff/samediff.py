@@ -34,6 +34,7 @@ from deeplearning4j_tpu.autodiff.variable import SDVariable, VariableType
 from deeplearning4j_tpu.compilecache.aot import (AOTDispatch,
                                                  AOTOutput as _AOTOutput,
                                                  ph_shape_sig)
+from deeplearning4j_tpu.monitor import memstats
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.ndarray.dtype import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
@@ -1291,7 +1292,7 @@ class SameDiff:
         t0 = _time.perf_counter()
         built = reused = 0
 
-        def _build(disp, args, sig, label, seen=None):
+        def _build(disp, args, sig, label, seen=None, steps=1):
             nonlocal built, reused
             if sig in disp.aot:
                 reused += 1
@@ -1299,6 +1300,11 @@ class SameDiff:
             with _tracer.span("compile.precompile", cat="compile",
                               target=label):
                 disp.aot[sig] = disp.lower(*args).compile()
+            # static memory & compute plan (monitor/memstats.py): the
+            # executable exists — reading memory_analysis/cost_analysis
+            # here is free observability
+            memstats.capture_plan(label, sig, compiled=disp.aot[sig],
+                                  steps=steps, graph=self)
             if seen is not None:
                 # pre-register the trace signature so the window
                 # executor's compile accounting reports 0 for shapes
@@ -1324,7 +1330,7 @@ class SameDiff:
             disp = self.make_train_step(sentinel=sentinel, tensorstats=ts)
             _build(disp, (params_abs, svars_abs, state_abs, it_abs,
                           consts_abs, ph, key),
-                   ph_shape_sig(ph), "train_step")
+                   ph_shape_sig(ph), "train_step", steps=1)
         if "window" in tiers:
             disp = self.make_train_window(accum_steps=A, sentinel=sentinel,
                                           tensorstats=ts)
@@ -1338,7 +1344,8 @@ class SameDiff:
             sizes = {K} | {1 << i for i in range((K - 1).bit_length())}
             for k in sorted(sizes, reverse=True):
                 args, sig = _window_args(k, with_accum=A > 1)
-                _build(disp, args, sig, f"window_k{k}", seen=seen)
+                _build(disp, args, sig, f"window_k{k}", seen=seen,
+                       steps=k)
         if "epoch" in tiers:
             if not epoch_steps:
                 raise ValueError("the scanned-epoch tier needs "
@@ -1346,7 +1353,8 @@ class SameDiff:
             unroll = int(getattr(tc, "scan_unroll", 1) or 1)
             disp = self.make_train_epoch(unroll=unroll, sentinel=sentinel)
             args, sig = _window_args(int(epoch_steps), with_accum=False)
-            _build(disp, args, sig, f"epoch_{epoch_steps}")
+            _build(disp, args, sig, f"epoch_{epoch_steps}",
+                   steps=int(epoch_steps))
         delta = COMPILE_STATS.delta(mark)
         info = {"compiled": built, "reused": reused,
                 "seconds": round(_time.perf_counter() - t0, 4),
@@ -1416,6 +1424,13 @@ class SameDiff:
                 jit_fn,
                 jit_fn.lower(params_abs, consts_abs, ph_specs,
                              jax.random.key(0)).compile())
+        # per-bucket serving memory plan (monitor/memstats.py): label
+        # carries the row count so /report can show the footprint
+        # ladder across warmup buckets
+        rows = next(iter(ph_specs.values())).shape
+        rows = rows[0] if rows else 1
+        memstats.capture_plan(f"output_b{rows}", ph_shape_sig(ph_specs),
+                              compiled=compiled.compiled, graph=self)
         self._fn_cache[cache_key] = compiled
         return compiled
 
@@ -1543,6 +1558,12 @@ class SameDiff:
             ts_names = layer_names(params)
         else:
             ts_names = ()
+        # memory-plan capture (monitor/memstats.py): with capture armed
+        # a new shape's first compile goes through the AOT path so its
+        # memory plan is observable; the sig work is skipped entirely
+        # when the rail is off (the common case on this legacy tier)
+        mem_on = memstats.plan_capture_enabled() or len(memstats.PLANS)
+        mem_sigs: set = set()
         for epoch in range(epochs):
             epoch_losses = []
             epoch_oks: List[jax.Array] = []   # sentinel flags, device-side
@@ -1564,9 +1585,16 @@ class SameDiff:
                         if pending_oks else None
                     stats_burst = list(pending_stats)
                     pending_stats.clear()
-                    vals_arr, oks, stats_host = jax.device_get(
-                        (jnp.stack([lv for _, lv in pending]), oks_stack,
-                         [s for _, s in stats_burst]))
+                    try:
+                        vals_arr, oks, stats_host = jax.device_get(
+                            (jnp.stack([lv for _, lv in pending]),
+                             oks_stack, [s for _, s in stats_burst]))
+                    except Exception as e:
+                        # async dispatch: an allocation failure often
+                        # surfaces at the first sync, not the dispatch
+                        memstats.reraise_oom(e, program="train_step",
+                                             step=iters[-1], epoch=epoch)
+                        raise
                     if oks is not None:
                         from deeplearning4j_tpu.faults.sentinels import \
                             check_ok_flags
@@ -1632,8 +1660,24 @@ class SameDiff:
                         if getattr(l, "batch_size", -1) is None:
                             l.batch_size = next(iter(ph.values())).shape[0]
                     with _tracer.span("dispatch", cat="train"):
-                        res = step(params, svars, state, it_dev,
-                                   constants, ph, base_key)
+                        if mem_on:
+                            step_sig = ph_shape_sig(ph)
+                            if step_sig not in mem_sigs:
+                                mem_sigs.add(step_sig)
+                                memstats.promote_dispatch(
+                                    step, (params, svars, state, it_dev,
+                                           constants, ph, base_key),
+                                    step_sig, "train_step", steps=1,
+                                    graph=self)
+                            memstats.note_dispatch(step_sig, steps=1)
+                        try:
+                            res = step(params, svars, state, it_dev,
+                                       constants, ph, base_key)
+                        except Exception as e:
+                            memstats.reraise_oom(e, program="train_step",
+                                                 step=iteration,
+                                                 epoch=epoch)
+                            raise
                         params, svars, state, it_dev, loss_val = res[:5]
                         r = 5
                         if use_sentinel:
@@ -1751,14 +1795,29 @@ class SameDiff:
             dt = self._vars[name].dtype if name in self._vars else None
             stacked[name] = _to_jnp(arr, dt)
         n_steps = next(iter(stacked.values())).shape[0]
+        # memory-plan capture + OOM forensics for the scanned tier: one
+        # signature per fit, promoted to an AOT compile when capture is
+        # armed so /report can show the whole-epoch program's footprint
+        scan_label = f"scanned_epoch_{n_steps}"
+        scan_sig = ph_shape_sig(stacked)
+        memstats.promote_dispatch(
+            epoch_step, (params, svars, state, it_dev, constants,
+                         stacked, base_key), scan_sig, scan_label,
+            steps=n_steps, graph=self)
+        memstats.note_dispatch(scan_sig, steps=n_steps)
         history = History()
         epoch_means = []
         panic = self._nan_panic_active(tc)
         for epoch in range(epochs):
             if use_sentinel:
-                params, svars, state, it_dev, losses, bad = epoch_step(
-                    params, svars, state, it_dev, constants, stacked,
-                    base_key)
+                try:
+                    params, svars, state, it_dev, losses, bad = \
+                        epoch_step(params, svars, state, it_dev,
+                                   constants, stacked, base_key)
+                except Exception as e:
+                    memstats.reraise_oom(e, program=scan_label,
+                                         step=iteration, epoch=epoch)
+                    raise
                 bad = int(bad)     # one scalar sync per scanned epoch
                 if bad >= 0:
                     from deeplearning4j_tpu.faults.sentinels import \
@@ -1767,9 +1826,14 @@ class SameDiff:
                     # per-step and windowed tiers' provenance
                     raise_diverged(bad, epoch, iteration)
             else:
-                params, svars, state, it_dev, losses = epoch_step(
-                    params, svars, state, it_dev, constants, stacked,
-                    base_key)
+                try:
+                    params, svars, state, it_dev, losses = epoch_step(
+                        params, svars, state, it_dev, constants, stacked,
+                        base_key)
+                except Exception as e:
+                    memstats.reraise_oom(e, program=scan_label,
+                                         step=iteration, epoch=epoch)
+                    raise
             m = jnp.mean(losses)
             if panic and not np.isfinite(float(m)):
                 raise NumericsException(

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.compilecache.aot import ph_shape_sig
+from deeplearning4j_tpu.monitor import memstats
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
 
@@ -118,12 +119,22 @@ class WindowStager:
         with _tracer.span("h2d_stage", cat="train", k=len(batches)):
             names = batches[0].keys()
             stacked = {}
+            h2d_bytes = 0
             for n in names:
                 items = [b[n] for b in batches]
                 if all(isinstance(a, np.ndarray) for a in items):
                     stacked[n] = np.stack(items)
+                    h2d_bytes += stacked[n].nbytes
                 else:
                     stacked[n] = jnp.stack([jnp.asarray(a) for a in items])
+            if h2d_bytes:
+                # tagged host→HBM transfer accounting: the staging
+                # bytes surface in {"type": "memory"} records
+                # (memory.AllocationsTracker is thread-safe — this runs
+                # on the stager thread)
+                from deeplearning4j_tpu.memory import AllocationsTracker
+                AllocationsTracker.get_instance().allocate(
+                    "h2d_stage", h2d_bytes)
             return len(batches), self._finalize(stacked)
 
     def _emit_bucketed(self, buf) -> bool:
@@ -380,8 +391,15 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                     else None
                 stats_burst = list(pending_stats)
                 pending_stats.clear()
-                vals_arr, bads, stats_host = jax.device_get(
-                    (losses_cat, bads_stack, stats_burst))
+                try:
+                    vals_arr, bads, stats_host = jax.device_get(
+                        (losses_cat, bads_stack, stats_burst))
+                except Exception as e:
+                    # async dispatch: an allocation failure inside a
+                    # window often surfaces HERE, at the first sync
+                    memstats.reraise_oom(e, step=iters[-1] if iters
+                                         else None, epoch=epoch)
+                    raise
                 if bads is not None:
                     from deeplearning4j_tpu.faults.sentinels import \
                         check_bad_steps
@@ -468,7 +486,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                     # AOT dispatch uses, so shapes prebuilt by
                     # sd.precompile() count as already-seen
                     trace_sig = ph_shape_sig(win)
-                    if trace_sig not in seen_sizes:
+                    first_dispatch = trace_sig not in seen_sizes
+                    if first_dispatch:
                         seen_sizes.add(trace_sig)
                         compiles += 1
                         sd._verbose_log(f"fit: compiling window length {k}")
@@ -478,14 +497,33 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                         # p, sv, st, [accum], it, losses, [bad],
                         # [stats, at]
                         if A > 1:
-                            out = window_fn(params, svars, state, accum,
-                                            it_dev, constants, win,
-                                            base_key)
+                            args = (params, svars, state, accum, it_dev,
+                                    constants, win, base_key)
+                        else:
+                            args = (params, svars, state, it_dev,
+                                    constants, win, base_key)
+                        if first_dispatch:
+                            # with plan capture armed (MonitorListener),
+                            # a new shape compiles through the AOT path
+                            # so its memory plan is captured — same
+                            # lowering, one compile either way, outputs
+                            # bit-identical (tests/test_memory_obs.py)
+                            memstats.promote_dispatch(
+                                window_fn, args, trace_sig,
+                                f"window_k{k}", steps=k, graph=sd)
+                        try:
+                            out = window_fn(*args)
+                        except Exception as e:
+                            memstats.reraise_oom(e,
+                                                 program=f"window_k{k}",
+                                                 step=iteration,
+                                                 epoch=epoch)
+                            raise
+                        memstats.note_dispatch(trace_sig, steps=k)
+                        if A > 1:
                             params, svars, state, accum = out[:4]
                             i = 4
                         else:
-                            out = window_fn(params, svars, state, it_dev,
-                                            constants, win, base_key)
                             params, svars, state = out[:3]
                             i = 3
                         it_dev = out[i]

@@ -133,6 +133,14 @@ def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
     if sd._updater_state is not None:
         updater_leaves = [np.asarray(l) for l in
                           jax.tree_util.tree_leaves(sd._updater_state)]
+    # tagged D2H accounting: the capture's device→host copy bytes land
+    # in the AllocationsTracker (thread-safe — capture may run on the
+    # training thread while the writer drains) and surface in
+    # {"type": "memory"} records (docs/observability.md)
+    from deeplearning4j_tpu.memory import AllocationsTracker
+    d2h = sum(a.nbytes for a in arrays.values()) \
+        + sum(l.nbytes for l in (updater_leaves or []))
+    AllocationsTracker.get_instance().allocate("checkpoint_d2h", d2h)
     tc = sd.training_config
     iteration = int(getattr(tc, "iteration_count", 0)) if tc else 0
     # the base seed of the run in flight (recorded by fit); falling back

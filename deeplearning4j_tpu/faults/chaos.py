@@ -30,6 +30,13 @@ generator. Faults on offer (the ones the recovery rail must survive):
   ``template``: the poisoned-batch-isolation e2e's fault of choice
   (XLA does not raise on NaN; the resilient dispatcher must detect the
   non-finite output rows and quarantine exactly this request).
+- ``resource_exhausted(at_call)`` / ``oom_serving(server, at_call)`` —
+  synthetic device OOM (a real ``XlaRuntimeError`` with the
+  ``RESOURCE_EXHAUSTED:`` status) from the training dispatch / serving
+  exec path: drives the OOM-forensics e2e — the exec paths must
+  convert it to a structured ``memory.MemoryExhaustedError`` and the
+  recovery rail must diagnose-and-abort, not retry
+  (docs/observability.md "OOM forensics").
 - ``host_loss(trainer, surviving_strategy, at_iteration)`` — elastic
   topology drill: the trainer's mesh shrinks mid-fit and a retryable
   ``host_loss`` fault fires; FaultTolerantFit resumes RESHARDED on the
@@ -58,6 +65,22 @@ import numpy as np
 from deeplearning4j_tpu.autodiff.training import Listener
 from deeplearning4j_tpu.dataset.iterators import DataSetIterator
 from deeplearning4j_tpu.faults.errors import TransientDeviceError
+
+
+def _synthetic_resource_exhausted(nbytes: int) -> BaseException:
+    """The backend's allocation-failure error, synthesized: a real
+    ``XlaRuntimeError`` with the ``RESOURCE_EXHAUSTED:`` status (so the
+    exec paths' detection — type AND message — exercises exactly the
+    production code path), falling back to a same-named RuntimeError
+    subclass where jaxlib's type is not constructible."""
+    msg = (f"RESOURCE_EXHAUSTED: chaos: out of memory while trying to "
+           f"allocate {int(nbytes)} bytes")
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        return XlaRuntimeError(msg)
+    except Exception:       # pragma: no cover - jaxlib layout drift
+        cls = type("XlaRuntimeError", (RuntimeError,), {})
+        return cls(msg)
 
 
 class ChaosSpec:
@@ -373,6 +396,69 @@ class ChaosMonkey:
             yield
         finally:
             sd.fit = orig
+
+    @contextlib.contextmanager
+    def resource_exhausted(self, at_call: int = 1, times: int = 1,
+                           nbytes: int = 1 << 30) -> Iterator[dict]:
+        """Synthetic device OOM in the TRAINING exec path: the
+        ``at_call``-th train dispatch (every ``AOTDispatch`` call —
+        per-step steps, fused windows, scanned epochs — counts) raises
+        ``RESOURCE_EXHAUSTED``, ``times`` times total. The fit tiers
+        convert it into a structured
+        :class:`~deeplearning4j_tpu.memory.MemoryExhaustedError` with
+        forensics attached, and ``FaultTolerantFit`` publishes the
+        ``{"type": "faults", "event": "oom"}`` diagnosis instead of
+        burning its retry budget — the OOM-forensics e2e's fault of
+        choice (docs/fault_tolerance.md). Yields the mutable
+        ``{"calls", "left"}`` state."""
+        from deeplearning4j_tpu.compilecache.aot import AOTDispatch
+        state = {"calls": 0, "left": int(times)}
+        orig = AOTDispatch.__call__
+
+        def chaotic_call(disp, *args):
+            state["calls"] += 1
+            if state["left"] > 0 and state["calls"] >= int(at_call):
+                state["left"] -= 1
+                self.log.append({"event": "resource_exhausted",
+                                 "call": state["calls"],
+                                 "t": time.time()})
+                raise _synthetic_resource_exhausted(nbytes)
+            return orig(disp, *args)
+
+        AOTDispatch.__call__ = chaotic_call
+        try:
+            yield state
+        finally:
+            AOTDispatch.__call__ = orig
+
+    @contextlib.contextmanager
+    def oom_serving(self, server, at_call: int = 1, times: int = 1,
+                    nbytes: int = 1 << 30) -> Iterator[dict]:
+        """Synthetic device OOM in the SERVING exec path: the
+        ``at_call``-th graph execution under
+        ``ParallelInference._execute`` raises ``RESOURCE_EXHAUSTED``
+        from inside ``sd.output`` — so the server's own conversion
+        (structured OOM + ``oom`` fault record + 503 /healthz) is what
+        the test exercises, not a replaced ``_execute``."""
+        state = {"calls": 0, "left": int(times)}
+        sd = server._spec.sd
+        orig = sd.output
+
+        def chaotic_output(*args, **kw):
+            state["calls"] += 1
+            if state["left"] > 0 and state["calls"] >= int(at_call):
+                state["left"] -= 1
+                self.log.append({"event": "resource_exhausted",
+                                 "call": state["calls"],
+                                 "t": time.time()})
+                raise _synthetic_resource_exhausted(nbytes)
+            return orig(*args, **kw)
+
+        sd.output = chaotic_output
+        try:
+            yield state
+        finally:
+            sd.output = orig
 
     # -- checkpoint/storage faults --------------------------------------
     @contextlib.contextmanager

@@ -46,6 +46,7 @@ from deeplearning4j_tpu.faults.errors import (FaultBudgetExhaustedError,
                                               FaultError,
                                               retryable_errors)
 from deeplearning4j_tpu.faults.iterators import RetryingIterator
+from deeplearning4j_tpu.memory import MemoryExhaustedError
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
 
@@ -345,6 +346,28 @@ class FaultTolerantFit:
                                          epochs=remaining,
                                          listeners=all_listeners)
                 break          # done (or a listener chose to stop early)
+            except MemoryExhaustedError as e:
+                # OOM is non-retryable-WITH-DIAGNOSIS: a rollback
+                # replays the same compiled program against the same
+                # HBM — it cannot shrink the footprint, so burning the
+                # retry budget would only delay the inevitable. Publish
+                # the forensics (program, per-device usage, live-array
+                # census, plan) as the {"type": "faults", "event":
+                # "oom"} record — /healthz goes sticky-503 on it — and
+                # abort cleanly (docs/fault_tolerance.md).
+                forensics = e.forensics()
+                self._publish(
+                    "oom", **e.provenance(),
+                    devices=[{k: d.get(k) for k in
+                              ("device", "bytes_in_use", "peak_bytes",
+                               "bytes_limit")}
+                             for d in forensics.get("devices", [])],
+                    live_arrays=(forensics.get("census") or {}
+                                 ).get("arrays"),
+                    live_bytes=(forensics.get("census") or {}
+                                ).get("total_bytes"),
+                    plan=forensics.get("plan"))
+                raise
             except retryable as e:
                 self._publish(
                     "fault",

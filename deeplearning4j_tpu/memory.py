@@ -11,13 +11,18 @@ is the per-fit HBM accounting the reference gets from
 AllocationsTracker.getInstance() around training calls. On backends
 whose PJRT client exposes no stats (CPU), live-array accounting is the
 fallback so the API stays total.
+
+The live-telemetry half (``{"type": "memory"}`` records at listener
+flush boundaries, compiled-program memory plans, the ``/memory`` route,
+OOM forensics) lives in :mod:`deeplearning4j_tpu.monitor.memstats` and
+samples this module — see docs/observability.md ("Memory
+observability").
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
-
-import numpy as np
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -30,19 +35,39 @@ class DeviceMemoryState:
     largest_alloc: int = 0
     bytes_limit: int = 0
     source: str = "pjrt"        # "pjrt" | "live_arrays"
+    skipped_arrays: int = 0     # live-array fallback only: arrays the
+    #                             census could not size (deleted/donated)
 
 
-def _live_array_bytes_by_device() -> Dict[str, int]:
+def _live_array_bytes_by_device() -> Tuple[Dict[str, int], int]:
+    """Python-side live-buffer accounting: per-device bytes of every
+    addressable ``jax.live_arrays()`` shard, plus the count of arrays
+    that could NOT be sized. An array can be un-sizable for two
+    legitimate reasons — it was ``delete()``d but the tracking list has
+    not dropped it yet, or its buffer was DONATED into a running
+    computation (reading shards then raises RuntimeError). Those are
+    skipped and **counted**, never silently dropped: a fallback total
+    that silently undercounts would masquerade as headroom."""
     import jax
     by_dev: Dict[str, int] = {}
+    skipped = 0
     for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                skipped += 1
+                continue
+        except Exception:
+            pass        # not every array type exposes is_deleted()
         try:
             for shard in a.addressable_shards:
                 d = str(shard.device)
                 by_dev[d] = by_dev.get(d, 0) + int(shard.data.nbytes)
-        except Exception:
-            pass
-    return by_dev
+        except RuntimeError:
+            # deleted/donated between the is_deleted() check and the
+            # shard read (the race is real: the async dispatch thread
+            # consumes donated buffers concurrently)
+            skipped += 1
+    return by_dev, skipped
 
 
 def snapshot() -> List[DeviceMemoryState]:
@@ -51,6 +76,7 @@ def snapshot() -> List[DeviceMemoryState]:
     import jax
     out: List[DeviceMemoryState] = []
     live = None
+    live_skipped = 0
     for dev in jax.local_devices():
         ms = None
         try:
@@ -68,11 +94,12 @@ def snapshot() -> List[DeviceMemoryState]:
                 source="pjrt"))
         else:
             if live is None:
-                live = _live_array_bytes_by_device()
+                live, live_skipped = _live_array_bytes_by_device()
             out.append(DeviceMemoryState(
                 device=str(dev),
                 bytes_in_use=live.get(str(dev), 0),
-                source="live_arrays"))
+                source="live_arrays",
+                skipped_arrays=live_skipped))
     return out
 
 
@@ -83,6 +110,34 @@ def total_bytes_in_use() -> int:
 def live_array_count() -> int:
     import jax
     return len(jax.live_arrays())
+
+
+def live_census(top_n: int = 12) -> Dict[str, Any]:
+    """The live-array census for OOM forensics: the ``top_n`` biggest
+    live arrays (shape/dtype/nbytes/device) plus aggregate counts —
+    what is actually holding HBM when an allocation fails."""
+    import jax
+    rows: List[dict] = []
+    total = 0
+    skipped = 0
+    count = 0
+    for a in jax.live_arrays():
+        count += 1
+        try:
+            if a.is_deleted():
+                skipped += 1
+                continue
+            nbytes = int(a.nbytes)
+            dev = str(next(iter(a.devices()), "?")) \
+                if hasattr(a, "devices") else "?"
+            rows.append({"shape": list(a.shape), "dtype": str(a.dtype),
+                         "nbytes": nbytes, "device": dev})
+            total += nbytes
+        except Exception:
+            skipped += 1
+    rows.sort(key=lambda r: -r["nbytes"])
+    return {"arrays": count, "skipped": skipped,
+            "total_bytes": total, "top": rows[:max(0, int(top_n))]}
 
 
 def device_memory_report() -> str:
@@ -99,7 +154,10 @@ def device_memory_report() -> str:
             if s.bytes_limit:
                 line += f", limit {s.bytes_limit / 2**20:.1f} MiB"
         else:
-            line += " (live-array accounting; PJRT stats unavailable)"
+            line += " (live-array accounting; PJRT stats unavailable"
+            if s.skipped_arrays:
+                line += f"; {s.skipped_arrays} arrays unsized"
+            line += ")"
         lines.append(line)
     return "\n".join(lines)
 
@@ -142,19 +200,47 @@ class MemoryWatermark:
         return sum(s.bytes_in_use - b.get(s.device, 0) for s in self.after)
 
     def report(self) -> str:
-        return (f"memory watermark: peak {self.peak_bytes / 2**20:.1f} "
-                f"MiB, net delta {self.delta_bytes / 2**20:+.1f} MiB\n"
-                + device_memory_report())
+        """Per-device peaks (not just the max — a lopsided mesh shows
+        one device pinned at the limit while the fleet average looks
+        healthy), then the net delta and the live device table."""
+        if not self.after:
+            self.after = snapshot()
+        lines = [f"memory watermark: peak {self.peak_bytes / 2**20:.1f} "
+                 f"MiB, net delta {self.delta_bytes / 2**20:+.1f} MiB"]
+        before = {s.device: s for s in self.before}
+        for s in self.after:
+            peak = s.peak_bytes or s.bytes_in_use
+            b = before.get(s.device)
+            delta = s.bytes_in_use - (b.bytes_in_use if b else 0)
+            line = (f"  {s.device}: peak {peak / 2**20:.1f} MiB, "
+                    f"delta {delta / 2**20:+.1f} MiB")
+            if s.bytes_limit:
+                line += (f", headroom "
+                         f"{(s.bytes_limit - s.bytes_in_use) / 2**20:.1f}"
+                         f" MiB")
+            lines.append(line)
+        lines.append(device_memory_report())
+        return "\n".join(lines)
 
 
 class AllocationsTracker:
     """Counting tracker for explicit instrumentation points (reference:
     AllocationsTracker.allocate/release accounting API). The framework's
-    own allocations go through XLA, so this tracks what callers tag."""
+    own allocations go through XLA, so this tracks what callers tag —
+    today the window stager's H2D staging (``h2d_stage``) and the
+    checkpoint writer's D2H capture (``checkpoint_d2h``), both cumulative
+    transfer totals surfaced in ``{"type": "memory"}`` records.
+
+    Thread-safe: the checkpoint writer thread, the window-stager thread
+    and the training thread all hit the same singleton. ``release``
+    clamps at zero — an unmatched release (a tag released more than it
+    allocated, e.g. across a ``reset()``) must not drive a lifetime
+    total negative and silently cancel later allocations."""
 
     _instance: Optional["AllocationsTracker"] = None
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._tracked: Dict[str, int] = {}
         self._counts: Dict[str, int] = {}
 
@@ -165,18 +251,110 @@ class AllocationsTracker:
         return cls._instance
 
     def allocate(self, tag: str, nbytes: int) -> None:
-        self._tracked[tag] = self._tracked.get(tag, 0) + int(nbytes)
-        self._counts[tag] = self._counts.get(tag, 0) + 1
+        with self._lock:
+            self._tracked[tag] = self._tracked.get(tag, 0) + int(nbytes)
+            self._counts[tag] = self._counts.get(tag, 0) + 1
 
     def release(self, tag: str, nbytes: int) -> None:
-        self._tracked[tag] = self._tracked.get(tag, 0) - int(nbytes)
+        with self._lock:
+            self._tracked[tag] = max(
+                0, self._tracked.get(tag, 0) - int(nbytes))
 
     def bytes_tracked(self, tag: str) -> int:
-        return self._tracked.get(tag, 0)
+        with self._lock:
+            return self._tracked.get(tag, 0)
 
     def totals(self) -> Dict[str, int]:
-        return dict(self._tracked)
+        with self._lock:
+            return dict(self._tracked)
+
+    def counts(self) -> Dict[str, int]:
+        """Per-tag event counts (how many tagged transfers/allocations
+        happened, independent of their byte totals)."""
+        with self._lock:
+            return dict(self._counts)
 
     def reset(self) -> None:
-        self._tracked.clear()
-        self._counts.clear()
+        with self._lock:
+            self._tracked.clear()
+            self._counts.clear()
+
+
+class MemoryExhaustedError(RuntimeError):
+    """A device allocation failed (``RESOURCE_EXHAUSTED``) — with
+    forensics attached, instead of the raw backend crash.
+
+    Carries the last per-device :func:`snapshot`, a :func:`live_census`
+    of what holds HBM, and the active compiled program's memory plan
+    (``monitor/memstats.py``) when one is known. Deliberately **not**
+    part of ``faults.retryable_errors()``: a rollback replays the same
+    program against the same HBM — it cannot shrink the footprint —
+    so ``FaultTolerantFit`` publishes the ``{"type": "faults",
+    "event": "oom"}`` diagnosis and aborts instead of burning the
+    retry budget (docs/fault_tolerance.md)."""
+
+    def __init__(self, message: str, *, program: Optional[str] = None,
+                 step: Optional[int] = None, epoch: Optional[int] = None,
+                 snapshot: Optional[List[DeviceMemoryState]] = None,
+                 census: Optional[dict] = None,
+                 plan: Optional[dict] = None):
+        super().__init__(message)
+        self.program = program
+        self.step = step
+        self.epoch = epoch
+        self.snapshot = list(snapshot or [])
+        self.census = census
+        self.plan = plan
+        self.cause = "oom"
+
+    def provenance(self) -> Dict[str, Any]:
+        """Machine-readable view for ``{"type": "faults"}`` records —
+        same shape as ``faults.FaultError.provenance()``."""
+        return {"error": type(self).__name__, "cause": "oom",
+                "step": self.step, "epoch": self.epoch,
+                "program": self.program}
+
+    def forensics(self) -> Dict[str, Any]:
+        """The full diagnosis: per-device usage, live-array census,
+        the active program's memory plan."""
+        return {**self.provenance(),
+                "devices": [dataclasses.asdict(s) for s in self.snapshot],
+                "census": self.census, "plan": self.plan}
+
+    def __str__(self) -> str:  # noqa: D105 — the postmortem one-pager
+        parts = [super().__str__()]
+        if self.program:
+            parts.append(f"active program: {self.program}")
+        for s in self.snapshot:
+            line = (f"{s.device}: {s.bytes_in_use / 2**20:.1f} MiB in "
+                    f"use, peak {(s.peak_bytes or 0) / 2**20:.1f} MiB")
+            if s.bytes_limit:
+                line += f", limit {s.bytes_limit / 2**20:.1f} MiB"
+            parts.append(line)
+        if self.plan:
+            parts.append(
+                f"program plan: temp "
+                f"{self.plan.get('temp_bytes', 0) / 2**20:.1f} MiB + args "
+                f"{self.plan.get('argument_bytes', 0) / 2**20:.1f} MiB + "
+                f"out {self.plan.get('output_bytes', 0) / 2**20:.1f} MiB")
+        if self.census:
+            parts.append(f"live arrays: {self.census.get('arrays', 0)} "
+                         f"({self.census.get('total_bytes', 0) / 2**20:.1f}"
+                         f" MiB); top: " + ", ".join(
+                             f"{r['shape']}:{r['dtype']}"
+                             f"={r['nbytes'] / 2**20:.1f}MiB"
+                             for r in self.census.get("top", [])[:4]))
+        return "\n  ".join(parts)
+
+
+class MemoryHeadroomError(RuntimeError):
+    """A guarded operation (serving hot reload, warmup of a new bucket)
+    was REFUSED because its projected footprint exceeds the device's
+    remaining HBM headroom — raised *before* the backend OOMs, so the
+    server keeps serving what it served (docs/serving.md "Resilience")."""
+
+    def __init__(self, message: str, *, required_bytes: int = 0,
+                 headroom_bytes: int = 0):
+        super().__init__(message)
+        self.required_bytes = int(required_bytes)
+        self.headroom_bytes = int(headroom_bytes)

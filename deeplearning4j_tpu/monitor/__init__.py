@@ -27,6 +27,7 @@ per-step tier), serving, checkpointing and the fault rail:
 
 See docs/observability.md.
 """
+from deeplearning4j_tpu.monitor import memstats
 from deeplearning4j_tpu.monitor.registry import MetricsRegistry
 from deeplearning4j_tpu.monitor.server import (TelemetryServer,
                                                health_snapshot, serve)

@@ -429,6 +429,60 @@ class MetricsRegistry:
                            help="iteration of the last tensorstats "
                                 "sample")
 
+    def fold_memory(self, record: dict) -> None:
+        """Fold one ``{"type": "memory"}`` record (monitor/memstats.py)
+        into ``hbm_*`` gauges — total and per-device bytes in use /
+        peak / limit / headroom, plus the AllocationsTracker's tagged
+        transfer totals (gauges, not counters: the record carries
+        cumulative values)."""
+        for key, metric in (("bytes_in_use", "hbm_bytes_in_use"),
+                            ("peak_bytes", "hbm_peak_bytes"),
+                            ("bytes_limit", "hbm_bytes_limit"),
+                            ("headroom", "hbm_headroom")):
+            if record.get(key) is not None:
+                self.set_gauge(metric, record[key],
+                               help="device HBM accounting "
+                                    "(monitor/memstats.py)")
+        for dev in record.get("devices", ()):
+            name = dev.get("device", "?")
+            for key, metric in (("bytes_in_use", "hbm_bytes_in_use"),
+                                ("peak_bytes", "hbm_peak_bytes"),
+                                ("bytes_limit", "hbm_bytes_limit")):
+                if dev.get(key):
+                    self.set_gauge(metric, dev[key],
+                                   help="device HBM accounting "
+                                        "(monitor/memstats.py)",
+                                   device=name)
+        for tag, nbytes in (record.get("tracked") or {}).items():
+            self.set_gauge("memory_tracked_bytes", nbytes,
+                           help="AllocationsTracker tagged transfer "
+                                "totals", tag=tag)
+        if record.get("live_skipped"):
+            self.set_gauge("memory_live_skipped_arrays",
+                           record["live_skipped"],
+                           help="live arrays the fallback census could "
+                                "not size (deleted/donated)")
+
+    def fold_memory_plan(self, record: dict) -> None:
+        """Fold one ``{"type": "memory_plan"}`` record into per-program
+        ``plan_*`` gauges — the compiled executable's predicted
+        footprint (temp/argument/output/generated-code bytes) and its
+        flops (the MFU-estimate numerator)."""
+        program = record.get("program", "?")
+        for key in ("temp_bytes", "argument_bytes", "output_bytes",
+                    "generated_code_bytes", "total_bytes"):
+            if record.get(key) is not None:
+                self.set_gauge(f"plan_{key}", record[key],
+                               help="compiled-program memory plan "
+                                    "(compiled.memory_analysis)",
+                               program=program)
+        for key in ("flops", "flops_per_step", "bytes_accessed"):
+            if record.get(key) is not None:
+                self.set_gauge(f"plan_{key}", record[key],
+                               help="compiled-program cost plan "
+                                    "(compiled.cost_analysis)",
+                               program=program)
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -485,6 +539,10 @@ class MetricsRegistry:
             self.fold_compile(rec)
         elif t == "reshard":
             self.fold_reshard(rec)
+        elif t == "memory":
+            self.fold_memory(rec)
+        elif t == "memory_plan":
+            self.fold_memory_plan(rec)
 
 
 __all__ = ["MetricsRegistry"]

@@ -26,6 +26,10 @@ route                 payload
                       queue-depth hook — the SLO shed-load signal)
 ``GET /report``       the self-contained ui/report HTML, rendered from
                       the live storage
+``GET /memory``       live HBM state: a fresh per-device snapshot,
+                      AllocationsTracker transfer totals, and every
+                      captured compiled-program memory plan
+                      (monitor/memstats.py)
 ``GET /trace``        Chrome/Perfetto trace JSON from the shared tracer
                       (load at ui.perfetto.dev)
 ``GET /stats``        recent storage records as JSON lines
@@ -66,14 +70,16 @@ _DEGRADING_EVENTS = frozenset({"fault", "rollback", "retry",
                                "topology_changed"})
 #: ... and the event that clears it
 _RECOVERED_EVENTS = frozenset({"recovered"})
-#: sticky failure: the retry budget is spent, the job is aborting
-_FATAL_EVENTS = frozenset({"retry_exhausted"})
+#: sticky failure: the retry budget is spent and the job is aborting,
+#: or device memory is exhausted (a rollback cannot shrink the program
+#: — the run/bucket will not heal without intervention)
+_FATAL_EVENTS = frozenset({"retry_exhausted", "oom"})
 
 #: record types whose ``t`` field is wall-clock (time.time()) — the
 #: last-step-age fallback when no heartbeat provider is registered
 #: ("score"/"perf" use perf_counter timestamps and must NOT mix in)
 _WALL_T_TYPES = ("steptime", "tensorstats", "metrics", "checkpoint",
-                 "faults", "serving")
+                 "faults", "serving", "memory")
 
 
 def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
@@ -274,6 +280,8 @@ class TelemetryServer:
             return self._health(ready_probe=True)
         if route == "/report":
             return self._report()
+        if route == "/memory":
+            return self._memory()
         if route == "/trace":
             return self._trace()
         if route == "/stats":
@@ -311,6 +319,25 @@ class TelemetryServer:
         html = render_report(self.storage, title=self.title)
         return 200, "text/html; charset=utf-8", html.encode("utf-8")
 
+    def _memory(self):
+        """Live HBM state (monitor/memstats.py): a fresh per-device
+        snapshot + tracked transfer totals + every captured compiled-
+        program memory plan + the last stored memory record (so the
+        flush-cadence history and the instantaneous view sit side by
+        side)."""
+        from deeplearning4j_tpu.monitor import memstats
+        body = memstats.memory_record(source="probe")
+        body["plans"] = [p.to_record() for p in memstats.PLANS.plans()]
+        active = memstats.PLANS.active_plan()
+        body["active_program"] = active.label if active is not None \
+            else None
+        if self.storage is not None:
+            last = self.storage.tail(1, "memory")
+            if last:
+                body["last_record"] = last[-1]
+        return 200, "application/json", \
+            json.dumps(body, default=str).encode("utf-8")
+
     def _trace(self):
         return 200, "application/json", \
             json.dumps(self.tracer.to_chrome_trace()).encode("utf-8")
@@ -338,6 +365,7 @@ class TelemetryServer:
                 ("/healthz", "liveness (fault/rollback state)"),
                 ("/readyz", "readiness (staleness + queue depth)"),
                 ("/report", "training report HTML"),
+                ("/memory", "live HBM snapshot + program memory plans"),
                 ("/trace", "Chrome/Perfetto trace JSON"),
                 ("/stats", "recent records (?n=500&type=...)")))
         body = (f"<!doctype html><html><head><meta charset='utf-8'>"

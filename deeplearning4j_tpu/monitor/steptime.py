@@ -181,7 +181,8 @@ class MonitorListener:
                  straggler: Optional[StragglerWatcher] = None,
                  rolling_window: int = 512, trace_record_spans: int = 400,
                  serve_port: Optional[int] = None,
-                 serve_host: str = "127.0.0.1"):
+                 serve_host: str = "127.0.0.1",
+                 memory: bool = True):
         self.storage = storage
         self.registry = registry if registry is not None else \
             MetricsRegistry()
@@ -204,6 +205,13 @@ class MonitorListener:
         self.server = None
         self._last_flush_t: Optional[float] = None
         self._last_iteration: Optional[int] = None
+        # HBM telemetry (monitor/memstats.py): one {"type": "memory"}
+        # record per listener flush — pure host reads at boundaries the
+        # host ALREADY syncs on, so clean runs stay bit-identical —
+        # plus plan capture for lazily-compiled programs and the live
+        # MFU-estimate gauge. memory=False turns the whole rail off.
+        self.memory = bool(memory)
+        self._published_plans: set = set()
 
     def reset(self) -> None:
         """Rollback hook (faults/recovery.py resets stateful listeners):
@@ -215,6 +223,13 @@ class MonitorListener:
     # -- listener protocol ----------------------------------------------
     def on_training_start(self, sd) -> None:
         self._mark = self.tracer.mark()
+        if self.memory:
+            # arm lazy-compile plan capture: a monitored fit's first
+            # dispatch per shape compiles through the AOT path (same
+            # lowering, one compile either way) so its memory plan —
+            # and the MFU numerator — is inspectable
+            from deeplearning4j_tpu.monitor import memstats
+            memstats.enable_plan_capture()
         if self._serve_port is not None and self.server is None:
             from deeplearning4j_tpu.monitor.server import TelemetryServer
             self.server = TelemetryServer(
@@ -247,14 +262,50 @@ class MonitorListener:
             self.storage.put(rec)
         self.registry.fold_storage(self.storage)
 
+    def _publish_memory(self, epoch: int, iterations,
+                        prev_flush_t: Optional[float],
+                        now: float) -> None:
+        """The memory half of a flush: one ``{"type": "memory"}``
+        record (pure host reads — no device sync) plus, when an active
+        program plan is known, the live MFU-estimate gauge (plan flops
+        per step ÷ measured step time ÷ device peak)."""
+        from deeplearning4j_tpu.monitor import memstats
+        rec = memstats.memory_record(
+            epoch=epoch,
+            iteration=int(iterations[-1]) if iterations else None)
+        self.storage.put(rec)
+        step_s = self.rolling.percentile(50) if len(self.rolling) else 0.0
+        if not step_s and prev_flush_t is not None and iterations:
+            # tracing disabled: no span-derived step times — fall back
+            # to flush wall time over the burst's step count
+            step_s = max(0.0, now - prev_flush_t) / max(1, len(iterations))
+        if step_s:
+            est = memstats.mfu_estimate(step_s)
+            if est is not None:
+                mfu, fps = est
+                self.registry.set_gauge(
+                    "mfu_estimate", round(mfu, 6),
+                    help="live MFU estimate: active-plan flops/step / "
+                         "measured step time / device peak flops")
+                self.registry.set_gauge(
+                    "plan_flops_per_step", fps,
+                    help="active compiled program's flops per train "
+                         "step (cost_analysis)")
+
     def iterations_done(self, sd, epoch: int, iterations, losses) -> None:
-        self._last_flush_t = time.time()
+        now = time.time()
+        prev_flush_t = self._last_flush_t
+        self._last_flush_t = now
         if iterations:
             self._last_iteration = int(iterations[-1])
         spans, self._mark, dropped = self.tracer.drain(self._mark)
         self._dropped += dropped
         rows = window_rows(spans)
+        if self.memory:
+            self._publish_memory(epoch, iterations, prev_flush_t, now)
         if not rows:
+            if self.memory:
+                self.registry.fold_storage(self.storage)
             return
         rec = {"type": "steptime", "epoch": int(epoch), "t": time.time(),
                "windows": len(rows), "steps": sum(r["k"] for r in rows),
@@ -317,6 +368,25 @@ class MonitorListener:
             self._compile_snap = snap
             self.registry.fold_compile(COMPILE_STATS)
             COMPILE_STATS.publish(self.storage)
+        if self.memory:
+            # plans captured for THIS graph (precompile, serving
+            # warmup, lazy-compile promotion) become {"type":
+            # "memory_plan"} records — the per-executable footprint
+            # ui/report's Memory panel charts. Filtered by graph
+            # identity: the registry is process-global, and a second
+            # model's listener must not republish the first model's
+            # plans into its own storage as if they were its run's.
+            from deeplearning4j_tpu.monitor import memstats
+            gid = memstats.graph_key(sd)
+            for plan in memstats.PLANS.plans():
+                if plan.graph is not None and plan.graph != gid:
+                    continue
+                key = (plan.label, plan.sig)
+                if key in self._published_plans:
+                    continue
+                self._published_plans.add(key)
+                self.storage.put(plan.to_record())
+            self.registry.fold_storage(self.storage)
         self.registry.publish(self.storage)
 
     def on_training_end(self, sd) -> None:

@@ -126,7 +126,8 @@ class ParallelInference:
                  profile_dir: Optional[str] = None,
                  warmup_buckets=None,
                  telemetry_port: Optional[int] = None,
-                 resilience=None):
+                 resilience=None,
+                 memory_sample_every: Optional[int] = 64):
         self.model = model
         self.mode = InferenceMode(mode)
         self.max_batch_size = int(max_batch_size)
@@ -152,6 +153,12 @@ class ParallelInference:
                            if self._ph_shapes[0] is not None else None)
         self._exec_lock = threading.Lock()
         self._shapes_seen = set()
+        # HBM telemetry at serving batch boundaries (monitor/memstats):
+        # every Nth _execute publishes a {"type": "memory"} record into
+        # stats_storage — pure host reads, off the exec lock. None = off.
+        self._mem_every = (max(1, int(memory_sample_every))
+                           if memory_sample_every else None)
+        self._mem_count = 0
         self._req_id = 0
         self._id_lock = threading.Lock()
         self._closed = False
@@ -283,8 +290,26 @@ class ParallelInference:
             # caches are only safe under the same lock _execute holds
             with self._exec_lock, \
                     _tracer.span("serving.warmup", cat="serving", bucket=b):
+                from deeplearning4j_tpu.monitor import memstats
                 self._spec.sd.precompile_output(ph,
                                                 self._spec.output_names)
+                # headroom guard (docs/serving.md "Resilience"): refuse
+                # to mark a bucket warm whose compiled plan (temps +
+                # outputs — arguments are the already-resident params)
+                # exceeds the projected HBM headroom; a typed refusal
+                # HERE beats a RESOURCE_EXHAUSTED on the first live
+                # request that lands in the bucket. No-op where the
+                # backend reports no bytes_limit (CPU). Looked up by
+                # the exact shape SIGNATURE, not the label — labels
+                # like "output_b4" alias across models in one process.
+                plan = memstats.PLANS.get(tuple(sorted(
+                    (n, tuple(shape)) for n, shape in ph.items())))
+                if plan is not None:
+                    need = int(plan.temp_bytes or 0) \
+                        + int(plan.output_bytes or 0)
+                    memstats.check_headroom(
+                        need, f"serving warmup bucket {b} "
+                              f"({type(self.model).__name__})")
                 # mark the shape as seen (under the SAME lock hold — a
                 # worker dispatching this bucket between compile and
                 # mark would count a spurious lazy `compiles`) so the
@@ -355,6 +380,18 @@ class ParallelInference:
             prof = self._profiler_session()
             try:
                 res = self._spec.sd.output(ph, self._spec.output_names)
+            except Exception as e:
+                # RESOURCE_EXHAUSTED → structured OOM with forensics
+                # (per-device usage + the bucket program) instead of a
+                # raw backend crash; published on the fault rail so
+                # /healthz flips 503 (docs/observability.md)
+                from deeplearning4j_tpu.monitor import memstats
+                if memstats.is_resource_exhausted(e):
+                    err = memstats.oom_error(e, program=f"serving_b{rows}")
+                    self._publish_fault("oom", program=f"serving_b{rows}",
+                                        rows=rows, error=repr(e))
+                    raise err from e
+                raise
             finally:
                 if prof is not None:
                     prof.__exit__(None, None, None)
@@ -365,6 +402,17 @@ class ParallelInference:
                                    exec_ms=exec_ms)
         if self.admission is not None:
             self.admission.observe(exec_ms)
+        if self._mem_every is not None and self.stats_storage is not None:
+            with self._id_lock:     # workers race this tail concurrently
+                self._mem_count += 1
+                fire = self._mem_count % self._mem_every == 0
+            if fire:
+                from deeplearning4j_tpu.monitor import memstats
+                try:
+                    self.stats_storage.put(
+                        memstats.memory_record(source="serving"))
+                except Exception:
+                    pass    # a broken stats sink must not fail requests
         return outs
 
     def _profiler_session(self):
@@ -819,7 +867,8 @@ class ParallelInference:
         return ph
 
     def reload_from(self, manager, step: Optional[int] = None,
-                    canary=None, strict: bool = True) -> dict:
+                    canary=None, strict: bool = True,
+                    headroom_guard: bool = True) -> dict:
         """Hot-swap serving parameters to a committed checkpoint, with
         no restart and no dropped requests.
 
@@ -839,7 +888,15 @@ class ParallelInference:
         The swap pours checkpoint arrays in by NAME (the same contract
         as ``update_model()``'s train→infer sync); a later
         ``update_model()`` re-syncs from the live training graph and
-        overwrites a reload."""
+        overwrites a reload.
+
+        ``headroom_guard`` (default on): refuse with a typed
+        :class:`~deeplearning4j_tpu.memory.MemoryHeadroomError` —
+        before anything is swapped — when the incoming arrays plus the
+        canary program's temps exceed the projected HBM headroom
+        (old and new parameters coexist through the swap; a mid-swap
+        OOM would be strictly worse than a refusal). No-op on backends
+        that report no memory limit."""
         import jax.numpy as jnp
         t0 = time.perf_counter()
         if step is None:
@@ -880,6 +937,33 @@ class ParallelInference:
             swap = {n: arr for n, arr in state.arrays.items()
                     if n in live and n in sd._arrays
                     and tuple(sd._arrays[n].shape) == tuple(np.shape(arr))}
+            if headroom_guard:
+                # old and new parameter sets coexist on-device through
+                # the swap + canary (the rollback path needs the old
+                # arrays alive), so the incoming bytes — plus the
+                # canary program's temps — must fit the projected HBM
+                # headroom. A typed refusal here (MemoryHeadroomError,
+                # nothing swapped, server keeps serving) beats an OOM
+                # mid-swap. No-op where no device reports a limit.
+                from deeplearning4j_tpu.monitor import memstats
+                incoming = sum(int(np.asarray(a).nbytes)
+                               for a in swap.values())
+                # the canary program's temps, when its exact shape was
+                # warmed (sig lookup — a LABEL lookup would alias
+                # across models in one process); a miss just omits the
+                # canary term, the incoming-bytes check still applies
+                canary_plan = None
+                try:
+                    cin = self._canary_input(canary)
+                    canary_plan = memstats.PLANS.get(tuple(sorted(
+                        (n, tuple(np.shape(v))) for n, v in cin.items())))
+                except Exception:
+                    pass
+                if canary_plan is not None:
+                    incoming += int(canary_plan.temp_bytes or 0) \
+                        + int(canary_plan.output_bytes or 0)
+                memstats.check_headroom(
+                    incoming, f"hot reload of checkpoint step {step}")
             prev = {n: sd._arrays[n] for n in swap}
             with _tracer.span("serving.reload", cat="serving",
                               step=int(step), arrays=len(swap)):

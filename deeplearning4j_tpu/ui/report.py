@@ -223,7 +223,70 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
-    "compile", "reshard", "tensorstats"})
+    "compile", "reshard", "tensorstats", "memory_plan"})
+
+
+#: memory-plan byte components for the stacked budget chart, mirroring
+#: monitor/memstats.PLAN_BYTE_FIELDS (colors match the steptime stack)
+_PLAN_COLORS = (("argument_bytes", "#1f77b4", "arguments"),
+                ("temp_bytes", "#ff7f0e", "temps"),
+                ("output_bytes", "#2ca02c", "outputs"),
+                ("generated_code_bytes", "#9467bd", "code"))
+
+
+def _svg_budget(plans: List[dict], w=640, h=220, label="") -> str:
+    """Stacked per-program memory-budget bars (one bar per captured
+    plan: argument/temp/output/generated-code bytes stacked) — the
+    chart version of PROFILE.md's hand-computed HBM breakdown."""
+    plans = [p for p in plans
+             if any(p.get(k) for k, _, _ in _PLAN_COLORS)]
+    if not plans:
+        return f"<p>(no data for {_html.escape(label)})</p>"
+
+    def _component(p, key):
+        v = p.get(key, 0) or 0
+        if key == "argument_bytes":
+            # donated/aliased bytes reuse argument space — subtract
+            # them here so the bar height equals the plan's
+            # total_bytes and the chart agrees with the table's
+            # "total MiB" column
+            v = max(0, v - (p.get("alias_bytes", 0) or 0))
+        return v
+
+    totals = [sum(_component(p, k) for k, _, _ in _PLAN_COLORS)
+              for p in plans]
+    mx = max(totals) or 1
+    n = len(plans)
+    bw = min(90, (w - 70) / n)
+    parts = [f'<svg width="{w}" height="{h}" style="background:#fafafa">',
+             f'<text x="5" y="14" font-size="12" fill="#444">'
+             f'{_html.escape(label)}</text>']
+    for i, p in enumerate(plans):
+        y = h - 36
+        x = 60 + i * bw
+        for key, color, name in _PLAN_COLORS:
+            v = _component(p, key)
+            bh = (h - 60) * v / mx
+            y -= bh
+            parts.append(
+                f'<rect x="{x:.1f}" y="{y:.1f}" '
+                f'width="{max(bw - 3, 1):.1f}" height="{bh:.1f}" '
+                f'fill="{color}"><title>{name}: {v / 2**20:.2f} MiB'
+                f'</title></rect>')
+        prog = str(p.get("program", "?"))[:12]
+        parts.append(f'<text x="{x:.1f}" y="{h - 22}" font-size="9" '
+                     f'fill="#666">{_html.escape(prog)}</text>')
+    parts.append(f'<text x="5" y="30" font-size="10" fill="#888">'
+                 f'{mx / 2**20:.1f} MiB</text>')
+    lx = 60
+    for _, color, name in _PLAN_COLORS:
+        parts.append(f'<rect x="{lx}" y="{h - 14}" width="10" '
+                     f'height="10" fill="{color}"/>')
+        parts.append(f'<text x="{lx + 13}" y="{h - 5}" font-size="10" '
+                     f'fill="#444">{name}</text>')
+        lx += 13 + 7 * len(name) + 14
+    parts.append("</svg>")
+    return "\n".join(parts)
 
 
 def render_report(storage: StatsStorage, title: str = "Training report"
@@ -232,6 +295,9 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     perf = storage.of_type("perf")
     params = storage.of_type("params")
     memory = storage.of_type("memory")
+    memory_plans = storage.of_type("memory_plan")
+    oom_events = [r for r in storage.of_type("faults")
+                  if r.get("event") == "oom"]
     end = storage.of_type("end")
     tensorstats = storage.of_type("tensorstats")
     steptime = [r for r in storage.of_type("steptime")
@@ -300,16 +366,110 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 f"<td>{ent.get('update_norm', float('nan')):.4g}</td></tr>")
         parts.append("</table>")
 
-    # -- system: memory --------------------------------------------------
+    # -- system: memory (monitor/memstats.py — docs/observability.md) ----
+    if memory or memory_plans or oom_events:
+        parts.append("<h2>Memory</h2>")
     if memory:
-        parts.append("<h2>Device memory</h2><div class='row'>")
+        # x = sample index, NOT iteration/epoch: records from different
+        # producers (listener flushes carry iterations, StatsListener
+        # epochs, serving samples neither) share one storage, and a
+        # mixed axis would make the polyline double back on itself —
+        # append order is time order, so the index is always monotonic
+        parts.append("<div class='row'>")
         parts.append(_svg_line(
-            [(r["epoch"], r["bytes_in_use"] / 2**20) for r in memory],
-            label="HBM in use (MiB)", color="#9467bd"))
+            [(i, r["bytes_in_use"] / 2**20)
+             for i, r in enumerate(memory)],
+            label="HBM in use (MiB) over samples", color="#9467bd"))
         parts.append(_svg_line(
-            [(r["epoch"], r["peak_bytes"] / 2**20) for r in memory],
-            label="HBM peak (MiB)", color="#8c564b"))
+            [(i, r["peak_bytes"] / 2**20)
+             for i, r in enumerate(memory)],
+            label="HBM peak (MiB) over samples", color="#8c564b"))
+        if any(r.get("headroom") is not None for r in memory):
+            parts.append(_svg_line(
+                [(i, r["headroom"] / 2**20)
+                 for i, r in enumerate(memory)
+                 if r.get("headroom") is not None],
+                label="HBM headroom (MiB) over samples", color="#2ca02c"))
         parts.append("</div>")
+        # per-device watermark curves: a lopsided mesh shows one device
+        # pinned at its limit while the fleet total looks healthy
+        dev_names = sorted({d.get("device", "?") for r in memory
+                            for d in r.get("devices", ())})
+        if len(dev_names) > 1:
+            parts.append("<div class='row'>")
+            for name in dev_names[:16]:
+                pts = []
+                for i, r in enumerate(memory):
+                    for d in r.get("devices", ()):
+                        if d.get("device") == name:
+                            pts.append((i,
+                                        d.get("bytes_in_use", 0) / 2**20))
+                if pts:
+                    parts.append(_svg_line(
+                        pts, w=320, h=120, color="#9467bd",
+                        label=f"{name} in use (MiB)"))
+            parts.append("</div>")
+        last = memory[-1]
+        tracked = last.get("tracked") or {}
+        bits = [f"{len(memory)} samples"]
+        if last.get("bytes_limit"):
+            bits.append(f"limit {last['bytes_limit'] / 2**20:.0f} MiB")
+        for tag, nb in sorted(tracked.items()):
+            bits.append(f"{tag} {nb / 2**20:.1f} MiB "
+                        f"({(last.get('tracked_counts') or {}).get(tag, 0)}"
+                        f" transfers)")
+        if last.get("live_skipped"):
+            bits.append(f"{last['live_skipped']} live arrays unsized")
+        parts.append("<p>" + ", ".join(bits) + "</p>")
+    if memory_plans:
+        # newest plan per program label (re-captures refresh)
+        by_prog: dict = {}
+        for r in memory_plans:
+            by_prog[r.get("program", "?")] = r
+        plans = [by_prog[k] for k in sorted(by_prog)]
+        parts.append(_svg_budget(
+            plans, label="compiled-program memory plans "
+                         "(memory_analysis)"))
+        parts.append(
+            "<table><tr><th>program</th><th>steps</th><th>args MiB</th>"
+            "<th>temps MiB</th><th>out MiB</th><th>total MiB</th>"
+            "<th>GFLOPs/step</th></tr>")
+        for p in plans:
+            fps = p.get("flops_per_step")
+            parts.append(
+                f"<tr><td>{_html.escape(str(p.get('program', '?')))}</td>"
+                f"<td>{p.get('steps', 1)}</td>"
+                f"<td>{(p.get('argument_bytes', 0) or 0) / 2**20:.2f}</td>"
+                f"<td>{(p.get('temp_bytes', 0) or 0) / 2**20:.2f}</td>"
+                f"<td>{(p.get('output_bytes', 0) or 0) / 2**20:.2f}</td>"
+                f"<td>{(p.get('total_bytes', 0) or 0) / 2**20:.2f}</td>"
+                f"<td>{'—' if fps is None else format(fps / 1e9, '.3f')}"
+                f"</td></tr>")
+        parts.append("</table>")
+    if oom_events:
+        parts.append(
+            f"<h3>OOM events ({len(oom_events)})</h3><table>"
+            f"<tr><th>program</th><th>step</th><th>epoch</th>"
+            f"<th>live arrays</th><th>live MiB</th><th>devices</th>"
+            f"</tr>")
+        for r in oom_events[-10:]:
+            devs = "; ".join(
+                f"{d.get('device', '?')}: "
+                f"{(d.get('bytes_in_use', 0) or 0) / 2**20:.1f} MiB"
+                + (f"/{d.get('bytes_limit', 0) / 2**20:.0f}"
+                   if d.get("bytes_limit") else "")
+                for d in (r.get("devices") or [])[:4]) or "—"
+            lb = r.get("live_bytes")
+            parts.append(
+                f"<tr><td>{_html.escape(str(r.get('program', '?')))}</td>"
+                f"<td>{r.get('step', '—')}</td>"
+                f"<td>{r.get('epoch', '—')}</td>"
+                f"<td>{r.get('live_arrays', '—')}</td>"
+                f"<td>{'—' if lb is None else format(lb / 2**20, '.1f')}"
+                f"</td><td>{_html.escape(devs)}</td></tr>")
+        parts.append("</table><p>device memory exhausted — forensics "
+                     "in the faults records (docs/observability.md "
+                     "\"OOM forensics\")</p>")
 
     # -- layer health: in-graph tensorstats (monitor/tensorstats.py) -----
     if tensorstats:

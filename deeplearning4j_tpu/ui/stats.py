@@ -15,7 +15,26 @@ Storage format: JSON-lines, one record per event
     {"type": "perf",   "iter": i, "batches_per_sec": x, ...}
     {"type": "params", "epoch": e, "params": {name: {mean, std, norm,
         hist, edges, update_norm, update_ratio}}}
-    {"type": "memory", "epoch": e, "bytes_in_use": n, "peak_bytes": n}
+    {"type": "memory", "t": wall, "epoch": e, "iteration": i,
+        "source": "flush"|"serving"|"probe"|"epoch",
+        "bytes_in_use": n, "peak_bytes": n, "bytes_limit": n,
+        "headroom": n, "devices": [{device, bytes_in_use, peak_bytes,
+        bytes_limit, source, skipped_arrays}], "tracked": {tag: bytes},
+        "tracked_counts": {tag: n}, "live_skipped": n}
+        (HBM telemetry sampled at listener flush / serving batch
+        boundaries — monitor/memstats.memory_record; the short form
+        without devices/tracked comes from StatsListener's per-epoch
+        sample. Rendered as the report's Memory panel,
+        docs/observability.md)
+    {"type": "memory_plan", "t": wall, "program": "window_k8",
+        "sig": s, "steps": k, "argument_bytes": n, "temp_bytes": n,
+        "output_bytes": n, "generated_code_bytes": n, "alias_bytes": n,
+        "total_bytes": n, "flops": f, "flops_per_step": f,
+        "bytes_accessed": f}
+        (one compiled executable's static memory & compute plan —
+        compiled.memory_analysis()/cost_analysis() captured at AOT
+        precompile / serving warmup / monitored lazy compiles,
+        monitor/memstats.py)
     {"type": "serving", "t": wall, "counters": {...},
         "failure_causes": {cause: n}, "timeout_causes": {cause: n},
         "last_error": {kind, cause, error, t} | null, "latency_ms":

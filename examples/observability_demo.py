@@ -111,6 +111,20 @@ def main():
                 line.startswith("dl4j_steptime_steps"):
             print(f"  {line}")
 
+    # -- HBM memory telemetry (monitor/memstats.py) ---------------------
+    mem = storage.of_type("memory")
+    last_mem = mem[-1]
+    print(f"memory: {len(mem)} samples at flush boundaries; "
+          f"{last_mem['bytes_in_use'] / 2**20:.1f} MiB in use across "
+          f"{len(last_mem['devices'])} device(s), tagged transfers "
+          f"{ {t: f'{b / 2**20:.1f}MiB' for t, b in last_mem['tracked'].items()} }")
+    from deeplearning4j_tpu.monitor import memstats
+    for plan in memstats.PLANS.plans():
+        print(f"  plan {plan.label}: args "
+              f"{(plan.argument_bytes or 0) / 2**20:.2f} MiB, temps "
+              f"{(plan.temp_bytes or 0) / 2**20:.2f} MiB, "
+              f"{(plan.flops_per_step or 0) / 1e6:.1f} MFLOPs/step")
+
     # -- the live endpoint: scrape the running process ------------------
     server = monitor.server
     with urllib.request.urlopen(server.url + "/metrics", timeout=10) as r:
@@ -123,6 +137,11 @@ def main():
         health = json.loads(r.read())
     print(f"live /healthz: fault_state={health['fault_state']}, "
           f"last step age {health['last_step_age_s']}s")
+    with urllib.request.urlopen(server.url + "/memory", timeout=10) as r:
+        mem_probe = json.loads(r.read())
+    print(f"live /memory: {mem_probe['bytes_in_use'] / 2**20:.1f} MiB "
+          f"in use, {len(mem_probe['plans'])} program plan(s), active "
+          f"program {mem_probe['active_program']}")
 
     # -- artifacts ------------------------------------------------------
     trace_path = TRACER.write_chrome_trace(
@@ -139,6 +158,8 @@ def main():
 
     assert storage.of_type("steptime") and storage.of_type("metrics")
     assert storage.of_type("tensorstats") and layer_series
+    assert mem and last_mem["devices"]
+    assert mem_probe["plans"], "no program memory plans captured"
     assert health["healthy"] is True
     assert any(s.name == "window" for s in TRACER.spans())
     assert np.isfinite(history.final_loss())
