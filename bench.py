@@ -120,7 +120,7 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
 
 
 def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
-                  seed=0, tensorstats=None):
+                  seed=0, tensorstats=None, analyze=True):
     """The BASELINE config-2 MLP graph (784 -> hidden -> 10, softmax CE,
     Adam 1e-3) — shared by bench_samediff_mlp and the cold-start child
     probe so the restart metric measures the same program the throughput
@@ -148,7 +148,8 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
                .data_set_feature_mapping("x")
                .data_set_label_mapping("labels")
                .fused_steps(fused_steps)
-               .sentinel(sentinel))
+               .sentinel(sentinel)
+               .analyze(analyze))
     if tensorstats is not None:
         builder.tensorstats(tensorstats)
     sd.training_config = builder.build()
@@ -158,7 +159,7 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                        fused_steps=1, sentinel=False,
                        monitor_storage=None, tensorstats=None,
-                       monitor_memory=True):
+                       monitor_memory=True, analyze=True):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
     give the listener-path variant (see bench_lenet); ``sentinel`` arms
@@ -171,7 +172,8 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
 
     rng = np.random.default_rng(0)
     sd = _build_mlp_sd(hidden=hidden, fused_steps=fused_steps,
-                       sentinel=sentinel, tensorstats=tensorstats)
+                       sentinel=sentinel, tensorstats=tensorstats,
+                       analyze=analyze)
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     n = 2048
@@ -256,6 +258,39 @@ def bench_tensorstats_overhead(batch=128, fused_steps=8, repeats=2):
             if best[True] else 0.0,
             "tensorstats_overhead_pct": round(overhead, 2),
             "every_n": cfg.every_n, "families": list(cfg.families),
+            "batch": batch, "fused_steps": fused_steps}
+
+
+def bench_analyze_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the pre-compile static analyzer (analyze/,
+    docs/static_analysis.md) on the warm dispatch path: the
+    fused-window listener config with TrainingConfig.analyze on vs
+    off. The analysis runs ONCE per graph version, before the first
+    compile — warm fits pay a cache-key dict lookup — so the bar is
+    ~0% (noise). The one-time analysis wall cost is reported
+    separately (analysis_seconds). Same best-of-``repeats``
+    interleaved estimator as the other rail probes."""
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            r = bench_samediff_mlp(batch=batch, listener=True,
+                                   fused_steps=fused_steps,
+                                   analyze=flag)
+            best[flag] = max(best[flag], r["samples_per_sec"])
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    # the one-time pre-compile cost, measured directly
+    from deeplearning4j_tpu.analyze import analyze_training
+    sd = _build_mlp_sd(fused_steps=fused_steps)
+    rep = analyze_training(sd, has_listeners=True)
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_analyze_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "analyze_overhead_pct": round(overhead, 2),
+            "analysis_seconds": round(rep.seconds, 4),
+            "rules_run": rep.rules_run,
+            "findings": sum(rep.counts().values()),
             "batch": batch, "fused_steps": fused_steps}
 
 
@@ -726,6 +761,10 @@ def main():
                      # records + plan capture + MFU gauge, ≤2% bar) +
                      # the hbm_peak/plan-bytes trajectory for BENCH_r08+
                      ("memory_overhead", bench_memory_overhead),
+                     # the static analyzer's warm-path cost (~0: it
+                     # runs once per graph version, pre-compile) +
+                     # its one-time wall seconds (analyze/)
+                     ("analyze_overhead", bench_analyze_overhead),
                      # the observability rail's cost + the step-time
                      # breakdown (where fused listener-path wall time
                      # goes), emitted into BENCH_r*.json going forward

@@ -1,0 +1,168 @@
+"""analyze/ — pre-compile static analysis of SameDiff graphs and
+TrainingConfigs.
+
+Reference parity: DL4J's ``OpValidation`` + SameDiff shape-inference
+checks (PAPER.md layer map L3) front-load graph validation so user
+errors surface as named diagnostics instead of native-runtime crashes.
+Here the native runtime is XLA: a wrong shape, a bf16 accumulation, or
+a ShardingSpec that cannot bind otherwise dies inside jit with a
+traceback naming none of the user's variables. The analyzer walks the
+graph + config **without compiling or executing** — abstract
+``jax.eval_shape`` per op, pure config checks — and emits structured
+:class:`Finding`\\ s (rule id, severity, variable/op provenance, fix
+hint).
+
+Entry points:
+
+- ``SameDiff.fit()`` / ``SameDiff.precompile()`` run
+  :func:`analyze_training` automatically (``TrainingConfig.analyze``:
+  ``True`` = warn on errors and proceed, ``"strict"`` = raise
+  :class:`GraphAnalysisError` before any compile, ``False`` = off);
+- ``ParallelInference(analyze=...)`` runs :func:`analyze_inference`
+  over the serving graph at construction;
+- ``python -m deeplearning4j_tpu.analyze model.zip`` lints a
+  serialized model + config from the command line;
+- findings publish as ``{"type": "analysis"}`` records
+  (``AnalysisReport.to_record``) rendered by ui/report's "Static
+  analysis" panel and folded into ``dl4j_analysis_*`` metrics.
+
+Rule catalog + severities + the strict-mode contract:
+docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.analyze.findings import (RULES, SEVERITIES,
+                                                 AnalysisReport, Finding,
+                                                 GraphAnalysisError,
+                                                 GraphAnalysisWarning,
+                                                 Rule, finding)
+from deeplearning4j_tpu.analyze import configpass, graphpass, numerics
+
+
+def _graph_size(sd):
+    return len(sd._vars), len(sd._ops)
+
+
+#: rules the inference (serving) analysis actually runs — no config
+#: rules (no TrainingConfig), no loss/dead-loss/CE-tail checks (a
+#: serving graph legitimately leaves its training half unreached).
+#: rules_run in a report counts EXECUTED rules, not the catalog.
+_INFERENCE_RULES = frozenset({
+    "graph.shape_mismatch", "graph.undefined_input",
+    "graph.unused_placeholder", "graph.name_shadowing",
+    "graph.state_alias", "numerics.lowp_loss_accum",
+    "numerics.lowp_reduction", "numerics.unguarded_log",
+    "numerics.unguarded_div"})
+
+_CONFIG_RULES = frozenset(r for r in RULES if r.startswith("config."))
+
+
+def analyze_training(sd, tc=None, has_listeners: Optional[bool] = None,
+                     device_count: Optional[int] = None,
+                     batch_size: Optional[int] = None,
+                     context: str = "fit") -> AnalysisReport:
+    """Full analysis of a training graph + config: shape/dtype
+    inference over the loss subgraph, graph hygiene, numerics hazards
+    under the config's MixedPrecision policy, and config/composition
+    lint. Never compiles, never touches a device.
+
+    ``has_listeners`` is the fit-context bit (None = unknown, e.g.
+    precompile) consulted by the tensorstats-unobserved knob check;
+    ``device_count`` bounds the sharding checks (None = skip the
+    device-divisibility half)."""
+    t0 = _time.perf_counter()
+    tc = tc if tc is not None else sd.training_config
+    report = AnalysisReport(context=context)
+    report.n_vars, report.n_ops = _graph_size(sd)
+    # executed-rule count, not the catalog size: with no config the 8
+    # config rules are skipped, and claiming they ran would read as
+    # "config lint passed" on a record where it never executed
+    report.rules_run = len(RULES) - (len(_CONFIG_RULES)
+                                     if tc is None else 0)
+
+    # resolve the analysis outputs the way the train step will
+    loss_names: Sequence[str] = ()
+    try:
+        loss_names = sd._resolve_loss()
+    except ValueError as e:
+        report.add(finding(
+            "graph.invalid_loss", "loss_variables", str(e),
+            fix_hint="set_loss_variables() before training"))
+    outputs = tuple(loss_names) + tuple(sd._state_updates.values())
+    if not outputs:
+        outputs = tuple(sd.outputs())
+
+    mp = getattr(tc, "mixed_precision", None) if tc is not None else None
+    facts = graphpass.infer_avals(sd, outputs, batch_size=batch_size)
+    report.extend(facts.findings)
+    if mp is not None:
+        # a second, policy-cast walk: the dtypes XLA will actually run
+        # (shape findings come from the natural walk only — the policy
+        # walk exists for the numerics pass)
+        policy_facts = graphpass.infer_avals(
+            sd, outputs, compute_dtype=mp.compute_dtype,
+            softmax_dtype=getattr(mp, "softmax_dtype", None),
+            batch_size=batch_size)
+    else:
+        policy_facts = facts
+
+    report.extend(graphpass.check_loss_variables(sd, facts, loss_names))
+    report.extend(graphpass.check_placeholder_hygiene(sd, facts))
+    report.extend(graphpass.check_dead_ops(sd, facts))
+    report.extend(graphpass.check_state_updates(sd, facts))
+
+    report.extend(numerics.check_lowp_accumulation(sd, policy_facts))
+    report.extend(numerics.check_nonfinite_prone(sd, facts))
+    report.extend(numerics.check_ce_tail_policy(sd, policy_facts, mp))
+
+    if tc is not None:
+        report.extend(configpass.check_mappings(sd, facts, tc))
+        report.extend(configpass.check_cadence(tc))
+        report.extend(configpass.check_sharding(sd, tc, device_count))
+        report.extend(configpass.check_knobs(tc, has_listeners))
+
+    report.seconds = _time.perf_counter() - t0
+    return report
+
+
+def analyze_inference(sd, outputs: Optional[Sequence[str]] = None,
+                      inputs: Optional[Sequence[str]] = None
+                      ) -> AnalysisReport:
+    """Graph-only analysis of an inference graph (the serving path):
+    shape/dtype inference over the requested outputs, hygiene, and the
+    non-finite-prone numerics checks. No config rules — serving has no
+    TrainingConfig — and no dead-loss check: a serving graph sliced
+    out of a training graph legitimately leaves its loss machinery
+    unreached. ``inputs`` scopes the unused-placeholder check to the
+    declared serving inputs (ParallelInference passes its spec's)."""
+    t0 = _time.perf_counter()
+    report = AnalysisReport(context="serving")
+    report.n_vars, report.n_ops = _graph_size(sd)
+    report.rules_run = len(_INFERENCE_RULES)
+    outs = tuple(outputs) if outputs else tuple(sd.outputs())
+    facts = graphpass.infer_avals(sd, outs)
+    report.extend(facts.findings)
+    report.extend(graphpass.check_placeholder_hygiene(
+        sd, facts, restrict_to=inputs))
+    report.extend(graphpass.check_state_updates(sd, facts))
+    report.extend(numerics.check_lowp_accumulation(sd, facts))
+    report.extend(numerics.check_nonfinite_prone(sd, facts))
+    report.seconds = _time.perf_counter() - t0
+    return report
+
+
+def analyze_model(model, **kw) -> AnalysisReport:
+    """Analyze anything graph-shaped: a SameDiff, or a MultiLayerNetwork
+    / ComputationGraph (their training graph + config)."""
+    sd = getattr(model, "samediff", model)
+    if getattr(sd, "training_config", None) is not None:
+        return analyze_training(sd, **kw)
+    return analyze_inference(sd)
+
+
+__all__ = ["RULES", "SEVERITIES", "Rule", "Finding", "finding",
+           "AnalysisReport", "GraphAnalysisError", "GraphAnalysisWarning",
+           "analyze_training", "analyze_inference", "analyze_model"]

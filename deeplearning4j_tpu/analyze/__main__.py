@@ -1,0 +1,76 @@
+"""CLI: lint a serialized SameDiff model (+ its training config).
+
+::
+
+    python -m deeplearning4j_tpu.analyze model.zip            # human text
+    python -m deeplearning4j_tpu.analyze model.zip --json     # one record
+    python -m deeplearning4j_tpu.analyze model.zip --strict   # warns fail
+    python -m deeplearning4j_tpu.analyze --rules              # catalog
+
+Exit codes: 0 clean (or info-only), 1 error-severity findings
+(``--strict``: warn-severity too), 2 usage/load failure. Runs on CPU
+with no compile — safe in CI against any committed model artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analyze",
+        description="pre-compile static analysis of a serialized "
+                    "SameDiff model + training config "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("model", nargs="?",
+                    help="path to a SameDiff .zip (autodiff/serde) or "
+                         "a nn model .zip (nn/model_serde)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the {'type': 'analysis'} record as JSON")
+    ap.add_argument("--strict", action="store_true",
+                    help="warn-severity findings also fail (exit 1)")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="resolve -1 placeholder batch dims to this "
+                         "extent (default: a substitute extent that "
+                         "suppresses batch-dim artifacts)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.analyze import (RULES, analyze_training,
+                                            analyze_inference)
+    if args.rules:
+        for r in RULES.values():
+            print(f"{r.rule_id:<32} {r.severity:<5} {r.summary}")
+        return 0
+    if not args.model:
+        ap.print_usage(sys.stderr)
+        print("error: a model path (or --rules) is required",
+              file=sys.stderr)
+        return 2
+
+    from deeplearning4j_tpu.autodiff import serde
+    try:
+        sd = serde.load(args.model)
+    except Exception as e:
+        print(f"error: cannot load {args.model!r}: {e}", file=sys.stderr)
+        return 2
+    if getattr(sd, "training_config", None) is not None:
+        report = analyze_training(sd, batch_size=args.batch_size)
+    else:
+        report = analyze_inference(sd)
+    report.context = "cli"
+
+    if args.json:
+        print(json.dumps(report.to_record()))
+    else:
+        print(report.render())
+    if report.errors() or (args.strict and report.warnings()):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""Config/composition lint: TrainingConfig knobs checked against the
+graph and the device topology BEFORE anything compiles.
+
+Every rule here encodes a constraint that today only surfaces at
+dispatch time (or never): feature/label mappings that cannot feed the
+graph, the fused/accum cadence alignment documented in
+docs/training_performance.md, donated buffers read after the step,
+ShardingSpecs that cannot bind (via the pure
+``ShardingSpec.validate`` — shared with ``build()``), sharding rules
+that match nothing, and armed chaos/tensorstats knobs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+from deeplearning4j_tpu.analyze.findings import Finding, finding
+from deeplearning4j_tpu.analyze.graphpass import GraphFacts
+
+
+def check_mappings(sd, facts: GraphFacts, tc) -> List[Finding]:
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    out: List[Finding] = []
+    feats = list(getattr(tc, "data_set_feature_mapping", ()) or ())
+    labels = list(getattr(tc, "data_set_label_mapping", ()) or ())
+    for field, names in (("data_set_feature_mapping", feats),
+                         ("data_set_label_mapping", labels)):
+        for n in names:
+            v = sd._vars.get(n)
+            if v is None:
+                out.append(finding(
+                    "config.mapping_unknown", f"{field}:{n}",
+                    f"{field} names {n!r}, which is not in the graph",
+                    fix_hint="map the placeholder names the graph "
+                             "declares"))
+            elif v.var_type != VariableType.PLACEHOLDER:
+                out.append(finding(
+                    "config.mapping_unknown", f"{field}:{n}",
+                    f"{field} names {n!r}, a {v.var_type.value} — "
+                    f"feeding it would shadow the stored value",
+                    fix_hint="map a PLACEHOLDER; convert the variable "
+                             "if it was meant to be fed"))
+    if feats or labels:
+        mapped = set(feats) | set(labels)
+        consumed: Set[str] = set()
+        for opn in facts.live_ops:
+            consumed.update(sd._ops[opn].inputs)
+        for ph in sd.placeholders():
+            if ph in consumed and ph not in mapped:
+                out.append(finding(
+                    "config.mapping_incomplete", ph,
+                    f"placeholder {ph!r} feeds the loss but is in "
+                    f"neither feature nor label mapping — tuple "
+                    f"batches cannot supply it",
+                    fix_hint="add it to a mapping, or fit with dict "
+                             "batches keyed by placeholder name"))
+    return out
+
+
+def check_cadence(tc) -> List[Finding]:
+    fused = max(1, int(getattr(tc, "fused_steps", 1) or 1))
+    accum = max(1, int(getattr(tc, "accum_steps", 1) or 1))
+    if accum > 1 and fused % accum != 0:
+        return [finding(
+            "config.cadence_misalignment",
+            f"fused_steps={fused}/accum_steps={accum}",
+            f"fused_steps={fused} is not a multiple of "
+            f"accum_steps={accum}: window boundaries land "
+            f"mid-accumulation-cycle, so checkpoint flushes cannot "
+            f"capture the partial accumulator and a rollback restarts "
+            f"that cycle from zeros",
+            fix_hint="keep fused_steps a multiple of accum_steps "
+                     "(docs/training_performance.md, "
+                     "docs/fault_tolerance.md)")]
+    return []
+
+
+def check_sharding(sd, tc, device_count: Optional[int]) -> List[Finding]:
+    spec = getattr(tc, "sharding", None)
+    if spec is None:
+        return []
+    if not hasattr(spec, "validate"):
+        # a live ShardingStrategy on the config: its mesh already bound
+        spec = spec.to_spec() if hasattr(spec, "to_spec") else None
+        if spec is None:
+            return []
+    out: List[Finding] = []
+    params = {n: tuple(a.shape)
+              for n, a in sd.trainable_params().items()}
+    try:
+        spec.validate(params=params, device_count=device_count)
+    except ValueError as e:
+        out.append(finding(
+            "config.sharding_invalid", "TrainingConfig.sharding",
+            str(e),
+            fix_hint="ShardingSpec axes must multiply into the device "
+                     "count and divide every matched parameter dim "
+                     "(docs/elastic_training.md)"))
+    for rule in getattr(spec, "rules", ()) or ():
+        if not any(rule.matches(n) for n in params):
+            out.append(finding(
+                "config.sharding_unmatched_rule", rule.pattern,
+                f"ShardingRule {rule.pattern!r} matches zero of the "
+                f"{len(params)} parameters — the intended layout "
+                f"silently degrades to the preset/replication",
+                fix_hint="check the pattern against "
+                         "sd.trainable_params() names"))
+    return out
+
+
+def check_knobs(tc, has_listeners: Optional[bool]) -> List[Finding]:
+    out: List[Finding] = []
+    if getattr(tc, "_chaos_spec", None) is not None:
+        out.append(finding(
+            "config.chaos_armed", "TrainingConfig._chaos_spec",
+            "a faults/chaos injection spec is armed on this config — "
+            "deterministic faults (NaN gradients, poisoned batches) "
+            "will fire during this fit",
+            fix_hint="chaos specs are for drills; clear the spec for "
+                     "production fits"))
+    if getattr(tc, "tensorstats", None) is not None \
+            and has_listeners is False:
+        out.append(finding(
+            "config.tensorstats_unobserved", "TrainingConfig.tensorstats",
+            "tensorstats is configured but this fit has no listeners: "
+            "the stats are silently skipped, and attaching listeners "
+            "later retraces the step program (a second compiled "
+            "signature)",
+            fix_hint="attach a MonitorListener/StatsListener, or drop "
+                     "tensorstats for listener-free fits"))
+    return out
+
+
+__all__ = ["check_mappings", "check_cadence", "check_sharding",
+           "check_knobs"]

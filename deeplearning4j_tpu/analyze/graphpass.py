@@ -1,0 +1,392 @@
+"""Graph passes: abstract shape/dtype inference + hygiene.
+
+The core is :func:`infer_avals` — an abstract interpretation of the
+recorded op order. Each op body runs under ``jax.eval_shape`` over the
+inputs' ``ShapeDtypeStruct``s, so the walk costs microseconds per op,
+never compiles, and never touches a device. Where the reference runs
+per-op C++ ``calculateOutputShapes`` (NativeOps.h), here the op body
+itself IS the shape function.
+
+Unknowns are tracked honestly: placeholders with ``-1`` batch dims get
+a substitute extent and TAINT everything downstream — an eval failure
+on tainted inputs is an artifact of the fake dim, not a user bug, and
+produces no finding (the same contract SameDiff.infer_shape keeps).
+Ops whose attrs need concrete tensor values (tf_compat reshape et al.)
+mark their outputs unknown and the walk continues.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.analyze.findings import Finding, finding
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.ops import registry
+
+#: substitute extents for unknown (-1) placeholder dims. Two walks with
+#: DIFFERENT extents separate real shape errors from artifacts of the
+#: substitution: a genuine mismatch (784-dim features into a 300-row
+#: kernel) fails at both extents, while a failure that only exists at
+#: one extent depended on the fake dim and is suppressed. Both are
+#: highly composite so stride/pool/head-split ops divide cleanly.
+FAKE_BATCH = 8
+FAKE_BATCH_CONFIRM = 12
+
+_LOWP = (jnp.bfloat16, jnp.float16)
+
+
+@dataclasses.dataclass
+class GraphFacts:
+    """What the abstract walk learned — shared by the numerics and
+    config passes so each graph is interpreted ONCE."""
+    env: Dict[str, Optional[jax.ShapeDtypeStruct]]  # None = unknown
+    tainted: Set[str]          # shapes involve substituted batch dims
+    live_ops: List[str]        # pruned topo order for the outputs
+    outputs: Tuple[str, ...]
+    findings: List[Finding]
+    #: tainted-failure candidates awaiting second-extent confirmation
+    _deferred: Dict[str, Finding] = dataclasses.field(default_factory=dict)
+
+
+def _aval_str(av) -> str:
+    if av is None:
+        return "?"
+    return f"{tuple(av.shape)} {av.dtype}"
+
+
+def provenance_chain(sd, names: Sequence[str], env, depth: int = 3
+                     ) -> List[str]:
+    """Producer chains for ``names``: each line walks var <- op(...)
+    up to ``depth`` hops, with the inferred shape/dtype inline — the
+    part of a diagnostic that names the USER's variables."""
+    lines = []
+    for name in names:
+        hops = []
+        cur = name
+        for _ in range(depth):
+            av = env.get(cur)
+            v = sd._vars.get(cur)
+            kind = v.var_type.value if v is not None else "?"
+            hops.append(f"{cur} [{kind} {_aval_str(av)}]")
+            prod = sd._producer.get(cur)
+            if prod is None:
+                break
+            node = sd._ops[prod]
+            hops.append(f"op {prod}({node.op})")
+            cur = node.inputs[0] if node.inputs else None
+            if cur is None:
+                break
+        lines.append("<- ".join(hops))
+    return lines
+
+
+def _aval(shape, dtype, weak_type=False):
+    """ShapeDtypeStruct preserving ``weak_type`` — a weakly-typed
+    stored constant (``sd.constant(0.17)`` under x64) promotes to its
+    partner's dtype at runtime; dropping the flag would make the walk
+    see a strong f64 and report promotion mismatches the real trace
+    never has."""
+    return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                weak_type=bool(weak_type))
+
+
+def _cast_aval(av, dtype):
+    wt = getattr(av, "weak_type", False)
+    if dtype is not None and jnp.issubdtype(av.dtype, jnp.floating):
+        return _aval(av.shape, jnp.dtype(dtype), wt)
+    return _aval(av.shape, av.dtype, wt)
+
+
+def infer_avals(sd, outputs: Sequence[str],
+                compute_dtype=None, softmax_dtype=None,
+                batch_size: Optional[int] = None) -> GraphFacts:
+    """Walk the pruned subgraph for ``outputs`` abstractly.
+
+    ``compute_dtype`` mirrors the MixedPrecision cast the train step
+    applies at the top of its trace (params/constants/placeholders cast
+    to the compute dtype, state vars stay f32) so the numerics pass
+    sees the dtypes XLA will actually run. ``softmax_dtype`` activates
+    the CE-tail scope the same way ``_build_step_parts`` does.
+
+    With ``batch_size=None``, ``-1`` placeholder dims get a substitute
+    extent; an eval failure downstream of one is only reported after a
+    second walk at a DIFFERENT extent reproduces it (see FAKE_BATCH)."""
+    facts = _walk(sd, outputs, compute_dtype, softmax_dtype,
+                  FAKE_BATCH if batch_size is None else int(batch_size),
+                  taint_fakes=batch_size is None)
+    if batch_size is None and facts._deferred:
+        confirm = _walk(sd, outputs, compute_dtype, softmax_dtype,
+                        FAKE_BATCH_CONFIRM, taint_fakes=True)
+        for opn, f in facts._deferred.items():
+            if opn in confirm._deferred:
+                facts.findings.append(f)
+    return facts
+
+
+def _walk(sd, outputs: Sequence[str], compute_dtype, softmax_dtype,
+          bsz: int, taint_fakes: bool) -> GraphFacts:
+    import contextlib
+
+    findings: List[Finding] = []
+    env: Dict[str, Optional[jax.ShapeDtypeStruct]] = {}
+    tainted: Set[str] = set()
+    deferred: Dict[str, Finding] = {}
+
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    for name, v in sd._vars.items():
+        if name in sd._arrays:
+            a = sd._arrays[name]
+            av = _aval(a.shape, a.dtype, getattr(a, "weak_type", False))
+            if compute_dtype is not None and \
+                    name not in sd._state_var_names:
+                av = _cast_aval(av, compute_dtype)
+            env[name] = av
+        elif v.var_type == VariableType.PLACEHOLDER:
+            shp = v._shape
+            if shp is None:
+                env[name] = None
+                continue
+            if any(d == -1 for d in shp):
+                if taint_fakes:
+                    tainted.add(name)
+                shp = tuple(bsz if d == -1 else d for d in shp)
+            av = jax.ShapeDtypeStruct(
+                tuple(shp), DataType.from_any(v.dtype).jnp)
+            env[name] = _cast_aval(av, compute_dtype)
+
+    if softmax_dtype is not None:
+        from deeplearning4j_tpu.ops.loss import softmax_dtype_scope
+        scope = lambda: softmax_dtype_scope(softmax_dtype)
+    else:
+        scope = contextlib.nullcontext
+
+    key = jax.random.key(0)       # concrete; only its aval matters
+    live = sd._prune(tuple(outputs))
+    for idx, node in enumerate(live):
+        try:
+            o = registry.get_op(node.op)
+        except KeyError as e:
+            findings.append(finding(
+                "graph.undefined_input", node.name, str(e),
+                fix_hint="the op name is not in the registry — a "
+                         "corrupted/hand-edited graph?"))
+            for on in node.outputs:
+                env[on] = None
+            continue
+        missing = [i for i in node.inputs if i not in env]
+        if missing:
+            findings.append(finding(
+                "graph.undefined_input", node.name,
+                f"op {node.name!r} ({node.op}) consumes "
+                f"{missing} which no variable or op defines",
+                fix_hint="declare the variable/placeholder, or fix the "
+                         "op's input list",
+                provenance=provenance_chain(
+                    sd, [i for i in node.inputs if i in env], env)))
+            for on in node.outputs:
+                env[on] = None
+            continue
+        in_avals = [env[i] for i in node.inputs]
+        node_taint = any(i in tainted for i in node.inputs)
+        if node_taint:
+            tainted.update(node.outputs)
+        if any(a is None for a in in_avals):
+            for on in node.outputs:
+                env[on] = None
+            continue
+        attrs = dict(node.attrs)
+        if node.random:
+            attrs["key"] = jax.random.fold_in(key, idx)
+        try:
+            with scope():
+                res = jax.eval_shape(
+                    lambda *a, _fn=o.fn, _at=attrs: _fn(*a, **_at),
+                    *in_avals)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # structural-tensor attrs need concrete values the abstract
+            # tracer can't provide — genuinely uninferable, not a bug
+            for on in node.outputs:
+                env[on] = None
+            continue
+        except (TypeError, ValueError) as e:
+            for on in node.outputs:
+                env[on] = None
+            ins = ", ".join(f"{n}={_aval_str(a)}"
+                            for n, a in zip(node.inputs, in_avals))
+            f = finding(
+                "graph.shape_mismatch", node.name,
+                f"op {node.name!r} ({node.op}) cannot compose its "
+                f"inputs ({ins}): {e}",
+                fix_hint="check the named producer shapes below — the "
+                         "mismatch is in the graph, not in XLA",
+                provenance=provenance_chain(sd, node.inputs, env))
+            if node_taint:
+                # downstream of a substituted batch extent: report only
+                # if the failure reproduces at a second extent (the
+                # caller's confirmation walk)
+                deferred[node.name] = f
+            else:
+                findings.append(f)
+            continue
+        except Exception:
+            # an op body that fails abstract eval for exotic reasons is
+            # unknown, not a user-facing finding (no false positives)
+            for on in node.outputs:
+                env[on] = None
+            continue
+        results = list(res) if isinstance(res, (tuple, list)) else [res]
+        for on, r in zip(node.outputs, results):
+            env[on] = _aval(r.shape, r.dtype,
+                            getattr(r, "weak_type", False)) \
+                if hasattr(r, "shape") else None
+
+    facts = GraphFacts(env=env, tainted=tainted,
+                       live_ops=[n.name for n in live],
+                       outputs=tuple(outputs), findings=findings)
+    facts._deferred = deferred
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# hygiene passes over the same facts
+
+def check_loss_variables(sd, facts: GraphFacts,
+                         loss_names: Sequence[str]) -> List[Finding]:
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    out: List[Finding] = []
+    for ln in loss_names:
+        v = sd._vars.get(ln)
+        if v is None:
+            out.append(finding(
+                "graph.invalid_loss", ln,
+                f"loss variable {ln!r} does not exist in the graph",
+                fix_hint="set_loss_variables() with an op output name"))
+            continue
+        if v.var_type != VariableType.ARRAY:
+            rid = ("config.donation_conflict"
+                   if v.var_type in (VariableType.VARIABLE,
+                                     VariableType.CONSTANT)
+                   else "graph.invalid_loss")
+            out.append(finding(
+                rid, ln,
+                f"loss variable {ln!r} is a {v.var_type.value}, not an "
+                f"op output — it carries no gradient"
+                + (" and its donated buffer is read back after the "
+                   "step invalidates it"
+                   if v.var_type == VariableType.VARIABLE else ""),
+                fix_hint="point the loss at the loss op's output"))
+            continue
+        av = facts.env.get(ln)
+        if av is not None and not jnp.issubdtype(av.dtype, jnp.floating):
+            out.append(finding(
+                "graph.invalid_loss", ln,
+                f"loss variable {ln!r} has dtype {av.dtype} — gradients "
+                f"need a floating loss",
+                fix_hint="cast the loss to float32 before reducing",
+                provenance=provenance_chain(sd, [ln], facts.env)))
+    return out
+
+
+def check_placeholder_hygiene(sd, facts: GraphFacts,
+                              restrict_to: Optional[Sequence[str]] = None
+                              ) -> List[Finding]:
+    """unused_placeholder + name_shadowing over the live subgraph.
+
+    ``restrict_to`` scopes the unused check to a declared input set
+    (the serving contract): a graph sliced out of a training graph
+    legitimately carries the label placeholders of its training half,
+    so only the inputs the caller SAYS it will feed are checked."""
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    out: List[Finding] = []
+    consumed: Set[str] = set()
+    for opn in facts.live_ops:
+        consumed.update(sd._ops[opn].inputs)
+    phs = [n for n, v in sd._vars.items()
+           if v.var_type == VariableType.PLACEHOLDER]
+    check = phs if restrict_to is None else \
+        [p for p in phs if p in set(restrict_to)]
+    for ph in check:
+        if ph not in consumed and ph not in facts.outputs:
+            out.append(finding(
+                "graph.unused_placeholder", ph,
+                f"placeholder {ph!r} is not consumed by any op "
+                f"contributing to outputs {list(facts.outputs)}",
+                fix_hint="remove it, or wire it into the graph — data "
+                         "fed to it is silently dropped"))
+    ph_set = set(phs)
+    for ph in phs:
+        base, _, suffix = ph.rpartition("_")
+        if base and suffix.isdigit() and base in ph_set:
+            out.append(finding(
+                "graph.name_shadowing", ph,
+                f"placeholder {ph!r} was auto-renamed from {base!r} "
+                f"(both exist) — feeds keyed {base!r} reach only the "
+                f"first",
+                fix_hint="give each placeholder a distinct explicit "
+                         "name"))
+    return out
+
+
+def check_dead_ops(sd, facts: GraphFacts) -> List[Finding]:
+    """Dead subgraphs, scoped to the high-signal case: a recorded
+    LOSS-category op contributing to none of the requested outputs is
+    near-certainly a forgotten ``loss_variables`` entry — the penalty
+    term trains nothing, silently. (Generic dead ops are usually the
+    benign inference head — e.g. the softmax activation a training
+    graph prunes but ``output(training=True)`` still fetches — so they
+    are not reported.)"""
+    live = set(facts.live_ops)
+    out: List[Finding] = []
+    for opn in sd._op_order:
+        if opn in live:
+            continue
+        node = sd._ops[opn]
+        try:
+            o = registry.get_op(node.op)
+        except KeyError:
+            continue
+        if o.category == "loss":
+            out.append(finding(
+                "graph.dead_op", opn,
+                f"loss op {opn!r} ({node.op}) contributes to none of "
+                f"the requested outputs {list(facts.outputs)} — the "
+                f"penalty is computed nowhere and trains nothing",
+                fix_hint="add its output to set_loss_variables(), or "
+                         "remove the op"))
+    return out
+
+
+def check_state_updates(sd, facts: GraphFacts) -> List[Finding]:
+    from deeplearning4j_tpu.autodiff.variable import VariableType
+    out: List[Finding] = []
+    for sv, src in sd._state_updates.items():
+        if src not in sd._vars:
+            out.append(finding(
+                "graph.state_alias", sv,
+                f"state var {sv!r} updates from {src!r}, which does "
+                f"not exist",
+                fix_hint="update_state() with an op output"))
+        elif src == sv:
+            out.append(finding(
+                "graph.state_alias", sv,
+                f"state var {sv!r} updates from itself — the update "
+                f"is a no-op",
+                fix_hint="point the update at the op computing the "
+                         "new statistics"))
+        elif sd._vars[src].var_type == VariableType.PLACEHOLDER:
+            out.append(finding(
+                "graph.state_alias", sv,
+                f"state var {sv!r} updates from placeholder {src!r} — "
+                f"raw fed data would overwrite the running statistics",
+                fix_hint="update from the op output that folds the "
+                         "batch statistics in"))
+    return out
+
+
+__all__ = ["GraphFacts", "infer_avals", "provenance_chain",
+           "check_loss_variables", "check_placeholder_hygiene",
+           "check_dead_ops", "check_state_updates", "FAKE_BATCH"]

@@ -91,6 +91,12 @@ class SameDiff:
         self.training_config = None
         self._updater_state = None
         self._seed = 0
+        # pre-compile static analysis (analyze/): the last
+        # AnalysisReport fit()/precompile() produced, plus the cache
+        # key (graph version + context) that makes repeat fits pay a
+        # dict lookup, not a re-analysis
+        self.last_analysis = None
+        self._analysis_key = None
         # dispatch/compile accounting of the most recent fit() epoch
         # (tier, dispatches_per_epoch, window sizes/compiles) — consumed
         # by ui/stats StatsListener and bench.py
@@ -1194,6 +1200,91 @@ class SameDiff:
         return compiled
 
     # ------------------------------------------------------------------
+    # pre-compile static analysis (analyze/ — docs/static_analysis.md)
+    def _maybe_analyze(self, has_listeners=None, context="fit"):
+        """Run the static analyzer per ``TrainingConfig.analyze``
+        (True = warn on error findings and proceed; "strict" = raise
+        GraphAnalysisError BEFORE any compile; False = off). Cached on
+        the graph version + fit context, so only the first fit of a
+        given graph pays the walk — warm dispatches see a dict lookup
+        (bench.py analyze_overhead)."""
+        tc = self.training_config
+        mode = getattr(tc, "analyze", True) if tc is not None else False
+        if not mode:
+            return None
+        # content fingerprint, not id(tc): the config is mutable and
+        # the common pattern is in-place mutation (tc.sharding = ...,
+        # fused_steps set by fit kwargs) — an identity key would serve
+        # a stale clean report for exactly the knob that changed.
+        # loss_variables rides the key too: set_loss_variables does
+        # not bump the graph version.
+        key = (self._version, has_listeners,
+               tuple(self.loss_variables), self._tc_fingerprint(tc))
+        if self._analysis_key == key and self.last_analysis is not None:
+            report = self.last_analysis
+            fresh = False
+        else:
+            from deeplearning4j_tpu.analyze import analyze_training
+            # a cache hit keeps the first producer's context — only a
+            # FRESH analysis stamps the entry point that ran it
+            report = analyze_training(self, tc,
+                                      has_listeners=has_listeners,
+                                      device_count=jax.device_count(),
+                                      context=context)
+            self.last_analysis = report
+            self._analysis_key = key
+            fresh = True
+            self._verbose_log(
+                f"static analysis ({report.context}): "
+                + ", ".join(f"{n} {s}"
+                            for s, n in report.counts().items())
+                + f" in {report.seconds:.3f}s")
+        errs = report.errors()
+        if errs:
+            # strict enforcement applies on EVERY call — a cached
+            # report of a still-broken graph must keep refusing, not
+            # just the fit that first analyzed it
+            if str(mode).lower() == "strict":
+                report.raise_if_errors()
+            if fresh:
+                from deeplearning4j_tpu.analyze import \
+                    GraphAnalysisWarning
+                import warnings as _warnings
+                _warnings.warn(
+                    f"static analysis found {len(errs)} error(s) — "
+                    f"the compile will likely fail; "
+                    f"sd.last_analysis.render() has the located "
+                    f"diagnostics (docs/static_analysis.md):\n"
+                    + "\n".join(f.render() for f in errs[:5]),
+                    GraphAnalysisWarning, stacklevel=3)
+        return report
+
+    @staticmethod
+    def _tc_fingerprint(tc):
+        """Cheap content key of the analysis-relevant TrainingConfig
+        fields (NOT iteration/epoch counters, which advance every
+        fit and would defeat the cache)."""
+        import json as _json
+        mp = getattr(tc, "mixed_precision", None)
+        sh = getattr(tc, "sharding", None)
+        if sh is not None:
+            sh = (sh if hasattr(sh, "to_json") else sh.to_spec()) \
+                .to_json()
+        ts = getattr(tc, "tensorstats", None)
+        return (tuple(getattr(tc, "data_set_feature_mapping", ()) or ()),
+                tuple(getattr(tc, "data_set_label_mapping", ()) or ()),
+                max(1, int(getattr(tc, "fused_steps", 1) or 1)),
+                max(1, int(getattr(tc, "accum_steps", 1) or 1)),
+                None if mp is None
+                else tuple(sorted(mp.to_json().items())),
+                None if sh is None
+                else _json.dumps(sh, sort_keys=True, default=str),
+                (ts.key() if hasattr(ts, "key") else bool(ts))
+                if ts is not None else None,
+                getattr(tc, "_chaos_spec", None) is not None,
+                str(getattr(tc, "analyze", True)))
+
+    # ------------------------------------------------------------------
     # AOT precompilation (compilecache/ — docs/cold_start.md)
     def _placeholder_specs(self, names=None, batch_size=None,
                            batch_shapes=None) -> Dict[str, Any]:
@@ -1262,6 +1353,10 @@ class SameDiff:
                              "graphs)")
         environment().apply_compilation_cache()
         install_compile_watcher()
+        # static analysis gates AOT builds too: a strict config fails
+        # with named diagnostics before paying any lowering/compile
+        # (listener presence unknown at precompile time)
+        self._maybe_analyze(has_listeners=None, context="precompile")
         K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
         A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
         sentinel = bool(getattr(tc, "sentinel", False))
@@ -1462,6 +1557,10 @@ class SameDiff:
         tc = self.training_config
         if tc is None:
             raise ValueError("set sd.training_config = TrainingConfig(...) first")
+        # pre-compile static analysis (analyze/): named diagnostics
+        # BEFORE tier selection, mesh placement, or any XLA compile —
+        # strict mode raises here (docs/static_analysis.md)
+        self._maybe_analyze(has_listeners=bool(listeners))
         if getattr(tc, "sharding", None) is not None:
             # declarative mesh sharding: place params/state on the
             # spec's mesh and pre-shard batches BEFORE tier selection,

@@ -160,6 +160,16 @@ class TrainingConfig:
     # tier with listeners) to deliver {"type": "tensorstats"} records;
     # parameter math is untouched — stats-on training is bit-identical.
     tensorstats: Optional[Any] = None
+    # pre-compile static analysis (analyze/, docs/static_analysis.md):
+    # fit()/precompile() walk the graph + this config WITHOUT compiling
+    # and surface structured findings (shape mismatches with producer
+    # chains, numerics hazards, sharding/cadence/mapping lint). True =
+    # error-severity findings warn (GraphAnalysisWarning) and the fit
+    # proceeds; "strict" = raise GraphAnalysisError BEFORE any XLA
+    # compile; False = off. Analysis runs once per graph version, so
+    # its cost never touches the warm dispatch path (bench.py
+    # analyze_overhead).
+    analyze: Any = True
 
     def __post_init__(self):
         if self.tensorstats is not None:
@@ -223,6 +233,9 @@ class TrainingConfig:
                                else self.sharding.to_spec()).to_json()),
             "tensorstats": (None if self.tensorstats is None
                             else self.tensorstats.to_json()),
+            "analyze": (self.analyze if isinstance(self.analyze,
+                                                   (bool, str))
+                        else bool(self.analyze)),
         }
 
     @staticmethod
@@ -255,6 +268,7 @@ class TrainingConfig:
             sentinel=d.get("sentinel", False),
             sharding=sharding,
             tensorstats=tensorstats,
+            analyze=d.get("analyze", True),
         )
 
     class Builder:
@@ -289,6 +303,10 @@ class TrainingConfig:
             self._kw["sharding"] = spec; return self
         def tensorstats(self, cfg=True):
             self._kw["tensorstats"] = cfg; return self
+        def analyze(self, mode=True):
+            """Pre-compile static analysis: True (warn), "strict"
+            (raise GraphAnalysisError before any compile), False."""
+            self._kw["analyze"] = mode; return self
         def build(self) -> "TrainingConfig":
             return TrainingConfig(**self._kw)
 

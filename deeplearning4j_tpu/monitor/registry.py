@@ -483,6 +483,26 @@ class MetricsRegistry:
                                     "(compiled.cost_analysis)",
                                program=program)
 
+    def fold_analysis(self, record: dict) -> None:
+        """Fold one ``{"type": "analysis"}`` record (analyze/,
+        docs/static_analysis.md) into ``analysis_*`` gauges — the
+        finding counts by severity a dashboard alerts on (a nonzero
+        error gauge means a fit is running against a graph the
+        analyzer would have failed in strict mode), plus the one-time
+        analysis cost."""
+        for sev, n in (record.get("counts") or {}).items():
+            self.set_gauge("analysis_findings", n,
+                           help="static-analysis findings by severity "
+                                "(analyze/)", severity=sev)
+        if record.get("rules_run") is not None:
+            self.set_gauge("analysis_rules_run", record["rules_run"],
+                           help="rules the last static analysis ran")
+        if record.get("seconds") is not None:
+            self.set_gauge("analysis_seconds", record["seconds"],
+                           help="wall seconds of the last static "
+                                "analysis (runs once per graph "
+                                "version, pre-compile)")
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -543,6 +563,8 @@ class MetricsRegistry:
             self.fold_memory(rec)
         elif t == "memory_plan":
             self.fold_memory_plan(rec)
+        elif t == "analysis":
+            self.fold_analysis(rec)
 
 
 __all__ = ["MetricsRegistry"]

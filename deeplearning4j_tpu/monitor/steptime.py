@@ -212,6 +212,12 @@ class MonitorListener:
         # MFU-estimate gauge. memory=False turns the whole rail off.
         self.memory = bool(memory)
         self._published_plans: set = set()
+        # id -> report (the ref pins the object so a recycled id can't
+        # suppress a fresh report's publish); bounded FIFO — a
+        # long-lived listener over many graph versions must not pin
+        # every report forever
+        self._published_analyses: dict = {}
+        self._published_analyses_cap = 32
 
     def reset(self) -> None:
         """Rollback hook (faults/recovery.py resets stateful listeners):
@@ -223,6 +229,20 @@ class MonitorListener:
     # -- listener protocol ----------------------------------------------
     def on_training_start(self, sd) -> None:
         self._mark = self.tracer.mark()
+        # static-analysis findings (analyze/): fit() stores its report
+        # on the graph before listeners start — publish each report
+        # ONCE (repeat fits of the same graph version reuse the cached
+        # report object) and fold through the storage's incremental
+        # fold mark like every other record
+        report = getattr(sd, "last_analysis", None)
+        if report is not None and id(report) not in self._published_analyses:
+            self._published_analyses[id(report)] = report
+            while len(self._published_analyses) > \
+                    self._published_analyses_cap:
+                self._published_analyses.pop(
+                    next(iter(self._published_analyses)))
+            self.storage.put(report.to_record())
+            self.registry.fold_storage(self.storage)
         if self.memory:
             # arm lazy-compile plan capture: a monitored fit's first
             # dispatch per shape compiles through the AOT path (same

@@ -419,10 +419,18 @@ def _build_graph(conf: ComputationGraphConfiguration, training: bool):
             if hasattr(node.op, "loss_function") or \
                     getattr(node.op, "consumes_labels", False):
                 # labels placeholder sized from this head's output type
+                # (heads with a different target layout override via
+                # labels_placeholder_shape — see nn/multilayer.py)
                 otype = node.op.output_type(itype)
                 ln = f"labels_{node.name}"
+                lab_hook = getattr(node.op, "labels_placeholder_shape",
+                                   None)
+                lab_shape = lab_hook(otype) if lab_hook is not None \
+                    else None
                 ctx.labels_var = sd.placeholder(
-                    ln, shape=otype.placeholder_shape(), dtype=conf.dtype)
+                    ln,
+                    shape=lab_shape if lab_shape is not None
+                    else otype.placeholder_shape(), dtype=conf.dtype)
                 labels_of[node.name] = ln
             out, otype = node.op.build(ctx, x, itype)
         else:

@@ -133,6 +133,17 @@ class Yolo2OutputLayer(BaseLayer):
     def output_type(self, itype):
         return itype
 
+    def labels_placeholder_shape(self, otype):
+        """The declared labels layout is (B, 4+C, H, W) — bbox corners
+        + class one-hot — NOT the A*(5+C) prediction grid the generic
+        labels-shaped-like-output fallback would declare (the wrong
+        declaration was caught by the static analyzer: yolo2_loss
+        cannot compose a (…, A*(5+C)) labels tensor)."""
+        c, h, w = otype.dims
+        n_anchors = max(1, len(self.anchors) // 2)
+        n_classes = c // n_anchors - 5
+        return (-1, 4 + n_classes, h, w)
+
     def build(self, ctx, x, itype):
         lname = ctx.lname("yolo2")
         c, h, w = itype.dims

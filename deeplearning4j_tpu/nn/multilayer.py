@@ -148,8 +148,19 @@ def _build_graph(conf: MultiLayerConfiguration, training: bool,
     x = sd.placeholder("input", shape=conf.input_type.placeholder_shape(),
                        dtype=conf.dtype)
     final = _final_output_type(conf)
-    ctx.labels_var = sd.placeholder("labels", shape=final.placeholder_shape(),
-                                    dtype=conf.dtype)
+    # labels default to the head's output shape; heads whose target
+    # layout differs (yolo: (B, 4+C, H, W) vs the A*(5+C) prediction
+    # grid) override via labels_placeholder_shape — a wrong declared
+    # shape is never enforced at feed time, but it poisons shape
+    # inference and the static analyzer (graph.shape_mismatch)
+    lab_hook = getattr(conf.layers[-1] if conf.layers else None,
+                       "labels_placeholder_shape", None)
+    lab_shape = lab_hook(final) if lab_hook is not None else None
+    ctx.labels_var = sd.placeholder(
+        "labels",
+        shape=lab_shape if lab_shape is not None
+        else final.placeholder_shape(),
+        dtype=conf.dtype)
     cur = _to_internal_layout(sd, x, conf.input_type, fmt, "input_nhwc")
     itype = conf.input_type
     for idx, layer in enumerate(conf.layers):

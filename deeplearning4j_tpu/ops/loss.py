@@ -71,13 +71,25 @@ def _reduce_loss(per_ex, weights, reduction: str):
     r = reduction.lower()
     if r == "none":
         return weighted
+    # the reduction to the scalar loss is where bf16 actually loses the
+    # training signal (an 8-bit mantissa stops accumulating once the
+    # running sum is ~256x a term): force an f32 accumulator whenever
+    # the per-example losses arrive in a low-precision dtype — the same
+    # contract the dense softmax-CE vocab sum already keeps
+    acc = jnp.float32 if weighted.dtype in (jnp.bfloat16, jnp.float16) \
+        else None
     if r == "sum":
-        return jnp.sum(weighted)
+        return jnp.sum(weighted, dtype=acc)
     if r in ("mean_by_weight", "weighted_mean"):
-        return jnp.sum(weighted) / jnp.maximum(jnp.sum(w), 1e-12)
+        return jnp.sum(weighted, dtype=acc) / \
+            jnp.maximum(jnp.sum(w, dtype=acc), 1e-12)
     if r in ("mean_by_nonzero_weight", "mean"):
-        nz = jnp.sum((w != 0).astype(per_ex.dtype))
-        return jnp.sum(weighted) / jnp.maximum(nz, 1.0)
+        # the nonzero COUNT accumulates f32 regardless: counting in
+        # bf16 saturates at 256 examples
+        nz = jnp.sum(w != 0, dtype=jnp.float32)
+        return jnp.sum(weighted, dtype=acc) / \
+            jnp.maximum(nz, 1.0).astype(weighted.dtype if acc is None
+                                        else acc)
     raise ValueError(f"unknown reduction {reduction}")
 
 

@@ -320,13 +320,117 @@ class ShardingSpec:
             sizes[fills[0]] = max(1, n_devices // fixed)
         return sizes
 
+    def validate(self, params: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 device_count: Optional[int] = None) -> Dict[str, int]:
+        """Pure build-time checks, raising the SAME errors ``build()``
+        would — without constructing a mesh or touching a device.
+        Shared by ``build()`` and the static analyzer's config pass
+        (analyze/configpass.py), so a bad spec is one named diagnostic
+        instead of a mid-fit crash.
+
+        - axis grammar: at most one ``-1`` fill, positive sizes,
+          a known ``preset``;
+        - ``batch_axes``/rule entries reference declared axis names;
+        - with ``device_count``: the fixed axes divide it
+          (``resolve_axes``);
+        - with ``params`` (``{name: shape}``): every rule-matched
+          parameter dim is divisible by its CONCRETE axis size (the
+          fill axis is checked only when ``device_count`` resolves it).
+
+        Returns the resolved (or partially resolved, when
+        ``device_count`` is None) axis sizes."""
+        sizes = self.resolve_axes(device_count) if device_count \
+            else {str(k): int(v) for k, v in self.axes.items()}
+        if device_count and not any(v == -1 for v in sizes.values()):
+            # no fill axis: resolve_axes never compares the fixed
+            # product against the device count, but DeviceMesh.create
+            # will — raise its error here, pre-mesh
+            n = 1
+            for v in sizes.values():
+                n *= v
+            if n > int(device_count):
+                raise ValueError(f"mesh {sizes} needs {n} devices, "
+                                 f"have {device_count}")
+        if len([v for v in sizes.values() if v == -1]) > 1:
+            raise ValueError(f"at most one -1 (fill) axis allowed, "
+                             f"got {sizes}")
+        for k, v in sizes.items():
+            if v != -1 and v <= 0:
+                raise ValueError(f"axis {k!r} size must be positive "
+                                 f"or -1, got {v}")
+        if self.preset not in _SPEC_PRESETS and self.preset != "megatron":
+            raise ValueError(
+                f"unknown sharding preset {self.preset!r}; expected one "
+                f"of {sorted(_SPEC_PRESETS) + ['megatron']} (use rules= "
+                f"for custom layouts)")
+
+        def _entry_axes(entry):
+            if entry is None:
+                return ()
+            return entry if isinstance(entry, tuple) else (entry,)
+
+        for a in self.batch_axes:
+            for ax in _entry_axes(a):
+                if ax not in sizes:
+                    raise ValueError(
+                        f"batch axis {ax!r} is not a declared mesh "
+                        f"axis {sorted(sizes)}")
+        rules = list(self.rules)
+        for rule in rules:
+            for entry in rule.spec:
+                for ax in _entry_axes(entry):
+                    if ax not in sizes:
+                        raise ValueError(
+                            f"rule {rule.pattern!r} shards over "
+                            f"{ax!r}, not a declared mesh axis "
+                            f"{sorted(sizes)}")
+        if params:
+            if self.preset == "megatron":
+                check_rules = rules + megatron_tensor_parallel_rules(
+                    list(params), warn_empty=False)
+            else:
+                check_rules = rules + _SPEC_PRESETS[self.preset](None)
+            for name, shape in params.items():
+                rule = next((r for r in check_rules if r.matches(name)),
+                            None)
+                if rule is None:
+                    continue
+                spec = (list(rule.spec) + [None] * len(shape))[:len(shape)]
+                for dim, entry in zip(shape, spec):
+                    extent = 1
+                    for ax in _entry_axes(entry):
+                        if ax not in sizes:
+                            # a preset rule can shard a matched param
+                            # over an axis this spec never declared
+                            # (e.g. "transformer" with data-only axes)
+                            # — at build time that dies inside
+                            # device_put; here it is a named error
+                            raise ValueError(
+                                f"parameter {name!r} matches rule "
+                                f"{rule.pattern!r} sharding over "
+                                f"{ax!r}, not a declared mesh axis "
+                                f"{sorted(sizes)}")
+                        v = sizes[ax]
+                        # an unresolved -1 fill axis is unknown until
+                        # device_count binds it — skip, don't multiply
+                        extent *= v if v > 0 else 1
+                    if extent > 1 and dim % extent != 0:
+                        raise ValueError(
+                            f"parameter {name!r} dim {dim} is not "
+                            f"divisible by axis extent {extent} "
+                            f"(rule {rule.pattern!r}, spec {rule.spec})")
+        return sizes
+
     def build(self, model=None,
               devices: Optional[Sequence] = None) -> ShardingStrategy:
         """Bind this spec to concrete devices (default: all visible).
         ``model`` is consulted only by the "megatron" preset (its rule
-        derivation reads the built network's parameter names)."""
+        derivation reads the built network's parameter names).
+        Grammar/divisibility errors come from :meth:`validate` first —
+        the same errors the static analyzer reports pre-compile."""
         import jax
         devices = list(devices if devices is not None else jax.devices())
+        self.validate(device_count=len(devices))
         sizes = self.resolve_axes(len(devices))
         n = 1
         for v in sizes.values():

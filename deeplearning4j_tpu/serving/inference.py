@@ -127,7 +127,8 @@ class ParallelInference:
                  warmup_buckets=None,
                  telemetry_port: Optional[int] = None,
                  resilience=None,
-                 memory_sample_every: Optional[int] = 64):
+                 memory_sample_every: Optional[int] = 64,
+                 analyze=True):
         self.model = model
         self.mode = InferenceMode(mode)
         self.max_batch_size = int(max_batch_size)
@@ -141,6 +142,32 @@ class ParallelInference:
         self.stats_storage = stats_storage
         self.profile_dir = profile_dir
         self._spec = _extract_spec(model)
+        # pre-compile static analysis of the serving graph (analyze/,
+        # docs/static_analysis.md): shape/hygiene/numerics findings as
+        # named diagnostics BEFORE the first bucket compiles. True =
+        # warn on error findings; "strict" = raise GraphAnalysisError;
+        # False = off. The report lands in self.analysis and — when a
+        # stats_storage is attached — as a {"type": "analysis"} record.
+        self.analysis = None
+        if analyze:
+            from deeplearning4j_tpu.analyze import (GraphAnalysisWarning,
+                                                    analyze_inference)
+            self.analysis = analyze_inference(
+                self._spec.sd, outputs=self._spec.output_names,
+                inputs=self._spec.input_names)
+            if stats_storage is not None:
+                stats_storage.put(self.analysis.to_record())
+            errs = self.analysis.errors()
+            if errs:
+                if str(analyze).lower() == "strict":
+                    self.analysis.raise_if_errors()
+                import warnings as _warnings
+                _warnings.warn(
+                    f"serving-graph static analysis found {len(errs)} "
+                    f"error(s); pi.analysis.render() has the located "
+                    f"diagnostics:\n"
+                    + "\n".join(f.render() for f in errs[:5]),
+                    GraphAnalysisWarning, stacklevel=2)
         if self.mode is InferenceMode.BATCHED and \
                 len(self._spec.input_names) != 1:
             raise ValueError(

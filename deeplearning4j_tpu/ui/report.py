@@ -223,7 +223,7 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
-    "compile", "reshard", "tensorstats", "memory_plan"})
+    "compile", "reshard", "tensorstats", "memory_plan", "analysis"})
 
 
 #: memory-plan byte components for the stacked budget chart, mirroring
@@ -307,6 +307,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     traces = storage.of_type("trace")
     metrics = storage.of_type("metrics")
     compiles = storage.of_type("compile")
+    analyses = storage.of_type("analysis")
     reshards = storage.of_type("reshard")
     serving = storage.of_type("serving")
     serving_faults = [r for r in storage.of_type("faults")
@@ -586,6 +587,53 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
             f"backend, {c.get('trace_seconds', 0.0):.2f}s tracing, "
             f"{c.get('saved_seconds', 0.0):.2f}s saved by the cache "
             f"(compilecache/, docs/cold_start.md)</p>")
+
+    # -- static analysis: pre-compile graph/config findings (analyze/) ---
+    if analyses:
+        a = analyses[-1]
+        counts = a.get("counts") or {}
+        g = a.get("graph") or {}
+        sev_color = {"error": "#d62728", "warn": "#ff7f0e",
+                     "info": "#888"}
+        parts.append(
+            f"<h2>Static analysis</h2><p>{a.get('context', '?')} "
+            f"context — {g.get('ops', '?')} ops / "
+            f"{g.get('vars', '?')} vars, {a.get('rules_run', '?')} "
+            f"rules in {a.get('seconds', 0.0):.3f}s: "
+            + ", ".join(f"{counts.get(s, 0)} {s}"
+                        for s in ("error", "warn", "info"))
+            + " (analyze/, docs/static_analysis.md)</p>")
+        findings = a.get("findings") or []
+        if findings:
+            order = {"error": 0, "warn": 1, "info": 2}
+            findings = sorted(findings,
+                              key=lambda f: order.get(
+                                  f.get("severity"), 3))
+            parts.append("<table><tr><th>severity</th><th>rule</th>"
+                         "<th>subject</th><th>finding</th></tr>")
+            for f in findings[:50]:
+                sev = str(f.get("severity", "?"))
+                tip = " | ".join(
+                    list(f.get("provenance") or [])
+                    + ([f"fix: {f['fix_hint']}"]
+                       if f.get("fix_hint") else []))
+                parts.append(
+                    f"<tr><td style='color:"
+                    f"{sev_color.get(sev, '#222')}'>"
+                    f"{_html.escape(sev)}</td>"
+                    f"<td>{_html.escape(str(f.get('rule_id', '?')))}"
+                    f"</td>"
+                    f"<td>{_html.escape(str(f.get('subject', '?')))}"
+                    f"</td>"
+                    f"<td title='{_html.escape(tip)}'>"
+                    f"{_html.escape(str(f.get('message', '')))}"
+                    f"</td></tr>")
+            parts.append("</table>")
+            extra = a.get("truncated", 0) + max(0, len(findings) - 50)
+            if extra:
+                parts.append(f"<p>({extra} further findings elided)</p>")
+        else:
+            parts.append("<p>clean — no findings.</p>")
 
     # -- elasticity: resharded restores across topology changes ----------
     if reshards:

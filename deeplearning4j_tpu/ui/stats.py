@@ -86,6 +86,17 @@ Storage format: JSON-lines, one record per event
         inside the compiled step — monitor/tensorstats.py, delivered
         through the Listener.tensorstats_done rail and rendered as the
         report's layer-health panel, docs/observability.md)
+    {"type": "analysis", "t": wall, "context": "fit"|"precompile"|
+        "serving"|"cli", "graph": {"vars": n, "ops": n},
+        "rules_run": n, "seconds": s,
+        "counts": {"error": n, "warn": n, "info": n},
+        "findings": [{rule_id, severity, subject, message, fix_hint,
+        provenance: [..]}], "truncated": n}
+        (pre-compile static-analysis findings — analyze/
+        AnalysisReport.to_record, published by MonitorListener at
+        training start and by ParallelInference at construction;
+        rendered as the report's "Static analysis" panel, folded to
+        dl4j_analysis_* gauges — docs/static_analysis.md)
 
 Unknown record types must DEGRADE GRACEFULLY in consumers: ui/report
 renders the sections it knows and lists unrecognized types in a footer

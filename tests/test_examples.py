@@ -15,7 +15,7 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            "multi_device_training.py", "moe_expert_parallel.py",
            "early_stopping_holdout.py", "serving_mnist.py",
            "checkpoint_resume.py", "self_healing_fit.py",
-           "observability_demo.py"]
+           "observability_demo.py", "analyze_model.py"]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)
@@ -33,3 +33,11 @@ def test_example_runs(script):
     assert proc.returncode == 0, (script, proc.stdout[-1500:],
                                   proc.stderr[-1500:])
     assert proc.stdout.strip(), script
+    # the examples double as the static analyzer's zero-false-positive
+    # sweep (ISSUE 12): every fit runs analyze/ by default, and an
+    # error-severity finding on a healthy example graph surfaces as a
+    # GraphAnalysisWarning on stderr — a hard failure here. (A
+    # PYTHONWARNINGS error:: filter cannot do this: dotted category
+    # names are rejected at interpreter startup and silently dropped.)
+    assert "GraphAnalysisWarning" not in proc.stderr, (
+        script, proc.stderr[-1500:])

@@ -700,33 +700,6 @@ class TestHistogramInvariants:
             assert all(a <= b for a, b in zip(ordered, ordered[1:]))
 
 
-class TestRecordTypeLint:
-    def test_every_published_record_type_is_rendered(self):
-        """Satellite (the PR-6 round-5 dead-record bug, made
-        structural): every ``{"type": ...}`` literal the package
-        publishes must be a type ui/report renders (``_KNOWN_TYPES``)
-        — or be explicitly exempted here with a reason, in which case
-        the runtime footer still lists it instead of dropping it."""
-        import pathlib
-
-        from deeplearning4j_tpu.ui import report as report_mod
-
-        # types knowingly left to the forward-compat footer (none
-        # today; add entries as "type": "why it is not rendered")
-        footer_ok = {}
-        pkg = pathlib.Path(report_mod.__file__).resolve().parents[1]
-        published = {}
-        pat = re.compile(r'"type":\s*"([a-z_]+)"')
-        for py in sorted(pkg.rglob("*.py")):
-            for m in pat.finditer(py.read_text(encoding="utf-8")):
-                published.setdefault(m.group(1), set()).add(
-                    str(py.relative_to(pkg)))
-        assert published, "lint walked no sources"
-        assert "tensorstats" in published        # the walk sees new code
-        dead = {t: sorted(files) for t, files in published.items()
-                if t not in report_mod._KNOWN_TYPES
-                and t not in footer_ok}
-        assert not dead, (
-            f"record types published but not rendered by ui/report "
-            f"(add to _KNOWN_TYPES + a renderer, or exempt with a "
-            f"reason): {dead}")
+# The PR-8 record-type lint moved to tests/test_static_lint.py (ISSUE
+# 12 satellite), where it grew bare-except and traced-path-RNG lints
+# alongside it.

@@ -1,0 +1,243 @@
+"""Repo-level static lint — the PR-8 record-type lint grown into its
+own module (ISSUE 12 satellite), on the ``_KNOWN_TYPES`` pattern: every
+ban has an explicit exemption table NAMING WHY each exception exists,
+so a new violation fails with a decision to make, not a mystery.
+
+Three lints:
+1. record types: every ``{"type": ...}`` literal the package publishes
+   must be rendered by ui/report (moved here from test_monitor);
+2. ``except: pass`` (bare) is banned package-wide — it was the shape
+   of the PR-6 silent-latch bugs;
+3. traced step-body code paths (ops/, the in-graph tensorstats and
+   sentinel builders) must not call wall clocks or unseeded NumPy RNG:
+   a ``time.time()`` or ``np.random.*`` inside a traced body is frozen
+   at TRACE time into the compiled program — it looks dynamic and is
+   silently constant, and it breaks bit-exact resume.
+"""
+import ast
+import pathlib
+import re
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.ui import report as report_mod
+
+PKG = pathlib.Path(deeplearning4j_tpu.__file__).resolve().parent
+
+
+def _iter_sources():
+    for py in sorted(PKG.rglob("*.py")):
+        rel = str(py.relative_to(PKG))
+        yield rel, py.read_text(encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
+# 1. record-type lint (grown from tests/test_monitor.py, PR 8)
+
+class TestRecordTypeLint:
+    def test_every_published_record_type_is_rendered(self):
+        """The PR-6 round-5 dead-record bug, made structural: every
+        ``{"type": ...}`` literal the package publishes must be a type
+        ui/report renders (``_KNOWN_TYPES``) — or be explicitly
+        exempted here with a reason, in which case the runtime footer
+        still lists it instead of dropping it."""
+        # types knowingly left to the forward-compat footer (none
+        # today; add entries as "type": "why it is not rendered")
+        footer_ok = {}
+        published = {}
+        pat = re.compile(r'"type":\s*"([a-z_]+)"')
+        for rel, text in _iter_sources():
+            for m in pat.finditer(text):
+                published.setdefault(m.group(1), set()).add(rel)
+        assert published, "lint walked no sources"
+        # the walk sees both the oldest and the newest record types
+        assert "tensorstats" in published
+        assert "analysis" in published          # this PR's record
+        dead = {t: sorted(files) for t, files in published.items()
+                if t not in report_mod._KNOWN_TYPES
+                and t not in footer_ok}
+        assert not dead, (
+            f"record types published but not rendered by ui/report "
+            f"(add to _KNOWN_TYPES + a renderer, or exempt with a "
+            f"reason): {dead}")
+
+
+# ---------------------------------------------------------------------------
+# 2. bare `except: pass`
+
+#: "relpath::function": "why this bare swallow is acceptable" — none
+#: today; every entry must name a reason
+BARE_EXCEPT_EXEMPT = {}
+
+
+def find_bare_except_pass(tree: ast.AST):
+    """(funcname, lineno) of every bare ``except:`` whose body is only
+    ``pass`` — the construct that silently eats KeyboardInterrupt and
+    latch-failures alike."""
+    hits = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = ["<module>"]
+
+        def _visit_func(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_ExceptHandler(self, node):
+            if node.type is None and len(node.body) == 1 and \
+                    isinstance(node.body[0], ast.Pass):
+                hits.append((self.stack[-1], node.lineno))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return hits
+
+
+class TestBareExceptLint:
+    def test_no_bare_except_pass_in_package(self):
+        violations = []
+        n_files = 0
+        for rel, text in _iter_sources():
+            n_files += 1
+            for func, lineno in find_bare_except_pass(ast.parse(text)):
+                key = f"{rel}::{func}"
+                if key not in BARE_EXCEPT_EXEMPT:
+                    violations.append(f"{rel}:{lineno} in {func}")
+        assert n_files > 100, "lint walked too few sources"
+        assert not violations, (
+            f"bare 'except: pass' swallows everything including "
+            f"KeyboardInterrupt — catch a type, or exempt with a "
+            f"reason in BARE_EXCEPT_EXEMPT: {violations}")
+
+    def test_checker_catches_seeded_violation(self):
+        tree = ast.parse(
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        pass\n"
+            "try:\n"
+            "    h()\n"
+            "except ValueError:\n"
+            "    pass\n")
+        hits = find_bare_except_pass(tree)
+        assert hits == [("f", 4)]     # the typed handler is fine
+
+
+# ---------------------------------------------------------------------------
+# 3. wall clocks / unseeded RNG in traced step-body code paths
+
+#: files whose function bodies are (partially) TRACED into compiled
+#: programs: every ops/ body, the in-graph tensorstats summaries, and
+#: the sentinel builders. Host-only helpers inside them go in the
+#: exemption table below.
+TRACED_FILES = ("ops/", "monitor/tensorstats.py", "faults/sentinels.py")
+
+#: "relpath::function::call": "why this call is host-side, not traced"
+TRACED_EXEMPT = {
+    "monitor/tensorstats.py::build_record::time.time":
+        "host-side record builder — runs at listener flush on fetched "
+        "numpy values, never inside the traced step",
+    "monitor/tensorstats.py::_flag::time.time":
+        "LayerHealthWatcher event stamping — a host watcher consuming "
+        "records, never traced",
+}
+
+_WALLCLOCK = {"time", "perf_counter", "monotonic", "time_ns"}
+
+
+def find_traced_hazards(tree: ast.AST):
+    """(funcname, call, lineno) for wall-clock reads, module-level
+    ``np.random.*`` (the unseeded global RNG), and zero-arg
+    ``np.random.default_rng()`` (unseeded)."""
+    hits = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = ["<module>"]
+
+        def _visit_func(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                mod, attr = f.value.id, f.attr
+                if mod in ("time", "_time") and attr in _WALLCLOCK:
+                    hits.append((self.stack[-1], f"time.{attr}",
+                                 node.lineno))
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id in ("np", "numpy") and \
+                    f.value.attr == "random":
+                if f.attr == "default_rng":
+                    if not node.args and not node.keywords:
+                        hits.append((self.stack[-1],
+                                     "np.random.default_rng()",
+                                     node.lineno))
+                else:
+                    hits.append((self.stack[-1],
+                                 f"np.random.{f.attr}", node.lineno))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return hits
+
+
+class TestTracedPathLint:
+    def test_no_wallclock_or_unseeded_rng_in_traced_paths(self):
+        violations = []
+        n_files = 0
+        for rel, text in _iter_sources():
+            if not any(rel.startswith(t) if t.endswith("/")
+                       else rel == t for t in TRACED_FILES):
+                continue
+            n_files += 1
+            for func, call, lineno in find_traced_hazards(
+                    ast.parse(text)):
+                key = f"{rel}::{func}::{call}"
+                if key not in TRACED_EXEMPT:
+                    violations.append(f"{rel}:{lineno} {call} in "
+                                      f"{func}")
+        assert n_files > 10, "lint walked too few traced sources"
+        assert not violations, (
+            f"wall clocks / unseeded RNG inside traced step-body code "
+            f"freeze at trace time (silently constant in the compiled "
+            f"program) and break bit-exact resume — thread a seeded "
+            f"key, or exempt host-side helpers with a reason in "
+            f"TRACED_EXEMPT: {violations}")
+
+    def test_exemptions_still_exist(self):
+        """Every exemption must still point at real code — a stale
+        entry means the hazard it excused is gone and the table rots."""
+        live = set()
+        for rel, text in _iter_sources():
+            for func, call, lineno in find_traced_hazards(
+                    ast.parse(text)):
+                live.add(f"{rel}::{func}::{call}")
+        stale = [k for k in TRACED_EXEMPT if k not in live]
+        assert not stale, f"stale TRACED_EXEMPT entries: {stale}"
+
+    def test_checker_catches_seeded_violations(self):
+        tree = ast.parse(
+            "import time\nimport numpy as np\n"
+            "def step(x):\n"
+            "    t = time.time()\n"
+            "    n = np.random.normal(size=3)\n"
+            "    r = np.random.default_rng()\n"
+            "    ok = np.random.default_rng(0)\n"       # seeded: fine
+            "    return x + t + n + r.normal()\n")
+        calls = {c for _, c, _ in find_traced_hazards(tree)}
+        assert calls == {"time.time", "np.random.normal",
+                         "np.random.default_rng()"}
