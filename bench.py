@@ -461,6 +461,91 @@ def bench_serving_resilience_overhead(n_requests=768, concurrency=8,
             "n_requests": n_requests, "concurrency": concurrency}
 
 
+def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
+                      worker_counts=(1, 2, 4)):
+    """Disk-backed streaming training vs the device-cached window bench
+    (datapipe/, docs/data_pipeline.md — the ROADMAP item-4 acceptance
+    bar: within ~5% of cached). The BASELINE config-2 MLP trains
+    through ``StreamingDataPipeline`` — sha256-verified shard reads +
+    supervised parallel prefetch feeding the fused-window stager — at
+    several prefetch-worker counts (the scaling column), against the
+    same model fed from ``DeviceCachedIterator``. One monitored run
+    reports the per-flush data-wait fraction (the number that says
+    whether the prefetch actually hides the disk)."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.autodiff import ScoreIterationListener
+    from deeplearning4j_tpu.datapipe import (StreamingDataPipeline,
+                                             write_dataset)
+    from deeplearning4j_tpu.monitor import (MonitorListener,
+                                            disable_tracing,
+                                            enable_tracing)
+    from deeplearning4j_tpu.ui.stats import StatsStorage
+
+    cached = bench_samediff_mlp(batch=batch, listener=True,
+                                fused_steps=fused_steps)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 784)).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    ds_dir = tempfile.mkdtemp(prefix="dl4j_disk_stream_")
+    try:
+        write_dataset(os.path.join(ds_dir, "ds"), X, Y,
+                      shard_size=shard_size, overwrite=True)
+        path = os.path.join(ds_dir, "ds")
+        per_workers = {}
+        epochs = 6
+        for workers in worker_counts:
+            sd = _build_mlp_sd(fused_steps=fused_steps)
+            listeners = [ScoreIterationListener(print_every=10 ** 9,
+                                                print_fn=lambda *a: None)]
+            pipe = StreamingDataPipeline(path, batch_size=batch,
+                                         shuffle=False,
+                                         n_workers=workers)
+            sd.fit(pipe, epochs=2, listeners=listeners)   # warmup
+            sps = _median_rate(lambda: sd.fit(pipe, epochs=epochs,
+                                              listeners=listeners),
+                               epochs * n)
+            per_workers[str(workers)] = round(sps, 1)
+        best_workers, best = max(per_workers.items(),
+                                 key=lambda kv: kv[1])
+        # one monitored (traced) run at the best worker count for the
+        # per-flush data-wait fraction — not part of the timing
+        storage = StatsStorage()
+        sd = _build_mlp_sd(fused_steps=fused_steps)
+        pipe = StreamingDataPipeline(path, batch_size=batch,
+                                     shuffle=False,
+                                     n_workers=int(best_workers))
+        listeners = [ScoreIterationListener(print_every=10 ** 9,
+                                            print_fn=lambda *a: None)]
+        sd.fit(pipe, epochs=2, listeners=listeners)       # warmup
+        enable_tracing(reset=True)
+        try:
+            sd.fit(pipe, epochs=2,
+                   listeners=listeners + [MonitorListener(storage)])
+        finally:
+            disable_tracing()
+        waits = [r["data_wait_frac"] for r in storage.of_type("datapipe")
+                 if r.get("data_wait_frac") is not None]
+        cached_sps = cached.get("samples_per_sec", 0.0)
+        gap = (cached_sps - best) / cached_sps * 100.0 if cached_sps \
+            else 0.0
+        return {"samples_per_sec": best,
+                "samples_per_sec_cached": cached_sps,
+                "disk_vs_cached_pct": round(gap, 2),
+                "workers_best": int(best_workers),
+                "samples_per_sec_by_workers": per_workers,
+                "data_wait_frac_per_flush": [round(w, 4)
+                                             for w in waits[-12:]],
+                "data_wait_frac_mean": round(
+                    float(np.mean(waits)), 4) if waits else None,
+                "shards": (n + shard_size - 1) // shard_size,
+                "shard_size": shard_size, "batch": batch,
+                "fused_steps": fused_steps}
+    finally:
+        shutil.rmtree(ds_dir, ignore_errors=True)
+
+
 def bench_resnet50(batch=128, steps=32, image=224, mixed_precision=True):
     """BASELINE config 3: zoo ResNet-50 training step, ImageNet shapes,
     bf16 mixed precision (f32 master params) at MXU-saturating batch."""
@@ -774,6 +859,10 @@ def main():
                      # bar) for BENCH_r08
                      ("serving_resilience_overhead",
                       bench_serving_resilience_overhead),
+                     # disk-backed streaming vs the cached-window bench
+                     # (datapipe/, ~5% bar) + data-wait per flush +
+                     # prefetch-worker scaling, for BENCH_r09
+                     ("disk_stream", bench_disk_stream),
                      # cold-start: fresh-process first-compile vs
                      # warm-cache restart per model (compilecache/)
                      ("cold_start", bench_cold_start),

@@ -1561,6 +1561,20 @@ class SameDiff:
         # BEFORE tier selection, mesh placement, or any XLA compile —
         # strict mode raises here (docs/static_analysis.md)
         self._maybe_analyze(has_listeners=bool(listeners))
+        # seekable streaming pipeline (datapipe/): register it on the
+        # graph so checkpoint captures embed its PipelineState at flush
+        # boundaries and anchor its pass starts to absolute iterations —
+        # a mid-epoch restore then SEEKS instead of replaying the pass
+        # (docs/data_pipeline.md). Cleared (None) for plain iterators so
+        # a previous fit's pipeline can't leak into this fit's snapshots.
+        from deeplearning4j_tpu.datapipe.pipeline import find_pipeline
+        _dp = find_pipeline(dataset_iterator)
+        self._active_datapipe = _dp
+        if _dp is not None and hasattr(_dp, "bind_iteration_source"):
+            _dp.bind_iteration_source(
+                lambda: int(getattr(tc, "iteration_count", 0) or 0))
+            _dp.bind_epoch_source(
+                lambda: int(getattr(tc, "epoch_count", 0) or 0))
         if getattr(tc, "sharding", None) is not None:
             # declarative mesh sharding: place params/state on the
             # spec's mesh and pre-shard batches BEFORE tier selection,

@@ -180,6 +180,18 @@ class WindowStager:
         except BaseException as e:     # propagate to the consumer
             self._err = e
         finally:
+            # close a closeable source (generators) from THIS thread —
+            # the one that iterated it: an abandoned mid-epoch stager
+            # then deterministically releases whatever the source holds
+            # (a streaming pipeline's prefetch workers, datapipe/)
+            # instead of waiting for GC to run its finally
+            close = getattr(self._source, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:      # noqa: BLE001 — shutdown path;
+                    pass               # the consumer's error (if any)
+                #                        is already in self._err
             self._put(self._END)
 
     # -- consumer side --------------------------------------------------
@@ -457,8 +469,14 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         else:
             if hasattr(dataset_iterator, "reset"):
                 dataset_iterator.reset()
-            stager = WindowStager(map(_name_batch, iter(dataset_iterator)),
-                                  K, finalize=_finalize)
+            # a real generator expression (not map()): the stager closes
+            # its source on shutdown, and generator .close() propagates
+            # GeneratorExit into a streaming pipeline's generator —
+            # releasing its prefetch workers deterministically
+            # (map objects have no close())
+            stager = WindowStager(
+                (_name_batch(b) for b in iter(dataset_iterator)),
+                K, finalize=_finalize)
             source = stager
         _END_OF_DATA = object()
         src_iter = iter(source)

@@ -155,6 +155,14 @@ def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
                          for k, v in normalizer._state().items()}}
     meta = dict(metadata or {})
     meta.setdefault("topology", capture_topology(sd))
+    # seekable streaming-pipeline position (datapipe/): fit() registers
+    # the active pipeline on the graph; its PipelineState at THIS
+    # iteration (shard cursor, shuffle pass, quarantine sets) rides the
+    # snapshot so a restore can seek mid-epoch instead of replaying the
+    # pass (docs/data_pipeline.md)
+    dp = getattr(sd, "_active_datapipe", None)
+    if dp is not None and "datapipe" not in meta:
+        meta["datapipe"] = dp.export_state(iteration)
     return TrainingState(arrays=arrays, updater_leaves=updater_leaves,
                          iteration=iteration, epoch=int(epoch),
                          rng_seed=int(rng_seed),

@@ -1,0 +1,42 @@
+"""datapipe/ — the fault-tolerant streaming data plane.
+
+The reference dedicates a whole layer (L6: datavec ``RecordReader`` →
+``TransformProcess`` → ``DataSetIterator``) to ETL; this package is
+that layer rebuilt with the detect→decide→recover discipline the
+compute rails (faults/, serving/) already have, applied to IO:
+
+- ``manifest``  : checksummed shard directories with the checkpoint/
+  staged-commit protocol (``write_dataset`` / ``load_manifest`` /
+  ``verify_dataset``) + per-host ``shard_assignment``
+- ``reader``    : ``ShardedRecordReader`` — open-time sha256
+  verification, transient-IO retry with bounded backoff, typed
+  retryable ``ShardCorruptError``, shard quarantine after a budget
+- ``prefetch``  : ``SupervisedPrefetcher`` — supervised worker pool
+  (exactly-once requeue of a dead worker's batch, bounded-backoff
+  respawn, read-timeout backup requests, in-order delivery)
+- ``pipeline``  : ``StreamingDataPipeline`` — the DataSetIterator
+  gluing it together, with record-level corrupt-row quarantine and
+  seekable deterministic per-pass state
+- ``state``     : ``PipelineState`` — the mid-epoch position captured
+  into checkpoints and restored by ``faults.FaultTolerantFit``
+
+See docs/data_pipeline.md.
+"""
+from deeplearning4j_tpu.datapipe.manifest import (ShardInfo, ShardManifest,
+                                                  load_manifest,
+                                                  shard_assignment,
+                                                  verify_dataset,
+                                                  write_dataset)
+from deeplearning4j_tpu.datapipe.pipeline import (StreamingDataPipeline,
+                                                  find_pipeline)
+from deeplearning4j_tpu.datapipe.prefetch import (SupervisedPrefetcher,
+                                                  WorkItem)
+from deeplearning4j_tpu.datapipe.reader import ShardedRecordReader
+from deeplearning4j_tpu.datapipe.state import PipelineState
+from deeplearning4j_tpu.faults.errors import ShardCorruptError
+
+__all__ = ["PipelineState", "ShardCorruptError", "ShardInfo",
+           "ShardManifest", "ShardedRecordReader",
+           "StreamingDataPipeline", "SupervisedPrefetcher", "WorkItem",
+           "find_pipeline", "load_manifest", "shard_assignment",
+           "verify_dataset", "write_dataset"]

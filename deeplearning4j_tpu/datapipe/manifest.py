@@ -1,0 +1,277 @@
+"""ShardManifest: checksummed, staged-commit dataset shards on disk.
+
+The checkpoint/ commit protocol (stage everything under ``<dir>.tmp``,
+fsync, write a manifest + COMMIT marker, ``os.replace`` the directory
+into place) applied to TRAINING DATA: a dataset directory is either
+fully committed — every shard present with the recorded size, sha256
+and record count — or it is not a dataset, and the reader says so with
+a typed :class:`~deeplearning4j_tpu.faults.errors.ShardCorruptError`
+instead of an exception from deep inside ``np.load``.
+
+Layout of a committed dataset directory::
+
+    dataset/
+      MANIFEST.json      {"format_version", "record_count", "layout",
+                          "shards": [{"file", "records", "size",
+                                      "sha256"}, ...]}
+      COMMIT             marker, written after the manifest
+      shard_00000.npz    {"features": (n, ...), "labels": (n, ...)}
+      shard_00001.npz    ... (or one array per named column with
+                          layout="columns")
+
+Record ids are GLOBAL: shard ``i`` holds records
+``[offset_i, offset_i + records_i)`` where ``offset_i`` is the sum of
+the record counts of shards ``0..i-1`` — the id space the streaming
+pipeline's shuffle, quarantine and seek state all live in.
+
+Reference parity: datavec's ``InputSplit``/``FileSplit`` enumerate
+files and trust them completely; here every byte the training loop
+will consume is covered by a digest, the same guarantee checkpoints
+already have (checkpoint/manifest.py).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.checkpoint.atomic import fsync_dir
+from deeplearning4j_tpu.checkpoint.manifest import sha256_file
+from deeplearning4j_tpu.faults.errors import ShardCorruptError
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMIT"
+SHARD_FMT = "shard_{i:05d}.npz"
+FORMAT_VERSION = 1
+
+#: shard payload layouts: "arrays" = features/labels arrays per shard;
+#: "columns" = one named 1-D array per schema column (the
+#: TransformProcess-streaming form)
+LAYOUTS = ("arrays", "columns")
+
+
+@dataclass
+class ShardInfo:
+    """One shard's manifest entry."""
+    file: str
+    records: int
+    size: int
+    sha256: str
+    offset: int = 0          # global id of this shard's first record
+
+    def to_json(self) -> dict:
+        return {"file": self.file, "records": int(self.records),
+                "size": int(self.size), "sha256": self.sha256}
+
+
+@dataclass
+class ShardManifest:
+    """The committed dataset's table of contents."""
+    shards: List[ShardInfo] = field(default_factory=list)
+    record_count: int = 0
+    layout: str = "arrays"
+
+    def __post_init__(self):
+        off = 0
+        for s in self.shards:
+            s.offset = off
+            off += int(s.records)
+        if not self.record_count:
+            self.record_count = off
+
+    def to_json(self) -> dict:
+        return {"format_version": FORMAT_VERSION,
+                "record_count": int(self.record_count),
+                "layout": self.layout,
+                "shards": [s.to_json() for s in self.shards]}
+
+    @staticmethod
+    def from_json(data: dict) -> "ShardManifest":
+        shards = [ShardInfo(file=e["file"], records=int(e["records"]),
+                            size=int(e["size"]), sha256=e["sha256"])
+                  for e in data.get("shards", [])]
+        return ShardManifest(shards=shards,
+                             record_count=int(data.get("record_count", 0)),
+                             layout=str(data.get("layout", "arrays")))
+
+
+def _write_durable(path: str, data: bytes) -> None:
+    with open(path, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def write_dataset(directory: str, features=None, labels=None, *,
+                  columns: Optional[Dict[str, np.ndarray]] = None,
+                  shard_size: int = 1024,
+                  overwrite: bool = False) -> ShardManifest:
+    """Commit a dataset directory of checksummed shards.
+
+    Either ``features``/``labels`` (row-aligned arrays; layout
+    ``"arrays"``) or ``columns`` (a dict of row-aligned 1-D/2-D column
+    arrays; layout ``"columns"`` — the form a ``TransformProcess``
+    consumes) — not both. Everything is staged under
+    ``<directory>.tmp`` and published with one atomic ``os.replace``,
+    so a writer killed mid-build can never leave a half-dataset that a
+    reader would mistake for the real thing (the checkpoint/ commit
+    discipline)."""
+    if (features is None) == (columns is None):
+        raise ValueError("pass features/labels OR columns=, not both")
+    if columns is not None:
+        parts = {str(k): np.asarray(v) for k, v in columns.items()}
+        layout = "columns"
+    else:
+        parts = {"features": np.asarray(features),
+                 "labels": np.asarray(labels)}
+        layout = "arrays"
+    lens = {len(a) for a in parts.values()}
+    if len(lens) != 1:
+        raise ValueError(f"all arrays must share the leading length; "
+                         f"got {sorted(lens)}")
+    n = lens.pop()
+    shard_size = max(1, int(shard_size))
+    directory = os.fspath(directory)
+    if os.path.exists(directory) and not overwrite:
+        raise FileExistsError(f"{directory} exists "
+                              f"(pass overwrite=True)")
+    tmp = directory + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    shards: List[ShardInfo] = []
+    for i, start in enumerate(range(0, n, shard_size)):
+        name = SHARD_FMT.format(i=i)
+        payload = {k: a[start:start + shard_size]
+                   for k, a in parts.items()}
+        data = _npz_bytes(payload)
+        path = os.path.join(tmp, name)
+        _write_durable(path, data)
+        shards.append(ShardInfo(
+            file=name, records=len(next(iter(payload.values()))),
+            size=len(data),
+            sha256=sha256_file(path)))
+    manifest = ShardManifest(shards=shards, record_count=n, layout=layout)
+    _write_durable(os.path.join(tmp, MANIFEST_NAME),
+                   json.dumps(manifest.to_json(), indent=1,
+                              sort_keys=True).encode())
+    _write_durable(os.path.join(tmp, COMMIT_NAME), b"committed\n")
+    # the full checkpoint/atomic discipline: fsync the staged dir's
+    # ENTRIES, publish with one rename, fsync the parent so the rename
+    # itself survives a crash — without these a power cut after return
+    # can unjournal the commit the module header promises
+    fsync_dir(tmp)
+    # the previous dataset (overwrite=True) survives until the
+    # replacement is FULLY staged: deleting it up front would leave NO
+    # dataset for the whole build if the writer crashes mid-shard —
+    # this narrows the loss window to the delete-rename gap below
+    if os.path.exists(directory):
+        shutil.rmtree(directory)
+    os.replace(tmp, directory)
+    fsync_dir(os.path.dirname(os.path.abspath(directory)) or ".")
+    return manifest
+
+
+def load_manifest(directory: str) -> ShardManifest:
+    """Load and structurally validate a committed dataset directory.
+    Raises :class:`ShardCorruptError` (typed, retryable) for every
+    failure mode a torn writer or bit-rot can produce — a missing
+    COMMIT marker, an unreadable/truncated manifest, a manifest whose
+    shard list is malformed."""
+    directory = os.fspath(directory)
+    if not os.path.isdir(directory):
+        raise ShardCorruptError(f"{directory}: not a dataset directory",
+                                shard=None)
+    if not os.path.isfile(os.path.join(directory, COMMIT_NAME)):
+        raise ShardCorruptError(
+            f"{directory}: missing COMMIT marker — the dataset was "
+            f"never committed (torn writer?)", shard=None)
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(mpath, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        manifest = ShardManifest.from_json(data)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise ShardCorruptError(
+            f"{directory}: unreadable manifest: {e!r}",
+            shard=MANIFEST_NAME) from e
+    if not manifest.shards:
+        raise ShardCorruptError(f"{directory}: manifest lists no shards",
+                                shard=MANIFEST_NAME)
+    if manifest.layout not in LAYOUTS:
+        raise ShardCorruptError(
+            f"{directory}: unknown shard layout "
+            f"{manifest.layout!r} (have {LAYOUTS})", shard=MANIFEST_NAME)
+    return manifest
+
+
+def verify_shard_bytes(info: ShardInfo, data: bytes) -> List[str]:
+    """Integrity problems of one shard's bytes vs its manifest entry
+    (empty = intact). Hashing the bytes actually read — not the file a
+    second time — closes the verify-then-read race."""
+    import hashlib
+    problems: List[str] = []
+    if len(data) != info.size:
+        problems.append(f"size {len(data)} != {info.size}")
+        return problems            # a truncated file will not hash either
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != info.sha256:
+        problems.append(f"sha256 mismatch ({digest[:12]}… != "
+                        f"{info.sha256[:12]}…)")
+    return problems
+
+
+def verify_dataset(directory: str, full: bool = True) -> List[str]:
+    """Whole-dataset integrity scan: structural manifest checks plus
+    (with ``full=True``) a re-hash of every shard. Returns the problem
+    list (empty = committed & intact) — the cheap pre-flight a job can
+    run before pointing a fleet at a dataset."""
+    try:
+        manifest = load_manifest(directory)
+    except ShardCorruptError as e:
+        return [str(e)]
+    problems: List[str] = []
+    for info in manifest.shards:
+        path = os.path.join(directory, info.file)
+        if not os.path.isfile(path):
+            problems.append(f"{info.file}: missing")
+            continue
+        size = os.path.getsize(path)
+        if size != info.size:
+            problems.append(f"{info.file}: size {size} != {info.size}")
+            continue
+        if full and sha256_file(path) != info.sha256:
+            problems.append(f"{info.file}: sha256 mismatch")
+    return problems
+
+
+def shard_assignment(n_shards: int, host_index: int,
+                     host_count: int) -> List[int]:
+    """Deterministic per-host shard partition: shard ``i`` belongs to
+    host ``i % host_count``. Disjoint and total by construction — the
+    union over hosts covers every shard exactly once (pinned in
+    tests/test_datapipe.py), the same round-robin
+    ``checkpoint.state.shard_names`` uses for array shards."""
+    host_index, host_count = int(host_index), int(host_count)
+    if host_count <= 0:
+        raise ValueError("host_count must be positive")
+    if not 0 <= host_index < host_count:
+        raise ValueError(f"host_index {host_index} outside "
+                         f"[0, {host_count})")
+    return [i for i in range(int(n_shards)) if i % host_count == host_index]
+
+
+__all__ = ["LAYOUTS", "ShardInfo", "ShardManifest", "load_manifest",
+           "shard_assignment", "verify_dataset", "verify_shard_bytes",
+           "write_dataset"]

@@ -1,0 +1,673 @@
+"""StreamingDataPipeline: the fault-tolerant disk→device input pipeline.
+
+One `DataSetIterator` that composes the whole datapipe/ rail (the L6
+datavec role — RecordReader → TransformProcess → DataSetIterator — with
+the detect→decide→recover discipline of faults/ and serving/ applied
+to IO):
+
+- **sharded, checksummed source** — a committed
+  :mod:`~deeplearning4j_tpu.datapipe.manifest` directory read through
+  :class:`~deeplearning4j_tpu.datapipe.reader.ShardedRecordReader`
+  (open-time sha256 verification, transient-IO retry, per-host shard
+  assignment for multihost, shard quarantine after a bounded budget);
+- **supervised parallel prefetch** —
+  :class:`~deeplearning4j_tpu.datapipe.prefetch.SupervisedPrefetcher`
+  workers read + transform batches ahead of the trainer (vectorized
+  NumPy, optionally a ``TransformProcess``), with exactly-once requeue
+  of a dead worker's claimed batch, bounded-backoff respawn, and
+  read-timeout backup requests; the batches feed ``fit()``'s existing
+  ``WindowStager`` H2D double-buffer unchanged;
+- **record-level corrupt-row quarantine** — non-finite rows are
+  dropped where the untrusted bytes enter (before the transform), the
+  ids quarantined PERSISTENTLY (later passes exclude them up front),
+  composing with ``faults.RetryingIterator``'s batch-level semantics
+  one level up;
+- **seekable deterministic state** — each pass's order is a pure
+  function of ``(seed, pass_index, host)``, so
+  :meth:`export_state`/:meth:`restore_state`/:meth:`seek_batches`
+  reposition the pipeline mid-pass in O(1) instead of replaying it.
+  ``SameDiff.fit`` registers the pipeline, checkpoint captures embed
+  the :class:`~deeplearning4j_tpu.datapipe.state.PipelineState` at
+  flush boundaries, and a resumed/rolled-back fit seeks — bit-exact vs
+  the uninterrupted run (docs/data_pipeline.md).
+
+::
+
+    write_dataset(path, X, Y, shard_size=1024)
+    pipe = StreamingDataPipeline(path, batch_size=128, seed=7,
+                                 n_workers=2)
+    ftf = FaultTolerantFit(net, CheckpointManager(ckpt_dir))
+    ftf.fit(pipe, epochs=10)      # survives torn shards, dead workers,
+                                  # flaky reads; resumes by seeking
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.dataset.iterators import DataSetIterator
+from deeplearning4j_tpu.datapipe.prefetch import (SupervisedPrefetcher,
+                                                  WorkItem)
+from deeplearning4j_tpu.datapipe.reader import ShardedRecordReader
+from deeplearning4j_tpu.datapipe.state import PipelineState
+from deeplearning4j_tpu.faults.errors import (DataPipelineError,
+                                              ShardCorruptError)
+
+#: wrapper-attribute chain find_pipeline() walks (RetryingIterator and
+#: the utility iterators expose ``_wrapped``)
+_UNWRAP_ATTRS = ("_wrapped", "_source")
+
+
+def find_pipeline(iterator, max_depth: int = 8):
+    """The seekable pipeline inside an iterator wrapper chain (or
+    None): the object exposing ``export_state`` — what fit() registers
+    for checkpoint capture and FaultTolerantFit seeks on rollback."""
+    probe = iterator
+    for _ in range(max_depth):
+        if probe is None:
+            return None
+        if hasattr(probe, "export_state"):
+            return probe
+        nxt = None
+        for attr in _UNWRAP_ATTRS:
+            nxt = getattr(probe, attr, None)
+            if nxt is not None:
+                break
+        probe = nxt
+    return None
+
+
+class StreamingDataPipeline(DataSetIterator):
+    """Disk-backed streaming batches with supervised prefetch and
+    seekable mid-epoch state.
+
+    ``transform``: vectorized callable ``(features, labels) ->
+    (features, labels)`` run on worker threads (layout ``"arrays"``).
+    ``transform_process``: an ``etl.TransformProcess`` applied per
+    batch over the shard columns (layout ``"columns"``; steps must be
+    row-count-preserving — a filter step would break the global
+    record-id accounting the quarantine/seek state lives in);
+    ``label_column``/``num_classes`` then split columns into
+    (features, one-hot labels) exactly like
+    ``RecordReaderDataSetIterator``.
+
+    Each ``iter()`` starts the next PASS; ``shuffle=True`` draws the
+    pass permutation from ``(seed, pass_index, host_index)`` — fresh
+    order every epoch, yet reproducible and therefore seekable.
+    """
+
+    def __init__(self, directory: str, batch_size: int = 32,
+                 shuffle: bool = True, seed: int = 0,
+                 transform: Optional[Callable] = None,
+                 transform_process=None, label_column=None,
+                 num_classes: Optional[int] = None,
+                 n_workers: int = 2, prefetch_depth: int = 4,
+                 host_index: Optional[int] = None,
+                 host_count: Optional[int] = None,
+                 verify: bool = True, read_retries: int = 3,
+                 read_backoff_base_s: float = 0.0,
+                 read_timeout_s: Optional[float] = None,
+                 shard_quarantine_budget: int = 2,
+                 quarantine_corrupt_rows: bool = True,
+                 drop_remainder: bool = False,
+                 on_event: Optional[Callable[[dict], None]] = None):
+        if host_index is None or host_count is None:
+            try:
+                import jax
+                host_index = jax.process_index() if host_index is None \
+                    else host_index
+                host_count = jax.process_count() if host_count is None \
+                    else host_count
+            except Exception:   # jax not initialized: single-host
+                host_index, host_count = host_index or 0, host_count or 1
+        self.host_index, self.host_count = int(host_index), int(host_count)
+        self.events: List[dict] = []
+        self._subscribers: List[Callable[[dict], None]] = []
+        if on_event is not None:
+            self._subscribers.append(on_event)
+        self._reader = ShardedRecordReader(
+            directory, host_index=self.host_index,
+            host_count=self.host_count, verify=verify,
+            read_retries=read_retries,
+            backoff_base_s=read_backoff_base_s,
+            quarantine_budget=shard_quarantine_budget,
+            on_event=self._emit_event)
+        self._batch = int(batch_size)
+        self._shuffle = bool(shuffle)
+        self._seed = int(seed)
+        self._transform = transform
+        self._tp = transform_process
+        if self._tp is not None:
+            if self._reader.manifest.layout != "columns":
+                raise ValueError(
+                    "transform_process= needs a columns-layout dataset "
+                    "(write_dataset(columns=...))")
+            for st in self._tp.steps:
+                if getattr(st, "changes_row_count", False):
+                    raise ValueError(
+                        f"{type(st).__name__.lstrip('_')} steps are not "
+                        f"streamable: changing the row count would break "
+                        f"the global record-id space the quarantine and "
+                        f"seek state live in — filter at dataset-build "
+                        f"time instead")
+        if self._reader.manifest.layout == "columns" and \
+                label_column is None:
+            raise ValueError("columns-layout datasets need label_column=")
+        self._label_column = label_column
+        self._num_classes = num_classes
+        self._n_workers = max(1, int(n_workers))
+        self._depth = max(1, int(prefetch_depth))
+        self._read_timeout_s = read_timeout_s
+        self._quarantine_rows = bool(quarantine_corrupt_rows)
+        self._drop_remainder = bool(drop_remainder)
+        self._lock = threading.Lock()
+        # persistent-across-passes state
+        self._quarantined_records: set = set()
+        self._passes_started = 0
+        self._pending_seek: Optional[dict] = None
+        # current-pass state
+        self._current_pass: Optional[int] = None
+        self._pass_quarantine_base: frozenset = frozenset()
+        self._pass_shard_base: frozenset = frozenset()
+        self._pass_anchor = 0
+        self._pass_complete = False
+        self._plan_cursor = 0
+        self._yield_counter = 0
+        self._gen_yield_base = 0
+        self._yield_plan: Dict[int, int] = {}
+        self._pass_start_iteration: Optional[int] = None
+        self._pass_start_epoch: Optional[int] = None
+        self._iteration_source: Optional[Callable[[], int]] = None
+        self._epoch_source: Optional[Callable[[], int]] = None
+        self._live_prefetcher: Optional[SupervisedPrefetcher] = None
+        # telemetry counters
+        self._records_delivered = 0
+        self._batches_delivered = 0
+        self._rows_quarantined = 0
+        self._records_withheld = 0
+        self._pf_totals = {"worker_restarts": 0, "requeues": 0,
+                           "slow_reads": 0}
+        self._pf_busy: Dict[int, float] = {}
+
+    # -- events ---------------------------------------------------------
+    def _emit_event(self, ev: dict) -> None:
+        self.events.append(ev)
+        del self.events[:-1000]             # bounded
+        for fn in list(self._subscribers):
+            try:
+                fn(ev)
+            except Exception:   # noqa: BLE001 — a raising subscriber
+                # (user callback, chaos healer doing file IO) must not
+                # kill the supervisor/worker thread that emitted the
+                # event: a dead supervisor turns the next worker crash
+                # into a silent hang instead of a typed failure
+                pass
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        """Attach an event listener (stats storage ``put``, chaos
+        injectors' heal triggers, tests)."""
+        self._subscribers.append(fn)
+
+    # -- DataSetIterator protocol ---------------------------------------
+    def reset(self) -> None:
+        """No-op by design: a PASS begins at ``iter()`` (each one gets
+        the next pass's permutation), so the double reset the fit tiers
+        + RetryingIterator issue per epoch cannot double-advance the
+        pass counter."""
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    @property
+    def record_count(self) -> int:
+        return int(self._reader.manifest.record_count)
+
+    # -- deterministic pass plan ----------------------------------------
+    def _pass_permutation(self, pass_index: int,
+                          quarantine_base: frozenset,
+                          shard_base: frozenset) -> np.ndarray:
+        ids = self._reader.record_ids(exclude_shards=shard_base)
+        if quarantine_base:
+            ids = ids[~np.isin(ids, np.fromiter(
+                quarantine_base, dtype=np.int64,
+                count=len(quarantine_base)))]
+        if self._shuffle:
+            rng = np.random.default_rng(
+                (self._seed, int(pass_index), self.host_index))
+            return rng.permutation(ids)
+        return ids
+
+    def _plan_items(self, perm: np.ndarray) -> List[WorkItem]:
+        items = []
+        for j, start in enumerate(range(0, len(perm), self._batch)):
+            chunk = perm[start:start + self._batch]
+            if self._drop_remainder and len(chunk) < self._batch:
+                break
+            items.append(WorkItem(j, chunk))
+        return items
+
+    # -- worker-side read + transform + row quarantine ------------------
+    def _assemble(self, cols: Dict[str, np.ndarray]):
+        """Column dict -> (features, labels), vectorized."""
+        if self._reader.manifest.layout == "arrays":
+            feats, labels = cols["features"], cols["labels"]
+            if self._transform is not None:
+                feats, labels = self._transform(feats, labels)
+            return np.asarray(feats), np.asarray(labels)
+        # columns layout: TransformProcess steps, then feature/label split
+        if self._tp is not None:
+            s = self._tp.initial_schema
+            for st in self._tp.steps:
+                cols = st.apply(s, cols)
+                s = st.apply_schema(s)
+            names = list(s.names())
+        else:
+            names = list(cols)
+        label_name = names[self._label_column] \
+            if isinstance(self._label_column, int) else self._label_column
+        feat_names = [n for n in names if n != label_name]
+        feats = np.stack([np.asarray(cols[n], np.float32)
+                          for n in feat_names], axis=1)
+        lab = cols[label_name]
+        if self._num_classes is not None:
+            labels = np.eye(self._num_classes, dtype=np.float32)[
+                np.asarray(lab).astype(np.int64)]
+        else:
+            labels = np.asarray(lab, np.float32).reshape(-1, 1)
+        return feats, labels
+
+    @staticmethod
+    def _corrupt_rows(cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        """Row mask of non-finite values across the RAW columns — the
+        scan happens where untrusted bytes enter, before any transform
+        can turn a NaN into a crash."""
+        bad = np.zeros(n, dtype=bool)
+        for a in cols.values():
+            if isinstance(a, np.ndarray) and \
+                    np.issubdtype(a.dtype, np.floating):
+                bad |= ~np.isfinite(a.reshape(n, -1)).all(axis=1)
+        return bad
+
+    def _read_item(self, item: WorkItem) -> dict:
+        ids = np.asarray(item.record_ids, dtype=np.int64)
+        try:
+            cols = self._reader.read_rows(ids)
+        except ShardCorruptError as e:
+            if e.cause != "shard_quarantined":
+                raise
+            # the shard was already quarantined (its first failure was
+            # raised loudly): withhold its rows, keep the rest
+            bad_shards = self._reader.quarantined_shards_snapshot()
+            offsets = [(s.offset, s.offset + s.records, i) for i, s in
+                       enumerate(self._reader.manifest.shards)
+                       if i in bad_shards]
+            withheld = np.zeros(len(ids), dtype=bool)
+            for lo, hi, _ in offsets:
+                withheld |= (ids >= lo) & (ids < hi)
+            n_withheld = int(withheld.sum())
+            with self._lock:
+                self._records_withheld += n_withheld
+            self._emit_event({"type": "faults",
+                              "event": "records_withheld",
+                              "t": time.time(), "records": n_withheld,
+                              "batch_index": item.index})
+            ids = ids[~withheld]
+            if not len(ids):
+                return {"index": item.index, "batch": None, "rows": 0}
+            cols = self._reader.read_rows(ids)
+        keep = np.ones(len(ids), dtype=bool)
+        with self._lock:
+            quarantined = self._quarantined_records
+            if quarantined:
+                keep &= ~np.isin(ids, np.fromiter(
+                    quarantined, dtype=np.int64, count=len(quarantined)))
+        if self._quarantine_rows:
+            bad = self._corrupt_rows(cols, len(ids))
+            fresh = bad & keep
+            if fresh.any():
+                fresh_ids = [int(i) for i in ids[fresh]]
+                with self._lock:
+                    self._quarantined_records.update(fresh_ids)
+                    self._rows_quarantined += len(fresh_ids)
+                self._emit_event({
+                    "type": "faults", "event": "record_quarantine",
+                    "t": time.time(), "records": len(fresh_ids),
+                    "batch_index": item.index,
+                    "record_ids": fresh_ids[:16]})
+            keep &= ~bad
+        if not keep.all():
+            ids = ids[keep]
+            cols = {k: a[keep] for k, a in cols.items()}
+        if not len(ids):
+            return {"index": item.index, "batch": None, "rows": 0}
+        feats, labels = self._assemble(cols)
+        return {"index": item.index, "batch": (feats, labels),
+                "rows": len(ids)}
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self):
+        return self._iterate()
+
+    def _iterate(self):
+        with self._lock:
+            if self._pending_seek is not None:
+                st = self._pending_seek
+                self._pending_seek = None
+                pass_index = st["pass_index"]
+                plan_start = st["cursor"]
+                yield_base = st["yielded"]
+                base = frozenset(st["base"])
+                shard_base = frozenset(st["shard_base"])
+                # the consumer-pass anchor (what a wrapper's absolute
+                # per-pass batch index is relative to) moves only on a
+                # NEW consumer timeline (fresh pass / restore), never on
+                # an intra-pass seek — RetryingIterator keeps counting
+                # from its pass start across repeated recoveries
+                anchor = st.get("anchor", yield_base)
+                self._passes_started = max(self._passes_started,
+                                           pass_index + 1)
+            else:
+                pass_index = self._passes_started
+                self._passes_started += 1
+                plan_start, yield_base, anchor = 0, 0, 0
+                base = frozenset(self._quarantined_records)
+                shard_base = frozenset(
+                    self._reader.quarantined_shards_snapshot())
+            self._current_pass = pass_index
+            self._pass_quarantine_base = base
+            self._pass_shard_base = shard_base
+            self._pass_anchor = anchor
+            self._pass_complete = False
+            self._plan_cursor = plan_start
+            self._yield_counter = yield_base
+            self._gen_yield_base = yield_base
+            self._yield_plan = {k: v for k, v in self._yield_plan.items()
+                                if k < yield_base} if yield_base else {}
+            src = self._iteration_source
+            self._pass_start_iteration = (int(src()) - yield_base) \
+                if src is not None else None
+            esrc = self._epoch_source
+            self._pass_start_epoch = int(esrc()) if esrc is not None \
+                else None
+        perm = self._pass_permutation(pass_index, base, shard_base)
+        plan = self._plan_items(perm)
+        if plan_start > len(plan):
+            raise DataPipelineError(
+                f"seek cursor {plan_start} beyond the pass's "
+                f"{len(plan)} batches — the source shrank since the "
+                f"state was captured", batch_index=plan_start,
+                cause="source_shrank")
+        pf = SupervisedPrefetcher(
+            plan[plan_start:], self._read_item,
+            n_workers=self._n_workers, depth=self._depth,
+            read_timeout_s=self._read_timeout_s,
+            on_event=self._emit_event)
+        with self._lock:
+            self._live_prefetcher = pf
+        try:
+            for out in pf:
+                if out["batch"] is None:        # fully-quarantined batch
+                    with self._lock:
+                        self._plan_cursor = out["index"] + 1
+                    continue
+                # plan-cursor advance and yield bookkeeping in ONE lock
+                # block: a checkpoint capture on the training thread
+                # between the two would read a cursor past a batch the
+                # yield map doesn't cover yet — a resume from that
+                # snapshot would seek over (never train) the in-flight
+                # batch
+                with self._lock:
+                    self._yield_plan[self._yield_counter] = out["index"]
+                    self._yield_counter += 1
+                    self._plan_cursor = out["index"] + 1
+                    self._records_delivered += out["rows"]
+                    self._batches_delivered += 1
+                yield out["batch"]
+            with self._lock:
+                self._pass_complete = True
+        finally:
+            self._fold_prefetcher(pf)
+            pf.close()
+
+    def _fold_prefetcher(self, pf: SupervisedPrefetcher) -> None:
+        with self._lock:
+            if self._live_prefetcher is pf:
+                self._live_prefetcher = None
+            self._pf_totals["worker_restarts"] += pf.restarts_total
+            self._pf_totals["requeues"] += pf.requeues_total
+            self._pf_totals["slow_reads"] += pf.slow_reads_total
+            for w, s in pf.worker_busy_seconds().items():
+                self._pf_busy[w] = self._pf_busy.get(w, 0.0) + s
+
+    # -- seekable state --------------------------------------------------
+    def bind_iteration_source(self, fn: Callable[[], int]) -> None:
+        """Register the trainer's absolute-iteration reader (fit()
+        wires ``tc.iteration_count``). With it bound, pass starts are
+        anchored to iterations and :meth:`export_state` can map a
+        checkpoint's iteration to the exact plan cursor."""
+        self._iteration_source = fn
+
+    def bind_epoch_source(self, fn: Callable[[], int]) -> None:
+        """Register the trainer's completed-epoch reader
+        (``tc.epoch_count``). It disambiguates the one position the
+        iteration alone cannot: a checkpoint captured EXACTLY at a pass
+        boundary. Before ``on_epoch_end`` counts the epoch, the resume
+        must re-enter the finished pass at its end (an empty epoch that
+        absorbs the pending count); after, it must start the next fresh
+        pass — exporting the wrong one trains a pass twice or not at
+        all."""
+        self._epoch_source = fn
+
+    def export_state(self, iteration: Optional[int] = None
+                     ) -> dict:
+        """The JSON-able :class:`PipelineState` at ``iteration`` (the
+        checkpointed step) — or at everything-delivered when no
+        iteration anchor exists. Called by
+        ``checkpoint.capture_training_state`` at flush boundaries."""
+        with self._lock:
+            quarantined = sorted(self._quarantined_records)
+            shards = sorted(self._reader.quarantined_shards_snapshot())
+            config = {"seed": self._seed,
+                      "batch_size": self._batch,
+                      "shuffle": self._shuffle,
+                      "host_index": self.host_index,
+                      "host_count": self.host_count}
+            if self._pending_seek is not None:
+                # an armed-but-not-yet-consumed seek (restore_state
+                # before the next pass begins) IS the position: a
+                # snapshot taken now — e.g. FaultTolerantFit's step-0
+                # rollback-target save right after resume_latest — must
+                # re-export it, not a fresh next pass that would skip
+                # the rest of the interrupted one
+                st = self._pending_seek
+                return PipelineState(
+                    pass_index=st["pass_index"], cursor=st["cursor"],
+                    yielded=st["yielded"],
+                    passes_started=self._passes_started,
+                    quarantined_records=quarantined,
+                    pass_quarantine_base=sorted(st["base"]),
+                    quarantined_shards=shards,
+                    pass_shard_base=sorted(st["shard_base"]),
+                    **config).to_json()
+            if self._current_pass is None:
+                # before the first pass: resume = start pass 0 fresh
+                return PipelineState(
+                    pass_index=self._passes_started, cursor=0, yielded=0,
+                    passes_started=self._passes_started,
+                    quarantined_records=quarantined,
+                    pass_quarantine_base=quarantined,
+                    quarantined_shards=shards,
+                    pass_shard_base=shards, **config).to_json()
+            if iteration is not None and \
+                    self._pass_start_iteration is not None:
+                y = max(0, min(int(iteration) - self._pass_start_iteration,
+                               self._yield_counter))
+            else:
+                y = self._yield_counter
+            if self._pass_complete and y >= self._yield_counter:
+                # the checkpoint sits EXACTLY on a pass boundary. Two
+                # distinct resumes hide here, told apart by whether
+                # on_epoch_end already counted the pass's epoch:
+                counted = (self._epoch_source is not None
+                           and self._pass_start_epoch is not None
+                           and int(self._epoch_source())
+                           > self._pass_start_epoch)
+                if counted:
+                    # counted (epoch-cadence snapshot): the restored
+                    # epoch budget excludes this pass → next fresh pass
+                    return PipelineState(
+                        pass_index=self._passes_started, cursor=0,
+                        yielded=0,
+                        passes_started=self._passes_started,
+                        quarantined_records=quarantined,
+                        pass_quarantine_base=quarantined,
+                        quarantined_shards=shards,
+                        pass_shard_base=shards, **config).to_json()
+                # NOT counted (iteration-cadence snapshot fired at the
+                # last flush of the epoch): the restored epoch budget
+                # still includes this pass, so the resume re-enters it
+                # AT ITS END — an empty epoch that absorbs the pending
+                # on_epoch_end count without retraining a single batch
+                return PipelineState(
+                    pass_index=self._current_pass,
+                    cursor=self._plan_cursor, yielded=int(y),
+                    passes_started=self._passes_started,
+                    quarantined_records=quarantined,
+                    pass_quarantine_base=sorted(
+                        self._pass_quarantine_base),
+                    quarantined_shards=shards,
+                    pass_shard_base=sorted(self._pass_shard_base),
+                    **config).to_json()
+            cursor = self._yield_plan.get(y, self._plan_cursor)
+            return PipelineState(
+                pass_index=self._current_pass, cursor=int(cursor),
+                yielded=int(y),
+                passes_started=self._passes_started,
+                quarantined_records=quarantined,
+                pass_quarantine_base=sorted(self._pass_quarantine_base),
+                quarantined_shards=shards,
+                pass_shard_base=sorted(self._pass_shard_base),
+                **config).to_json()
+
+    def restore_state(self, state) -> None:
+        """Arm the pipeline so its NEXT pass resumes exactly where
+        ``state`` points: same pass permutation, plan cursor, and
+        quarantine sets. Accepts the dict :meth:`export_state`
+        produced (what ``TrainingState.metadata['datapipe']`` holds)
+        or a :class:`PipelineState`."""
+        st = state if isinstance(state, PipelineState) \
+            else PipelineState.from_json(dict(state))
+        if st.seed != self._seed:
+            raise DataPipelineError(
+                f"PipelineState was captured with shuffle seed "
+                f"{st.seed}, this pipeline uses {self._seed} — the "
+                f"replayed pass orders would differ silently",
+                cause="seed_mismatch")
+        # the cursor is denominated in plan batches of the CAPTURING
+        # configuration: restoring into a differently-shaped plan would
+        # seek to different records with no error (None = old state
+        # without the field: check skipped)
+        for field, mine in (("batch_size", self._batch),
+                            ("shuffle", self._shuffle),
+                            ("host_index", self.host_index),
+                            ("host_count", self.host_count)):
+            theirs = getattr(st, field)
+            if theirs is not None and theirs != mine:
+                raise DataPipelineError(
+                    f"PipelineState was captured with {field}="
+                    f"{theirs}, this pipeline uses {mine} — the plan "
+                    f"cursor would seek to different records silently",
+                    cause="config_mismatch")
+        with self._lock:
+            self._quarantined_records = set(st.quarantined_records)
+            self._reader.quarantine_shards(st.quarantined_shards)
+            # the snapshot's pass counter is AUTHORITATIVE, not merged:
+            # an in-process rollback rolls the timeline (and therefore
+            # the fresh-pass numbering) BACK — keeping the live counter
+            # would skip the abandoned pass's permutation on retry and
+            # train different data than the uninterrupted run
+            self._passes_started = st.passes_started
+            self._pending_seek = {"pass_index": st.pass_index,
+                                  "cursor": st.cursor,
+                                  "yielded": st.yielded,
+                                  "anchor": st.yielded,
+                                  "base": list(st.pass_quarantine_base),
+                                  "shard_base":
+                                  list(st.pass_shard_base)}
+            self._current_pass = None
+            self._pass_complete = False
+
+    def seek_batches(self, skip: int):
+        """Re-open the CURRENT pass positioned after ``skip`` batches
+        already delivered to the consumer — the O(1) recovery hook
+        ``faults.RetryingIterator`` uses instead of reset-and-fast-
+        forward. Returns the positioned iterator. Raises a
+        ``source_shrank`` :class:`DataPipelineError` when ``skip``
+        exceeds what this pass can deliver."""
+        with self._lock:
+            if self._current_pass is None:
+                raise DataPipelineError(
+                    "seek_batches: no pass in progress (iterate first)",
+                    cause="seek")
+            # ``skip`` is the CONSUMER's absolute per-pass batch count
+            # (RetryingIterator never resets its index across repeated
+            # recoveries), so it is relative to the pass ANCHOR — not
+            # to the current generator, which may itself be the product
+            # of an earlier seek
+            y = self._pass_anchor + max(0, int(skip))
+            if y > self._yield_counter:
+                raise DataPipelineError(
+                    f"seek_batches: {skip} batches requested but only "
+                    f"{self._yield_counter - self._pass_anchor} were "
+                    f"delivered this pass — the source shrank",
+                    batch_index=int(skip), cause="source_shrank")
+            cursor = self._yield_plan.get(y, self._plan_cursor)
+            self._pending_seek = {"pass_index": self._current_pass,
+                                  "cursor": int(cursor), "yielded": y,
+                                  "anchor": self._pass_anchor,
+                                  "base":
+                                  sorted(self._pass_quarantine_base),
+                                  "shard_base":
+                                  sorted(self._pass_shard_base)}
+        return self._iterate()
+
+    # -- observability ---------------------------------------------------
+    @property
+    def quarantined_records(self) -> set:
+        with self._lock:
+            return set(self._quarantined_records)
+
+    def stats(self) -> dict:
+        """Cumulative pipeline counters (monotonic — the monitor
+        listener publishes per-flush deltas as ``{"type": "datapipe"}``
+        records)."""
+        with self._lock:
+            pf = self._live_prefetcher
+            totals = dict(self._pf_totals)
+            busy = dict(self._pf_busy)
+            out = {"records": self._records_delivered,
+                   "batches": self._batches_delivered,
+                   "rows_quarantined": self._rows_quarantined,
+                   "records_withheld": self._records_withheld,
+                   "passes_started": self._passes_started,
+                   "workers": self._n_workers}
+            # fold the LIVE prefetcher inside the lock: _fold_prefetcher
+            # (also under it) must not land between the snapshot and the
+            # merge, or the same pass would count twice
+            if pf is not None:
+                totals["worker_restarts"] += pf.restarts_total
+                totals["requeues"] += pf.requeues_total
+                totals["slow_reads"] += pf.slow_reads_total
+                for w, s in pf.worker_busy_seconds().items():
+                    busy[w] = busy.get(w, 0.0) + s
+        out.update(totals)
+        out["worker_busy_s"] = {str(k): round(v, 6)
+                                for k, v in sorted(busy.items())}
+        out.update(self._reader.stats())
+        return out
+
+
+__all__ = ["StreamingDataPipeline", "find_pipeline"]

@@ -1,0 +1,377 @@
+"""Supervised parallel prefetch: the worker pool behind the pipeline.
+
+The serving resilience rail's ``WorkerSupervisor`` shape (PR 9) applied
+to data loading: prefetch workers are SUPERVISED, not immortal —
+
+- a worker claims one :class:`WorkItem` (a batch's record-id range) at
+  a time through an :class:`InflightSlot`-style claim window; a dead
+  worker's claimed item is requeued at the FRONT **exactly once**
+  (``WorkItem.requeues``; an item lost to two crashed workers fails
+  in-stream with a typed ``DataPipelineError`` instead of ping-ponging)
+  and the worker is respawned with bounded exponential backoff;
+- a read exceeding ``read_timeout_s`` gets a BACKUP: the supervisor
+  requeues the item (its own one-hedge budget — a timeout is not a
+  loss and never poisons) so another worker re-reads it while the
+  straggler finishes — first result wins, late duplicates are
+  discarded (content is deterministic, so either copy is identical).
+  The classic tail-latency hedge, here for a hung NFS read;
+- STRUCTURED loader errors (``DataPipelineError`` and its
+  ``ShardCorruptError`` subtype — the reader's post-retry verdicts)
+  travel IN-STREAM as a poisoned result at the right batch index (the
+  ``AsyncDataSetIterator`` convention), so the consumer raises them in
+  order and an epoch can never end silently short; any OTHER exception
+  is a worker crash and takes the supervision path above.
+
+Results are re-ordered: the consumer iterates batches in plan order
+regardless of which worker finished first, with a bounded reorder
+window (``depth``) so a slow head batch backpressures the pool instead
+of letting it race ahead unboundedly.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.faults.errors import DataPipelineError
+
+#: chaos seam (faults/chaos.py worker_killer): {"at_index", "left",
+#: "log"} — a claiming worker whose item index matches raises an
+#: UNSTRUCTURED error, i.e. a worker crash, exercising the
+#: exactly-once requeue + respawn path. None = no injection.
+_CHAOS_KILL: Optional[dict] = None
+
+
+class WorkItem:
+    """One batch's worth of work: plan index + the global record ids
+    composing it. ``requeues`` counts CRASH losses (exactly-once
+    budget); ``hedges`` counts read-timeout backup requests (at most
+    one — a timeout is not a loss, the straggler is still working, so
+    it must never consume the crash budget or poison the item)."""
+
+    __slots__ = ("index", "record_ids", "requeues", "hedges")
+
+    def __init__(self, index: int, record_ids: np.ndarray):
+        self.index = int(index)
+        self.record_ids = record_ids
+        self.requeues = 0
+        self.hedges = 0
+
+
+class _WorkerSlot:
+    """Per-worker claim window (serving/resilience.InflightSlot shape):
+    what the supervisor requeues when the worker dies or stalls
+    mid-read. Plain attribute writes (atomic under the GIL)."""
+
+    def __init__(self):
+        self.claimed: Optional[WorkItem] = None
+        self.read_started: Optional[float] = None
+        self.timeout_fired = False
+        self.exited = False
+        self.crashed: Optional[BaseException] = None
+        self.busy_s = 0.0              # cumulative read seconds
+
+
+class _Poison:
+    """In-stream structured failure at a batch index."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+class SupervisedPrefetcher:
+    """Run ``items`` through ``read_item`` on a supervised worker pool;
+    iterate the results in plan order.
+
+    ``read_item(item) -> batch`` runs on worker threads (the verified
+    shard read + the vectorized transform). ``on_event`` receives one
+    dict per supervision decision (also folded into ``stats()``).
+    """
+
+    def __init__(self, items: List[WorkItem],
+                 read_item: Callable[[WorkItem], object],
+                 n_workers: int = 2, depth: int = 4,
+                 read_timeout_s: Optional[float] = None,
+                 backoff_base_s: float = 0.01, backoff_max_s: float = 1.0,
+                 poll_s: float = 0.01,
+                 on_event: Optional[Callable[[dict], None]] = None):
+        self._queue: "deque[WorkItem]" = deque(items)
+        # items carry ABSOLUTE plan indices (a seek-resumed pass starts
+        # mid-plan); emission runs [first, end) in index order
+        self._first = items[0].index if items else 0
+        self._end = items[-1].index + 1 if items else 0
+        self._read_item = read_item
+        self._depth = max(1, int(depth))
+        self._read_timeout_s = read_timeout_s
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.poll_s = float(poll_s)
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._results: Dict[int, object] = {}
+        self._next_emit = self._first
+        self._stopping = False
+        self._started = time.monotonic()
+        # counters (datapipe telemetry)
+        self.restarts_total = 0
+        self.requeues_total = 0
+        self.slow_reads_total = 0
+        self.items_served = 0
+        self._entries: List[dict] = []
+        for i in range(max(1, int(n_workers))):
+            slot = _WorkerSlot()
+            self._entries.append({"index": i, "slot": slot,
+                                  "thread": self._spawn(i, slot),
+                                  "restarts": 0, "consecutive": 0,
+                                  "busy_s": 0.0})
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="DatapipeSupervisor", daemon=True)
+        self._supervisor.start()
+
+    # -- events ---------------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            self._on_event({"type": "faults", "event": kind,
+                            "t": time.time(), **fields})
+
+    # -- worker side ----------------------------------------------------
+    def _spawn(self, index: int, slot: _WorkerSlot) -> threading.Thread:
+        t = threading.Thread(target=self._worker, args=(index, slot),
+                             name=f"DatapipeWorker-{index}", daemon=True)
+        t.start()
+        return t
+
+    def _claim(self, slot: _WorkerSlot) -> Optional[WorkItem]:
+        """Pop the head work item once it is inside the reorder window
+        (head.index < next_emit + depth); None = work exhausted or
+        shutdown."""
+        with self._cond:
+            while not self._stopping:
+                if self._queue and (self._queue[0].index
+                                    < self._next_emit + self._depth):
+                    item = self._queue.popleft()
+                    slot.claimed = item
+                    slot.read_started = time.monotonic()
+                    slot.timeout_fired = False
+                    return item
+                if not self._queue and self._all_resolved_locked():
+                    return None
+                self._cond.wait(timeout=0.05)
+            return None
+
+    def _all_resolved_locked(self) -> bool:
+        if self._next_emit >= self._end:
+            return True
+        # anything still claimed may yet produce a result
+        return not any(e["slot"].claimed is not None
+                       for e in self._entries) and not self._queue \
+            and all(i in self._results
+                    for i in range(self._next_emit, self._end))
+
+    def _deliver(self, item: WorkItem, result: object) -> None:
+        with self._cond:
+            if item.index not in self._results and \
+                    item.index >= self._next_emit:
+                self._results[item.index] = result
+            # a late straggler/backup duplicate is silently dropped:
+            # the first arrival already owns the index (identical bytes)
+            self._cond.notify_all()
+
+    def _worker(self, index: int, slot: _WorkerSlot) -> None:
+        try:
+            while True:
+                item = self._claim(slot)
+                if item is None:
+                    slot.exited = True
+                    return
+                kill = _CHAOS_KILL
+                if kill is not None and kill.get("left", 0) > 0 and \
+                        item.index == kill.get("at_index"):
+                    kill["left"] -= 1
+                    kill.setdefault("log", []).append(
+                        {"event": "worker_killed", "batch_index":
+                         item.index, "worker": index, "t": time.time()})
+                    raise RuntimeError(
+                        f"chaos: prefetch worker {index} killed at "
+                        f"batch {item.index}")
+                t0 = time.monotonic()
+                try:
+                    batch = self._read_item(item)
+                except DataPipelineError as e:
+                    # structured loader verdict: poison in-stream at the
+                    # right index — the consumer raises it in order
+                    if e.batch_index is None:
+                        e.batch_index = item.index
+                    self._deliver(item, _Poison(e))
+                    slot.claimed = None
+                    slot.read_started = None
+                    continue
+                finally:
+                    slot.busy_s += time.monotonic() - t0
+                self._deliver(item, batch)
+                slot.claimed = None
+                slot.read_started = None
+        except BaseException as e:      # worker crash → supervision path
+            # record and RETURN (no re-raise: the supervisor owns the
+            # episode, and threading's excepthook would spray the
+            # injected chaos traceback over every drill's stderr)
+            slot.crashed = e
+
+    # -- supervisor -----------------------------------------------------
+    def _requeue(self, item: WorkItem, why: str, worker: int) -> None:
+        with self._cond:
+            already = item.index in self._results or \
+                item.index < self._next_emit
+            if already:
+                return
+            if why == "read_timeout":
+                # a timeout is a HEDGE, not a loss: the straggler still
+                # owns a live claim and may deliver. At most one backup
+                # per item, and timeouts never poison (a same-shard
+                # backup serialized behind the straggler's shard lock
+                # would otherwise "lose" the batch twice while both
+                # readers are healthy)
+                if item.hedges >= 1:
+                    return
+                item.hedges += 1
+            elif item.requeues >= 1:
+                # exactly-once: a batch lost to two CRASHED workers
+                # fails its slot with a typed in-stream error instead
+                # of ping-ponging
+                self._results[item.index] = _Poison(DataPipelineError(
+                    f"batch {item.index} lost to {why} twice; giving up",
+                    batch_index=item.index, cause=why))
+                self._cond.notify_all()
+                return
+            else:
+                item.requeues += 1
+            self.requeues_total += 1
+            self._queue.appendleft(item)
+            self._cond.notify_all()
+        self._event("prefetch_requeue", batch_index=item.index,
+                    cause=why, worker=worker)
+
+    def _handle_crash(self, entry: dict) -> None:
+        slot: _WorkerSlot = entry["slot"]
+        entry["busy_s"] += slot.busy_s
+        slot.busy_s = 0.0       # folded; a skipped respawn (shutdown)
+        #                         must not count this slot twice
+        item = slot.claimed
+        self.restarts_total += 1
+        entry["restarts"] += 1
+        entry["consecutive"] += 1
+        self._event("worker_crash", worker=entry["index"],
+                    error=repr(slot.crashed) if slot.crashed else None,
+                    batch_index=item.index if item else None)
+        if item is not None:
+            self._requeue(item, "worker_crash", entry["index"])
+            slot.claimed = None    # requeued; the dead slot must not
+            #                        read as in-flight work
+        backoff = min(self.backoff_max_s, self.backoff_base_s *
+                      (2 ** (entry["consecutive"] - 1)))
+        # respawn is a DEADLINE checked by the supervise loop, never an
+        # inline sleep: blocking here would suspend crash detection and
+        # timeout hedging for every OTHER worker for the whole backoff
+        entry["respawn_at"] = time.monotonic() + backoff
+        entry["backoff_s"] = backoff
+        entry["thread"] = None
+
+    def _maybe_respawn(self, entry: dict) -> None:
+        if self._stopping or time.monotonic() < entry["respawn_at"]:
+            return
+        new_slot = _WorkerSlot()
+        entry["slot"] = new_slot
+        entry["thread"] = self._spawn(entry["index"], new_slot)
+        self._event("worker_restart", worker=entry["index"],
+                    restarts=entry["restarts"],
+                    backoff_s=round(entry["backoff_s"], 4))
+
+    def _supervise(self) -> None:
+        while not self._stopping:
+            for entry in self._entries:
+                t, slot = entry["thread"], entry["slot"]
+                if t is None:                 # dead, awaiting respawn
+                    self._maybe_respawn(entry)
+                    continue
+                if t.is_alive():
+                    if entry["consecutive"] and slot.claimed is None \
+                            and slot.busy_s > 0:
+                        entry["consecutive"] = 0    # served work again
+                    item = slot.claimed
+                    if item is not None and not slot.timeout_fired and \
+                            self._read_timeout_s is not None and \
+                            slot.read_started is not None and \
+                            time.monotonic() - slot.read_started \
+                            > self._read_timeout_s:
+                        # straggler read: hedge with a backup worker;
+                        # the late original result will be discarded
+                        slot.timeout_fired = True
+                        self.slow_reads_total += 1
+                        self._event("slow_read", worker=entry["index"],
+                                    batch_index=item.index,
+                                    timeout_s=self._read_timeout_s)
+                        self._requeue(item, "read_timeout",
+                                      entry["index"])
+                    continue
+                if slot.exited or self._stopping:
+                    continue
+                self._handle_crash(entry)
+            with self._cond:
+                if self._next_emit >= self._end:
+                    return
+            time.sleep(self.poll_s)
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        try:
+            while True:
+                with self._cond:
+                    while self._next_emit < self._end and \
+                            self._next_emit not in self._results and \
+                            not self._stopping:
+                        self._cond.wait(timeout=0.1)
+                    if self._stopping or self._next_emit >= self._end:
+                        return
+                    result = self._results.pop(self._next_emit)
+                    self._next_emit += 1
+                    self.items_served += 1
+                    self._cond.notify_all()
+                if isinstance(result, _Poison):
+                    raise result.error
+                yield result
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._supervisor.join(timeout=5)
+        for entry in self._entries:
+            if entry["thread"] is not None:   # None = awaiting respawn
+                entry["thread"].join(timeout=5)
+
+    # -- observability --------------------------------------------------
+    def worker_busy_seconds(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        for entry in self._entries:
+            out[entry["index"]] = entry["busy_s"] + entry["slot"].busy_s
+        return out
+
+    def stats(self) -> dict:
+        return {"workers": len(self._entries),
+                "worker_restarts": self.restarts_total,
+                "requeues": self.requeues_total,
+                "slow_reads": self.slow_reads_total,
+                "items_served": self.items_served,
+                "wall_s": time.monotonic() - self._started,
+                "worker_busy_s": self.worker_busy_seconds()}
+
+
+__all__ = ["SupervisedPrefetcher", "WorkItem"]

@@ -1,0 +1,304 @@
+"""ShardedRecordReader: verified, retrying, quarantining shard access.
+
+The read path is ONE seam — :func:`_read_file_bytes` — so the chaos
+harness can inject flaky/slow IO exactly where production IO happens,
+and so verification hashes THE BYTES THAT WERE READ (a verify-the-file-
+then-load-the-file sequence would race bit-rot between the two opens).
+
+Failure discipline (detect → decide → recover, applied to IO):
+
+- a shard whose bytes fail verification (size, sha256, record count,
+  unreadable npz) raises a typed, RETRYABLE
+  :class:`~deeplearning4j_tpu.faults.errors.ShardCorruptError` with
+  shard + offset provenance;
+- transient read errors (``OSError``) and verification failures are
+  retried up to ``read_retries`` times with bounded exponential
+  backoff — flaky NFS heals on the re-read;
+- a shard that exhausts its retry budget ``quarantine_budget`` times
+  is QUARANTINED: its records drop out of ``record_ids()`` (loudly —
+  a ``shard_quarantined`` event carries the lost-record count), and
+  further reads of it fail fast. Bit-rot costs one shard, not the job.
+
+Reads are whole-shard (one sequential read + one hash per shard
+content version, cached by ``(path, mtime_ns, size)``) with an LRU of
+decoded shards, so a shuffled pass touching a shard from many batches
+decodes it once.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datapipe.manifest import (ShardInfo, ShardManifest,
+                                                  load_manifest,
+                                                  shard_assignment,
+                                                  verify_shard_bytes)
+from deeplearning4j_tpu.faults.errors import ShardCorruptError
+
+
+def _read_file_bytes(path: str) -> bytes:
+    """THE shard-IO seam: every byte the reader consumes flows through
+    here (chaos.flaky_read / chaos.slow_reader patch this)."""
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+class ShardedRecordReader:
+    """Verified access to a committed dataset directory's shards.
+
+    ``host_index``/``host_count`` select this process's shard subset
+    (disjoint-and-total round-robin, manifest.shard_assignment);
+    record ids stay GLOBAL so multihost quarantine/seek state is
+    host-portable. Thread-safe: prefetch workers call
+    :meth:`read_rows` concurrently.
+    """
+
+    def __init__(self, directory: str, host_index: int = 0,
+                 host_count: int = 1, verify: bool = True,
+                 read_retries: int = 3, backoff_base_s: float = 0.0,
+                 backoff_max_s: float = 1.0, quarantine_budget: int = 2,
+                 cache_shards: int = 4,
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.directory = os.fspath(directory)
+        self.manifest: ShardManifest = load_manifest(self.directory)
+        self.assigned: List[int] = shard_assignment(
+            len(self.manifest.shards), host_index, host_count)
+        # the manifest is immutable after load: precompute the shard
+        # offset table once (read_rows maps ids -> shards per batch on
+        # the hot worker path)
+        self._offsets = np.array([s.offset for s in self.manifest.shards],
+                                 dtype=np.int64)
+        self.verify = bool(verify)
+        self.read_retries = max(0, int(read_retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.quarantine_budget = max(1, int(quarantine_budget))
+        self._sleep = sleep
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        # per-shard in-flight guards: concurrent workers wanting the
+        # same uncached shard load it ONCE (the second finds the cache
+        # populated) — without this, every cold shard pays duplicate
+        # read+hash+decode at n_workers>1, and a transiently-corrupt
+        # shard has its quarantine budget double-counted by the racing
+        # workers' simultaneously-exhausted retry loops
+        self._shard_locks: Dict[int, threading.Lock] = {}
+        # decoded-shard LRU + per-content verification memo
+        self._cache: "OrderedDict[int, Dict[str, np.ndarray]]" = \
+            OrderedDict()
+        self._cache_cap = max(1, int(cache_shards))
+        self._verified: Dict[int, tuple] = {}     # idx -> (mtime_ns, size)
+        self._failures: Dict[int, int] = {}       # idx -> exhausted budgets
+        self.quarantined_shards: set = set()
+        # observability counters (datapipe telemetry reads these)
+        self.read_retries_total = 0
+        self.shard_reads_total = 0
+        self.bytes_read_total = 0
+
+    # ------------------------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            self._on_event({"type": "faults", "event": kind,
+                            "t": time.time(), **fields})
+
+    def shard(self, index: int) -> ShardInfo:
+        return self.manifest.shards[index]
+
+    def quarantined_shards_snapshot(self) -> set:
+        """Locked copy of the quarantine set — workers mutate the live
+        set under the reader lock, so cross-thread consumers (pipeline
+        pass planning, checkpoint capture) must read a snapshot, not
+        iterate the live set."""
+        with self._lock:
+            return set(self.quarantined_shards)
+
+    def quarantine_shards(self, indices) -> None:
+        """Locked bulk add (restore_state re-arms a snapshot's set)."""
+        with self._lock:
+            self.quarantined_shards.update(int(i) for i in indices)
+
+    def record_ids(self, exclude_shards=None) -> np.ndarray:
+        """This host's GLOBAL record ids, excluded shards removed
+        (sorted ascending — the permutation's stable input).
+        ``exclude_shards`` defaults to the LIVE quarantine set; the
+        pipeline passes each pass's FROZEN pass-start set instead, so a
+        shard quarantined mid-pass withholds rows without re-planning
+        the pass a seek-resume would then mis-enter."""
+        if exclude_shards is None:
+            exclude_shards = self.quarantined_shards
+        parts = []
+        for i in self.assigned:
+            if i in exclude_shards:
+                continue
+            s = self.manifest.shards[i]
+            parts.append(np.arange(s.offset, s.offset + s.records,
+                                   dtype=np.int64))
+        return np.concatenate(parts) if parts else \
+            np.empty(0, dtype=np.int64)
+
+    def shard_of(self, record_id: int) -> int:
+        """Global record id -> owning shard index."""
+        if not 0 <= record_id < self.manifest.record_count:
+            raise IndexError(f"record id {record_id} outside the "
+                             f"dataset's {self.manifest.record_count} "
+                             f"records")
+        return int(np.searchsorted(self._offsets, record_id,
+                                   side="right") - 1)
+
+    # ------------------------------------------------------------------
+    def _load_verified(self, index: int) -> Dict[str, np.ndarray]:
+        """Read + verify + decode one shard's bytes (no retry here —
+        one attempt; the caller owns the budget)."""
+        info = self.manifest.shards[index]
+        path = os.path.join(self.directory, info.file)
+        try:
+            data = _read_file_bytes(path)
+        except OSError as e:
+            raise ShardCorruptError(
+                f"shard {info.file}: read failed: {e!r}",
+                shard=info.file, offset=info.offset, cause="io") from e
+        with self._lock:
+            self.shard_reads_total += 1
+            self.bytes_read_total += len(data)
+        if self.verify:
+            problems = verify_shard_bytes(info, data)
+            if problems:
+                raise ShardCorruptError(
+                    f"shard {info.file}: {'; '.join(problems)} — "
+                    f"bit-rot or a torn write (records "
+                    f"[{info.offset}, {info.offset + info.records}))",
+                    shard=info.file, offset=info.offset)
+        try:
+            with np.load(io.BytesIO(data)) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+        except Exception as e:   # zipfile/np decode of damaged bytes
+            raise ShardCorruptError(
+                f"shard {info.file}: undecodable npz: {e!r}",
+                shard=info.file, offset=info.offset) from e
+        lens = {len(a) for a in arrays.values()}
+        if not arrays or lens != {info.records}:
+            raise ShardCorruptError(
+                f"shard {info.file}: {sorted(lens)} rows decoded but the "
+                f"manifest records {info.records}",
+                shard=info.file, offset=info.offset)
+        return arrays
+
+    def _shard_lock(self, index: int) -> threading.Lock:
+        with self._lock:
+            lk = self._shard_locks.get(index)
+            if lk is None:
+                lk = self._shard_locks[index] = threading.Lock()
+            return lk
+
+    def _get_shard(self, index: int) -> Dict[str, np.ndarray]:
+        """Cached, retrying shard load; quarantines the shard after
+        ``quarantine_budget`` exhausted retry budgets. Serialized per
+        shard (distinct shards still load in parallel)."""
+        with self._shard_lock(index):
+            return self._get_shard_locked(index)
+
+    def _get_shard_locked(self, index: int) -> Dict[str, np.ndarray]:
+        info = self.manifest.shards[index]
+        path = os.path.join(self.directory, info.file)
+        with self._lock:
+            if index in self.quarantined_shards:
+                raise ShardCorruptError(
+                    f"shard {info.file} is quarantined "
+                    f"({info.records} records withheld)",
+                    shard=info.file, offset=info.offset,
+                    cause="shard_quarantined")
+            try:
+                st = os.stat(path)
+                token = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                token = None
+            cached = self._cache.get(index)
+            if cached is not None and self._verified.get(index) == token \
+                    and token is not None:
+                self._cache.move_to_end(index)
+                return cached
+        last: Optional[ShardCorruptError] = None
+        for attempt in range(self.read_retries + 1):
+            try:
+                arrays = self._load_verified(index)
+                with self._lock:
+                    self._cache[index] = arrays
+                    self._cache.move_to_end(index)
+                    while len(self._cache) > self._cache_cap:
+                        self._cache.popitem(last=False)
+                    try:
+                        st = os.stat(path)
+                        self._verified[index] = (st.st_mtime_ns,
+                                                 st.st_size)
+                    except OSError:
+                        self._verified.pop(index, None)
+                return arrays
+            except ShardCorruptError as e:
+                last = e
+                with self._lock:
+                    self.read_retries_total += 1
+                self._event("read_retry", shard=info.file, attempt=attempt,
+                            error=repr(e))
+                if attempt < self.read_retries and self.backoff_base_s > 0:
+                    self._sleep(min(self.backoff_max_s,
+                                    self.backoff_base_s * (2 ** attempt)))
+        # budget spent on this open: count it toward the shard's
+        # quarantine budget and surface the typed, retryable error
+        with self._lock:
+            self._failures[index] = self._failures.get(index, 0) + 1
+            exhausted = self._failures[index]
+            if exhausted >= self.quarantine_budget:
+                self.quarantined_shards.add(index)
+                quarantined = True
+            else:
+                quarantined = False
+        if quarantined:
+            self._event("shard_quarantined", shard=info.file,
+                        records=info.records,
+                        failures=exhausted, error=repr(last))
+        raise last
+
+    # ------------------------------------------------------------------
+    def read_rows(self, record_ids: np.ndarray) -> Dict[str, np.ndarray]:
+        """Gather GLOBAL record ids (any shards, any order) into one
+        row-aligned column dict — the vectorized read a prefetch worker
+        issues per batch. Preserves the id order given (the shuffled
+        batch composition)."""
+        ids = np.asarray(record_ids, dtype=np.int64)
+        offsets = self._offsets
+        shard_idx = np.searchsorted(offsets, ids, side="right") - 1
+        out_parts: Dict[str, List[np.ndarray]] = {}
+        order: List[np.ndarray] = []
+        for si in np.unique(shard_idx):
+            mask = shard_idx == si
+            local = ids[mask] - offsets[si]
+            arrays = self._get_shard(int(si))
+            for name, a in arrays.items():
+                out_parts.setdefault(name, []).append(a[local])
+            order.append(np.flatnonzero(mask))
+        if not order:
+            return {}
+        # reassemble in the requested (shuffled) id order
+        perm = np.concatenate(order)
+        inv = np.empty(len(ids), dtype=np.int64)
+        inv[perm] = np.arange(len(ids))
+        return {name: np.concatenate(parts)[inv]
+                for name, parts in out_parts.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"shard_reads": self.shard_reads_total,
+                    "read_retries": self.read_retries_total,
+                    "bytes_read": self.bytes_read_total,
+                    "quarantined_shards": len(self.quarantined_shards)}
+
+
+__all__ = ["ShardedRecordReader", "_read_file_bytes"]

@@ -1,0 +1,113 @@
+"""PipelineState: the seekable position of a streaming input pipeline.
+
+Everything a resumed process needs to continue a disk-backed fit
+MID-EPOCH, bit-exact, without replaying the pass:
+
+- ``pass_index``   — the pass (epoch) in progress; the pass's shuffle
+  permutation is a pure function of ``(seed, pass_index, host)``, so
+  the index IS the shuffle RNG state;
+- ``cursor``       — the next PLAN batch of that pass (plan = the
+  pass's permutation chunked into batches); seeking = recomputing the
+  permutation and starting at ``cursor``, O(1) in records read vs the
+  O(n) reset-and-fast-forward a plain iterator needs;
+- ``yielded``      — batches DELIVERED to the trainer at the same
+  point (differs from ``cursor`` only when fully-quarantined batches
+  were skipped); the capture-time bridge between the trainer's
+  iteration counter and the plan cursor;
+- ``seed`` / ``passes_started`` — the shuffle base seed and the fresh-
+  pass counter (so post-resume epochs continue the uninterrupted run's
+  pass sequence);
+- ``quarantined_records`` / ``pass_quarantine_base`` — the corrupt-row
+  quarantine set now, and as of the pass's start (the permutation is
+  computed over the BASE set — a row quarantined mid-pass must not
+  change the order of batches already consumed);
+- ``quarantined_shards`` / ``pass_shard_base`` — shards withheld after
+  their read budget, now and as of the pass's start (same reasoning:
+  the permutation is computed over the pass-start shard set, so a
+  shard quarantined mid-pass withholds rows without re-planning the
+  pass a resume would then mis-seek into);
+- ``batch_size`` / ``shuffle`` / ``host_index`` / ``host_count`` — the
+  plan-shaping configuration at capture time. ``cursor`` is
+  denominated in plan batches of THIS configuration; restoring into a
+  pipeline with a different one would silently seek to different
+  records, so ``restore_state`` checks and raises.
+
+Serialized as plain JSON-able dicts inside
+``TrainingState.metadata["datapipe"]`` (checkpoint/state.py captures
+it at every checkpoint flush; faults.FaultTolerantFit restores it on
+rollback). See docs/data_pipeline.md for what is and is not bit-exact
+across a resume.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class PipelineState:
+    pass_index: int = 0
+    cursor: int = 0
+    yielded: int = 0
+    seed: int = 0
+    passes_started: int = 0
+    quarantined_records: List[int] = dataclasses.field(default_factory=list)
+    pass_quarantine_base: List[int] = dataclasses.field(
+        default_factory=list)
+    quarantined_shards: List[int] = dataclasses.field(default_factory=list)
+    pass_shard_base: List[int] = dataclasses.field(default_factory=list)
+    # plan-shaping configuration (None = unknown, e.g. an old state:
+    # restore then skips the check)
+    batch_size: Optional[int] = None
+    shuffle: Optional[bool] = None
+    host_index: Optional[int] = None
+    host_count: Optional[int] = None
+
+    def to_json(self) -> dict:
+        return {"pass_index": int(self.pass_index),
+                "cursor": int(self.cursor),
+                "yielded": int(self.yielded),
+                "seed": int(self.seed),
+                "passes_started": int(self.passes_started),
+                "quarantined_records": sorted(
+                    int(i) for i in self.quarantined_records),
+                "pass_quarantine_base": sorted(
+                    int(i) for i in self.pass_quarantine_base),
+                "quarantined_shards": sorted(
+                    int(i) for i in self.quarantined_shards),
+                "pass_shard_base": sorted(
+                    int(i) for i in self.pass_shard_base),
+                "batch_size": self.batch_size,
+                "shuffle": self.shuffle,
+                "host_index": self.host_index,
+                "host_count": self.host_count}
+
+    @staticmethod
+    def from_json(data: dict) -> "PipelineState":
+        def _opt(key, cast):
+            v = data.get(key)
+            return None if v is None else cast(v)
+
+        return PipelineState(
+            pass_index=int(data.get("pass_index", 0)),
+            cursor=int(data.get("cursor", 0)),
+            yielded=int(data.get("yielded", data.get("cursor", 0))),
+            seed=int(data.get("seed", 0)),
+            passes_started=int(data.get("passes_started", 0)),
+            quarantined_records=[int(i) for i in
+                                 data.get("quarantined_records", [])],
+            pass_quarantine_base=[int(i) for i in
+                                  data.get("pass_quarantine_base", [])],
+            quarantined_shards=[int(i) for i in
+                                data.get("quarantined_shards", [])],
+            pass_shard_base=[int(i) for i in
+                             data.get("pass_shard_base",
+                                      data.get("quarantined_shards",
+                                               []))],
+            batch_size=_opt("batch_size", int),
+            shuffle=_opt("shuffle", bool),
+            host_index=_opt("host_index", int),
+            host_count=_opt("host_count", int))
+
+
+__all__ = ["PipelineState"]

@@ -12,6 +12,13 @@ host↔device traffic is the dispatch stream. On a tunneled chip (or any
 host-bottlenecked feed) this is the difference between transfer-bound and
 compute-bound training; the reference's nearest analogue is workspace-
 cached DataSets, which still live host-side.
+
+For datasets that do NOT fit in HBM (or host RAM), the disk-backed
+counterpart is ``datapipe.StreamingDataPipeline``: checksummed shard
+directories, supervised parallel prefetch, and seekable mid-epoch
+resume state — a DataSetIterator like everything here, so it drops into
+any fit()/RetryingIterator/AsyncDataSetIterator composition
+(docs/data_pipeline.md).
 """
 from __future__ import annotations
 

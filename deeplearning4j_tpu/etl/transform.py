@@ -68,6 +68,12 @@ def analyze(schema: Schema, reader) -> DataAnalysis:
 
 # ---------------------------------------------------------------------------
 class _Step:
+    #: True for steps whose ``apply`` may emit a different number of
+    #: rows than it received (filters). Streaming consumers that key
+    #: state on a stable global record-id space
+    #: (``datapipe.StreamingDataPipeline``) reject such steps up front.
+    changes_row_count = False
+
     def apply_schema(self, schema: Schema) -> Schema:
         raise NotImplementedError
 
@@ -118,6 +124,7 @@ class _RenameColumn(_Step):
 class _FilterRows(_Step):
     """Keep rows where predicate(cols) is True (vectorized bool mask)."""
     predicate: Callable[[Dict[str, np.ndarray]], np.ndarray]
+    changes_row_count = True
 
     def apply_schema(self, s):
         return s

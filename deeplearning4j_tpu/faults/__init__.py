@@ -18,10 +18,12 @@ See docs/fault_tolerance.md and docs/elastic_training.md.
 from deeplearning4j_tpu.checkpoint.manager import (ShardCountMismatchError,
                                                    TopologyChangedError)
 from deeplearning4j_tpu.faults.chaos import (ChaosMonkey, FileBarrier,
-                                             HostKiller, HostLossInjector)
+                                             HostKiller, HostLossInjector,
+                                             TornShard)
 from deeplearning4j_tpu.faults.errors import (DataPipelineError,
                                               FaultBudgetExhaustedError,
                                               FaultError,
+                                              ShardCorruptError,
                                               TrainingDivergedError,
                                               TransientDeviceError,
                                               retryable_errors)
@@ -35,6 +37,6 @@ __all__ = ["ChaosMonkey", "DataPipelineError", "FaultBudgetExhaustedError",
            "FaultError", "FaultTolerantFit", "FileBarrier", "HostKiller",
            "HostLossInjector", "LayerHealthWatcher", "LossSpikeWatcher",
            "PlateauWatcher", "RetryPolicy", "RetryingIterator",
-           "ShardCountMismatchError", "TopologyChangedError",
-           "TrainingDivergedError", "TransientDeviceError",
-           "retryable_errors"]
+           "ShardCorruptError", "ShardCountMismatchError", "TornShard",
+           "TopologyChangedError", "TrainingDivergedError",
+           "TransientDeviceError", "retryable_errors"]

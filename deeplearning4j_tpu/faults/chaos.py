@@ -16,6 +16,20 @@ generator. Faults on offer (the ones the recovery rail must survive):
   self-healing end-to-end test's fault of choice.
 - ``flaky_iterator(it, fail_at_batch)`` — the loader raises a transient
   ``IOError`` at a chosen batch index, a limited number of times.
+- ``torn_shard(directory, shard_index)`` — datapipe IO fault: bit-flip
+  or truncate a committed shard file on disk (restored on exit). With
+  ``heal_after_failures=N`` the original bytes return after the reader
+  has failed N verifications — transient bit-rot, the self-heal e2e's
+  fault of choice; without it the damage is permanent and drives the
+  shard-quarantine path.
+- ``flaky_read(times, every)`` / ``slow_reader(delay_s)`` — patch the
+  ONE shard-IO seam (``datapipe.reader._read_file_bytes``): transient
+  ``IOError`` every Nth read / injected latency (straggler drills for
+  the read-timeout backup path).
+- ``worker_killer(at_batch)`` — a prefetch worker crashes while
+  holding the claimed batch: drives the supervisor's exactly-once
+  requeue + bounded-backoff respawn (and, at ``times=2``, the
+  twice-lost typed failure).
 - ``failing_os_replace(times)`` / ``failing_fsync(times)`` — the next
   ``times`` checkpoint commit renames / durability fsyncs raise
   ``OSError``, leaving exactly the torn ``step_N.tmp`` state a killed
@@ -170,6 +184,82 @@ class BatchPoisoner(DataSetIterator):
                     batch = (self._poison(f), l)
             self._step += 1
             yield batch
+
+
+class TornShard:
+    """Deterministic on-disk shard corruption (datapipe/): ``inject()``
+    damages the committed shard file (``bitflip`` one payload byte, or
+    ``truncate`` to half) while keeping the original bytes in memory;
+    ``heal()`` restores them. As a context manager the shard is
+    corrupted for the body and restored on exit.
+
+    ``heal_after_failures=N`` makes the damage TRANSIENT: subscribed to
+    a pipeline's event stream (``pipeline.subscribe(ts.observe)`` —
+    done by ``ChaosMonkey.torn_shard(pipeline=...)``), the original
+    bytes come back after the reader has failed N verification
+    attempts on this shard — so the reader's retry budget heals the
+    fault (flaky-NFS bit-rot), which is what the zero-dropped-samples
+    self-heal e2e needs. Without it the corruption is permanent and
+    the bounded budget quarantines the shard."""
+
+    def __init__(self, directory: str, shard_index: int = 0,
+                 mode: str = "bitflip",
+                 heal_after_failures: Optional[int] = None,
+                 log: Optional[List] = None):
+        from deeplearning4j_tpu.datapipe.manifest import SHARD_FMT
+        if mode not in ("bitflip", "truncate"):
+            raise ValueError(f"mode {mode!r}: use 'bitflip'|'truncate'")
+        self.shard_file = SHARD_FMT.format(i=int(shard_index))
+        self.path = os.path.join(os.fspath(directory), self.shard_file)
+        self.mode = mode
+        self.heal_after = heal_after_failures
+        self._log = log if log is not None else []
+        with open(self.path, "rb") as fh:
+            self._orig = fh.read()
+        self._failures = 0
+        self.healed = False
+
+    def inject(self) -> "TornShard":
+        if self.mode == "truncate":
+            data = self._orig[: len(self._orig) // 2]
+        else:
+            buf = bytearray(self._orig)
+            buf[len(buf) // 2] ^= 0xFF
+            data = bytes(buf)
+        with open(self.path, "wb") as fh:
+            fh.write(data)
+        self.healed = False
+        self._log.append({"event": "shard_torn", "shard": self.shard_file,
+                          "mode": self.mode, "t": time.time()})
+        return self
+
+    def heal(self) -> None:
+        if self.healed:
+            return
+        with open(self.path, "wb") as fh:
+            fh.write(self._orig)
+        self.healed = True
+        self._log.append({"event": "shard_healed",
+                          "shard": self.shard_file, "t": time.time()})
+
+    def observe(self, ev: dict) -> None:
+        """Pipeline-event hook: count this shard's read failures and
+        heal once ``heal_after_failures`` is reached (the restore runs
+        on the worker thread, BETWEEN its retry attempts — so the next
+        attempt reads good bytes)."""
+        if self.healed or self.heal_after is None:
+            return
+        if ev.get("event") in ("read_retry", "shard_quarantined") and \
+                ev.get("shard") == self.shard_file:
+            self._failures += 1
+            if self._failures >= self.heal_after:
+                self.heal()
+
+    def __enter__(self) -> "TornShard":
+        return self.inject()
+
+    def __exit__(self, *exc) -> None:
+        self.heal()
 
 
 class HostLossInjector(Listener):
@@ -349,6 +439,106 @@ class ChaosMonkey:
                                  "from the seed")
             at_step = self.draw_step(0, n_steps)
         return BatchPoisoner(wrapped, at_step, times=times, log=self.log)
+
+    def torn_shard(self, directory, shard_index: Optional[int] = None,
+                   n_shards: Optional[int] = None, mode: str = "bitflip",
+                   heal_after_failures: Optional[int] = None,
+                   pipeline=None) -> TornShard:
+        """Corrupt a committed datapipe shard on disk (see
+        :class:`TornShard`). ``pipeline=`` subscribes the healer to the
+        pipeline's event stream so ``heal_after_failures`` counts real
+        reader verdicts. Draws the shard from the seed when only
+        ``n_shards`` is given. Use as a context manager (restores the
+        bytes on exit) or call ``.inject()`` for permanent damage."""
+        if shard_index is None:
+            if n_shards is None:
+                raise ValueError("pass shard_index= or n_shards= to draw "
+                                 "one from the seed")
+            shard_index = self.draw_step(0, n_shards)
+        ts = TornShard(directory, shard_index, mode=mode,
+                       heal_after_failures=heal_after_failures,
+                       log=self.log)
+        if pipeline is not None:
+            pipeline.subscribe(ts.observe)
+        return ts
+
+    @contextlib.contextmanager
+    def flaky_read(self, times: int = 1, every: int = 1,
+                   match: Optional[str] = None) -> Iterator[dict]:
+        """Transient IO at the shard-read seam: every ``every``-th
+        ``datapipe.reader._read_file_bytes`` call (optionally filtered
+        to paths containing ``match``) raises ``IOError``, ``times``
+        times total — the reader's transient-retry budget must absorb
+        it. Yields the mutable ``{"calls", "left"}`` state."""
+        from deeplearning4j_tpu.datapipe import reader as _reader
+        state = {"calls": 0, "left": int(times)}
+        orig = _reader._read_file_bytes
+
+        def chaotic_read(path):
+            if match is None or match in os.path.basename(str(path)):
+                state["calls"] += 1
+                if state["left"] > 0 and state["calls"] % int(every) == 0:
+                    state["left"] -= 1
+                    self.log.append({"event": "read_failed",
+                                     "path": str(path),
+                                     "call": state["calls"],
+                                     "t": time.time()})
+                    raise IOError(f"chaos: injected read failure "
+                                  f"({os.path.basename(str(path))})")
+            return orig(path)
+
+        _reader._read_file_bytes = chaotic_read
+        try:
+            yield state
+        finally:
+            _reader._read_file_bytes = orig
+
+    @contextlib.contextmanager
+    def slow_reader(self, delay_s: float, times: int = 1, every: int = 1,
+                    match: Optional[str] = None) -> Iterator[dict]:
+        """Latency injection at the shard-read seam: every ``every``-th
+        read sleeps ``delay_s`` before returning real bytes, ``times``
+        times total — the straggler drill for the prefetch pool's
+        read-timeout backup requests."""
+        from deeplearning4j_tpu.datapipe import reader as _reader
+        state = {"calls": 0, "left": int(times)}
+        orig = _reader._read_file_bytes
+
+        def slow_read(path):
+            if match is None or match in os.path.basename(str(path)):
+                state["calls"] += 1
+                if state["left"] > 0 and state["calls"] % int(every) == 0:
+                    state["left"] -= 1
+                    self.log.append({"event": "slow_read_injected",
+                                     "path": str(path),
+                                     "delay_s": float(delay_s),
+                                     "t": time.time()})
+                    time.sleep(float(delay_s))
+            return orig(path)
+
+        _reader._read_file_bytes = slow_read
+        try:
+            yield state
+        finally:
+            _reader._read_file_bytes = orig
+
+    @contextlib.contextmanager
+    def worker_killer(self, at_batch: int, times: int = 1
+                      ) -> Iterator[dict]:
+        """Kill the prefetch worker that claims plan batch ``at_batch``
+        (an unstructured crash while HOLDING the claim), ``times``
+        times total: the supervisor must requeue the batch exactly
+        once and respawn the worker; at ``times=2`` the twice-lost
+        batch fails typed instead of ping-ponging."""
+        from deeplearning4j_tpu.datapipe import prefetch as _prefetch
+        state = {"at_index": int(at_batch), "left": int(times),
+                 "log": self.log}
+        prev = _prefetch._CHAOS_KILL
+        _prefetch._CHAOS_KILL = state
+        try:
+            yield state
+        finally:
+            _prefetch._CHAOS_KILL = prev
 
     # -- device faults --------------------------------------------------
     @contextlib.contextmanager

@@ -63,6 +63,31 @@ class DataPipelineError(FaultError):
     cause_tag = "data_pipeline"
 
 
+class ShardCorruptError(DataPipelineError):
+    """A data shard failed integrity verification: the bytes on disk do
+    not match the ``ShardManifest`` (sha256/size/record-count mismatch,
+    truncation, an unreadable npz) or the manifest itself is torn.
+    RETRYABLE (⊂ :class:`DataPipelineError`): flaky NFS can serve bad
+    bytes once and good bytes on the re-read, so the sharded reader
+    retries within its budget before the shard is quarantined.
+    ``shard`` names the shard file and ``offset`` the first affected
+    record offset within it (None = whole-shard damage)."""
+
+    cause_tag = "shard_corrupt"
+
+    def __init__(self, message: str, *, shard: Optional[str] = None,
+                 offset: Optional[int] = None, **kw):
+        super().__init__(message, **kw)
+        self.shard = shard
+        self.offset = offset
+
+    def provenance(self) -> Dict[str, Any]:
+        out = super().provenance()
+        out["shard"] = self.shard
+        out["offset"] = self.offset
+        return out
+
+
 class TransientDeviceError(FaultError):
     """A device/runtime error believed transient (injected by the chaos
     harness; real runs map backend runtime errors onto the same retry

@@ -15,20 +15,33 @@ Production loaders fail in three ways and each gets its own treatment:
   a structured :class:`DataPipelineError` carrying the failing batch
   index escapes to the caller (where ``FaultTolerantFit`` decides).
 
-Fast-forward replays the wrapped iterator from ``reset()``, so exact
-recovery (no sample trained twice or dropped, index-keyed quarantine
-naming the right batch) requires a source that is restartable and
-deterministic per pass. Shuffling/sampling sources
-(``ArrayDataSetIterator(shuffle=True)``, ``SamplingDataSetIterator``)
-produce a FRESH order each pass: a retry then resumes at position
-``index`` of a different permutation — some samples of the recovered
-pass repeat and others drop. That is usually acceptable for SGD (the
-pass is stochastic anyway) but not for exact-order pipelines; wrap a
-deterministic view, or disable with
-``RetryPolicy(data_max_retries=0)``. Reference parity: the
-reference's executor retry loops (EarlyStoppingTrainer's fit loop
-catches per-minibatch exceptions); here the budget, backoff and
-quarantine are explicit and observable via ``events``.
+Recovery positioning takes one of two paths:
+
+- **seek (O(1))** — a wrapped source exposing ``seek_batches(skip)``
+  (``datapipe.StreamingDataPipeline``: its pass order is a pure
+  function of ``(seed, pass_index, host)``, so any position is
+  recomputable) is repositioned directly: the SAME pass's permutation
+  continues at batch ``skip`` without a single record re-read. Exact
+  recovery is guaranteed by construction.
+- **reset + fast-forward (O(n) fallback)** — a plain iterator is
+  ``reset()`` and replayed past the batches already delivered. Exact
+  recovery (no sample trained twice or dropped, index-keyed
+  quarantine naming the right batch) then requires a source that is
+  restartable and deterministic per pass. Shuffling/sampling sources
+  (``ArrayDataSetIterator(shuffle=True)``,
+  ``SamplingDataSetIterator``) produce a FRESH order each pass: a
+  retry resumes at position ``index`` of a different permutation —
+  some samples of the recovered pass repeat and others drop. That is
+  usually acceptable for SGD (the pass is stochastic anyway) but not
+  for exact-order pipelines; wrap a deterministic view, use the
+  seekable pipeline, or disable with
+  ``RetryPolicy(data_max_retries=0)``.
+
+Both paths are pinned by regression tests (tests/test_datapipe.py).
+Reference parity: the reference's executor retry loops
+(EarlyStoppingTrainer's fit loop catches per-minibatch exceptions);
+here the budget, backoff and quarantine are explicit and observable
+via ``events``.
 """
 from __future__ import annotations
 
@@ -126,11 +139,18 @@ class RetryingIterator(DataSetIterator):
 
     # -- iteration ------------------------------------------------------
     def _restarted(self, skip: int):
-        """Reset the wrapped source and fast-forward past ``skip``
-        already-delivered batches; returns a fresh iterator positioned
-        at batch index ``skip``. A source that shrank below ``skip``
-        between attempts is a pipeline fault, not a clean end-of-pass —
-        silent truncation is exactly what this rail exists to prevent."""
+        """A fresh iterator positioned at batch index ``skip`` of the
+        current pass. Seekable sources (``seek_batches``) are
+        repositioned in O(1) — the same pass's order continues with no
+        records re-read; plain iterators reset and fast-forward (O(n)
+        replay). A source that shrank below ``skip`` between attempts
+        is a pipeline fault, not a clean end-of-pass — silent
+        truncation is exactly what this rail exists to prevent (the
+        seek path raises it typed from ``seek_batches``)."""
+        seek = getattr(self._wrapped, "seek_batches", None)
+        if callable(seek):
+            with _tracer.span("data.loader_seek", cat="data", skip=skip):
+                return seek(skip)
         with _tracer.span("data.loader_retry", cat="data", skip=skip):
             self.reset()
             it = iter(self._wrapped)

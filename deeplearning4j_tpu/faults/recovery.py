@@ -138,6 +138,33 @@ class FaultTolerantFit:
             return self.model.restore_latest(self.manager)
         return self.manager.restore_latest(model=self.model)
 
+    def _restore_datapipe(self, state) -> None:
+        """Seek the streaming pipeline (datapipe/) back to the
+        restored snapshot's position: the checkpoint's
+        ``metadata["datapipe"]`` PipelineState (shard cursor, shuffle
+        pass, quarantine sets) is re-armed on the live pipeline, so the
+        retried fit resumes the interrupted pass MID-EPOCH by seeking —
+        bit-exact vs uninterrupted — instead of replaying it (or worse,
+        training a different permutation)."""
+        if state is None:
+            return
+        meta = getattr(state, "metadata", None) or {}
+        data = meta.get("datapipe")
+        if not data:
+            return
+        dp = getattr(self, "_datapipe", None)
+        if dp is None:
+            # restored before fit() saw the iterator (resume_latest in
+            # a relaunched job): apply when fit() registers the pipeline
+            self._pending_datapipe_state = data
+            return
+        dp.restore_state(data)
+        self._publish("datapipe_seek",
+                      pass_index=data.get("pass_index"),
+                      cursor=data.get("cursor"),
+                      quarantined=len(data.get("quarantined_records",
+                                               ())))
+
     def _maybe_precompile(self) -> None:
         """Re-run AOT precompilation from the remembered spec after a
         recovery that dropped or invalidated compiled programs (LR
@@ -199,8 +226,13 @@ class FaultTolerantFit:
             self._publish("topology_changed", error=type(e).__name__,
                           step=e.step, manifest=e.manifest,
                           runtime=e.runtime)
-            return self._reshard_restore(cause=e)
+            res = self._reshard_restore(cause=e)
+            if res is not None:
+                self._restore_datapipe(res[1])
+            return res
         self._publish_trainer_reshard()
+        if res is not None and isinstance(res, tuple) and len(res) == 2:
+            self._restore_datapipe(res[1])
         return res
 
     def _publish_trainer_reshard(self, precompile: bool = True) -> None:
@@ -256,6 +288,7 @@ class FaultTolerantFit:
                     "no committed checkpoint to roll back to",
                     cause="no_checkpoint") from cause
             step, _state = res
+            self._restore_datapipe(_state)
             rb_span.set(restored_step=int(step))
         finally:
             rb_span.__exit__(*sys.exc_info())
@@ -306,6 +339,30 @@ class FaultTolerantFit:
                 quarantine_corrupt=policy.quarantine_corrupt,
                 on_event=(self.stats_storage.put
                           if self.stats_storage is not None else None))
+        # seekable streaming pipeline (datapipe/): registered BEFORE the
+        # rollback-target save below so even the step-0 snapshot embeds
+        # its PipelineState — a rollback all the way to the start then
+        # replays PASS 0's permutation (a fresh pass index would train a
+        # different order than the uninterrupted run)
+        from deeplearning4j_tpu.datapipe.pipeline import find_pipeline
+        self._datapipe = find_pipeline(dataset_iterator)
+        # assigned UNCONDITIONALLY (including None): the rollback-target
+        # save below runs before sd.fit() refreshes the attribute, and a
+        # stale pipeline from a previous fit would embed bogus
+        # PipelineState into this fit's step-0 snapshot
+        self.sd._active_datapipe = self._datapipe
+        if self._datapipe is not None:
+            pending = getattr(self, "_pending_datapipe_state", None)
+            if pending:
+                # resume_latest() restored a snapshot before this fit
+                # saw the iterator: seek now
+                self._pending_datapipe_state = None
+                self._datapipe.restore_state(pending)
+                self._publish("datapipe_seek",
+                              pass_index=pending.get("pass_index"),
+                              cursor=pending.get("cursor"),
+                              quarantined=len(pending.get(
+                                  "quarantined_records", ())))
         ckpt_iters = self._ckpt_iters
         accum = max(1, int(getattr(tc, "accum_steps", 1) or 1))
         if ckpt_iters is not None and accum > 1 and ckpt_iters % accum:

@@ -503,6 +503,38 @@ class MetricsRegistry:
                                 "analysis (runs once per graph "
                                 "version, pre-compile)")
 
+    def fold_datapipe(self, record: dict) -> None:
+        """Fold one ``{"type": "datapipe"}`` record (the streaming
+        input pipeline's per-flush telemetry, datapipe/ +
+        monitor/steptime.MonitorListener) into ``datapipe_*`` metrics:
+        delta counters for records/batches delivered, IO retries,
+        quarantines and supervision decisions, plus throughput /
+        data-wait / per-worker-utilization gauges."""
+        for key in ("records", "batches", "read_retries", "shard_reads",
+                    "bytes_read", "rows_quarantined", "records_withheld",
+                    "worker_restarts", "requeues", "slow_reads"):
+            v = record.get(key)
+            if v:
+                self.inc(f"datapipe_{key}_total", v,
+                         help="streaming data-plane counter (datapipe/)")
+        for key, metric in (("records_per_sec", "datapipe_records_per_sec"),
+                            ("data_wait_frac",
+                             "datapipe_data_wait_fraction"),
+                            ("quarantined_shards",
+                             "datapipe_quarantined_shards"),
+                            ("passes_started",
+                             "datapipe_passes_started"),
+                            ("workers", "datapipe_workers")):
+            if record.get(key) is not None:
+                self.set_gauge(metric, record[key],
+                               help="streaming data-plane gauge "
+                                    "(datapipe/)")
+        for worker, util in (record.get("worker_utilization")
+                             or {}).items():
+            self.set_gauge("datapipe_worker_utilization", util,
+                           help="prefetch-worker busy fraction since "
+                                "the previous flush", worker=str(worker))
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -553,6 +585,8 @@ class MetricsRegistry:
             self.fold_faults([rec])
         elif t == "steptime":
             self.fold_steptime(rec)
+        elif t == "datapipe":
+            self.fold_datapipe(rec)
         elif t == "tensorstats":
             self.fold_tensorstats(rec)
         elif t == "compile":

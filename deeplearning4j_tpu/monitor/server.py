@@ -79,7 +79,7 @@ _FATAL_EVENTS = frozenset({"retry_exhausted", "oom"})
 #: last-step-age fallback when no heartbeat provider is registered
 #: ("score"/"perf" use perf_counter timestamps and must NOT mix in)
 _WALL_T_TYPES = ("steptime", "tensorstats", "metrics", "checkpoint",
-                 "faults", "serving", "memory")
+                 "faults", "serving", "memory", "datapipe")
 
 
 def health_snapshot(storage=None, providers: Dict[str, Callable] = None,

@@ -211,6 +211,10 @@ class MonitorListener:
         # plus plan capture for lazily-compiled programs and the live
         # MFU-estimate gauge. memory=False turns the whole rail off.
         self.memory = bool(memory)
+        # streaming-pipeline telemetry (datapipe/): (pipeline id,
+        # cumulative-counter snapshot) for per-flush deltas; None until
+        # the first flush sees a registered pipeline
+        self._datapipe_snap: Optional[tuple] = None
         self._published_plans: set = set()
         # id -> report (the ref pins the object so a recycled id can't
         # suppress a fresh report's publish); bounded FIFO — a
@@ -312,6 +316,54 @@ class MonitorListener:
                     help="active compiled program's flops per train "
                          "step (cost_analysis)")
 
+    def _publish_datapipe(self, sd, epoch: int,
+                          steptime_rec: Optional[dict],
+                          prev_flush_t: Optional[float],
+                          now: float) -> None:
+        """The data-plane half of a flush: one ``{"type": "datapipe"}``
+        record of per-flush DELTAS of the registered streaming
+        pipeline's cumulative counters (records/sec, retries,
+        quarantines, supervision decisions, per-worker utilization) —
+        pure host reads, published only when a pipeline is active."""
+        dp = getattr(sd, "_active_datapipe", None)
+        if dp is None or not hasattr(dp, "stats"):
+            return
+        snap = dp.stats()
+        # snapshot keyed by pipeline IDENTITY — the OBJECT, pinned, not
+        # id(): a listener reused across fits with different pipelines
+        # must not delta the new pipeline's counters against the old
+        # one's, and a recycled CPython id would alias them (the same
+        # recycled-id class the analysis-report pin set guards against)
+        prev_dp, prev = self._datapipe_snap or (None, {})
+        if prev_dp is not None and prev_dp is not dp:
+            prev = {}
+        self._datapipe_snap = (dp, snap)
+        rec = {"type": "datapipe", "t": now, "epoch": int(epoch)}
+        for key in ("records", "batches", "read_retries", "shard_reads",
+                    "bytes_read", "rows_quarantined", "records_withheld",
+                    "worker_restarts", "requeues", "slow_reads"):
+            rec[key] = max(0, snap.get(key, 0) - prev.get(key, 0))
+        for key in ("quarantined_shards", "passes_started", "workers"):
+            if snap.get(key) is not None:
+                rec[key] = snap[key]
+        dt = max(1e-9, now - prev_flush_t) if prev_flush_t else None
+        if dt is not None:
+            rec["records_per_sec"] = round(rec["records"] / dt, 2)
+        if steptime_rec:
+            wall = steptime_rec.get("wall_s") or 0.0
+            if wall:
+                rec["data_wait_frac"] = round(
+                    steptime_rec.get("data_wait_s", 0.0) / wall, 4)
+        busy = snap.get("worker_busy_s") or {}
+        prev_busy = prev.get("worker_busy_s") or {}
+        if dt is not None and busy:
+            rec["worker_utilization"] = {
+                str(w): round(min(1.0, max(
+                    0.0, busy.get(w, 0.0)
+                    - prev_busy.get(w, 0.0)) / dt), 4)
+                for w in busy}
+        self.storage.put(rec)
+
     def iterations_done(self, sd, epoch: int, iterations, losses) -> None:
         now = time.time()
         prev_flush_t = self._last_flush_t
@@ -324,8 +376,8 @@ class MonitorListener:
         if self.memory:
             self._publish_memory(epoch, iterations, prev_flush_t, now)
         if not rows:
-            if self.memory:
-                self.registry.fold_storage(self.storage)
+            self._publish_datapipe(sd, epoch, None, prev_flush_t, now)
+            self.registry.fold_storage(self.storage)
             return
         rec = {"type": "steptime", "epoch": int(epoch), "t": time.time(),
                "windows": len(rows), "steps": sum(r["k"] for r in rows),
@@ -363,6 +415,7 @@ class MonitorListener:
         if self._dropped:
             rec["spans_dropped"] = self._dropped
         self.storage.put(rec)
+        self._publish_datapipe(sd, epoch, rec, prev_flush_t, now)
         # fold through the storage's incremental per-(registry, storage)
         # high-water mark, NOT per-record: a TelemetryServer sharing
         # this registry folds the same storage on every /metrics scrape,

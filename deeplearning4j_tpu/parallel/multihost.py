@@ -64,6 +64,18 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def host_shard_assignment(n_shards: int) -> list:
+    """THIS process's data-shard indices under the canonical per-host
+    partition (``datapipe.shard_assignment``: round-robin, disjoint and
+    total across hosts). ``datapipe.StreamingDataPipeline`` applies it
+    automatically; this is the hook for custom readers that want the
+    same split — e.g. pairing hand-rolled loaders with per-process
+    checkpoint shards (docs/data_pipeline.md)."""
+    from deeplearning4j_tpu.datapipe.manifest import shard_assignment
+    return shard_assignment(n_shards, jax.process_index(),
+                            jax.process_count())
+
+
 def sync_global_devices(tag: str = "barrier") -> None:
     """Cross-host barrier (no-op single-process)."""
     if jax.process_count() > 1:

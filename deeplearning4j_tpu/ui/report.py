@@ -223,7 +223,8 @@ def _svg_swimlane(spans: List[dict], w=940, h_lane=26, label="",
 _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
-    "compile", "reshard", "tensorstats", "memory_plan", "analysis"})
+    "compile", "reshard", "tensorstats", "memory_plan", "analysis",
+    "datapipe"})
 
 
 #: memory-plan byte components for the stacked budget chart, mirroring
@@ -309,6 +310,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     compiles = storage.of_type("compile")
     analyses = storage.of_type("analysis")
     reshards = storage.of_type("reshard")
+    datapipe = storage.of_type("datapipe")
     serving = storage.of_type("serving")
     serving_faults = [r for r in storage.of_type("faults")
                       if r.get("origin") == "serving"]
@@ -664,6 +666,52 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
         parts.append("</table><p>save-on-N / restore-on-M elastic "
                      "restores (checkpoint/reshard.py, "
                      "docs/elastic_training.md)</p>")
+
+    # -- data plane: streaming-pipeline telemetry (datapipe/) ------------
+    if datapipe:
+        parts.append("<h2>Data pipeline</h2><div class='row'>")
+        pts = [(i, r["records_per_sec"]) for i, r in enumerate(datapipe)
+               if r.get("records_per_sec") is not None]
+        if pts:
+            parts.append(_svg_line(
+                pts, label="records/sec over flushes", color="#17becf"))
+        wait_pts = [(i, 100.0 * r["data_wait_frac"])
+                    for i, r in enumerate(datapipe)
+                    if r.get("data_wait_frac") is not None]
+        if wait_pts:
+            parts.append(_svg_line(
+                wait_pts, label="data-wait % of wall per flush",
+                color="#d62728"))
+        parts.append("</div>")
+        tot = {k: sum(r.get(k, 0) for r in datapipe)
+               for k in ("records", "batches", "read_retries",
+                         "rows_quarantined", "records_withheld",
+                         "worker_restarts", "requeues", "slow_reads")}
+        last = datapipe[-1]
+        bits = [f"{tot['records']} records / {tot['batches']} batches "
+                f"delivered",
+                f"{last.get('passes_started', '?')} passes"]
+        for key, label in (("read_retries", "read retries"),
+                           ("rows_quarantined", "rows quarantined"),
+                           ("records_withheld", "records withheld"),
+                           ("worker_restarts", "worker restarts"),
+                           ("requeues", "requeues"),
+                           ("slow_reads", "slow reads")):
+            if tot[key]:
+                bits.append(f"{tot[key]} {label}")
+        if last.get("quarantined_shards"):
+            bits.append(f"{last['quarantined_shards']} shards "
+                        f"quarantined")
+        parts.append("<p>" + ", ".join(bits) +
+                     " (datapipe/, docs/data_pipeline.md)</p>")
+        util = last.get("worker_utilization") or {}
+        if util:
+            parts.append("<table><tr><th>prefetch worker</th>"
+                         "<th>utilization (last flush)</th></tr>")
+            for w in sorted(util):
+                parts.append(f"<tr><td>{_html.escape(str(w))}</td>"
+                             f"<td>{100.0 * util[w]:.1f}%</td></tr>")
+            parts.append("</table>")
 
     # -- serving: traffic + the resilience rail --------------------------
     if serving:

@@ -1,0 +1,896 @@
+"""Fault-tolerant streaming data plane (datapipe/).
+
+Covers the ISSUE-13 contract: manifest commit/verify/torn-shard
+detection, worker-crash exactly-once requeue + respawn, record-level
+quarantine persisted across passes, multihost shard assignment
+disjoint-and-total, disk-backed fit bit-exact vs in-memory, mid-epoch
+seek-resume bit-exact (incl. shuffle RNG and dropout), RetryingIterator
+seek-vs-fallback regression, datapipe telemetry (records / fold /
+report), and the chaos self-heal e2e (torn shard + killed prefetch
+worker + transient reads in ONE run, zero dropped/duplicated samples).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import (SameDiff, ScoreIterationListener,
+                                         TrainingConfig)
+from deeplearning4j_tpu.datapipe import (PipelineState, ShardCorruptError,
+                                         ShardedRecordReader,
+                                         StreamingDataPipeline,
+                                         find_pipeline, load_manifest,
+                                         shard_assignment, verify_dataset,
+                                         write_dataset)
+from deeplearning4j_tpu.datapipe.manifest import SHARD_FMT
+from deeplearning4j_tpu.faults import (ChaosMonkey, DataPipelineError,
+                                       FaultTolerantFit, RetryPolicy,
+                                       RetryingIterator,
+                                       TransientDeviceError)
+from deeplearning4j_tpu.learning.updaters import Adam
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def _data(n=96, width=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, width)).astype(np.float32)
+    Y = np.eye(classes, dtype=np.float32)[np.arange(n) % classes]
+    return X, Y
+
+
+def _dataset(tmp_path, n=96, shard_size=16, seed=0):
+    X, Y = _data(n=n, seed=seed)
+    path = os.path.join(str(tmp_path), "ds")
+    write_dataset(path, X, Y, shard_size=shard_size)
+    return path, X, Y
+
+
+def _mlp(seed=0, dropout=None, fused_steps=2, lr=1e-2):
+    rng = np.random.default_rng(seed)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 8))
+    w0 = sd.var("w0", value=rng.normal(0, 0.3, (8, 16)).astype(np.float32))
+    b0 = sd.var("b0", value=np.zeros(16, np.float32))
+    h = sd.nn.relu(x.mmul(w0).add(b0))
+    if dropout is not None:
+        h = sd.random.dropout(h, p=dropout)
+    w1 = sd.var("w1", value=rng.normal(0, 0.3, (16, 4)).astype(np.float32))
+    b1 = sd.var("b1", value=np.zeros(4, np.float32))
+    logits = h.mmul(w1).add(b1, name="logits")
+    labels = sd.placeholder("labels", shape=(-1, 4))
+    sd.loss.softmax_cross_entropy(logits, labels, name="loss")
+    sd.set_loss_variables(["loss"])
+    sd.training_config = (TrainingConfig.builder()
+                          .updater(Adam(learning_rate=lr))
+                          .data_set_feature_mapping("x")
+                          .data_set_label_mapping("labels")
+                          .fused_steps(fused_steps).build())
+    sd._seed = 99
+    return sd
+
+
+def _quiet(every=10 ** 9):
+    return ScoreIterationListener(print_every=every,
+                                  print_fn=lambda *a: None)
+
+
+def _params(sd):
+    return {n: np.asarray(a) for n, a in sd.trainable_params().items()}
+
+
+def _assert_params_equal(a, b, msg=""):
+    for n in a:
+        np.testing.assert_array_equal(a[n], b[n], err_msg=f"{msg}{n}")
+
+
+class _FaultAt:
+    """One-shot in-fit device fault at an absolute iteration — drives
+    FaultTolerantFit's rollback while the pipeline is mid-pass."""
+
+    frequency = 1
+
+    def __init__(self, at):
+        self.at, self.fired = int(at), False
+
+    def on_training_start(self, sd):
+        pass
+
+    def on_epoch_start(self, sd, epoch):
+        pass
+
+    def iterations_done(self, sd, epoch, iterations, losses):
+        if not self.fired and any(i >= self.at for i in iterations):
+            self.fired = True
+            raise TransientDeviceError(
+                "chaos: injected device loss", step=max(iterations),
+                cause="device")
+
+    def on_epoch_end(self, sd, epoch, loss):
+        pass
+
+    def on_training_end(self, sd):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# manifest: staged commit + verification + torn-shard detection
+
+class TestManifest:
+    def test_write_verify_roundtrip(self, tmp_path):
+        path, X, Y = _dataset(tmp_path, n=100, shard_size=16)
+        m = load_manifest(path)
+        assert m.record_count == 100
+        assert len(m.shards) == 7               # six full + ragged tail
+        assert [s.records for s in m.shards] == [16] * 6 + [4]
+        # offsets form the global id space
+        assert [s.offset for s in m.shards] == \
+            [0, 16, 32, 48, 64, 80, 96]
+        assert verify_dataset(path) == []
+
+    def test_missing_commit_marker_is_typed(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        os.remove(os.path.join(path, "COMMIT"))
+        with pytest.raises(ShardCorruptError, match="COMMIT"):
+            load_manifest(path)
+
+    def test_torn_manifest_is_typed(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        with open(os.path.join(path, "MANIFEST.json"), "w") as fh:
+            fh.write("{not json")
+        with pytest.raises(ShardCorruptError, match="manifest"):
+            load_manifest(path)
+
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+    def test_torn_shard_detected_with_provenance(self, tmp_path, mode):
+        path, _, _ = _dataset(tmp_path)
+        chaos = ChaosMonkey(seed=3)
+        torn = chaos.torn_shard(path, shard_index=2, mode=mode)
+        with torn:
+            assert any("shard_00002" in p for p in verify_dataset(path))
+            reader = ShardedRecordReader(path, read_retries=0,
+                                         quarantine_budget=10)
+            with pytest.raises(ShardCorruptError) as ei:
+                reader.read_rows(np.arange(32, 40))
+            # typed provenance: shard file + record offset, retryable
+            assert ei.value.shard == SHARD_FMT.format(i=2)
+            assert ei.value.offset == 32
+            assert isinstance(ei.value, DataPipelineError)
+        # healed on context exit
+        assert verify_dataset(path) == []
+
+    def test_overwrite_keeps_old_dataset_until_staged(self, tmp_path):
+        """overwrite=True must not delete the committed dataset before
+        the replacement is FULLY staged — a writer crashing mid-build
+        leaves the OLD data, not nothing."""
+        path, X, Y = _dataset(tmp_path)
+        chaos = ChaosMonkey(seed=0)
+        with chaos.failing_fsync(times=1):      # dies staging shard 0
+            with pytest.raises(OSError):
+                write_dataset(path, X, Y, shard_size=8, overwrite=True)
+        assert verify_dataset(path) == []       # old dataset intact
+        write_dataset(path, X, Y, shard_size=8, overwrite=True)
+        assert verify_dataset(path) == []
+        assert len(load_manifest(path).shards) == 12
+
+    def test_staged_commit_never_publishes_half_dataset(self, tmp_path):
+        X, Y = _data(n=32)
+        path = os.path.join(str(tmp_path), "ds")
+        chaos = ChaosMonkey(seed=0)
+        with chaos.failing_os_replace(times=1, match="ds"):
+            with pytest.raises(OSError):
+                write_dataset(path, X, Y, shard_size=8)
+        # nothing published; the staging dir is what's left
+        assert not os.path.exists(path)
+        # a later writer succeeds over the leftovers
+        write_dataset(path, X, Y, shard_size=8)
+        assert verify_dataset(path) == []
+
+
+# ---------------------------------------------------------------------------
+# reader: retry budget, shard quarantine, multihost assignment
+
+class TestReader:
+    def test_transient_read_error_retried(self, tmp_path):
+        path, X, _ = _dataset(tmp_path)
+        chaos = ChaosMonkey(seed=1)
+        reader = ShardedRecordReader(path, read_retries=2)
+        with chaos.flaky_read(times=1):
+            rows = reader.read_rows(np.arange(0, 8))
+        np.testing.assert_array_equal(rows["features"], X[:8])
+        assert reader.read_retries_total == 1
+
+    def test_persistent_corruption_quarantines_after_budget(self,
+                                                            tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        events = []
+        reader = ShardedRecordReader(path, read_retries=1,
+                                     quarantine_budget=2,
+                                     on_event=events.append)
+        chaos = ChaosMonkey(seed=1)
+        torn = chaos.torn_shard(path, shard_index=0, mode="bitflip")
+        torn.inject()
+        try:
+            for _ in range(2):                 # two exhausted budgets
+                with pytest.raises(ShardCorruptError):
+                    reader.read_rows(np.arange(0, 8))
+        finally:
+            torn.heal()
+        assert 0 in reader.quarantined_shards
+        assert any(e["event"] == "shard_quarantined" for e in events)
+        # quarantined shard's records drop out of the id space, loudly
+        ids = reader.record_ids()
+        assert ids.min() == 16 and len(ids) == 96 - 16
+        with pytest.raises(ShardCorruptError, match="quarantined"):
+            reader.read_rows(np.arange(0, 8))
+
+    def test_shard_assignment_disjoint_and_total(self):
+        for n_shards in (1, 5, 8, 17):
+            for host_count in (1, 2, 3, 8):
+                parts = [shard_assignment(n_shards, h, host_count)
+                         for h in range(host_count)]
+                flat = [i for p in parts for i in p]
+                assert sorted(flat) == list(range(n_shards))   # total
+                assert len(flat) == len(set(flat))             # disjoint
+        # the parallel/ convenience wraps the same partition for THIS
+        # process (single-process test runtime: owns everything)
+        from deeplearning4j_tpu.parallel.multihost import \
+            host_shard_assignment
+        assert host_shard_assignment(5) == [0, 1, 2, 3, 4]
+
+    def test_multihost_pipelines_cover_all_records_disjointly(self,
+                                                              tmp_path):
+        path, X, _ = _dataset(tmp_path, n=96, shard_size=16)
+        seen = []
+        for h in range(3):
+            pipe = StreamingDataPipeline(path, batch_size=8,
+                                         shuffle=False, host_index=h,
+                                         host_count=3, n_workers=1)
+            for feats, _labels in pipe:
+                seen.extend(feats[:, 0].tolist())
+        assert sorted(seen) == sorted(X[:, 0].tolist())
+
+
+# ---------------------------------------------------------------------------
+# pipeline basics: ordering, determinism, transforms, state serde
+
+class TestPipeline:
+    def test_unshuffled_order_and_ragged_tail(self, tmp_path):
+        path, X, Y = _dataset(tmp_path, n=100, shard_size=16)
+        pipe = StreamingDataPipeline(path, batch_size=24, shuffle=False,
+                                     n_workers=2)
+        batches = list(pipe)
+        assert [len(b[0]) for b in batches] == [24, 24, 24, 24, 4]
+        np.testing.assert_array_equal(
+            np.concatenate([b[0] for b in batches]), X)
+        np.testing.assert_array_equal(
+            np.concatenate([b[1] for b in batches]), Y)
+
+    def test_shuffle_fresh_per_pass_and_reproducible(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        a = StreamingDataPipeline(path, batch_size=16, seed=9,
+                                  n_workers=2)
+        p0 = np.concatenate([b[0] for b in a])
+        p1 = np.concatenate([b[0] for b in a])
+        assert not np.array_equal(p0, p1)       # fresh order per pass
+        b = StreamingDataPipeline(path, batch_size=16, seed=9,
+                                  n_workers=2)
+        np.testing.assert_array_equal(p0, np.concatenate(
+            [bb[0] for bb in b]))               # same seed → same passes
+        np.testing.assert_array_equal(p1, np.concatenate(
+            [bb[0] for bb in b]))
+
+    def test_vectorized_transform_runs_on_workers(self, tmp_path):
+        path, X, Y = _dataset(tmp_path)
+        tids = set()
+
+        def xform(feats, labels):
+            tids.add(threading.get_ident())
+            return feats * 2.0, labels
+
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     transform=xform, n_workers=2)
+        out = np.concatenate([b[0] for b in pipe])
+        np.testing.assert_allclose(out, X * 2.0)
+        assert threading.get_ident() not in tids   # ran off-thread
+
+    def test_transform_process_columns_layout(self, tmp_path):
+        from deeplearning4j_tpu.etl import (CATEGORICAL, FLOAT, ColumnMeta,
+                                            Schema, TransformProcess)
+        n = 48
+        rng = np.random.default_rng(0)
+        cols = {"a": rng.normal(size=n).astype(np.float32),
+                "b": rng.normal(size=n).astype(np.float32),
+                "label": np.asarray((np.arange(n) % 3), np.int64)}
+        path = os.path.join(str(tmp_path), "cols")
+        write_dataset(path, columns=cols, shard_size=16)
+        schema = Schema([ColumnMeta("a", FLOAT), ColumnMeta("b", FLOAT),
+                         ColumnMeta("label", FLOAT)])
+        tp = (TransformProcess.builder(schema)
+              .map_column("a", lambda v: v * 10.0)
+              .build())
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     transform_process=tp,
+                                     label_column="label", num_classes=3,
+                                     n_workers=2)
+        feats = np.concatenate([b[0] for b in pipe])
+        labels = np.concatenate([b[1] for b in pipe])
+        np.testing.assert_allclose(feats[:, 0], cols["a"] * 10.0,
+                                   rtol=1e-6)
+        assert labels.shape == (n, 3)
+        assert (labels.argmax(axis=1) == cols["label"]).all()
+
+    def test_filter_step_rejected_in_streaming(self, tmp_path):
+        from deeplearning4j_tpu.etl import (FLOAT, ColumnMeta, Schema,
+                                            TransformProcess)
+        path = os.path.join(str(tmp_path), "cols")
+        write_dataset(path, columns={
+            "a": np.zeros(8, np.float32),
+            "label": np.zeros(8, np.float32)}, shard_size=4)
+        schema = Schema([ColumnMeta("a", FLOAT),
+                         ColumnMeta("label", FLOAT)])
+        tp = (TransformProcess.builder(schema)
+              .filter_rows(lambda c: c["a"] > 0).build())
+        with pytest.raises(ValueError, match="streamable"):
+            StreamingDataPipeline(path, batch_size=4,
+                                  transform_process=tp,
+                                  label_column="label")
+
+    def test_pipeline_state_serde_roundtrip(self):
+        st = PipelineState(pass_index=3, cursor=7, yielded=6, seed=11,
+                           passes_started=4,
+                           quarantined_records=[5, 2],
+                           pass_quarantine_base=[2],
+                           quarantined_shards=[1])
+        st2 = PipelineState.from_json(st.to_json())
+        assert st2.to_json() == st.to_json()
+        assert st2.quarantined_records == [2, 5]    # sorted
+
+    def test_restore_state_rejects_seed_mismatch(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=1)
+        with pytest.raises(DataPipelineError, match="seed"):
+            pipe.restore_state(PipelineState(seed=2))
+
+    def test_restore_state_rejects_plan_config_mismatch(self, tmp_path):
+        """The cursor is denominated in plan batches of the capturing
+        configuration — a different batch_size/shuffle/host split must
+        raise instead of silently seeking to different records."""
+        path, _, _ = _dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=1)
+        list(pipe)
+        st = pipe.export_state()
+        for other in (StreamingDataPipeline(path, batch_size=8, seed=1),
+                      StreamingDataPipeline(path, batch_size=16, seed=1,
+                                            shuffle=False)):
+            with pytest.raises(DataPipelineError,
+                               match="config_mismatch|uses"):
+                other.restore_state(st)
+        # old states without the config fields restore unchecked
+        legacy = dict(st)
+        for key in ("batch_size", "shuffle", "host_index", "host_count"):
+            legacy.pop(key)
+        StreamingDataPipeline(path, batch_size=8,
+                              seed=1).restore_state(legacy)
+
+    def test_mid_pass_shard_quarantine_does_not_replan_on_seek(
+            self, tmp_path):
+        """The pass permutation is computed over the PASS-START shard
+        set: a shard quarantined mid-pass withholds its rows from the
+        already-planned batches, and a seek back into the pass keeps
+        that plan — re-planning over the shrunken id set would shift
+        every later batch (duplicating/dropping healthy records)."""
+        path, X, _ = _dataset(tmp_path, n=96, shard_size=16)
+        pipe = StreamingDataPipeline(path, batch_size=10, shuffle=False,
+                                     n_workers=1)
+        it = iter(pipe)
+        got = [next(it)[0] for _ in range(2)]        # batches 0, 1
+        # shard 3 (ids 48..63) dies mid-pass
+        pipe._reader.quarantined_shards.add(3)
+        rest = [b[0] for b in pipe.seek_batches(2)]
+        out = np.concatenate(got + rest)
+        # frozen plan: original chunking, shard-3 rows withheld — NOT a
+        # re-chunked permutation of the surviving ids
+        keep = np.ones(96, bool)
+        keep[48:64] = False
+        np.testing.assert_array_equal(out, X[keep])
+        sizes = [len(b) for b in rest]
+        assert sizes == [10, 10, 8, 6, 10, 10, 6]    # 48/49, 60-63 holes
+
+    def test_export_state_preserves_pending_seek(self, tmp_path):
+        """A snapshot taken AFTER restore_state but BEFORE the next
+        pass begins (FaultTolerantFit's step-0 rollback-target save in
+        a relaunched job) must re-export the armed position, not a
+        fresh next pass that would skip the interrupted one's rest."""
+        path, _, _ = _dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=5)
+        it = iter(pipe)
+        for _ in range(3):
+            next(it)
+        st = pipe.export_state()
+        fresh = StreamingDataPipeline(path, batch_size=16, seed=5)
+        fresh.restore_state(st)
+        st2 = fresh.export_state()              # pending, not consumed
+        for key in ("pass_index", "cursor", "yielded",
+                    "pass_quarantine_base", "pass_shard_base"):
+            assert st2[key] == st[key], key
+
+    def test_find_pipeline_unwraps_retrying_iterator(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16)
+        assert find_pipeline(pipe) is pipe
+        assert find_pipeline(RetryingIterator(pipe)) is pipe
+        assert find_pipeline(object()) is None
+
+
+# ---------------------------------------------------------------------------
+# supervised prefetch: crash requeue, respawn, stragglers
+
+class TestPrefetchSupervision:
+    @pytest.mark.chaos
+    def test_worker_crash_requeued_exactly_once(self, tmp_path):
+        path, X, _ = _dataset(tmp_path)
+        chaos = ChaosMonkey(seed=2)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=2)
+        with chaos.worker_killer(at_batch=3, times=1):
+            batches = list(pipe)
+        # every batch delivered exactly once, in order, despite the crash
+        np.testing.assert_array_equal(
+            np.concatenate([b[0] for b in batches]), X)
+        st = pipe.stats()
+        assert st["worker_restarts"] == 1
+        assert st["requeues"] == 1
+        kinds = {e["event"] for e in pipe.events}
+        # (worker_restart fires after the respawn backoff; a short pass
+        # can finish on the surviving worker first — crash + requeue
+        # are the deterministic half of the episode)
+        assert {"worker_crash", "prefetch_requeue"} <= kinds
+        assert any(e["event"] == "worker_killed" for e in chaos.log)
+
+    @pytest.mark.chaos
+    def test_batch_lost_twice_fails_typed(self, tmp_path):
+        path, _, _ = _dataset(tmp_path)
+        chaos = ChaosMonkey(seed=2)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=2)
+        with chaos.worker_killer(at_batch=3, times=2):
+            with pytest.raises(DataPipelineError, match="twice"):
+                list(pipe)
+
+    @pytest.mark.chaos
+    def test_slow_read_gets_backup_request(self, tmp_path):
+        path, X, _ = _dataset(tmp_path, n=96, shard_size=16)
+        chaos = ChaosMonkey(seed=2)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=2, read_timeout_s=0.15)
+        with chaos.slow_reader(delay_s=1.0, times=1):
+            batches = list(pipe)
+        # the straggler read was hedged; content exact, nothing doubled
+        np.testing.assert_array_equal(
+            np.concatenate([b[0] for b in batches]), X)
+        assert pipe.stats()["slow_reads"] >= 1
+        assert any(e["event"] == "slow_read" for e in pipe.events)
+
+
+# ---------------------------------------------------------------------------
+# record-level quarantine
+
+class TestRecordQuarantine:
+    def _poisoned_dataset(self, tmp_path, bad_rows=(5, 23)):
+        X, Y = _data(n=64)
+        for r in bad_rows:
+            X[r, 1] = np.nan
+        path = os.path.join(str(tmp_path), "ds")
+        write_dataset(path, X, Y, shard_size=16)
+        return path, X, bad_rows
+
+    def test_corrupt_rows_dropped_and_persisted_across_passes(
+            self, tmp_path):
+        path, X, bad_rows = self._poisoned_dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=2)
+        pass0 = np.concatenate([b[0] for b in pipe])
+        assert len(pass0) == 64 - len(bad_rows)
+        assert np.isfinite(pass0).all()
+        assert pipe.quarantined_records == set(bad_rows)
+        assert any(e["event"] == "record_quarantine" for e in pipe.events)
+        # pass 2: quarantined ids excluded from the PLAN up front —
+        # batch sizes are exact again (no mid-batch holes)
+        sizes = [len(b[0]) for b in pipe]
+        assert sizes == [16, 16, 16, 14]
+        assert pipe.stats()["rows_quarantined"] == len(bad_rows)
+
+    def test_quarantine_state_rides_pipeline_state(self, tmp_path):
+        path, _, bad_rows = self._poisoned_dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False)
+        list(pipe)
+        st = PipelineState.from_json(pipe.export_state())
+        assert st.quarantined_records == sorted(bad_rows)
+        # a FRESH pipeline restoring the boundary state first replays
+        # the finished pass AT ITS END (empty — the form that absorbs a
+        # not-yet-counted epoch, see export_state), then the next pass
+        # excludes the quarantined ids up front
+        pipe2 = StreamingDataPipeline(path, batch_size=16, shuffle=False)
+        pipe2.restore_state(st)
+        assert sum(len(b[0]) for b in pipe2) == 0
+        assert sum(len(b[0]) for b in pipe2) == 64 - len(bad_rows)
+
+    def test_composes_with_retrying_iterator_batch_semantics(
+            self, tmp_path):
+        # the pipeline's record-level quarantine feeds CLEAN batches to
+        # RetryingIterator, whose batch-level corrupt scan then never
+        # fires — the two rails compose instead of double-dropping
+        path, _, bad_rows = self._poisoned_dataset(tmp_path)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False)
+        wrapped = RetryingIterator(pipe)
+        total = sum(len(b[0]) for b in wrapped)
+        assert total == 64 - len(bad_rows)
+        assert wrapped.quarantined == set()     # nothing left to catch
+
+
+# ---------------------------------------------------------------------------
+# RetryingIterator: seek path vs O(n) fallback (regression pins BOTH)
+
+class TestRetryingIteratorSeek:
+    def test_seekable_source_recovers_by_seeking(self, tmp_path):
+        path, X, _ = _dataset(tmp_path, n=96, shard_size=16)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=True,
+                                     seed=4, n_workers=1)
+        reference = [b[0] for b in
+                     StreamingDataPipeline(path, batch_size=16,
+                                           shuffle=True, seed=4,
+                                           n_workers=1)]
+
+        class FlakyOnce:
+            """Transient failure surfaced to RetryingIterator at batch
+            3 of the pass."""
+
+            def __init__(self, wrapped):
+                self._wrapped = wrapped
+                self.fired = False
+
+            def reset(self):
+                self._wrapped.reset()
+
+            def __iter__(self):
+                for i, b in enumerate(self._wrapped):
+                    if i == 3 and not self.fired:
+                        self.fired = True
+                        raise IOError("flake")
+                    yield b
+
+            def seek_batches(self, skip):
+                # delegate: this wrapper is transparent to position
+                return iter(self._seek_gen(skip))
+
+            def _seek_gen(self, skip):
+                it = self._wrapped.seek_batches(skip)
+                for i, b in enumerate(it):
+                    if i + skip == 3 and not self.fired:
+                        self.fired = True
+                        raise IOError("flake")
+                    yield b
+
+        flaky = FlakyOnce(pipe)
+        out = [b[0] for b in RetryingIterator(flaky)]
+        # recovered pass == the uninterrupted pass-0 permutation,
+        # because the seek stayed INSIDE the same pass
+        assert len(out) == len(reference)
+        for a, b in zip(out, reference):
+            np.testing.assert_array_equal(a, b)
+        # the pipeline never replayed batches 0..2 (seek, not ffwd):
+        # 6 plan batches + 1 re-delivery of the batch the flake ate
+        # (an O(n) fallback would have re-pulled the whole prefix)
+        assert pipe.stats()["batches"] == len(reference) + 1
+
+    def test_second_recovery_in_one_pass_seeks_correctly(self, tmp_path):
+        """RetryingIterator's per-pass batch index is ABSOLUTE and
+        never resets across recoveries — the pipeline must anchor
+        repeated seeks to the pass start, not to the previous seek's
+        generator (double-counting raised a spurious source_shrank on
+        the SECOND transient failure of a pass)."""
+        path, X, _ = _dataset(tmp_path, n=96, shard_size=16)
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=1)
+
+        class FlakyTwice:
+            def __init__(self, wrapped):
+                self._wrapped = wrapped
+                self.fail_at = {1, 4}            # two failures, one pass
+
+            def reset(self):
+                self._wrapped.reset()
+
+            def __iter__(self):
+                return self._gen(iter(self._wrapped), 0)
+
+            def seek_batches(self, skip):
+                return self._gen(self._wrapped.seek_batches(skip), skip)
+
+            def _gen(self, it, base):
+                for i, b in enumerate(it):
+                    if base + i in self.fail_at:
+                        self.fail_at.discard(base + i)
+                        raise IOError("flake")
+                    yield b
+
+        out = [b[0] for b in RetryingIterator(FlakyTwice(pipe))]
+        assert len(out) == 6
+        np.testing.assert_array_equal(np.concatenate(out), X)
+
+    def test_plain_iterator_keeps_on_fallback_path(self):
+        """The O(n) reset+fast-forward fallback still recovers plain
+        deterministic iterators (and re-pulls the already-delivered
+        prefix, which is what makes it O(n))."""
+        X = np.arange(40, dtype=np.float32).reshape(10, 4)
+        pulls = {"n": 0}
+
+        class Flaky:
+            def __init__(self):
+                self.fired = False
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for i in range(0, 10, 2):
+                    pulls["n"] += 1
+                    if i == 6 and not self.fired:
+                        self.fired = True
+                        raise IOError("flake")
+                    yield X[i:i + 2], X[i:i + 2]
+
+        out = list(RetryingIterator(Flaky()))
+        assert len(out) == 5
+        # 4 pulls to the failure + 3 replayed (fast-forward) + 2 rest
+        assert pulls["n"] > 5                   # the O(n) replay happened
+        np.testing.assert_array_equal(
+            np.concatenate([b[0] for b in out]), X)
+
+
+# ---------------------------------------------------------------------------
+# fit integration: bit-exactness, checkpoints, seek-resume
+
+class TestFitIntegration:
+    def test_disk_backed_fit_bit_exact_vs_in_memory(self, tmp_path):
+        from deeplearning4j_tpu.dataset import ArrayDataSetIterator
+        path, X, Y = _dataset(tmp_path)
+        sd_mem = _mlp()
+        sd_mem.fit(ArrayDataSetIterator(X, Y, batch_size=16),
+                   epochs=3, listeners=[_quiet()])
+        sd_disk = _mlp()
+        pipe = StreamingDataPipeline(path, batch_size=16, shuffle=False,
+                                     n_workers=2)
+        sd_disk.fit(pipe, epochs=3, listeners=[_quiet()])
+        _assert_params_equal(_params(sd_mem), _params(sd_disk))
+        # the per-step tier trains the same trajectory too
+        sd_ps = _mlp(fused_steps=1)
+        pipe_ps = StreamingDataPipeline(path, batch_size=16,
+                                        shuffle=False, n_workers=2)
+        sd_ps.fit(pipe_ps, epochs=3, listeners=[_quiet()])
+        _assert_params_equal(_params(sd_mem), _params(sd_ps))
+
+    def test_checkpoints_embed_pipeline_state(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import (CheckpointListener,
+                                                   CheckpointManager)
+        path, _, _ = _dataset(tmp_path)
+        sd = _mlp()
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=5)
+        mgr = CheckpointManager(tmp_path / "ck", keep_last_n=None,
+                                async_write=False)
+        sd.fit(pipe, epochs=2,
+               listeners=[CheckpointListener(mgr, every_n_iterations=2)])
+        state = mgr.restore(4)                  # mid-epoch-0
+        dp = state.metadata["datapipe"]
+        assert dp["pass_index"] == 0 and dp["cursor"] == 4
+        assert dp["seed"] == 5
+        state8 = mgr.restore(8)                 # mid-epoch-1
+        assert state8.metadata["datapipe"]["pass_index"] == 1
+        assert state8.metadata["datapipe"]["cursor"] == 2
+
+    def test_mid_epoch_seek_resume_bit_exact_incl_dropout(self, tmp_path):
+        """The acceptance drill: restore a MID-EPOCH snapshot in a
+        fresh process (fresh model + fresh pipeline), seek, finish —
+        bit-exact vs uninterrupted including the shuffle RNG (seeded
+        pass permutations) and dropout (iteration-folded keys)."""
+        from deeplearning4j_tpu.checkpoint import (CheckpointListener,
+                                                   CheckpointManager)
+        from deeplearning4j_tpu.checkpoint.state import \
+            restore_training_state
+        path, _, _ = _dataset(tmp_path)
+        sd_a = _mlp(dropout=0.3)
+        sd_a.fit(StreamingDataPipeline(path, batch_size=16, seed=5),
+                 epochs=2, listeners=[_quiet()])
+        pa = _params(sd_a)
+        sd_b = _mlp(dropout=0.3)
+        mgr = CheckpointManager(tmp_path / "ck", keep_last_n=None,
+                                async_write=False)
+        sd_b.fit(StreamingDataPipeline(path, batch_size=16, seed=5),
+                 epochs=2,
+                 listeners=[CheckpointListener(mgr, every_n_iterations=2)])
+        state = mgr.restore(4)                  # mid-epoch 0
+        sd_c = _mlp(dropout=0.3)
+        restore_training_state(sd_c, state)
+        pipe_c = StreamingDataPipeline(path, batch_size=16, seed=5)
+        pipe_c.restore_state(state.metadata["datapipe"])
+        sd_c.fit(pipe_c, epochs=2, listeners=[_quiet()])
+        _assert_params_equal(pa, _params(sd_c))
+
+    @pytest.mark.chaos
+    def test_rollback_seeks_instead_of_replaying(self, tmp_path):
+        """A mid-fit fault rolls back to a mid-epoch snapshot and the
+        pipeline SEEKS (datapipe_seek event) — final params bit-exact
+        vs uninterrupted, across mid-epoch AND epoch-boundary
+        snapshots."""
+        from deeplearning4j_tpu.checkpoint import CheckpointManager
+        path, _, _ = _dataset(tmp_path)
+        sd_ref = _mlp()
+        mgr_ref = CheckpointManager(tmp_path / "ckr", keep_last_n=None,
+                                    async_write=False)
+        FaultTolerantFit(sd_ref, mgr_ref,
+                         checkpoint_every_n_iterations=2,
+                         policy=RetryPolicy(backoff_base=0.0)).fit(
+            StreamingDataPipeline(path, batch_size=16, seed=5),
+            epochs=3)
+        p_ref = _params(sd_ref)
+        it_ref = sd_ref.training_config.iteration_count
+        for fault_at in (7, 11):       # mid-epoch / epoch-boundary
+            sd = _mlp()
+            pipe = StreamingDataPipeline(path, batch_size=16, seed=5)
+            mgr = CheckpointManager(tmp_path / f"ck{fault_at}",
+                                    keep_last_n=None, async_write=False)
+            ftf = FaultTolerantFit(sd, mgr,
+                                   checkpoint_every_n_iterations=2,
+                                   policy=RetryPolicy(backoff_base=0.0))
+            ftf.fit(pipe, epochs=3, listeners=[_FaultAt(fault_at)])
+            assert ftf.rollbacks == 1
+            assert any(e["event"] == "datapipe_seek"
+                       for e in ftf.events)
+            assert sd.training_config.iteration_count == it_ref
+            _assert_params_equal(p_ref, _params(sd),
+                                 msg=f"fault@{fault_at}: ")
+
+    def test_resume_latest_applies_pipeline_state_on_next_fit(
+            self, tmp_path):
+        """The relaunched-job path: resume_latest() BEFORE fit() sees
+        the iterator — the pending PipelineState applies when fit
+        registers the pipeline."""
+        from deeplearning4j_tpu.checkpoint import (CheckpointListener,
+                                                   CheckpointManager)
+        path, _, _ = _dataset(tmp_path)
+        sd_a = _mlp()
+        sd_a.fit(StreamingDataPipeline(path, batch_size=16, seed=5),
+                 epochs=2, listeners=[_quiet()])
+        pa = _params(sd_a)
+        sd_b = _mlp()
+        mgr = CheckpointManager(tmp_path / "ck", keep_last_n=None,
+                                async_write=False)
+        # "interrupted" run: one epoch, single mid-epoch snapshot at
+        # step 4 — the relaunch restores via resume_latest, then fit()
+        # with a FRESH pipeline applies the pending PipelineState
+        sd_b.fit(StreamingDataPipeline(path, batch_size=16, seed=5),
+                 epochs=1,
+                 listeners=[CheckpointListener(mgr,
+                                               every_n_iterations=4)])
+        assert mgr.latest_step() == 4
+        sd_c = _mlp()
+        ftf = FaultTolerantFit(sd_c, mgr,
+                               checkpoint_every_n_iterations=4,
+                               policy=RetryPolicy(backoff_base=0.0))
+        assert ftf.resume_latest() is not None
+        pipe_c = StreamingDataPipeline(path, batch_size=16, seed=5)
+        ftf.fit(pipe_c, epochs=2)
+        assert any(e["event"] == "datapipe_seek" for e in ftf.events)
+        _assert_params_equal(pa, _params(sd_c))
+
+
+# ---------------------------------------------------------------------------
+# telemetry: records at flush boundaries, fold, report, /metrics
+
+class TestTelemetry:
+    def test_datapipe_records_fold_and_render(self, tmp_path):
+        from deeplearning4j_tpu.monitor import (MonitorListener,
+                                                disable_tracing,
+                                                enable_tracing)
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        from deeplearning4j_tpu.ui.report import render_report
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        path, _, _ = _dataset(tmp_path)
+        sd = _mlp()
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=5,
+                                     n_workers=2)
+        storage = StatsStorage()
+        enable_tracing(reset=True)
+        try:
+            mon = MonitorListener(storage, registry=MetricsRegistry(),
+                                  frequency=2)
+            sd.fit(pipe, epochs=2, listeners=[mon])
+        finally:
+            disable_tracing()
+        recs = storage.of_type("datapipe")
+        assert recs, "no datapipe records at flush boundaries"
+        assert sum(r.get("records", 0) for r in recs) == 2 * 96
+        assert any(r.get("records_per_sec") is not None for r in recs)
+        assert any(r.get("data_wait_frac") is not None for r in recs)
+        assert any(r.get("worker_utilization") for r in recs)
+        prom = mon.registry.to_prometheus_text()
+        assert "dl4j_datapipe_records_total 192" in prom
+        assert "dl4j_datapipe_worker_utilization" in prom
+        html = render_report(storage)
+        assert "Data pipeline" in html
+        # record-type lint contract: no forward-compat footer leak
+        assert "unrendered record types" not in html
+
+    def test_fold_datapipe_direct(self):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.fold_datapipe({"type": "datapipe", "records": 128,
+                           "read_retries": 2, "rows_quarantined": 1,
+                           "records_per_sec": 5000.0,
+                           "data_wait_frac": 0.25,
+                           "worker_utilization": {"0": 0.8, "1": 0.4}})
+        assert reg.get("datapipe_records_total") == 128
+        assert reg.get("datapipe_read_retries_total") == 2
+        assert reg.get("datapipe_data_wait_fraction") == 0.25
+        assert reg.get("datapipe_worker_utilization", worker="0") == 0.8
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: one run survives torn shard + dead worker +
+# transient reads, zero dropped/duplicated samples, bit-exact
+
+class TestChaosE2E:
+    @pytest.mark.chaos
+    def test_self_heal_e2e_bit_exact(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import CheckpointManager
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        path, _, _ = _dataset(tmp_path)
+        # clean reference trajectory
+        sd_ref = _mlp()
+        mgr_ref = CheckpointManager(tmp_path / "ckr", keep_last_n=None,
+                                    async_write=False)
+        FaultTolerantFit(sd_ref, mgr_ref,
+                         checkpoint_every_n_iterations=2,
+                         policy=RetryPolicy(backoff_base=0.0)).fit(
+            StreamingDataPipeline(path, batch_size=16, seed=5,
+                                  n_workers=2), epochs=3)
+        p_ref = _params(sd_ref)
+        it_ref = sd_ref.training_config.iteration_count
+        # chaos run: transient torn shard (heals after 2 failed reads)
+        # + a killed prefetch worker + transient IO, all in ONE fit
+        sd = _mlp()
+        storage = StatsStorage()
+        pipe = StreamingDataPipeline(path, batch_size=16, seed=5,
+                                     n_workers=2, read_retries=3)
+        mgr = CheckpointManager(tmp_path / "ck", keep_last_n=None,
+                                async_write=False)
+        ftf = FaultTolerantFit(sd, mgr, checkpoint_every_n_iterations=2,
+                               policy=RetryPolicy(backoff_base=0.0),
+                               stats_storage=storage)
+        chaos = ChaosMonkey(seed=7)
+        torn = chaos.torn_shard(path, shard_index=2,
+                                heal_after_failures=2, pipeline=pipe)
+        torn.inject()
+        try:
+            with chaos.worker_killer(at_batch=3, times=1):
+                with chaos.flaky_read(times=2, every=3):
+                    history = ftf.fit(pipe, epochs=3)
+        finally:
+            torn.heal()
+        assert history is not None
+        # zero dropped/duplicated samples: the strongest proof is the
+        # bit-exact trajectory — any drop/dup would shift every later
+        # update
+        assert sd.training_config.iteration_count == it_ref
+        _assert_params_equal(p_ref, _params(sd))
+        st = pipe.stats()
+        assert st["read_retries"] >= 2          # chaos really fired
+        assert st["worker_restarts"] == 1
+        assert st["rows_quarantined"] == 0      # transient, not dropped
+        kinds = {e["event"] for e in pipe.events}
+        assert {"read_retry", "worker_crash", "prefetch_requeue"} <= kinds
+        assert {"shard_torn", "shard_healed", "worker_killed",
+                "read_failed"} <= {e["event"] for e in chaos.log}

@@ -15,7 +15,8 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            "multi_device_training.py", "moe_expert_parallel.py",
            "early_stopping_holdout.py", "serving_mnist.py",
            "checkpoint_resume.py", "self_healing_fit.py",
-           "observability_demo.py", "analyze_model.py"]
+           "observability_demo.py", "analyze_model.py",
+           "streaming_fit.py"]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)
