@@ -120,7 +120,8 @@ def bench_lenet(batch=128, listener=False, fused_steps=1):
 
 
 def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
-                  seed=0, tensorstats=None, analyze=True):
+                  seed=0, tensorstats=None, analyze=True,
+                  fingerprints=False):
     """The BASELINE config-2 MLP graph (784 -> hidden -> 10, softmax CE,
     Adam 1e-3) — shared by bench_samediff_mlp and the cold-start child
     probe so the restart metric measures the same program the throughput
@@ -152,6 +153,8 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
                .analyze(analyze))
     if tensorstats is not None:
         builder.tensorstats(tensorstats)
+    if fingerprints:
+        builder.fingerprints(True)
     sd.training_config = builder.build()
     return sd
 
@@ -159,7 +162,8 @@ def _build_mlp_sd(hidden=(512, 256), fused_steps=1, sentinel=False,
 def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
                        fused_steps=1, sentinel=False,
                        monitor_storage=None, tensorstats=None,
-                       monitor_memory=True, analyze=True):
+                       monitor_memory=True, analyze=True,
+                       fingerprints=False):
     """BASELINE config 2: SameDiff MLP via the graph-autodiff train path
     (reference TrainingSession.java:74). ``listener``/``fused_steps``
     give the listener-path variant (see bench_lenet); ``sentinel`` arms
@@ -173,7 +177,7 @@ def bench_samediff_mlp(batch=128, hidden=(512, 256), listener=False,
     rng = np.random.default_rng(0)
     sd = _build_mlp_sd(hidden=hidden, fused_steps=fused_steps,
                        sentinel=sentinel, tensorstats=tensorstats,
-                       analyze=analyze)
+                       analyze=analyze, fingerprints=fingerprints)
 
     from deeplearning4j_tpu.dataset import DeviceCachedIterator
     n = 2048
@@ -258,6 +262,43 @@ def bench_tensorstats_overhead(batch=128, fused_steps=8, repeats=2):
             if best[True] else 0.0,
             "tensorstats_overhead_pct": round(overhead, 2),
             "every_n": cfg.every_n, "families": list(cfg.families),
+            "batch": batch, "fused_steps": fused_steps}
+
+
+def bench_integrity_overhead(batch=128, fused_steps=8, repeats=2):
+    """Cost of the integrity rail (integrity/, docs/fault_tolerance.md
+    "Non-raising failures"): the fused-window K=8 listener config with
+    state fingerprints + an armed StallWatchdog on vs both off. The
+    fingerprint adds ONE uint32 word-sum of params/optimizer state per
+    window (computed once on the post-scan carry) and its share of the
+    flush's device_get; the watchdog adds one guard (a deadline
+    register/unregister under a lock) around every dispatch and flush.
+    Replay probes / replica checks are cadence knobs benchmarked as
+    off (their cost is 1/N redispatches by construction). Acceptance
+    bar ≤2% steps/s; same best-of-``repeats`` interleaved estimator as
+    sentinel_overhead (tunnel jitter exceeds the effect size)."""
+    from deeplearning4j_tpu.integrity import StallWatchdog
+
+    best = {False: 0.0, True: 0.0}
+    for _ in range(repeats):
+        for flag in (False, True):
+            if flag:
+                wd = StallWatchdog(k=8.0, floor_s=5.0, grace_s=120.0)
+                with wd:
+                    r = bench_samediff_mlp(batch=batch, listener=True,
+                                           fused_steps=fused_steps,
+                                           fingerprints=True)
+            else:
+                r = bench_samediff_mlp(batch=batch, listener=True,
+                                       fused_steps=fused_steps)
+            best[flag] = max(best[flag], r["samples_per_sec"])
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    return {"samples_per_sec": best[True],
+            "samples_per_sec_integrity_off": best[False],
+            "step_time_ms": round(1000.0 * batch / best[True], 3)
+            if best[True] else 0.0,
+            "integrity_overhead_pct": round(overhead, 2),
             "batch": batch, "fused_steps": fused_steps}
 
 
@@ -859,6 +900,10 @@ def main():
                      # bar) for BENCH_r08
                      ("serving_resilience_overhead",
                       bench_serving_resilience_overhead),
+                     # the integrity rail's cost (state fingerprints +
+                     # stall-watchdog guards on the fused K=8 listener
+                     # path, ≤2% bar) for BENCH_r10
+                     ("integrity_overhead", bench_integrity_overhead),
                      # disk-backed streaming vs the cached-window bench
                      # (datapipe/, ~5% bar) + data-wait per flush +
                      # prefetch-worker scaling, for BENCH_r09

@@ -1010,7 +1010,7 @@ class SameDiff:
             print(f"[deeplearning4j_tpu] {msg}")
 
     def make_train_epoch(self, donate: bool = True, unroll: int = 1,
-                         sentinel: bool = False):
+                         sentinel: bool = False, fingerprint: bool = False):
         """Whole-epoch train step: lax.scan of the step body over batches
         stacked on a leading steps axis. ONE device dispatch per epoch —
         on a tunneled/host-bottlenecked chip this removes the per-step
@@ -1023,11 +1023,12 @@ class SameDiff:
         An epoch IS a window of length n_steps — this delegates to
         make_train_window."""
         return self.make_train_window(donate=donate, unroll=unroll,
-                                      sentinel=sentinel)
+                                      sentinel=sentinel,
+                                      fingerprint=fingerprint)
 
     def make_train_window(self, accum_steps: int = 1, donate: bool = True,
                           unroll: int = 1, sentinel: bool = False,
-                          tensorstats=None):
+                          tensorstats=None, fingerprint: bool = False):
         """Fused-window train step: K consecutive steps in ONE compiled
         dispatch — a lax.scan of the step body over a (K, batch, ...)
         stacked window of placeholders. Per-step losses come back as a
@@ -1061,12 +1062,23 @@ class SameDiff:
         iteration it was sampled at (-1 = no sample point). The host
         fetches both at flush boundaries in the same device_get burst
         as losses and sentinel verdicts; no per-step sync.
+
+        ``fingerprint=True`` (TrainingConfig.fingerprints, integrity/
+        fingerprint.py) appends ONE extra uint32 output: the bitwise
+        word-sum digest of the window's final params + state vars +
+        optimizer state — the silent-corruption sentinel. Computed once
+        per window on the final carry (not per step), order-independent
+        so the host can recompute it from captured bytes; parameter
+        math is untouched.
         """
         ts = tensorstats
         if ts is not None:
             from deeplearning4j_tpu.monitor.tensorstats import (sample_mask,
                                                                 zeros_stats)
             ts_n_layers = len(self.trainable_params())
+        if fingerprint:
+            from deeplearning4j_tpu.integrity.fingerprint import \
+                tree_fingerprint as _tree_fp
         if accum_steps <= 1:
             step_body, loss_names = self._build_step_body(
                 sentinel=sentinel, tensorstats=ts)
@@ -1115,6 +1127,10 @@ class SameDiff:
                 carry, losses = jax.lax.scan(body, tuple(carry0),
                                              stacked_phv, unroll=unroll)
                 out = list(carry[:4]) + [losses] + list(carry[4:])
+                if fingerprint:
+                    # digest of the window's FINAL state, once per
+                    # window on the post-scan carry — not per step
+                    out.append(_tree_fp(carry[0], carry[1], carry[2]))
                 return tuple(out)
 
             donate_args = (0, 1, 2, 3)
@@ -1181,12 +1197,18 @@ class SameDiff:
                 carry, losses = jax.lax.scan(body, tuple(carry0),
                                              stacked_phv, unroll=unroll)
                 out = list(carry[:5]) + [losses] + list(carry[5:])
+                if fingerprint:
+                    # params/svars/updater state only: the accum carry
+                    # is NOT part of the checkpoint schema, so it stays
+                    # outside the digest too (autodiff/window.py)
+                    out.append(_tree_fp(carry[0], carry[1], carry[2]))
                 return tuple(out)
 
             donate_args = (0, 1, 2, 3, 4)
         cache_key = ("train_window", self._version, loss_names,
                      int(accum_steps), donate, int(unroll), bool(sentinel),
-                     ts.key() if ts is not None else None)
+                     ts.key() if ts is not None else None,
+                     bool(fingerprint))
         compiled = self._fn_cache.get(cache_key)
         if compiled is None:
             self._verbose_log(
@@ -1426,12 +1448,15 @@ class SameDiff:
             _build(disp, (params_abs, svars_abs, state_abs, it_abs,
                           consts_abs, ph, key),
                    ph_shape_sig(ph), "train_step", steps=1)
+        fp_on = bool(getattr(tc, "fingerprints", False))
         if "window" in tiers:
             disp = self.make_train_window(accum_steps=A, sentinel=sentinel,
-                                          tensorstats=ts)
+                                          tensorstats=ts,
+                                          fingerprint=fp_on)
             from deeplearning4j_tpu.autodiff.window import window_trace_set
             seen = window_trace_set(self, A, sentinel,
-                                    ts.key() if ts is not None else None)
+                                    ts.key() if ts is not None else None,
+                                    fp_on)
             # every pow2 the tail decomposition can emit: a ragged tail
             # of r < K steps uses buckets up to the largest pow2 ≤ r,
             # so cover all powers of two ≤ K-1 (for pow2 K this is the
@@ -1446,7 +1471,8 @@ class SameDiff:
                 raise ValueError("the scanned-epoch tier needs "
                                  "epoch_steps= (batches per epoch)")
             unroll = int(getattr(tc, "scan_unroll", 1) or 1)
-            disp = self.make_train_epoch(unroll=unroll, sentinel=sentinel)
+            disp = self.make_train_epoch(unroll=unroll, sentinel=sentinel,
+                                         fingerprint=fp_on)
             args, sig = _window_args(int(epoch_steps), with_accum=False)
             _build(disp, args, sig, f"epoch_{epoch_steps}",
                    steps=int(epoch_steps))
@@ -1618,6 +1644,17 @@ class SameDiff:
         ts_cfg = getattr(tc, "tensorstats", None) if listeners else None
         step = self.make_train_step(sentinel=use_sentinel,
                                     tensorstats=ts_cfg)
+        # bitwise state fingerprints (integrity/): the per-step tier
+        # does not thread the digest through the step body — a tiny
+        # separate digest program dispatches at the flush boundaries
+        # (and once at fit end), fetched in the same burst
+        fp_on = bool(getattr(tc, "fingerprints", False))
+        self._device_fingerprint = None
+        if fp_on:
+            from deeplearning4j_tpu.integrity.fingerprint import \
+                make_fingerprint_fn
+            fp_fn = make_fingerprint_fn(self)
+        from deeplearning4j_tpu.integrity.watchdog import guard as _wd_guard
         # step() donates param/state buffers; work on copies so the graph's
         # stored arrays stay valid for output()/save() during training
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
@@ -1698,16 +1735,24 @@ class SameDiff:
                         if pending_oks else None
                     stats_burst = list(pending_stats)
                     pending_stats.clear()
+                    fp_dev = fp_fn(params, svars, state) if fp_on else None
                     try:
-                        vals_arr, oks, stats_host = jax.device_get(
-                            (jnp.stack([lv for _, lv in pending]),
-                             oks_stack, [s for _, s in stats_burst]))
+                        with _wd_guard("flush"):
+                            vals_arr, oks, stats_host, fp_host = \
+                                jax.device_get(
+                                    (jnp.stack([lv for _, lv in pending]),
+                                     oks_stack,
+                                     [s for _, s in stats_burst], fp_dev))
                     except Exception as e:
                         # async dispatch: an allocation failure often
                         # surfaces at the first sync, not the dispatch
                         memstats.reraise_oom(e, program="train_step",
                                              step=iters[-1], epoch=epoch)
                         raise
+                    if fp_host is not None:
+                        self._device_fingerprint = {
+                            "iteration": iters[-1] + 1,
+                            "fp": int(fp_host)}
                     if oks is not None:
                         from deeplearning4j_tpu.faults.sentinels import \
                             check_ok_flags
@@ -1784,8 +1829,9 @@ class SameDiff:
                                     graph=self)
                             memstats.note_dispatch(step_sig, steps=1)
                         try:
-                            res = step(params, svars, state, it_dev,
-                                       constants, ph, base_key)
+                            with _wd_guard("step_dispatch"):
+                                res = step(params, svars, state, it_dev,
+                                           constants, ph, base_key)
                         except Exception as e:
                             memstats.reraise_oom(e, program="train_step",
                                                  step=iteration,
@@ -1876,6 +1922,12 @@ class SameDiff:
             self._arrays[n] = p
         self._updater_state = state
         tc.iteration_count = iteration
+        if fp_on:
+            # final boundary digest: a checkpoint captured after this
+            # fit verifies its host bytes against it
+            self._device_fingerprint = {
+                "iteration": int(iteration),
+                "fp": int(jax.device_get(fp_fn(params, svars, state)))}
         for l in listeners:
             l.on_training_end(self)
         return history
@@ -1885,9 +1937,11 @@ class SameDiff:
         from deeplearning4j_tpu.autodiff.training import History
         tc = self.training_config
         use_sentinel = bool(getattr(tc, "sentinel", False))
+        fp_on = bool(getattr(tc, "fingerprints", False))
+        self._device_fingerprint = None
         epoch_step = self.make_train_epoch(
             unroll=getattr(tc, "scan_unroll", 1) or 1,
-            sentinel=use_sentinel)
+            sentinel=use_sentinel, fingerprint=fp_on)
         params = jax.tree_util.tree_map(jnp.copy, self.trainable_params())
         svars = jax.tree_util.tree_map(jnp.copy, self.state_vars_map())
         if self._updater_state is not None and \
@@ -1920,33 +1974,32 @@ class SameDiff:
         memstats.note_dispatch(scan_sig, steps=n_steps)
         history = History()
         epoch_means = []
+        last_fp = None                 # device uint32, fetched at fit end
         panic = self._nan_panic_active(tc)
         for epoch in range(epochs):
+            try:
+                res = epoch_step(params, svars, state, it_dev,
+                                 constants, stacked, base_key)
+            except Exception as e:
+                memstats.reraise_oom(e, program=scan_label,
+                                     step=iteration, epoch=epoch)
+                raise
+            # positional layout (make_train_window): p, sv, st, it,
+            # losses [, bad] [, fp]
+            params, svars, state, it_dev, losses = res[:5]
+            r = 5
             if use_sentinel:
-                try:
-                    params, svars, state, it_dev, losses, bad = \
-                        epoch_step(params, svars, state, it_dev,
-                                   constants, stacked, base_key)
-                except Exception as e:
-                    memstats.reraise_oom(e, program=scan_label,
-                                         step=iteration, epoch=epoch)
-                    raise
-                bad = int(bad)     # one scalar sync per scanned epoch
+                bad = int(res[r])  # one scalar sync per scanned epoch
+                r += 1
                 if bad >= 0:
                     from deeplearning4j_tpu.faults.sentinels import \
                         raise_diverged
                     # epoch = this fit's loop index, matching the
                     # per-step and windowed tiers' provenance
                     raise_diverged(bad, epoch, iteration)
-            else:
-                try:
-                    params, svars, state, it_dev, losses = epoch_step(
-                        params, svars, state, it_dev, constants, stacked,
-                        base_key)
-                except Exception as e:
-                    memstats.reraise_oom(e, program=scan_label,
-                                         step=iteration, epoch=epoch)
-                    raise
+            if fp_on:
+                last_fp = res[r]
+                r += 1
             m = jnp.mean(losses)
             if panic and not np.isfinite(float(m)):
                 raise NumericsException(
@@ -1968,6 +2021,11 @@ class SameDiff:
         self._updater_state = state
         tc.iteration_count = iteration
         tc.epoch_count = getattr(tc, "epoch_count", 0) + epochs
+        if last_fp is not None:
+            # the boundary digest a checkpoint capture after this fit
+            # verifies against (integrity/fingerprint.py)
+            self._device_fingerprint = {"iteration": int(iteration),
+                                        "fp": int(last_fp)}
         return history
 
     # ------------------------------------------------------------------

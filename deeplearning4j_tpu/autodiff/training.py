@@ -160,6 +160,26 @@ class TrainingConfig:
     # tier with listeners) to deliver {"type": "tensorstats"} records;
     # parameter math is untouched — stats-on training is bit-identical.
     tensorstats: Optional[Any] = None
+    # bitwise state fingerprints (integrity/fingerprint.py): the
+    # compiled window additionally emits one uint32 digest of
+    # params + state vars + optimizer state (a word-sum folded in
+    # like the sentinel — one extra int per window), read at the
+    # flush boundaries the host already syncs on. Checkpoint captures
+    # compare it against the host bytes and stamp the snapshot;
+    # restores re-verify the stamp; mismatch raises a typed
+    # faults.SilentCorruptionError. Parameter math is untouched —
+    # fingerprints-on training is bit-identical (bench.py
+    # integrity_overhead, ≤2% bar with the stall watchdog armed too).
+    fingerprints: bool = False
+    # replay probe cadence (windows): every Nth window is re-dispatched
+    # from a stashed carry and the two digests compared — genuine
+    # in-dispatch SDC/nondeterminism disagrees. Costs 1/N extra
+    # compute; 0 = off.
+    fingerprint_replay_every: int = 0
+    # cross-replica agreement cadence (flushes): every Nth listener
+    # flush compares per-replica digests of DP-sharded params bitwise
+    # (integrity.check_replica_agreement). 0 = off.
+    fingerprint_replica_every: int = 0
     # pre-compile static analysis (analyze/, docs/static_analysis.md):
     # fit()/precompile() walk the graph + this config WITHOUT compiling
     # and surface structured findings (shape mismatches with producer
@@ -233,6 +253,9 @@ class TrainingConfig:
                                else self.sharding.to_spec()).to_json()),
             "tensorstats": (None if self.tensorstats is None
                             else self.tensorstats.to_json()),
+            "fingerprints": self.fingerprints,
+            "fingerprint_replay_every": self.fingerprint_replay_every,
+            "fingerprint_replica_every": self.fingerprint_replica_every,
             "analyze": (self.analyze if isinstance(self.analyze,
                                                    (bool, str))
                         else bool(self.analyze)),
@@ -268,6 +291,10 @@ class TrainingConfig:
             sentinel=d.get("sentinel", False),
             sharding=sharding,
             tensorstats=tensorstats,
+            fingerprints=d.get("fingerprints", False),
+            fingerprint_replay_every=d.get("fingerprint_replay_every", 0),
+            fingerprint_replica_every=d.get("fingerprint_replica_every",
+                                            0),
             analyze=d.get("analyze", True),
         )
 
@@ -303,6 +330,15 @@ class TrainingConfig:
             self._kw["sharding"] = spec; return self
         def tensorstats(self, cfg=True):
             self._kw["tensorstats"] = cfg; return self
+        def fingerprints(self, on: bool = True, replay_every: int = 0,
+                         replica_every: int = 0):
+            """Bitwise state fingerprints (integrity/): capture/restore
+            verification plus the optional replay-probe and
+            cross-replica-agreement cadences."""
+            self._kw["fingerprints"] = bool(on)
+            self._kw["fingerprint_replay_every"] = int(replay_every)
+            self._kw["fingerprint_replica_every"] = int(replica_every)
+            return self
         def analyze(self, mode=True):
             """Pre-compile static analysis: True (warn), "strict"
             (raise GraphAnalysisError before any compile), False."""

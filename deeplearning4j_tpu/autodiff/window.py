@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.compilecache.aot import ph_shape_sig
+from deeplearning4j_tpu.integrity.watchdog import guard as _wd_guard
 from deeplearning4j_tpu.monitor import memstats
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 
@@ -218,18 +219,19 @@ class WindowStager:
 
 
 def window_trace_set(sd, accum_steps: int, sentinel: bool,
-                     ts_key=None) -> set:
-    """The per-(graph version, accum, sentinel, tensorstats) set of
-    window trace signatures already compiled. This is the ONE key
-    construction, shared by the executor's compile accounting below and
-    ``SameDiff.precompile()``'s pre-registration — if the key shape
-    changed in only one place, precompiled sigs would land in a set fit
-    never reads and ``window_compiles`` would silently report nonzero
-    after a precompile (the same drift ``ph_shape_sig`` was unified to
-    prevent for the signature itself). ``ts_key`` is
-    ``TensorStatsConfig.key()`` or None (stats-free)."""
+                     ts_key=None, fingerprint: bool = False) -> set:
+    """The per-(graph version, accum, sentinel, tensorstats,
+    fingerprint) set of window trace signatures already compiled. This
+    is the ONE key construction, shared by the executor's compile
+    accounting below and ``SameDiff.precompile()``'s pre-registration —
+    if the key shape changed in only one place, precompiled sigs would
+    land in a set fit never reads and ``window_compiles`` would
+    silently report nonzero after a precompile (the same drift
+    ``ph_shape_sig`` was unified to prevent for the signature itself).
+    ``ts_key`` is ``TensorStatsConfig.key()`` or None (stats-free)."""
     return sd.__dict__.setdefault("_window_traces", {}) \
-        .setdefault((sd._version, accum_steps, sentinel, ts_key), set())
+        .setdefault((sd._version, accum_steps, sentinel, ts_key,
+                     bool(fingerprint)), set())
 
 
 def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
@@ -245,12 +247,27 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     K = max(1, int(getattr(tc, "fused_steps", 1) or 1))
     A = max(1, int(getattr(tc, "accum_steps", 1) or 1))
     use_sentinel = bool(getattr(tc, "sentinel", False))
+    # bitwise state fingerprints (integrity/fingerprint.py): one extra
+    # uint32 output per window, read only at flush boundaries; the
+    # optional replay probe re-dispatches every Nth window from a
+    # stashed carry and compares digests, and the optional replica
+    # check compares per-replica digests every Nth flush
+    fp_on = bool(getattr(tc, "fingerprints", False))
+    probe_every = int(getattr(tc, "fingerprint_replay_every", 0) or 0) \
+        if fp_on else 0
+    replica_every = int(getattr(tc, "fingerprint_replica_every", 0) or 0) \
+        if fp_on else 0
+    sd._device_fingerprint = None
+    if fp_on:
+        from deeplearning4j_tpu.integrity.fingerprint import (
+            check_probes, check_replica_agreement)
     # in-graph tensor statistics (monitor/tensorstats.py): only with
     # listeners — the records ride the listener rail; a listener-free
     # fit dispatches the stats-free window
     ts_cfg = getattr(tc, "tensorstats", None) if listeners else None
     window_fn = sd.make_train_window(accum_steps=A, sentinel=use_sentinel,
-                                     tensorstats=ts_cfg)
+                                     tensorstats=ts_cfg,
+                                     fingerprint=fp_on)
     # window_fn donates param/state buffers; work on copies so the
     # graph's stored arrays stay valid for output()/save() mid-fit
     params = jax.tree_util.tree_map(jnp.copy, sd.trainable_params())
@@ -295,7 +312,14 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     # compiled window lengths (jit retraces per leading-dim K): tracked
     # per (graph version, accum) so stats report real compile counts
     seen_sizes = window_trace_set(
-        sd, A, use_sentinel, ts_cfg.key() if ts_cfg is not None else None)
+        sd, A, use_sentinel, ts_cfg.key() if ts_cfg is not None else None,
+        fp_on)
+    # last window's device digest (a device scalar until fetched at a
+    # flush / fit end) + probe/replica bookkeeping shared across epochs
+    last_fp_box: List[Optional[jax.Array]] = [None]
+    replica_mark = [0]
+    win_count = 0
+    probes_total = 0
     if ts_cfg is not None:
         from deeplearning4j_tpu.monitor.tensorstats import layer_names
         ts_names = layer_names(params)
@@ -364,6 +388,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
         pending_bads: List[jax.Array] = []   # sentinel scalars, device
         epoch_bads: List[jax.Array] = []     # ... for the listener-free path
         pending_stats: List[tuple] = []      # (stats pytree, at) device
+        pending_probes: List[tuple] = []     # (start_iter, fp, fp_replay)
+        epoch_probes: List[tuple] = []       # ... listener-free variant
         epoch_start_iter = iteration
         dispatches = 0
         compiles = 0
@@ -395,17 +421,27 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             ts_recs: List[dict] = []
             with _tracer.span("flush", cat="train", steps=len(iters)):
                 losses_cat = jnp.concatenate([lv for _, _, lv in pending])
-                # losses + sentinel verdicts + sampled tensorstats in
-                # ONE device→host transfer; poisoned windows must not
-                # feed listeners/checkpoints, so verdicts are checked
-                # (and may raise) before the burst is delivered
+                # losses + sentinel verdicts + sampled tensorstats +
+                # fingerprints/probe digests in ONE device→host
+                # transfer; poisoned windows must not feed listeners/
+                # checkpoints, so verdicts are checked (and may raise)
+                # before the burst is delivered
                 bads_stack = jnp.stack(pending_bads) if pending_bads \
                     else None
                 stats_burst = list(pending_stats)
                 pending_stats.clear()
+                probes = list(pending_probes)
+                pending_probes.clear()
+                probes_stack = jnp.stack(
+                    [jnp.stack((a, b)) for _, a, b in probes]) \
+                    if probes else None
+                fp_dev = last_fp_box[0] if fp_on else None
                 try:
-                    vals_arr, bads, stats_host = jax.device_get(
-                        (losses_cat, bads_stack, stats_burst))
+                    with _wd_guard("flush"):
+                        vals_arr, bads, stats_host, fp_host, probes_host \
+                            = jax.device_get(
+                                (losses_cat, bads_stack, stats_burst,
+                                 fp_dev, probes_stack))
                 except Exception as e:
                     # async dispatch: an allocation failure inside a
                     # window often surfaces HERE, at the first sync
@@ -418,6 +454,21 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                     pending_bads.clear()
                     check_bad_steps(np.asarray(bads), epoch,
                                     epoch_start_iter)
+                if fp_host is not None:
+                    # the boundary digest a checkpoint capture at this
+                    # flush verifies its host bytes against
+                    sd._device_fingerprint = {"iteration": iters[-1] + 1,
+                                              "fp": int(fp_host)}
+                if probes:
+                    # replay-probe verdicts gate delivery like the
+                    # sentinel: a corrupted window's losses must not
+                    # reach listeners/checkpoints
+                    check_probes(np.asarray(probes_host),
+                                 [s for s, _, _ in probes])
+                if replica_every:
+                    replica_mark[0] += 1
+                    if replica_mark[0] % replica_every == 0:
+                        check_replica_agreement({**params, **svars})
                 if stats_burst:
                     # windows with no sample point carry at = -1 (zeros
                     # payload) and are dropped here
@@ -513,13 +564,24 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                     with _tracer.span("dispatch", cat="train", k=k):
                         # positional output layout (make_train_window):
                         # p, sv, st, [accum], it, losses, [bad],
-                        # [stats, at]
+                        # [stats, at], [fp]
                         if A > 1:
                             args = (params, svars, state, accum, it_dev,
                                     constants, win, base_key)
                         else:
                             args = (params, svars, state, it_dev,
                                     constants, win, base_key)
+                        # replay probe (integrity/fingerprint.py): stash
+                        # copies of the donated carry BEFORE the main
+                        # dispatch so the window can be re-dispatched
+                        # from identical inputs and the two digests
+                        # compared at the next flush
+                        probe_this = probe_every and \
+                            win_count % probe_every == probe_every - 1
+                        if probe_this:
+                            stash = jax.tree_util.tree_map(
+                                jnp.copy, args[:5 if A > 1 else 4])
+                        win_count += 1
                         if first_dispatch:
                             # with plan capture armed (MonitorListener),
                             # a new shape compiles through the AOT path
@@ -530,7 +592,9 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                                 window_fn, args, trace_sig,
                                 f"window_k{k}", steps=k, graph=sd)
                         try:
-                            out = window_fn(*args)
+                            with _wd_guard("window_dispatch",
+                                           first=first_dispatch):
+                                out = window_fn(*args)
                         except Exception as e:
                             memstats.reraise_oom(e,
                                                  program=f"window_k{k}",
@@ -552,6 +616,24 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                             i += 1
                         if ts_cfg is not None:
                             pending_stats.append((out[i], out[i + 1]))
+                            i += 2
+                        if fp_on:
+                            last_fp_box[0] = out[i]
+                            i += 1
+                        if probe_this:
+                            # second dispatch of the SAME window from
+                            # the stash (which it donates); only its
+                            # digest is kept — compared at the flush
+                            with _tracer.span("integrity.replay_probe",
+                                              cat="integrity", k=k), \
+                                    _wd_guard("window_dispatch"):
+                                out2 = window_fn(*stash, constants, win,
+                                                 base_key)
+                            probes_total += 1
+                            # fp is the LAST window output by layout
+                            (pending_probes if listeners
+                             else epoch_probes).append(
+                                (iteration, out[-1], out2[-1]))
                     dispatches += 1
                     sizes[k] = sizes.get(k, 0) + 1
                     if bad is not None:
@@ -581,6 +663,13 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
                 stager.close()
         # listener-free sentinel path: one stacked verdict fetch per epoch
         _check_bads(epoch_bads)
+        if epoch_probes:
+            # listener-free replay probes: one stacked digest fetch
+            fetched = np.asarray(jnp.stack(
+                [jnp.stack((a, b)) for _, a, b in epoch_probes]))
+            starts = [s for s, _, _ in epoch_probes]
+            epoch_probes.clear()
+            check_probes(fetched, starts)
         if listeners:
             _flush()
             if flush_every:
@@ -607,7 +696,8 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
             "steps_per_epoch": iteration - epoch_start_iter,
             "dispatches_per_epoch": dispatches,
             "window_sizes": sizes, "window_compiles": compiles,
-            "sentinel": use_sentinel}
+            "sentinel": use_sentinel, "fingerprints": fp_on,
+            "replay_probes": probes_total}
         if listeners:
             # sync current training state into the graph (copies — the
             # next window donates the working buffers)
@@ -629,6 +719,14 @@ def fit_windowed(sd, dataset_iterator, epochs: int = 1, listeners=()):
     sd._updater_state = state
     sd._grad_accum = accum         # partial accumulation survives the fit
     tc.iteration_count = iteration
+    if fp_on and last_fp_box[0] is not None:
+        cur = sd._device_fingerprint
+        if cur is None or cur.get("iteration") != iteration:
+            # listener-free (or post-final-flush) boundary digest for
+            # checkpoint captures taken after this fit
+            sd._device_fingerprint = {
+                "iteration": int(iteration),
+                "fp": int(jax.device_get(last_fp_box[0]))}
     for l in listeners:
         l.on_training_end(sd)
     return history

@@ -20,7 +20,13 @@ The persistence layer the elastic-training roadmap builds on:
   iterations / epochs / seconds) for any ``fit(listeners=...)`` path;
 - ``savers``      — early-stopping model saver routed through the
   manager;
-- ``preemption``  — SIGTERM → final synchronous checkpoint → exit.
+- ``preemption``  — SIGTERM → final synchronous checkpoint → exit;
+- ``scrub``       — :class:`Scrubber`: rate-limited background
+  re-hashing of committed step dirs against their manifests during
+  idle time, quarantining rotten steps aside (``step_N.rotten`` +
+  typed record) so ``restore_latest`` never lands on bit-rot
+  mid-recovery; ``python -m deeplearning4j_tpu.checkpoint scrub`` is
+  the offline CLI (integrity rail, docs/fault_tolerance.md).
 
 Reference parity: util/ModelSerializer + optimize/listeners/
 CheckpointListener, redesigned Orbax-style (off-critical-path
@@ -39,6 +45,7 @@ from deeplearning4j_tpu.checkpoint.manifest import (is_committed, sha256_file,
 from deeplearning4j_tpu.checkpoint.preemption import Preempted, PreemptionHook
 from deeplearning4j_tpu.checkpoint.reshard import restore_resharded
 from deeplearning4j_tpu.checkpoint.savers import CheckpointModelSaver
+from deeplearning4j_tpu.checkpoint.scrub import Scrubber
 from deeplearning4j_tpu.checkpoint.state import (TrainingState,
                                                  capture_topology,
                                                  capture_training_state,
@@ -47,7 +54,8 @@ from deeplearning4j_tpu.checkpoint.state import (TrainingState,
 __all__ = [
     "CheckpointError", "CheckpointListener", "CheckpointManager",
     "CheckpointModelSaver", "Preempted", "PreemptionHook",
-    "ShardCountMismatchError", "TopologyChangedError", "TrainingState",
+    "Scrubber", "ShardCountMismatchError", "TopologyChangedError",
+    "TrainingState",
     "atomic_copy", "atomic_output_file", "atomic_write_bytes",
     "atomic_write_via", "capture_topology", "capture_training_state",
     "fsync_dir", "is_committed", "restore_resharded",

@@ -127,7 +127,8 @@ class CheckpointManager:
                  stats_storage=None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 barrier: Optional[Callable[[str], None]] = None):
+                 barrier: Optional[Callable[[str], None]] = None,
+                 verify_memo_ttl_s: float = 300.0):
         self.directory = os.fspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep_last_n = keep_last_n
@@ -156,6 +157,19 @@ class CheckpointManager:
             barrier = sync_global_devices
         self._barrier = barrier
         self._pinned: set = set()
+        # verified-(path → (dir_token, verified_at)) memo: restore/
+        # rollback paths full-rehash every candidate dir; repeated
+        # rollbacks in one recovery loop must not re-hash unchanged
+        # committed files on the critical path (the datapipe/reader.py
+        # pattern). The token (per-file mtime_ns + size) invalidates on
+        # any filesystem change; because MEDIA rot bypasses the
+        # filesystem entirely (no mtime update), entries also expire
+        # after ``verify_memo_ttl_s`` — the recovery loop's
+        # seconds-apart rollbacks stay memoized while the blind window
+        # against in-place decay stays bounded. The background Scrubber
+        # re-hashes regardless and refreshes the memo.
+        self._verify_memo_ttl_s = float(verify_memo_ttl_s)
+        self._verified_memo: Dict[str, tuple] = {}
         if self.process_index == 0:
             self._recover_aside()     # crash-interrupted re-save repair
         self._q: "queue.Queue" = queue.Queue()
@@ -178,16 +192,45 @@ class CheckpointManager:
     def _tmp_dir(self, step: int) -> str:
         return self.step_dir(step) + ".tmp"
 
+    def _verify_full(self, d: str) -> List[str]:
+        """Memoized full verification of one step dir: an unchanged
+        ``dir_token`` (every file's mtime_ns + size) since the last
+        clean full verify within ``verify_memo_ttl_s`` skips the
+        re-hash; any change, any problem, or an expired entry drops
+        the memo and re-hashes."""
+        token = _manifest.dir_token(d)
+        ent = self._verified_memo.get(d)
+        if token is not None and ent is not None and ent[0] == token \
+                and time.monotonic() - ent[1] <= self._verify_memo_ttl_s:
+            return []
+        problems = _manifest.verify_dir(d, full=True)
+        if problems or token is None:
+            self._verified_memo.pop(d, None)
+        else:
+            self._verified_memo[d] = (token, time.monotonic())
+        return problems
+
+    def note_verified(self, d: str) -> None:
+        """Record an externally-performed clean full verification
+        (the background ``checkpoint.Scrubber`` re-hashes on its own
+        cadence and feeds the restore-path memo through this)."""
+        token = _manifest.dir_token(d)
+        if token is not None:
+            self._verified_memo[d] = (token, time.monotonic())
+
     def all_steps(self, verify: bool = False) -> List[int]:
         """Committed step numbers, ascending. ``verify=True`` re-hashes
-        every file (slow); default checks marker/manifest/sizes only."""
+        every file (memoized per unchanged dir); default checks
+        marker/manifest/sizes only."""
         steps = []
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
             if not m:
                 continue
             d = os.path.join(self.directory, name)
-            if _manifest.is_committed(d, full=verify):
+            ok = not self._verify_full(d) if verify \
+                else _manifest.is_committed(d, full=False)
+            if ok:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
@@ -208,8 +251,9 @@ class CheckpointManager:
             if os.path.isdir(final):
                 continue               # step exists; leftover is garbage
             d = os.path.join(self.directory, name)
-            if _manifest.is_committed(d, full=True):
+            if not self._verify_full(d):
                 os.replace(d, final)
+                self._verified_memo.pop(d, None)   # moved; token stale
                 fsync_dir(self.directory)
 
     def uncommitted_dirs(self) -> List[str]:
@@ -223,8 +267,7 @@ class CheckpointManager:
             d = os.path.join(self.directory, name)
             if _TMP_RE.match(name):
                 bad.append(d)
-            elif _STEP_RE.match(name) and \
-                    not _manifest.is_committed(d, full=True):
+            elif _STEP_RE.match(name) and self._verify_full(d):
                 bad.append(d)
         return bad
 
@@ -257,9 +300,14 @@ class CheckpointManager:
             if model is None:
                 raise ValueError("save() needs state= or model=")
             # the only part of an async save the training thread stalls
-            # for: the device→host copy of the full training state
+            # for: the device→host copy of the full training state —
+            # a blocking device boundary, so the stall watchdog
+            # (integrity/watchdog.py) guards it
+            from deeplearning4j_tpu.integrity.watchdog import \
+                guard as _wd_guard
             with _tracer.span("checkpoint.capture", cat="checkpoint",
-                              step=int(step)):
+                              step=int(step)), \
+                    _wd_guard("checkpoint_capture"):
                 state = capture_training_state(model, epoch=epoch,
                                                normalizer=normalizer)
         if metrics:
@@ -423,16 +471,32 @@ class CheckpointManager:
             raise ShardCountMismatchError(step, manifest_count,
                                           self.process_count)
 
+    @staticmethod
+    def _verify_stamp(state: TrainingState, step: int):
+        """Re-verify the fingerprint stamp of a read state
+        (integrity/fingerprint.py): unstamped states pass (pre-
+        integrity checkpoints), a mismatching stamp raises a typed
+        ``SilentCorruptionError`` — the payload changed since capture
+        in a way the sha256 manifest did not witness (e.g. manifest and
+        payload both rewritten)."""
+        from deeplearning4j_tpu.integrity.fingerprint import \
+            verify_state_stamp
+        verify_state_stamp(state, where=f"restore step {step}")
+
     def restore(self, step: int, model=None, strict: bool = True,
                 allow_reshard: bool = False) -> TrainingState:
         """Load (and verify) step ``step``; optionally restore into
         ``model``. Raises CheckpointError if the step is missing or
-        fails integrity verification, and ShardCountMismatchError when
-        the step was committed by a different process count than this
-        runtime has (``allow_reshard=True`` bypasses the check and
-        merges every shard regardless — the reshard path)."""
+        fails integrity verification, SilentCorruptionError if its
+        fingerprint stamp no longer matches the payload, and
+        ShardCountMismatchError when the step was committed by a
+        different process count than this runtime has
+        (``allow_reshard=True`` bypasses the check and merges every
+        shard regardless — the reshard path). Full re-hashing is
+        memoized per unchanged directory (``_verify_full``), so
+        repeated rollbacks in one recovery loop pay it once."""
         d = self.step_dir(step)
-        problems = _manifest.verify_dir(d, full=True)
+        problems = self._verify_full(d)
         if problems:
             raise CheckpointError(
                 f"checkpoint step {step} at {d} is not committed/intact: "
@@ -448,17 +512,40 @@ class CheckpointManager:
             raise CheckpointError(
                 f"checkpoint step {step} lost files after verification "
                 f"({e})") from e
+        self._verify_stamp(state, step)
         if model is not None:
             restore_training_state(model, state, strict=strict)
         return state
 
+    def latest_verified_step(self) -> Optional[int]:
+        """The newest committed step whose fingerprint stamp
+        re-verifies (None when no stamped-and-verified step exists) —
+        the rollback target ``FaultTolerantFit`` prefers after a
+        :class:`SilentCorruptionError`."""
+        from deeplearning4j_tpu.integrity.fingerprint import \
+            verify_state_stamp
+        for step in sorted(self.all_steps(), reverse=True):
+            d = self.step_dir(step)
+            if self._verify_full(d):
+                continue
+            try:
+                state = read_state_files(d)
+                if verify_state_stamp(state, where="scan"):
+                    return step
+            except Exception:       # noqa: BLE001 — scan, not restore
+                continue
+        return None
+
     def restore_latest(self, model=None, strict: bool = True,
-                       allow_reshard: bool = False
+                       allow_reshard: bool = False,
+                       verified_only: bool = False
                        ) -> Optional[Tuple[int, TrainingState]]:
         """Restore the newest COMMITTED checkpoint, skipping torn,
         uncommitted, or corrupted directories (missing COMMIT, bad
         manifest, truncated/bit-flipped payloads). Returns
         ``(step, state)`` or None when nothing restorable exists.
+        Full re-hashing is memoized per unchanged directory, so a
+        recovery loop's repeated rollbacks re-hash only what changed.
 
         A committed checkpoint whose shard count differs from this
         runtime's process count raises a structured
@@ -467,7 +554,16 @@ class CheckpointManager:
         ``faults.FaultTolerantFit`` keys elastic recovery on.
         ``allow_reshard=True`` merges all shards regardless of writer
         count (``checkpoint.reshard.restore_resharded`` is the blessed
-        cross-topology restore built on the same contract)."""
+        cross-topology restore built on the same contract).
+
+        A fingerprint-stamped state whose stamp no longer matches its
+        payload raises ``SilentCorruptionError``; with
+        ``verified_only=True`` it is SKIPPED instead — along with
+        unstamped states while any older verified one exists — so the
+        walk lands on the newest checkpoint that provably holds the
+        bytes the device computed (rollback-to-verified,
+        docs/fault_tolerance.md). Falls back to the newest intact
+        unstamped state when nothing verifies."""
         if self.process_index == 0:
             self._recover_aside()
         candidates = []
@@ -475,9 +571,10 @@ class CheckpointManager:
             m = _STEP_RE.match(name)
             if m:
                 candidates.append(int(m.group(1)))
+        fallback: Optional[Tuple[int, TrainingState]] = None
         for step in sorted(candidates, reverse=True):
             d = self.step_dir(step)
-            if _manifest.verify_dir(d, full=True):
+            if self._verify_full(d):
                 continue                       # torn/corrupt: skip
             if not allow_reshard:
                 self._check_shard_topology(step)
@@ -489,6 +586,25 @@ class CheckpointManager:
                 raise CheckpointError(
                     f"checkpoint step {step} lost files after "
                     f"verification ({e})") from e
+            if verified_only:
+                from deeplearning4j_tpu.integrity.fingerprint import \
+                    verify_state_stamp
+                try:
+                    ok = verify_state_stamp(state,
+                                            where=f"restore step {step}")
+                except Exception:   # mismatching stamp: keep walking
+                    continue
+                if ok is None:      # unstamped: fallback candidate
+                    if fallback is None:
+                        fallback = (step, state)
+                    continue
+            else:
+                self._verify_stamp(state, step)
+            if model is not None:
+                restore_training_state(model, state, strict=strict)
+            return step, state
+        if fallback is not None:
+            step, state = fallback
             if model is not None:
                 restore_training_state(model, state, strict=strict)
             return step, state

@@ -109,3 +109,26 @@ def verify_dir(directory: str, full: bool = True) -> List[str]:
 
 def is_committed(directory: str, full: bool = True) -> bool:
     return not verify_dir(directory, full=full)
+
+
+def dir_token(directory: str):
+    """Cheap change token for a whole step directory: the sorted
+    ``(name, mtime_ns, size)`` tuple of every file in it (one level —
+    checkpoints are flat). Two equal tokens mean the files have not
+    changed since the last full verification, so a repeat restore can
+    skip the re-hash (the ``datapipe/reader.py`` verified-memo pattern
+    lifted to directories). Returns None when the directory is
+    unreadable — never memoize that."""
+    try:
+        entries = []
+        for name in sorted(os.listdir(directory)):
+            p = os.path.join(directory, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None
+            if os.path.isfile(p):
+                entries.append((name, st.st_mtime_ns, st.st_size))
+        return tuple(entries)
+    except OSError:
+        return None

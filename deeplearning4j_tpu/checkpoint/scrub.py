@@ -1,0 +1,253 @@
+"""Proactive checkpoint scrubbing: find bit-rot BEFORE the rollback.
+
+``restore_latest`` already skips a checkpoint that fails verification —
+but discovering rot at restore time means discovering it at the worst
+possible moment: mid-recovery, with the run down and the rollback
+clock ticking. The :class:`Scrubber` moves that discovery to idle
+time: a rate-limited background thread re-hashes every committed step
+directory against its sha256 manifest on a cadence and QUARANTINES
+rotten steps aside:
+
+- ``step_N`` → ``step_N.rotten`` (an ``os.replace`` rename — atomic,
+  and the name no longer matches the step pattern, so
+  ``restore_latest``/retention/gc never touch it again; the bytes stay
+  on disk for forensics);
+- a typed ``ROTTEN.json`` record (step, problems, epoch, discovery
+  time) is written inside the quarantined dir;
+- ``{"type": "integrity", "event": "checkpoint_quarantined"}`` (and a
+  per-cycle ``"scrub"`` summary) is published to the stats storage —
+  ``MetricsRegistry.fold_integrity`` turns them into
+  ``dl4j_integrity_*`` series.
+
+Retention-aware: quarantine happens through the rename above, never a
+delete — a pinned or keep-every-N step that rots is preserved aside
+with its record, and the retention window naturally slides to the
+surviving steps (``all_steps`` no longer sees the rotten name).
+
+Clean verifications feed the manager's restore-path memo
+(``CheckpointManager.note_verified``), so a later rollback skips the
+re-hash the scrubber already paid.
+
+Offline fleet-side variant: ``python -m deeplearning4j_tpu.checkpoint
+scrub <dir>`` (exit 0 clean / 1 rot / 2 usage — the analyze-CLI
+convention). See docs/fault_tolerance.md "Non-raising failures".
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.checkpoint.atomic import fsync_dir
+from deeplearning4j_tpu.checkpoint.manifest import dir_token, verify_dir
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+ROTTEN_RECORD = "ROTTEN.json"
+ROTTEN_SUFFIX = ".rotten"
+
+
+def _dir_bytes(d: str) -> int:
+    total = 0
+    try:
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            if os.path.isfile(p):
+                total += os.path.getsize(p)
+    except OSError:
+        pass
+    return total
+
+
+def scan_tree(directory: str) -> List[dict]:
+    """One verification pass over every committed-looking step dir
+    under ``directory``: ``[{step, path, bytes, problems}, ...]``
+    (``problems`` empty = intact). Shared by the Scrubber and the
+    offline CLI."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as e:
+        raise FileNotFoundError(
+            f"checkpoint tree {directory!r} unreadable: {e}") from e
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        d = os.path.join(directory, name)
+        if not os.path.isdir(d):
+            continue
+        out.append({"step": int(m.group(1)), "path": d,
+                    "bytes": _dir_bytes(d),
+                    "problems": verify_dir(d, full=True)})
+    return out
+
+
+class Scrubber:
+    """Rate-limited background checkpoint scrubber (module docstring).
+
+    ::
+
+        scrub = Scrubber(manager, interval_s=300, max_mb_per_s=64,
+                         storage=storage)
+        with scrub:                   # start() / stop()
+            ftf.fit(it, epochs=50)
+        scrub.last_report             # the final cycle's summary
+
+    Accepts a :class:`~deeplearning4j_tpu.checkpoint.manager.
+    CheckpointManager` (shares its directory and feeds its restore-path
+    verification memo) or a bare directory path. ``max_mb_per_s``
+    bounds the re-hash read rate so scrubbing never competes with the
+    training job's own IO; ``quarantine=False`` reports rot without
+    moving it (the CLI's default).
+    """
+
+    def __init__(self, manager_or_dir, interval_s: float = 300.0,
+                 max_mb_per_s: Optional[float] = 64.0,
+                 storage=None, quarantine: bool = True,
+                 sleep: Callable[[float], None] = time.sleep):
+        if hasattr(manager_or_dir, "directory"):
+            self.manager = manager_or_dir
+            self.directory = manager_or_dir.directory
+        else:
+            self.manager = None
+            self.directory = os.fspath(manager_or_dir)
+        self.interval_s = float(interval_s)
+        self.max_mb_per_s = max_mb_per_s
+        self.storage = storage
+        self.quarantine = bool(quarantine)
+        self._sleep = sleep
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.cycles = 0
+        self.quarantined: List[int] = []
+        self.last_report: Optional[dict] = None
+        self.events: List[dict] = []
+
+    # -- one pass -------------------------------------------------------
+    def scrub_once(self) -> dict:
+        """Verify every committed step dir once; quarantine rot. The
+        memoized-clean fast path is deliberately NOT used — re-hashing
+        unchanged bytes is the scrubber's entire job (rot does not
+        update mtimes); clean results feed the memo instead."""
+        t0 = time.perf_counter()
+        scanned = rotten = 0
+        hashed_bytes = 0
+        quarantined: List[int] = []
+        for ent in scan_tree(self.directory):
+            if self._stop.is_set():
+                break
+            scanned += 1
+            hashed_bytes += ent["bytes"]
+            if ent["problems"]:
+                rotten += 1
+                q = self._quarantine(ent) if self.quarantine else None
+                if q is not None:
+                    quarantined.append(ent["step"])
+                self._publish({
+                    "type": "integrity",
+                    "event": "checkpoint_quarantined" if q is not None
+                    else "checkpoint_rotten",
+                    "t": time.time(), "step": ent["step"],
+                    "problems": ent["problems"][:8],
+                    "quarantined_to": q})
+            elif self.manager is not None:
+                # a clean full re-hash is exactly what the restore
+                # memo wants: feed it so the next rollback skips this
+                self.manager.note_verified(ent["path"])
+            self._throttle(t0, hashed_bytes)
+        report = {"type": "integrity", "event": "scrub",
+                  "t": time.time(), "scanned": scanned,
+                  "rotten": rotten, "quarantined": quarantined,
+                  "bytes": hashed_bytes,
+                  "seconds": round(time.perf_counter() - t0, 6)}
+        self.cycles += 1
+        self.quarantined.extend(quarantined)
+        self.last_report = report
+        self._publish(report)
+        return report
+
+    def _throttle(self, t0: float, total: int) -> None:
+        """Keep the cumulative hash rate under ``max_mb_per_s`` by
+        sleeping off any surplus after each directory."""
+        if not self.max_mb_per_s:
+            return
+        budget_s = total / (self.max_mb_per_s * 1e6)
+        surplus = budget_s - (time.perf_counter() - t0)
+        if surplus > 0:
+            self._sleep(surplus)
+
+    def _quarantine(self, ent: dict) -> Optional[str]:
+        """``step_N`` → ``step_N.rotten`` + typed ROTTEN.json record.
+        Atomic rename: a concurrent restore either still sees the
+        committed name (and its own verification rejects it) or no
+        step at all — never a half-moved dir. A step that rots AGAIN
+        after a re-save quarantines to ``step_N.rotten.2`` (.3, ...):
+        the first incident's forensics stay on disk untouched."""
+        src = ent["path"]
+        dst = src + ROTTEN_SUFFIX
+        k = 2
+        while os.path.isdir(dst):       # rot found twice: keep first
+            dst = f"{src}{ROTTEN_SUFFIX}.{k}"
+            k += 1
+        try:
+            os.replace(src, dst)
+            fsync_dir(self.directory)
+        except OSError:
+            # racing a re-save/retention of the same step: the next
+            # cycle re-examines whatever won
+            return None
+        # the rename IS the quarantine; the record write is best-effort
+        # (a full disk must not misreport an already-moved dir)
+        if self.manager is not None:
+            self.manager._verified_memo.pop(src, None)
+        try:
+            with open(os.path.join(dst, ROTTEN_RECORD), "w",
+                      encoding="utf-8") as fh:
+                json.dump({"step": ent["step"],
+                           "problems": ent["problems"],
+                           "bytes": ent["bytes"],
+                           "quarantined_t": time.time()}, fh, indent=1)
+        except OSError:
+            pass
+        return dst
+
+    # -- background lifecycle -------------------------------------------
+    def start(self) -> "Scrubber":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="checkpoint-scrubber",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scrub_once()
+            except FileNotFoundError:
+                pass                   # tree vanished; retry next cycle
+            self._stop.wait(self.interval_s)
+
+    def __enter__(self) -> "Scrubber":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _publish(self, rec: dict) -> None:
+        self.events.append(rec)
+        if self.storage is not None:
+            self.storage.put(rec)
+
+
+__all__ = ["ROTTEN_RECORD", "ROTTEN_SUFFIX", "Scrubber", "scan_tree"]

@@ -155,6 +155,40 @@ def capture_training_state(model_or_sd, epoch: int = 0, normalizer=None,
                          for k, v in normalizer._state().items()}}
     meta = dict(metadata or {})
     meta.setdefault("topology", capture_topology(sd))
+    # bitwise fingerprint stamp (integrity/fingerprint.py): with
+    # TrainingConfig.fingerprints armed, digest the captured HOST bytes
+    # and — when the fit left a device digest for this exact boundary —
+    # compare the two. A mismatch means the state corrupted between the
+    # device computing it and this capture reading it (a bad D2H copy,
+    # host memory rot): raise typed BEFORE the damage is committed.
+    # The stamp rides the snapshot so restore re-verifies it.
+    if tc is not None and getattr(tc, "fingerprints", False) \
+            and "integrity" not in meta:
+        from deeplearning4j_tpu.integrity.fingerprint import (ALGO,
+                                                              np_fingerprint)
+        host_fp = np_fingerprint(
+            list(arrays.values()) + list(updater_leaves or []))
+        dev = getattr(sd, "_device_fingerprint", None)
+        dev_fp = None
+        verified = None
+        if dev is not None and int(dev.get("iteration", -1)) == iteration:
+            dev_fp = int(dev["fp"])
+            verified = dev_fp == host_fp
+            if not verified:
+                from deeplearning4j_tpu.faults.errors import \
+                    SilentCorruptionError
+                raise SilentCorruptionError(
+                    f"checkpoint capture at iteration {iteration}: host "
+                    f"bytes hash to {host_fp:#010x} but the device "
+                    f"computed {dev_fp:#010x} at the same boundary — "
+                    f"the state corrupted between the dispatch and this "
+                    f"capture (device→host copy or host memory); "
+                    f"refusing to commit a poisoned checkpoint",
+                    check="capture", expected=dev_fp, actual=host_fp,
+                    step=int(iteration), epoch=int(epoch))
+        meta["integrity"] = {"algo": ALGO, "fingerprint": int(host_fp),
+                             "device_fingerprint": dev_fp,
+                             "verified": verified}
     # seekable streaming-pipeline position (datapipe/): fit() registers
     # the active pipeline on the graph; its PipelineState at THIS
     # iteration (shard cursor, shuffle pass, quarantine sets) rides the
@@ -223,6 +257,9 @@ def restore_training_state(model_or_sd, state: TrainingState,
         # sequence of an uninterrupted run
         sd._seed = int(state.rng_seed)
         sd._fit_base_seed = int(state.rng_seed)
+    # a restored state invalidates any device digest a previous fit
+    # left behind (integrity/fingerprint.py): the next fit re-arms it
+    sd._device_fingerprint = None
     if hasattr(model_or_sd, "_sync_infer"):
         model_or_sd._sync_infer()
     return state.make_normalizer()

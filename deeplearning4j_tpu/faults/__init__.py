@@ -24,7 +24,9 @@ from deeplearning4j_tpu.faults.errors import (DataPipelineError,
                                               FaultBudgetExhaustedError,
                                               FaultError,
                                               ShardCorruptError,
+                                              SilentCorruptionError,
                                               TrainingDivergedError,
+                                              TrainingStalledError,
                                               TransientDeviceError,
                                               retryable_errors)
 from deeplearning4j_tpu.faults.iterators import RetryingIterator
@@ -37,6 +39,7 @@ __all__ = ["ChaosMonkey", "DataPipelineError", "FaultBudgetExhaustedError",
            "FaultError", "FaultTolerantFit", "FileBarrier", "HostKiller",
            "HostLossInjector", "LayerHealthWatcher", "LossSpikeWatcher",
            "PlateauWatcher", "RetryPolicy", "RetryingIterator",
-           "ShardCorruptError", "ShardCountMismatchError", "TornShard",
-           "TopologyChangedError", "TrainingDivergedError",
+           "ShardCorruptError", "ShardCountMismatchError",
+           "SilentCorruptionError", "TornShard", "TopologyChangedError",
+           "TrainingDivergedError", "TrainingStalledError",
            "TransientDeviceError", "retryable_errors"]

@@ -34,6 +34,20 @@ generator. Faults on offer (the ones the recovery rail must survive):
   ``times`` checkpoint commit renames / durability fsyncs raise
   ``OSError``, leaving exactly the torn ``step_N.tmp`` state a killed
   writer leaves.
+- ``stalled_dispatch(delay_s, at_call)`` — a train dispatch blocks for
+  ``delay_s`` before returning real results: the recoverable-stall
+  drill for ``integrity.StallWatchdog`` (typed ``TrainingStalledError``
+  + forensics + /healthz 503, then a clean rollback-retry).
+- ``bitflip_param(at_call)`` — silent data corruption: one bit of the
+  dispatched window's returned params flips, finite-in finite-out;
+  ``refingerprint=True`` keeps the corruption self-consistent (SDC
+  inside the dispatch — the replay probe's case), ``False`` leaves the
+  device digest intact (a corrupted D2H copy — the capture check's
+  case). With fingerprints off the flip is genuinely silent.
+- ``rot_checkpoint(dir, step)`` — flip/truncate a committed checkpoint
+  payload on disk without touching its manifest: the bit-rot
+  ``restore_latest`` must skip and ``checkpoint.Scrubber``
+  quarantines (``step_N.rotten``).
 - ``sigterm_listener(at_iteration)`` — delivers SIGTERM to this process
   at a training iteration, mid-window (drives PreemptionHook drills).
 - ``failing_exec(server, n, every)`` — serving-side: every ``every``-th
@@ -586,6 +600,167 @@ class ChaosMonkey:
             yield
         finally:
             sd.fit = orig
+
+    @contextlib.contextmanager
+    def bitflip_param(self, at_call: int = 1, times: int = 1,
+                      bit: int = 17, leaf: Optional[str] = None,
+                      refingerprint: bool = True) -> Iterator[dict]:
+        """Silent data corruption: the ``at_call``-th train dispatch's
+        RETURNED params have one bit flipped (``times`` times total) —
+        finite-in, finite-out, so the isfinite sentinel never fires;
+        only the integrity rail (integrity/fingerprint.py) can see it.
+
+        Two flavors, matching the two real failure modes:
+
+        - ``refingerprint=True`` (default) also recomputes the
+          window's fingerprint output over the flipped state — the
+          corruption is SELF-CONSISTENT, exactly what SDC inside the
+          dispatch looks like (device state and its digest agree but
+          differ from a correct replay). Detected by the REPLAY PROBE
+          (``TrainingConfig.fingerprint_replay_every``) or a
+          cross-replica check, NOT by the capture check.
+        - ``refingerprint=False`` leaves the in-program digest intact —
+          the corruption happened AFTER the device computed it (a bad
+          device→host copy, host memory rot). Detected by the CAPTURE
+          check at the next checkpoint.
+
+        ``bit`` indexes into the flattened first float leaf (or
+        ``leaf``, by name); with fingerprints off the flip is genuinely
+        silent — the negative control the docs warn about. Yields the
+        mutable ``{"calls", "left", "flips"}`` state."""
+        from deeplearning4j_tpu.compilecache.aot import AOTDispatch
+        state = {"calls": 0, "left": int(times), "flips": []}
+        orig = AOTDispatch.__call__
+        monkey = self
+
+        def _flip_leaf(arr):
+            import jax
+            host = np.asarray(arr).copy()
+            words = host.view(np.uint8).reshape(-1)
+            pos = int(bit) % (words.size * 8)
+            words[pos // 8] ^= np.uint8(1 << (pos % 8))
+            return jax.device_put(host), pos
+
+        def chaotic_call(disp, *args):
+            out = orig(disp, *args)
+            state["calls"] += 1
+            if state["left"] <= 0 or state["calls"] < int(at_call) or \
+                    not (isinstance(out, tuple) and out
+                         and isinstance(out[0], dict)):
+                return out
+            state["left"] -= 1
+            params = dict(out[0])
+            name = leaf if leaf is not None else sorted(
+                n for n, a in params.items()
+                if np.issubdtype(np.asarray(a).dtype, np.floating))[0]
+            params[name], pos = _flip_leaf(params[name])
+            rest = list(out[1:])
+            import jax
+            fp_i = None
+            if rest:
+                last = rest[-1]
+                if getattr(last, "dtype", None) is not None and \
+                        getattr(last, "shape", None) == () and \
+                        str(last.dtype) == "uint32":
+                    fp_i = len(rest) - 1
+            if refingerprint and fp_i is not None:
+                # self-consistent SDC: re-digest the FLIPPED state
+                # (params + svars + updater state — the same leaf set
+                # the in-program digest covers)
+                from deeplearning4j_tpu.integrity.fingerprint import \
+                    np_fingerprint
+                leaves = list(params.values()) \
+                    + jax.tree_util.tree_leaves(rest[0]) \
+                    + jax.tree_util.tree_leaves(rest[1])
+                rest[fp_i] = jax.device_put(
+                    np.uint32(np_fingerprint(leaves)))
+            monkey.log.append({"event": "param_bit_flipped",
+                               "call": state["calls"], "leaf": name,
+                               "bit": pos,
+                               "refingerprint": bool(refingerprint
+                                                     and fp_i is not None),
+                               "t": time.time()})
+            state["flips"].append((name, pos))
+            return (params, *rest)
+
+        AOTDispatch.__call__ = chaotic_call
+        try:
+            yield state
+        finally:
+            AOTDispatch.__call__ = orig
+
+    @contextlib.contextmanager
+    def stalled_dispatch(self, delay_s: float, at_call: int = 1,
+                         times: int = 1) -> Iterator[dict]:
+        """Wedged-dispatch drill: the ``at_call``-th train dispatch
+        blocks ``delay_s`` seconds before returning real results,
+        ``times`` times total — a RECOVERABLE stall (the call
+        eventually un-wedges). With a ``StallWatchdog`` armed past its
+        deadline this drives the full stall path: forensics dump,
+        ``{"type": "faults", "event": "stall"}``, /healthz 503, a typed
+        ``TrainingStalledError`` at the boundary's exit, and a
+        FaultTolerantFit rollback-retry that passes cleanly (one-shot).
+        Yields the mutable ``{"calls", "left"}`` state."""
+        from deeplearning4j_tpu.compilecache.aot import AOTDispatch
+        state = {"calls": 0, "left": int(times)}
+        orig = AOTDispatch.__call__
+        monkey = self
+
+        def chaotic_call(disp, *args):
+            state["calls"] += 1
+            if state["left"] > 0 and state["calls"] >= int(at_call):
+                state["left"] -= 1
+                monkey.log.append({"event": "dispatch_stalled",
+                                   "call": state["calls"],
+                                   "delay_s": float(delay_s),
+                                   "t": time.time()})
+                time.sleep(float(delay_s))
+            return orig(disp, *args)
+
+        AOTDispatch.__call__ = chaotic_call
+        try:
+            yield state
+        finally:
+            AOTDispatch.__call__ = orig
+
+    # -- checkpoint/storage faults --------------------------------------
+    def rot_checkpoint(self, directory, step: Optional[int] = None,
+                       mode: str = "bitflip") -> dict:
+        """Checkpoint bit-rot: damage the payload bytes of a COMMITTED
+        step dir on disk (newest by default) without touching its
+        manifest — the classic cold-storage rot ``restore_latest``'s
+        verification must skip and the ``checkpoint.Scrubber``
+        quarantines. ``mode='bitflip'`` flips one payload byte;
+        ``'truncate'`` halves the largest payload file. Permanent (no
+        heal — rot does not heal). Returns ``{step, file, mode}``."""
+        from deeplearning4j_tpu.checkpoint.scrub import _STEP_RE
+        directory = os.fspath(getattr(directory, "directory", directory))
+        steps = sorted(int(m.group(1))
+                       for m in (_STEP_RE.match(n)
+                                 for n in os.listdir(directory)) if m)
+        if not steps:
+            raise ValueError(f"no committed steps under {directory!r}")
+        step = steps[-1] if step is None else int(step)
+        d = os.path.join(directory, f"step_{step:08d}")
+        payloads = [n for n in sorted(os.listdir(d))
+                    if n not in ("MANIFEST.json", "COMMIT")
+                    and os.path.isfile(os.path.join(d, n))]
+        target = max(payloads,
+                     key=lambda n: os.path.getsize(os.path.join(d, n)))
+        p = os.path.join(d, target)
+        with open(p, "rb") as fh:
+            data = fh.read()
+        if mode == "truncate":
+            data = data[: len(data) // 2]
+        else:
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0xFF
+            data = bytes(buf)
+        with open(p, "wb") as fh:
+            fh.write(data)
+        self.log.append({"event": "checkpoint_rotted", "step": step,
+                         "file": target, "mode": mode, "t": time.time()})
+        return {"step": step, "file": target, "mode": mode}
 
     @contextlib.contextmanager
     def resource_exhausted(self, at_call: int = 1, times: int = 1,

@@ -105,6 +105,73 @@ class FaultBudgetExhaustedError(FaultError):
     cause_tag = "budget_exhausted"
 
 
+class TrainingStalledError(FaultError):
+    """A blocking device boundary (window dispatch, flush device_get,
+    serving exec, checkpoint capture) exceeded its adaptive stall
+    deadline (integrity/watchdog.py) — the non-raising failure class:
+    a wedged collective, a hung host↔device transfer, a dead tunnel.
+    RETRYABLE: a stall that eventually un-wedges (transient network
+    partition, a straggling peer that recovers) heals through the
+    normal rollback path; a permanent wedge never returns from the
+    blocking call, but the watchdog has already published the
+    ``{"type": "faults", "event": "stall"}`` record, flipped
+    ``/healthz`` to 503, and dumped forensics for the supervisor that
+    will eventually kill the process.
+
+    ``forensics`` carries all-thread stacks, an HBM snapshot and the
+    active compiled-program memory plan captured AT EXPIRY (while the
+    boundary was still wedged), not at raise time."""
+
+    cause_tag = "stall"
+
+    def __init__(self, message: str, *, boundary: Optional[str] = None,
+                 waited_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 forensics: Optional[Dict[str, Any]] = None, **kw):
+        super().__init__(message, **kw)
+        self.boundary = boundary
+        self.waited_s = waited_s
+        self.deadline_s = deadline_s
+        self.forensics = dict(forensics or {})
+
+    def provenance(self) -> Dict[str, Any]:
+        out = super().provenance()
+        out["boundary"] = self.boundary
+        out["waited_s"] = self.waited_s
+        out["deadline_s"] = self.deadline_s
+        return out
+
+
+class SilentCorruptionError(FaultError):
+    """Bitwise state divergence that raised nothing: a replay probe's
+    fingerprint mismatch (SDC/nondeterminism inside a dispatch), a
+    device-vs-host fingerprint mismatch at checkpoint capture (a
+    corrupted device→host copy), cross-replica fingerprint disagreement
+    under DP sharding, or a checkpoint whose fingerprint stamp no
+    longer matches its payload at restore (integrity/fingerprint.py).
+    RETRYABLE — but ``faults.FaultTolerantFit`` answers it by rolling
+    back to the last *fingerprint-verified* checkpoint rather than
+    merely the newest (docs/fault_tolerance.md "Non-raising
+    failures")."""
+
+    cause_tag = "silent_corruption"
+
+    def __init__(self, message: str, *, check: Optional[str] = None,
+                 expected: Optional[int] = None,
+                 actual: Optional[int] = None, **kw):
+        super().__init__(message, **kw)
+        self.check = check
+        self.expected = expected
+        self.actual = actual
+
+    def provenance(self) -> Dict[str, Any]:
+        out = super().provenance()
+        out["check"] = self.check
+        out["expected"] = self.expected
+        out["actual"] = self.actual
+        return out
+
+
 def retryable_errors() -> tuple:
     """Exception classes the recovery driver treats as recoverable:
     the structured fault taxonomy, numerics panics from the fit tiers,
@@ -113,7 +180,8 @@ def retryable_errors() -> tuple:
     topology-change signals routed through resharded restore), and the
     backend's runtime errors (preemption / transient device loss
     surface there)."""
-    types = [TrainingDivergedError, DataPipelineError, TransientDeviceError]
+    types = [TrainingDivergedError, DataPipelineError, TransientDeviceError,
+             TrainingStalledError, SilentCorruptionError]
     from deeplearning4j_tpu.autodiff.samediff import NumericsException
     types.append(NumericsException)
     from deeplearning4j_tpu.checkpoint.manager import CheckpointError

@@ -44,6 +44,7 @@ from deeplearning4j_tpu.checkpoint.manager import (CheckpointError,
                                                    TopologyChangedError)
 from deeplearning4j_tpu.faults.errors import (FaultBudgetExhaustedError,
                                               FaultError,
+                                              SilentCorruptionError,
                                               retryable_errors)
 from deeplearning4j_tpu.faults.iterators import RetryingIterator
 from deeplearning4j_tpu.memory import MemoryExhaustedError
@@ -130,13 +131,31 @@ class FaultTolerantFit:
                              "init() the network) before FaultTolerantFit")
         return tc
 
-    def _restore_latest(self):
+    def _restore_latest(self, verified_only: bool = False):
         """Restore the newest committed checkpoint into the model via
-        the most specific hook it offers (ParallelTrainer re-shards)."""
-        if hasattr(self.model, "restore_latest") and \
-                not isinstance(self.model, CheckpointManager):
-            return self.model.restore_latest(self.manager)
-        return self.manager.restore_latest(model=self.model)
+        the most specific hook it offers (ParallelTrainer re-shards).
+        ``verified_only`` routes through the manager's fingerprint-
+        verified walk (integrity/) — the rollback target after a
+        :class:`SilentCorruptionError` must be a checkpoint whose
+        stamp still proves its bytes, not merely the newest. A model
+        hook that accepts ``verified_only`` (ParallelTrainer) keeps
+        its mesh re-commit even on the verified walk; one that
+        predates the parameter falls back to the manager path."""
+        hook = getattr(self.model, "restore_latest", None)
+        if hook is not None and not isinstance(self.model,
+                                               CheckpointManager):
+            if not verified_only:
+                return hook(self.manager)
+            import inspect
+            try:
+                accepts = "verified_only" in \
+                    inspect.signature(hook).parameters
+            except (TypeError, ValueError):
+                accepts = False
+            if accepts:
+                return hook(self.manager, verified_only=True)
+        return self.manager.restore_latest(model=self.model,
+                                           verified_only=verified_only)
 
     def _restore_datapipe(self, state) -> None:
         """Seek the streaming pipeline (datapipe/) back to the
@@ -222,6 +241,15 @@ class FaultTolerantFit:
         committed checkpoint exists."""
         try:
             res = self._restore_latest()
+        except SilentCorruptionError as e:
+            # the newest checkpoint's fingerprint stamp no longer
+            # matches its payload: publish, then restart from the
+            # newest VERIFIED one instead
+            self._publish("corrupt_checkpoint", **e.provenance())
+            res = self._restore_latest(verified_only=True)
+            if res is not None:
+                self._restore_datapipe(res[1])
+            return res
         except TopologyChangedError as e:
             self._publish("topology_changed", error=type(e).__name__,
                           step=e.step, manifest=e.manifest,
@@ -270,10 +298,23 @@ class FaultTolerantFit:
         will_rescale = self.policy.lr_rescale != 1.0 and isinstance(
             getattr(self._tc().updater, "learning_rate", None),
             (int, float))
+        # a SilentCorruptionError rolls back to the last fingerprint-
+        # VERIFIED checkpoint, not merely the newest: the newest may
+        # have captured the corrupted state, or its stamp may itself be
+        # the mismatch (docs/fault_tolerance.md "Non-raising failures")
+        verified_only = isinstance(cause, SilentCorruptionError)
         try:
             try:
-                res = self._restore_latest()
-                self._publish_trainer_reshard(precompile=not will_rescale)
+                res = self._restore_latest(verified_only=verified_only)
+                self._publish_trainer_reshard(
+                    precompile=not will_rescale)
+            except SilentCorruptionError as e:
+                # the NEWEST checkpoint's stamp failed during a plain
+                # rollback: publish the corruption and fall back to the
+                # verified walk
+                self._publish("corrupt_checkpoint", **e.provenance())
+                verified_only = True
+                res = self._restore_latest(verified_only=True)
             except TopologyChangedError as e:
                 # the world changed shape between the snapshot and this
                 # rollback (host loss, elastic rescale): reassemble from
@@ -313,6 +354,7 @@ class FaultTolerantFit:
             "rollback", restored_step=int(step),
             gc_removed=len(removed), overhead_s=round(dt, 6),
             lr_rescale=self.policy.lr_rescale,
+            verified_only=verified_only,
             **(cause.provenance() if isinstance(cause, FaultError)
                else {"error": type(cause).__name__, "cause": "exception"}))
         return step

@@ -1,0 +1,259 @@
+"""Bitwise state fingerprints: silent-corruption detection for ~free.
+
+The divergence sentinel (faults/sentinels.py) catches values that go
+NON-FINITE; silent data corruption flips a bit and stays finite. The
+fingerprint rail closes that gap with one deterministic 32-bit digest
+of the full training state (params + state vars + optimizer state):
+
+    fingerprint = sum mod 2^32 of every 32-bit word of every leaf
+
+Why this exact construction (and not a "real" hash):
+
+- **order-independent** — modular addition commutes, so the device
+  (whatever reduce order XLA schedules) and the host (numpy, any leaf
+  order) compute the SAME digest from the same bytes. That is what
+  makes device-vs-host and device-vs-stamp comparisons meaningful.
+- **single-bit-flip-complete** — flipping bit ``b`` of any word changes
+  the sum by ±2^b mod 2^32 ≠ 0: every single-event upset is detected.
+  (Coordinated multi-bit damage can cancel; that failure mode belongs
+  to the sha256 manifest on disk, not to an in-dispatch digest.)
+- **fuses into the step** — on device it is one memory-bound uint32
+  reduce appended to the compiled window, emitted as ONE extra scalar
+  output per window exactly like the PR-4 sentinel carry; the host
+  reads it only at the flush boundaries it already syncs on.
+
+Checks built on it (docs/fault_tolerance.md "Non-raising failures"):
+
+- **capture check** — ``checkpoint.state.capture_training_state``
+  recomputes the digest from the captured HOST bytes and compares it
+  to the device digest of the same boundary: a corrupted device→host
+  copy raises :class:`~deeplearning4j_tpu.faults.errors.
+  SilentCorruptionError` before the damage can be committed.
+- **fingerprint-stamped checkpoints** — the host digest rides
+  ``TrainingState.metadata["integrity"]``; restore recomputes and
+  verifies it (:func:`verify_state_stamp`), so a checkpoint that rots
+  in a way the sha256 manifest can no longer witness (manifest and
+  payload both rewritten) still fails typed.
+- **replay probe** — the windowed fit re-dispatches a window from a
+  stashed carry every ``TrainingConfig.fingerprint_replay_every``
+  windows and compares the two digests: genuine in-dispatch SDC or
+  nondeterminism makes them disagree (autodiff/window.py).
+- **cross-replica agreement** — under DP sharding every replica holds
+  the same params; :func:`check_replica_agreement` compares per-shard
+  digests bitwise and names the diverged device.
+
+With no fault present the rail never touches parameter math:
+fingerprints-on training is bit-identical to off (tested).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+ALGO = "u32sum-v1"
+
+_MASK = np.uint64(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# host (numpy) digest — must agree bit-for-bit with the device digest
+
+def np_leaf_fingerprint(a) -> int:
+    """Sum mod 2^32 of the 32-bit words of one array's raw bytes,
+    mirroring the device construction per itemsize (8/16-bit elements
+    zero-extend to uint32 EACH; 64-bit elements split into two words)."""
+    a = np.ascontiguousarray(np.asarray(a))
+    if a.size == 0:
+        return 0
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint8)
+    itemsize = a.dtype.itemsize
+    if itemsize == 1:
+        words = a.reshape(-1).view(np.uint8)
+    elif itemsize == 2:
+        words = a.reshape(-1).view(np.uint16)
+    elif itemsize == 4:
+        words = a.reshape(-1).view(np.uint32)
+    elif itemsize == 8:
+        words = a.reshape(-1).view(np.uint32)   # little-endian word pairs
+    else:
+        raise TypeError(f"unsupported itemsize {itemsize} "
+                        f"(dtype {a.dtype})")
+    # uint64 accumulate then fold: portable regardless of numpy's
+    # overflow behavior on platform-sized sums
+    return int(np.sum(words.astype(np.uint64)) & _MASK)
+
+
+def np_fingerprint(leaves: Iterable) -> int:
+    """Combined digest of many arrays (order-independent by
+    construction — modular addition commutes)."""
+    total = 0
+    for leaf in leaves:
+        total = (total + np_leaf_fingerprint(leaf)) & 0xFFFFFFFF
+    return int(total)
+
+
+def state_fingerprint(state) -> int:
+    """Host digest of a ``checkpoint.TrainingState``: the same leaf set
+    the device digest covers — arrays (trainable params + state vars)
+    plus the optimizer-state leaves. Counters/normalizer stay outside
+    (they are host-side ints the manifest already covers)."""
+    leaves = list(state.arrays.values())
+    if state.updater_leaves is not None:
+        leaves.extend(state.updater_leaves)
+    return np_fingerprint(leaves)
+
+
+def verify_state_stamp(state, where: str = "restore") -> Optional[bool]:
+    """Re-verify a fingerprint-stamped ``TrainingState``. Returns None
+    when unstamped (pre-integrity checkpoints restore as before), True
+    when the stamp matches, and raises
+    :class:`~deeplearning4j_tpu.faults.errors.SilentCorruptionError`
+    on a mismatch — the typed signal ``FaultTolerantFit`` answers by
+    rolling back to the last *verified* checkpoint."""
+    stamp = (state.metadata or {}).get("integrity")
+    if not stamp or stamp.get("fingerprint") is None:
+        return None
+    expected = int(stamp["fingerprint"])
+    actual = state_fingerprint(state)
+    if actual != expected:
+        from deeplearning4j_tpu.faults.errors import SilentCorruptionError
+        raise SilentCorruptionError(
+            f"checkpoint fingerprint stamp mismatch at {where}: state "
+            f"hashes to {actual:#010x} but was stamped {expected:#010x} "
+            f"(step {state.iteration}) — the payload changed since "
+            f"capture in a way the sha256 manifest did not witness",
+            check=f"stamp_{where}", expected=expected, actual=actual,
+            step=int(state.iteration), epoch=int(state.epoch))
+    return True
+
+
+# ---------------------------------------------------------------------------
+# device (traced) digest
+
+def jnp_leaf_fingerprint(x):
+    """Traced uint32 digest of one array — the device mirror of
+    :func:`np_leaf_fingerprint` (bitcast to same-width unsigned words,
+    zero-extend sub-32-bit words, split 64-bit words, wraparound sum)."""
+    import jax
+    import jax.numpy as jnp
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    nbits = jnp.dtype(x.dtype).itemsize * 8
+    target = {8: jnp.uint8, 16: jnp.uint16,
+              32: jnp.uint32, 64: jnp.uint32}[nbits]
+    words = jax.lax.bitcast_convert_type(x, target)
+    return jnp.sum(words.astype(jnp.uint32), dtype=jnp.uint32)
+
+
+def tree_fingerprint(*trees):
+    """Traced combined digest over pytrees (params, svars, optimizer
+    state). Emitted by the compiled window as ONE extra uint32 scalar;
+    order-independent, so it agrees with the host digest of the same
+    leaves regardless of flattening order."""
+    import jax
+    import jax.numpy as jnp
+    total = jnp.asarray(0, jnp.uint32)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total = total + jnp_leaf_fingerprint(leaf)
+    return total
+
+
+def make_fingerprint_fn(sd):
+    """A tiny jitted ``(params, svars, state) -> uint32`` digest
+    program for tiers that do not thread the digest through the
+    compiled step (the per-step fit dispatches it at flush boundaries).
+    Cached on the graph's version-keyed fn cache."""
+    import jax
+    key = ("fingerprint_fn", sd._version)
+    fn = sd._fn_cache.get(key)
+    if fn is None:
+        fn = jax.jit(tree_fingerprint)
+        sd._fn_cache[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# cross-replica agreement (DP sharding)
+
+def replica_fingerprints(tree) -> Dict[str, List[Tuple[str, Tuple, int]]]:
+    """Per-addressable-shard host digests of every array in ``tree``:
+    ``{name: [(device, index_key, fingerprint), ...]}``. Shards that
+    cover the SAME global slice (``index_key``) are replicas and must
+    match bitwise."""
+    out: Dict[str, List[Tuple[str, Tuple, int]]] = {}
+    for name, arr in tree.items():
+        shards = getattr(arr, "addressable_shards", None)
+        if not shards:
+            continue
+        rows = []
+        for sh in shards:
+            key = tuple((s.start, s.stop, s.step)
+                        for s in (sh.index if isinstance(sh.index, tuple)
+                                  else (sh.index,)))
+            rows.append((str(sh.device), key,
+                         np_leaf_fingerprint(np.asarray(sh.data))))
+        out[name] = rows
+    return out
+
+
+def check_replica_agreement(tree, raise_: bool = True) -> List[dict]:
+    """Compare replicas bitwise: any two shards of the same array
+    covering the same global slice must hold identical bytes. Returns
+    the disagreement list (empty = agreement); with ``raise_`` (the
+    default) a non-empty list raises
+    :class:`~deeplearning4j_tpu.faults.errors.SilentCorruptionError`
+    naming the array and devices — SDC on one replica, or
+    nondeterministic collective math, depending on which side you
+    trust."""
+    bad: List[dict] = []
+    for name, rows in replica_fingerprints(tree).items():
+        groups: Dict[Tuple, List[Tuple[str, int]]] = {}
+        for device, key, fp in rows:
+            groups.setdefault(key, []).append((device, fp))
+        for key, members in groups.items():
+            fps = {fp for _, fp in members}
+            if len(fps) > 1:
+                bad.append({"array": name, "slice": key,
+                            "replicas": members})
+    if bad and raise_:
+        from deeplearning4j_tpu.faults.errors import SilentCorruptionError
+        first = bad[0]
+        raise SilentCorruptionError(
+            f"cross-replica fingerprint disagreement on "
+            f"{first['array']!r}: {first['replicas']} (+{len(bad) - 1} "
+            f"more array(s)) — replicas of a DP-sharded parameter must "
+            f"match bitwise; one device's copy has silently diverged",
+            check="replica_agreement")
+    return bad
+
+
+def check_probes(pairs, starts) -> None:
+    """Host-side verdict over a fetched burst of replay-probe pairs:
+    ``pairs`` is an (N, 2) uint32 array of (main, replay) digests
+    aligned with window-start iterations ``starts``. The first
+    disagreement raises with that window's provenance."""
+    pairs = np.asarray(pairs)
+    if pairs.size == 0:
+        return
+    for (a, b), start in zip(pairs, starts):
+        if int(a) != int(b):
+            from deeplearning4j_tpu.faults.errors import \
+                SilentCorruptionError
+            raise SilentCorruptionError(
+                f"replay probe mismatch for the window starting at "
+                f"iteration {int(start)}: dispatch fingerprint "
+                f"{int(a):#010x} != replay {int(b):#010x} — the same "
+                f"program on the same inputs produced different bits "
+                f"(SDC or nondeterminism); roll back to the last "
+                f"verified checkpoint", check="replay_probe",
+                expected=int(b), actual=int(a), step=int(start))
+
+
+__all__ = ["ALGO", "check_probes", "check_replica_agreement",
+           "jnp_leaf_fingerprint", "make_fingerprint_fn",
+           "np_fingerprint", "np_leaf_fingerprint",
+           "replica_fingerprints", "state_fingerprint",
+           "tree_fingerprint", "verify_state_stamp"]

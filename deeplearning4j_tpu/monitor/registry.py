@@ -535,6 +535,46 @@ class MetricsRegistry:
                            help="prefetch-worker busy fraction since "
                                 "the previous flush", worker=str(worker))
 
+    def fold_integrity(self, record: dict) -> None:
+        """Fold one ``{"type": "integrity"}`` record (the integrity
+        rail: checkpoint scrubber cycles/quarantines and stall-watchdog
+        forensics — integrity/, checkpoint/scrub.py) into
+        ``integrity_*`` metrics. Stall FAULT events already count under
+        ``faults_events_total{event="stall"}``; this adds the scrub
+        cadence and the rot/quarantine tallies a fleet dashboard
+        alerts on."""
+        ev = record.get("event")
+        if ev == "scrub":
+            self.inc("integrity_scrub_cycles_total",
+                     help="checkpoint scrub cycles completed")
+            self.inc("integrity_scrubbed_dirs_total",
+                     record.get("scanned", 0),
+                     help="step dirs re-hashed by the scrubber")
+            self.inc("integrity_scrub_bytes_total",
+                     record.get("bytes", 0),
+                     help="bytes re-hashed by the scrubber")
+            self.inc("integrity_rotten_total", record.get("rotten", 0),
+                     help="step dirs that failed scrub verification")
+            self.observe("integrity_scrub_seconds",
+                         record.get("seconds", 0.0),
+                         help="scrub cycle wall time")
+        elif ev in ("checkpoint_quarantined", "checkpoint_rotten"):
+            self.inc("integrity_quarantined_total",
+                     1 if ev == "checkpoint_quarantined" else 0,
+                     help="rotten checkpoints moved aside "
+                          "(step_N.rotten)")
+            if record.get("step") is not None:
+                self.set_gauge("integrity_last_rotten_step",
+                               record["step"],
+                               help="newest step found rotten")
+        elif ev == "stall_forensics":
+            self.inc("integrity_stalls_total",
+                     help="stall-watchdog expiries (forensics dumped)")
+            if record.get("waited_s") is not None:
+                self.observe("integrity_stall_waited_seconds",
+                             record["waited_s"],
+                             help="how long stalled boundaries blocked")
+
     def fold_steptime(self, record: dict) -> None:
         """Fold one ``{"type": "steptime"}`` breakdown record
         (monitor/steptime.py)."""
@@ -599,6 +639,8 @@ class MetricsRegistry:
             self.fold_memory_plan(rec)
         elif t == "analysis":
             self.fold_analysis(rec)
+        elif t == "integrity":
+            self.fold_integrity(rec)
 
 
 __all__ = ["MetricsRegistry"]

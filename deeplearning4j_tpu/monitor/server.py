@@ -32,6 +32,9 @@ route                 payload
                       (monitor/memstats.py)
 ``GET /trace``        Chrome/Perfetto trace JSON from the shared tracer
                       (load at ui.perfetto.dev)
+``GET /stacks``       all-thread Python stack dump (integrity/
+                      watchdog.py) — look at a run that seems wedged;
+                      the stall watchdog's forensics reuse it
 ``GET /stats``        recent storage records as JSON lines
                       (``?n=500&type=tensorstats``)
 ``GET /``             a minimal index linking the routes
@@ -65,9 +68,12 @@ from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.monitor.registry import MetricsRegistry
 
-#: fault-rail events that flip /healthz to 503 (a recovery in progress)
+#: fault-rail events that flip /healthz to 503 (a recovery in progress;
+#: "stall" is the watchdog's wedged-boundary verdict — the run may
+#: never raise, but the probe must go red immediately)
 _DEGRADING_EVENTS = frozenset({"fault", "rollback", "retry",
-                               "topology_changed"})
+                               "topology_changed", "stall",
+                               "corrupt_checkpoint"})
 #: ... and the event that clears it
 _RECOVERED_EVENTS = frozenset({"recovered"})
 #: sticky failure: the retry budget is spent and the job is aborting,
@@ -79,7 +85,7 @@ _FATAL_EVENTS = frozenset({"retry_exhausted", "oom"})
 #: last-step-age fallback when no heartbeat provider is registered
 #: ("score"/"perf" use perf_counter timestamps and must NOT mix in)
 _WALL_T_TYPES = ("steptime", "tensorstats", "metrics", "checkpoint",
-                 "faults", "serving", "memory", "datapipe")
+                 "faults", "serving", "memory", "datapipe", "integrity")
 
 
 def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
@@ -284,6 +290,8 @@ class TelemetryServer:
             return self._memory()
         if route == "/trace":
             return self._trace()
+        if route == "/stacks":
+            return self._stacks()
         if route == "/stats":
             return self._stats(qs)
         if route == "/":
@@ -342,6 +350,17 @@ class TelemetryServer:
         return 200, "application/json", \
             json.dumps(self.tracer.to_chrome_trace()).encode("utf-8")
 
+    def _stacks(self):
+        """All-thread Python stack dump (integrity/watchdog.py) — the
+        standalone look-at-a-wedged-run debug route; the stall
+        watchdog's forensics reuse the same dump. Same security note as
+        every other route: loopback-only by default, serves process
+        internals unauthenticated."""
+        from deeplearning4j_tpu.integrity.watchdog import dump_all_stacks
+        body = {"t": time.time(), "threads": dump_all_stacks()}
+        return 200, "application/json", \
+            json.dumps(body, default=str).encode("utf-8")
+
     def _stats(self, qs):
         if self.storage is None:
             return 404, "application/json", \
@@ -367,6 +386,8 @@ class TelemetryServer:
                 ("/report", "training report HTML"),
                 ("/memory", "live HBM snapshot + program memory plans"),
                 ("/trace", "Chrome/Perfetto trace JSON"),
+                ("/stacks", "all-thread stack dump (wedged-run "
+                            "debugging)"),
                 ("/stats", "recent records (?n=500&type=...)")))
         body = (f"<!doctype html><html><head><meta charset='utf-8'>"
                 f"<title>{_html.escape(self.title)}</title></head>"

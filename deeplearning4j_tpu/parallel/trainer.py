@@ -178,7 +178,8 @@ class ParallelTrainer:
                            epochs=epochs, listeners=listeners)
 
     def restore_latest(self, manager, strict: bool = True,
-                       strategy: Optional[ShardingStrategy] = None):
+                       strategy: Optional[ShardingStrategy] = None,
+                       verified_only: bool = False):
         """Resume from a checkpoint.CheckpointManager: restore the newest
         committed step into the model (host arrays), then re-commit the
         arrays to their mesh shardings. Returns (step, TrainingState) or
@@ -190,8 +191,13 @@ class ParallelTrainer:
         strategy). When the checkpoint's recorded topology differs from
         the target mesh the re-placement is surfaced as a
         ``checkpoint.reshard`` span plus a ``{"type": "reshard"}``
-        record, and ``self.last_reshard`` holds the summary."""
-        res = manager.restore_latest(model=self.model, strict=strict)
+        record, and ``self.last_reshard`` holds the summary.
+
+        ``verified_only`` routes through the manager's fingerprint-
+        verified walk (integrity/) while KEEPING the mesh re-commit
+        below — the rollback-to-verified path for sharded models."""
+        res = manager.restore_latest(model=self.model, strict=strict,
+                                     verified_only=verified_only)
         self.last_reshard = None
         if res is not None:
             # adopt the override only once a restore actually landed —

@@ -401,12 +401,22 @@ class ParallelInference:
         with self._exec_lock, \
                 _tracer.span("serving.exec", cat="serving", rows=real,
                              padding=rows - real):
-            if sig not in self._shapes_seen:
+            first_exec = sig not in self._shapes_seen
+            if first_exec:
                 self._shapes_seen.add(sig)
                 self.metrics.inc("compiles")
             prof = self._profiler_session()
             try:
-                res = self._spec.sd.output(ph, self._spec.output_names)
+                # blocking device boundary: the stall watchdog
+                # (integrity/watchdog.py) arms an adaptive deadline so
+                # a wedged exec dumps forensics + flips /healthz
+                # instead of hanging the lane silently; a first
+                # (compiling) shape gets the compile grace
+                from deeplearning4j_tpu.integrity.watchdog import \
+                    guard as _wd_guard
+                with _wd_guard("serving_execute", first=first_exec):
+                    res = self._spec.sd.output(ph,
+                                               self._spec.output_names)
             except Exception as e:
                 # RESOURCE_EXHAUSTED → structured OOM with forensics
                 # (per-device usage + the bucket program) instead of a

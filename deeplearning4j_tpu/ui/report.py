@@ -224,7 +224,7 @@ _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
     "compile", "reshard", "tensorstats", "memory_plan", "analysis",
-    "datapipe"})
+    "datapipe", "integrity"})
 
 
 #: memory-plan byte components for the stacked budget chart, mirroring
@@ -314,6 +314,9 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     serving = storage.of_type("serving")
     serving_faults = [r for r in storage.of_type("faults")
                       if r.get("origin") == "serving"]
+    integrity = storage.of_type("integrity")
+    stall_events = [r for r in storage.of_type("faults")
+                    if r.get("event") == "stall"]
 
     parts = [f"""<!doctype html><html><head><meta charset="utf-8">
 <title>{_html.escape(title)}</title>
@@ -712,6 +715,57 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 parts.append(f"<tr><td>{_html.escape(str(w))}</td>"
                              f"<td>{100.0 * util[w]:.1f}%</td></tr>")
             parts.append("</table>")
+
+    # -- integrity: stalls, scrub cycles, quarantined rot ----------------
+    if integrity or stall_events:
+        parts.append("<h2>Integrity</h2>")
+    if stall_events:
+        parts.append(
+            f"<h3>Stalls ({len(stall_events)})</h3><table>"
+            f"<tr><th>boundary</th><th>blocked (s)</th>"
+            f"<th>deadline (s)</th><th>threads dumped</th></tr>")
+        for r in stall_events[-20:]:
+            parts.append(
+                f"<tr><td>{_html.escape(str(r.get('boundary', '?')))}"
+                f"</td><td>{r.get('waited_s', 0.0):.3f}</td>"
+                f"<td>{r.get('deadline_s', 0.0):.3f}</td>"
+                f"<td>{r.get('threads', '—')}</td></tr>")
+        parts.append("</table><p>adaptive-deadline expiries "
+                     "(integrity/watchdog.py — forensics in the "
+                     "integrity records / GET /stacks)</p>")
+    if integrity:
+        scrubs = [r for r in integrity if r.get("event") == "scrub"]
+        rot = [r for r in integrity
+               if r.get("event") in ("checkpoint_quarantined",
+                                     "checkpoint_rotten")]
+        if scrubs:
+            tot_dirs = sum(r.get("scanned", 0) for r in scrubs)
+            tot_bytes = sum(r.get("bytes", 0) for r in scrubs)
+            tot_rot = sum(r.get("rotten", 0) for r in scrubs)
+            parts.append(
+                f"<p>checkpoint scrubber: {len(scrubs)} cycle(s), "
+                f"{tot_dirs} step dir(s) re-hashed "
+                f"({tot_bytes / 2**20:.1f} MiB), {tot_rot} rotten "
+                f"(checkpoint/scrub.py)</p>")
+        if rot:
+            parts.append(
+                "<table><tr><th>rotten step</th><th>problems</th>"
+                "<th>quarantined to</th></tr>")
+            for r in rot[-20:]:
+                probs = "; ".join(str(p) for p in
+                                  (r.get("problems") or [])[:3])
+                dest = str(r.get("quarantined_to") or "—")
+                parts.append(
+                    f"<tr><td>{r.get('step', '?')}</td>"
+                    f"<td>{_html.escape(probs)}</td>"
+                    f"<td>{_html.escape(dest)}</td></tr>")
+            parts.append("</table>")
+        probes = [r for r in integrity
+                  if r.get("event") == "stall_forensics"]
+        if probes:
+            parts.append(f"<p>{len(probes)} stall forensics record(s) "
+                         f"captured (all-thread stacks + HBM snapshot "
+                         f"+ active plan)</p>")
 
     # -- serving: traffic + the resilience rail --------------------------
     if serving:
