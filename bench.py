@@ -502,6 +502,79 @@ def bench_serving_resilience_overhead(n_requests=768, concurrency=8,
             "n_requests": n_requests, "concurrency": concurrency}
 
 
+def bench_generative(n_requests=32, max_slots=8, max_seq_len=160,
+                     prompt_len=(2, 16), new_tokens=None,
+                     concurrency=32, seed=11):
+    """Continuous-batching generative serving (serving/generative.py,
+    ROADMAP item 1, BENCH_r10): a seeded mixed prompt/output-length
+    trace driven through a GPT decode server twice — ``admit=
+    "continuous"`` (step-boundary admission into free KV slots) vs
+    ``admit="static"`` (the wait-for-full-batch baseline, a new wave
+    only when every slot is free). Same trace, same compiled programs;
+    the acceptance bar is continuous ≥ 2x static tokens/sec on mixed
+    lengths. Reports tokens/sec/chip, p50/p99 TTFT, p50 inter-token
+    latency and slot occupancy, all from the shared
+    ``GenerativeLoadGenerator`` driver."""
+    from deeplearning4j_tpu.serving.generative import GenerativeServer
+    from deeplearning4j_tpu.serving.loadgen import GenerativeLoadGenerator
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_generative_spec)
+
+    # big enough that decode compute (not host scheduling) dominates
+    # the CPU smoke wall clock; on-chip the step ratio is the binding
+    # quantity and it runs 2.5-3x (decode_steps in the sub-dicts)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=8, intermediate_size=512,
+                    max_seq_len=max_seq_len)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    spec = gpt_generative_spec(sd, cfg)
+    if new_tokens is None:
+        # long-tailed output lengths (the distribution continuous
+        # batching exists for): mostly short answers, a 20% tail of
+        # long generations that would hold a static batch hostage
+        def new_tokens(rng):
+            return int(rng.integers(2, 9)) if rng.random() < 0.8 \
+                else int(rng.integers(80, 129))
+    out = {}
+    for mode in ("continuous", "static"):
+        srv = GenerativeServer(spec, max_slots=max_slots,
+                               max_seq_len=max_seq_len, admit=mode,
+                               warmup=True)
+        try:
+            lg = GenerativeLoadGenerator(srv, seed=seed,
+                                         prompt_len=prompt_len,
+                                         new_tokens=new_tokens)
+            res = lg.run_closed(n_requests=n_requests,
+                                concurrency=concurrency)
+        finally:
+            srv.shutdown()
+        rec = srv.metrics.to_record()
+        out[mode] = {
+            "tokens_per_sec": round(res.tokens_per_sec, 1),
+            "ttft_p50_ms": round(res.ttft_percentile(50), 3),
+            "ttft_p99_ms": round(res.ttft_percentile(99), 3),
+            "intertoken_p50_ms": round(res.intertoken_percentile(50), 3),
+            "slot_occupancy": rec["generative"]["slot_occupancy"],
+            "decode_steps": rec["generative"]["decode_steps"],
+            "n_ok": res.n_ok,
+            "compiles": rec["counters"]["compiles"],
+            "warmup_compiles": rec["counters"]["warmup_compiles"]}
+    cont, stat = out["continuous"], out["static"]
+    speedup = cont["tokens_per_sec"] / stat["tokens_per_sec"] \
+        if stat["tokens_per_sec"] else 0.0
+    return {"samples_per_sec": cont["tokens_per_sec"],   # tokens/sec/chip
+            "tokens_per_sec": cont["tokens_per_sec"],
+            "ttft_p50_ms": cont["ttft_p50_ms"],
+            "ttft_p99_ms": cont["ttft_p99_ms"],
+            "intertoken_p50_ms": cont["intertoken_p50_ms"],
+            "slot_occupancy": cont["slot_occupancy"],
+            "static_tokens_per_sec": stat["tokens_per_sec"],
+            "static_slot_occupancy": stat["slot_occupancy"],
+            "continuous_vs_static_speedup": round(speedup, 2),
+            "max_slots": max_slots, "n_requests": n_requests,
+            "continuous": cont, "static": stat}
+
+
 def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
                       worker_counts=(1, 2, 4)):
     """Disk-backed streaming training vs the device-cached window bench
@@ -900,6 +973,12 @@ def main():
                      # bar) for BENCH_r08
                      ("serving_resilience_overhead",
                       bench_serving_resilience_overhead),
+                     # continuous-batching generative serving vs the
+                     # static wait-for-full-batch baseline on one
+                     # seeded mixed-length trace (tokens/sec/chip,
+                     # p50/p99 TTFT, inter-token p50, slot occupancy —
+                     # serving/generative.py) for BENCH_r10
+                     ("generative", bench_generative),
                      # the integrity rail's cost (state fingerprints +
                      # stall-watchdog guards on the fused K=8 listener
                      # path, ≤2% bar) for BENCH_r10

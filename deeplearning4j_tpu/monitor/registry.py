@@ -297,6 +297,21 @@ class MetricsRegistry:
         # 503 signal on a dashboard) + last hot-reload provenance; the
         # shed/requeue/restart/quarantine/reload counters already
         # export through the generic serving_<counter>_total loop above
+        # generative tier (serving/generative.py): occupancy + token
+        # throughput gauges; the token/prefill/step counters already
+        # export through the generic serving_<counter>_total loop
+        gen = rec.get("generative") or {}
+        if gen:
+            self.set_gauge("serving_slot_occupancy_ratio",
+                           gen.get("slot_occupancy", 0.0),
+                           help="mean active slots / max_slots per "
+                                "decode step")
+            self.set_gauge("serving_tokens_per_sec",
+                           gen.get("tokens_per_sec", 0.0),
+                           help="lifetime generated-token rate")
+            self.set_gauge("serving_max_slots",
+                           gen.get("max_slots", 0),
+                           help="KV cache slots")
         res = rec.get("resilience") or {}
         state = res.get("breaker_state")
         if state is not None:

@@ -23,12 +23,23 @@ layer (the L7 serving tier of the reference ecosystem's map, PAPER.md
   workers with exactly-once crash requeue, bisecting poisoned-batch
   isolation (``PoisonedRequestError``), and checkpoint-driven hot
   reload (``ParallelInference.reload_from`` with canary + rollback).
-- ``loadgen``: closed/open-loop load generator for tests and examples.
+- ``generative``: continuous-batching autoregressive serving
+  (:class:`GenerativeServer`) — slotted KV cache slabs in HBM,
+  step-boundary admission into free slots, ONE compiled decode step
+  advancing every active slot, pow2 prefill buckets, streaming token
+  delivery, SLO admission on p99 decode-step time, and supervised
+  crash recovery (requeue at prefill, exactly once).
+- ``loadgen``: closed/open-loop load generator for tests and examples,
+  plus a generative traffic mode (mixed prompt/output lengths, TTFT +
+  inter-token percentiles).
 
 See docs/serving.md for the full knob reference.
 """
 from deeplearning4j_tpu.serving.batching import (
     Batch, BucketSpec, DynamicBatcher, pad_to_bucket, pow2_buckets)
+from deeplearning4j_tpu.serving.generative import (
+    GenerationCancelled, GenerationHandle, GenerativeMetrics,
+    GenerativeServer, GenerativeSpec, SlotAllocator, greedy_decode)
 from deeplearning4j_tpu.serving.inference import (
     InferenceMode, ParallelInference, ServingSpec)
 from deeplearning4j_tpu.serving.loadgen import LoadGenerator, LoadResult
@@ -52,4 +63,7 @@ __all__ = [
     "ResilienceConfig", "AdmissionController", "CircuitBreaker",
     "WorkerSupervisor", "PoisonedRequestError", "ReloadFailedError",
     "LoadGenerator", "LoadResult",
+    "GenerativeServer", "GenerativeSpec", "GenerativeMetrics",
+    "GenerationHandle", "GenerationCancelled", "SlotAllocator",
+    "greedy_decode",
 ]

@@ -1,0 +1,1076 @@
+"""Continuous-batching generative serving: slotted KV caches,
+step-boundary admission, streaming decode.
+
+The decoder-LM serving tier (ROADMAP item 1): ``ParallelInference``
+batches fixed-shape forwards, but an autoregressive request is a LOOP —
+one token per model invocation, sequence lengths unknown in advance. A
+static batcher ("wait for a full batch, run it to completion") lets one
+long generation hold every co-batched short request hostage and leaves
+finished slots idle; the mechanism proven by Orca's iteration-level
+scheduling (Yu et al., OSDI '22) and vLLM's slot-based KV memory (Kwon
+et al., SOSP '23) is to keep the decode batch full by admitting new
+requests **at step boundaries** into preallocated KV slots:
+
+- **KV slabs** — two HBM arrays (K and V), shaped
+  ``[layers, max_slots, heads, max_seq, head_dim]``, allocated ONCE at
+  construction (headroom-guarded via ``monitor/memstats``) and donated
+  through every dispatch so the cache is updated in place — no
+  per-request allocation, no fragmentation.
+- **ONE decode program** — a single jitted step advances *all* active
+  slots per dispatch (active-slot mask + per-slot position indices);
+  its shapes never change, so the decode path compiles exactly once.
+- **pow2 prefill buckets** — a new request's prompt runs through a
+  bucket-padded prefill program that fills its slot's KV rows and emits
+  the first token (TTFT = queue wait + one prefill); the bucket ladder
+  reuses ``serving/batching.py``'s machinery, so mixed prompt lengths
+  cost ≤ log2(max_seq) compiled shapes.
+- **continuous batching** — the scheduler admits queued requests into
+  free slots at every step boundary, streams each token to its
+  request's iterator/callback as it resolves, and retires finished
+  slots (EOS / ``max_new_tokens`` / deadline / cancel / sequence
+  capacity) immediately, so the next queued request starts on the very
+  next step.
+- **SLO admission** — a rolling p99 of decode-step time
+  (``serving/resilience.AdmissionController``) turns queue depth into a
+  TTFT estimate; a deadline-carrying request that cannot make it is
+  shed typed (``ServerOverloadedError(retry_after_s=...)``) before it
+  occupies a slot.
+- **crash recovery** — the decode worker runs under the PR-9
+  ``WorkerSupervisor``: a crashed worker's in-flight generations are
+  requeued at the FRONT exactly once and re-enter at prefill with
+  ``prompt + tokens-generated-so-far`` (greedy decode is deterministic,
+  so the continuation matches; already-streamed tokens are not
+  re-streamed), and the respawned worker starts from fresh slabs.
+
+Correctness contract (tests/test_generative.py): greedy tokens are
+identical to :func:`greedy_decode` (the unbatched single-request
+reference) for every request in a mixed-length run; a retired slot's
+cache — even poisoned with NaNs — can never influence its successor
+(masked positions have their V rows zeroed *under the mask*, see
+``zoo/gpt.py gpt_decode_fns``), so slot reuse is bit-exact vs a fresh
+server. See docs/serving.md "Generative serving".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from queue import SimpleQueue
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.compilecache.aot import AOTDispatch, ph_shape_sig
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
+from deeplearning4j_tpu.serving.batching import BucketSpec, pow2_buckets
+from deeplearning4j_tpu.serving.metrics import (LatencyHistogram,
+                                                ServingMetrics)
+from deeplearning4j_tpu.serving.queue import (
+    InferenceRequest, RequestQueue, ServerClosedError, ServerOverloadedError,
+    ServingError, ServingTimeoutError)
+from deeplearning4j_tpu.serving.resilience import (AdmissionController,
+                                                   InflightSlot,
+                                                   ResilienceConfig,
+                                                   WorkerSupervisor)
+
+
+class GenerationCancelled(ServingError):
+    """The request was cancelled by its client; ``tokens`` holds what
+    was generated before the cancel took effect at a step boundary."""
+
+    def __init__(self, message: str, tokens: Optional[List[int]] = None):
+        super().__init__(message)
+        self.tokens = list(tokens or [])
+
+
+@dataclass
+class GenerativeSpec:
+    """A model's generative-serving contract — the decode-mode analogue
+    of :class:`~deeplearning4j_tpu.serving.inference.ServingSpec`
+    (produced by e.g. ``zoo.gpt.gpt_generative_spec``).
+
+    - ``params()`` pulls the current trained parameter arrays (by-name
+      sync from the training graph; ``GenerativeServer.update_model()``
+      re-pulls).
+    - ``prefill(params, kc, vc, io)`` with ``io = {"tokens": [L] int32,
+      "length": (), "slot": ()}`` fills slot ``io["slot"]``'s KV rows
+      from a bucket-padded prompt and returns
+      ``(kc, vc, next_token, last_logits)``.
+    - ``decode(params, kc, vc, io)`` with ``io = {"tokens": [S],
+      "positions": [S], "active": [S] bool}`` advances every active
+      slot one token and returns ``(kc, vc, next_tokens, logits)``.
+    - ``kv_shape(max_slots, max_seq)`` is the shape of ONE slab (K and
+      V are two arrays of this shape).
+
+    Both functions must be pure and shape-static so the server can jit
+    them with donated slabs and AOT-precompile every shape it will ever
+    dispatch (docs/cold_start.md).
+    """
+
+    params: Callable[[], Dict[str, object]]
+    prefill: Callable
+    decode: Callable
+    kv_shape: Callable[[int, int], tuple]
+    vocab_size: int
+    max_seq_len: int
+    kv_dtype: str = "float32"
+    eos_id: Optional[int] = None
+
+
+class SlotAllocator:
+    """Free-list allocator over ``n`` KV slots. ``free()`` of a slot
+    that is not currently allocated raises — the slot-lifecycle
+    invariant ("freed exactly once") is enforced here, not hoped for."""
+
+    def __init__(self, n: int):
+        if n <= 0:
+            raise ValueError("need at least one slot")
+        self.n = int(n)
+        self._free = list(range(self.n - 1, -1, -1))   # pop() -> slot 0 first
+        self._inuse: set = set()
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("no free slots")
+        s = self._free.pop()
+        self._inuse.add(s)
+        return s
+
+    def free(self, s: int) -> None:
+        if s not in self._inuse:
+            raise RuntimeError(f"slot {s} freed twice (or never allocated)")
+        self._inuse.discard(s)
+        self._free.append(s)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> set:
+        return set(self._inuse)
+
+    def reset(self) -> None:
+        self._free = list(range(self.n - 1, -1, -1))
+        self._inuse.clear()
+
+
+_STREAM_DONE = object()
+
+
+@dataclass
+class GenerationRequest(InferenceRequest):
+    """One queued generation: prompt + budget + the per-token stream.
+    Rides the existing :class:`RequestQueue` (deadlines expire queued
+    requests, ``requeue`` puts crash-recovered ones back at the front)
+    and the :class:`WorkerSupervisor`'s exactly-once requeue contract
+    (``requeues``)."""
+
+    prompt: np.ndarray = None
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    on_token: Optional[Callable[[int], None]] = None
+    generated: List[int] = field(default_factory=list)
+    cancelled: bool = False
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+    _stream: SimpleQueue = field(default_factory=SimpleQueue)
+
+    def prefix(self) -> np.ndarray:
+        """Prompt + tokens generated so far — what a crash-requeued
+        request re-prefills with (greedy decode is deterministic, so
+        the continuation is the one the dead worker would have
+        produced; already-streamed tokens are not re-emitted)."""
+        if not self.generated:
+            return np.asarray(self.prompt, np.int32)
+        return np.concatenate([np.asarray(self.prompt, np.int32),
+                               np.asarray(self.generated, np.int32)])
+
+    # stream closure rides every resolution path (success, failure,
+    # queued-deadline expiry) so a consumer iterating tokens() can
+    # never hang on a finished request
+    def close_stream(self, error: Optional[BaseException] = None) -> None:
+        self._stream.put((_STREAM_DONE, error))
+
+    def emit(self, token: int) -> None:
+        self.generated.append(int(token))
+        self._stream.put((int(token), None))
+
+    def succeed(self) -> None:
+        if not self.future.done():
+            self.future.set_result(list(self.generated))
+        self.close_stream()
+
+    def fail(self, exc: BaseException) -> None:
+        super().fail(exc)
+        self.close_stream(exc)
+
+    def time_out(self) -> None:
+        super().time_out()
+        self.close_stream(self.future.exception()
+                          if self.future.done() else None)
+
+
+class GenerationHandle:
+    """Client view of one generation: a Future of the full token list
+    plus a streaming iterator of tokens as they resolve."""
+
+    def __init__(self, req: GenerationRequest):
+        self._req = req
+        self.future = req.future
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return self.future.result(timeout)
+
+    def partial(self) -> List[int]:
+        """Tokens generated so far (snapshot)."""
+        return list(self._req.generated)
+
+    def cancel(self) -> None:
+        """Request cancellation; takes effect at the next step boundary
+        (the slot is freed, the future resolves to the partial token
+        list, the stream closes cleanly)."""
+        self._req.cancelled = True
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Iterate tokens as they are generated. Raises the request's
+        failure (deadline, crash, ...) at the point the stream closed
+        on it; a clean finish (EOS/max_new_tokens/cancel) just ends
+        the iteration. ``timeout`` bounds the wait for EACH token: a
+        gap longer than that raises the builtin :class:`TimeoutError`
+        (the generation itself is unaffected — iterating again resumes
+        from the next undelivered token)."""
+        from queue import Empty
+        while True:
+            try:
+                token, err = self._req._stream.get(timeout=timeout)
+            except Empty:
+                raise TimeoutError(
+                    f"no token from generation {self._req.id} within "
+                    f"{timeout}s (the request is still in flight; "
+                    f"re-iterate to resume the stream)") from None
+            if token is _STREAM_DONE:
+                if err is not None and \
+                        not isinstance(err, GenerationCancelled):
+                    raise err
+                return
+            yield token
+
+    def __iter__(self):
+        return self.tokens()
+
+
+class GenerativeMetrics(ServingMetrics):
+    """ServingMetrics plus the generative lanes: TTFT (submit → first
+    streamed token), inter-token latency, prefill time, token/step
+    counters and slot occupancy. The extra counters/lanes export
+    through the existing generic folds (``fold_serving`` →
+    ``dl4j_serving_*``) without new record types."""
+
+    def __init__(self, max_slots: int = 0):
+        super().__init__()
+        self.max_slots = int(max_slots)
+        self.ttft_ms = LatencyHistogram()
+        self.intertoken_ms = LatencyHistogram()
+        self.prefill_ms = LatencyHistogram()
+        for c in ("tokens_generated", "prefills", "decode_steps",
+                  "slots_active_sum", "requests_cancelled"):
+            self.counters[c] = 0
+
+    def observe_ttft(self, ms: float) -> None:
+        with self._lock:
+            self.ttft_ms.record(ms)
+
+    def observe_intertoken(self, ms: float) -> None:
+        with self._lock:
+            self.intertoken_ms.record(ms)
+
+    def observe_prefill(self, ms: float) -> None:
+        with self._lock:
+            self.counters["prefills"] += 1
+            self.prefill_ms.record(ms)
+
+    def observe_decode_step(self, active: int, ms: float) -> None:
+        with self._lock:
+            self.counters["decode_steps"] += 1
+            self.counters["slots_active_sum"] += int(active)
+            self.counters["batches_dispatched"] += 1
+            self.counters["rows_served"] += int(active)
+            self.counters["rows_padded"] += max(0, self.max_slots
+                                                - int(active))
+            self.batch_sizes[int(active)] = \
+                self.batch_sizes.get(int(active), 0) + 1
+            self.exec_ms.record(ms)
+
+    def to_record(self) -> dict:
+        rec = super().to_record()
+        with self._lock:
+            rec["latency_ms"]["ttft"] = self.ttft_ms.summary()
+            rec["latency_ms"]["intertoken"] = self.intertoken_ms.summary()
+            rec["latency_ms"]["prefill"] = self.prefill_ms.summary()
+            steps = self.counters["decode_steps"]
+            occ = (self.counters["slots_active_sum"]
+                   / (steps * self.max_slots)) \
+                if steps and self.max_slots else 0.0
+            uptime = max(time.time() - self._start_t, 1e-9)
+            rec["generative"] = {
+                "max_slots": self.max_slots,
+                "tokens_generated": self.counters["tokens_generated"],
+                "prefills": self.counters["prefills"],
+                "decode_steps": steps,
+                "slot_occupancy": round(occ, 4),
+                "tokens_per_sec": round(
+                    self.counters["tokens_generated"] / uptime, 3)}
+        return rec
+
+    def stats(self) -> str:
+        rec = self.to_record()
+        g = rec["generative"]
+        lines = [super().stats(),
+                 f"  generative: {g['tokens_generated']} tokens "
+                 f"({g['tokens_per_sec']} tok/s lifetime), "
+                 f"{g['prefills']} prefills, {g['decode_steps']} decode "
+                 f"steps, slot occupancy {g['slot_occupancy']:.1%} of "
+                 f"{g['max_slots']} slots"]
+        for name in ("ttft", "intertoken", "prefill"):
+            s = rec["latency_ms"][name]
+            lines.append(f"  {name:<10} p50 {s['p50']:.3f} ms  "
+                         f"p95 {s['p95']:.3f} ms  p99 {s['p99']:.3f} ms  "
+                         f"max {s['max']:.3f} ms  (n={s['count']})")
+        return "\n".join(lines)
+
+
+def _spec_dispatchers(spec: GenerativeSpec,
+                      kv_shape: tuple) -> Dict[str, AOTDispatch]:
+    """One (decode, prefill) dispatcher pair per (spec, KV slab shape),
+    memoized on the spec object: every consumer of the same model AND
+    slab geometry — servers, restarts, the :func:`greedy_decode`
+    reference — shares one compile set. Keyed by the slab shape, not
+    just the spec: AOT executables are looked up by the io-dict shape
+    signature alone, so two servers differing only in ``max_seq_len``
+    would otherwise collide on the same decode signature and the
+    second would silently fall off the warmed path onto lazy compiles
+    (the aval-mismatch fallback) under live traffic."""
+    cache = getattr(spec, "_disp_cache", None)
+    if cache is None:
+        cache = {}
+        spec._disp_cache = cache
+    key = tuple(int(d) for d in kv_shape)
+    pair = cache.get(key)
+    if pair is None:
+        import jax
+        pair = {
+            "decode": AOTDispatch(
+                jax.jit(spec.decode, donate_argnums=(1, 2)), ph_arg=3),
+            "prefill": AOTDispatch(
+                jax.jit(spec.prefill, donate_argnums=(1, 2)), ph_arg=3)}
+        cache[key] = pair
+    return pair
+
+
+class GenerativeServer:
+    """Continuous-batching autoregressive model server.
+
+    ::
+
+        spec = zoo.gpt.gpt_generative_spec(sd, cfg)
+        srv = GenerativeServer(spec, max_slots=8, max_seq_len=128)
+        handle = srv.submit([1, 2, 3], max_new_tokens=32)
+        for tok in handle.tokens():      # streams as decoded
+            ...
+        tokens = handle.result()         # or the full list
+        srv.shutdown()
+
+    ``admit="continuous"`` (default) fills free slots from the queue at
+    every step boundary; ``admit="static"`` is the wait-for-full-batch
+    baseline (a new wave is admitted only when every slot is free) —
+    kept for the benchmark comparison, not for production.
+
+    ``warmup=True`` AOT-precompiles the decode program and every
+    prefill bucket before the worker starts (compiles stay 0 under
+    traffic; with a persistent compilation cache a warm restart serves
+    with 0 backend compiles — docs/cold_start.md). ``resilience=True``
+    arms SLO admission (p99 decode-step TTFT estimates) and worker
+    supervision (crash requeue at prefill, exactly once).
+    """
+
+    def __init__(self, spec, max_slots: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue_len: int = 256,
+                 default_timeout_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 stats_storage=None,
+                 telemetry_port: Optional[int] = None,
+                 resilience=True,
+                 warmup: bool = True,
+                 admit: str = "continuous",
+                 memory_sample_every: Optional[int] = 64,
+                 start: bool = True):
+        if not isinstance(spec, GenerativeSpec):
+            if hasattr(spec, "generative_spec"):
+                spec = spec.generative_spec()
+            else:
+                raise TypeError(
+                    f"{type(spec).__name__} is not generatively servable: "
+                    f"pass a GenerativeSpec (e.g. from "
+                    f"zoo.gpt.gpt_generative_spec)")
+        if admit not in ("continuous", "static"):
+            raise ValueError(f"admit= must be 'continuous' or 'static', "
+                             f"got {admit!r}")
+        self.spec = spec
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len or spec.max_seq_len)
+        if self.max_seq_len > spec.max_seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"positional capacity {spec.max_seq_len}")
+        self.admit_mode = admit
+        self.eos_id = eos_id if eos_id is not None else spec.eos_id
+        self.default_timeout_ms = default_timeout_ms
+        self.max_queue_len = int(max_queue_len)
+        self.stats_storage = stats_storage
+        self.metrics = GenerativeMetrics(self.max_slots)
+        # pow2 prefill bucket ladder (serving/batching.py machinery):
+        # halving down from max_seq_len to 1 — ≤ log2(max_seq)+1
+        # compiled prefill shapes for ANY prompt-length mix
+        self._buckets = BucketSpec(
+            buckets if buckets is not None
+            else pow2_buckets(self.max_seq_len,
+                              n_buckets=int(self.max_seq_len).bit_length()))
+        if self._buckets.max_rows > self.max_seq_len:
+            raise ValueError(
+                f"largest prefill bucket {self._buckets.max_rows} exceeds "
+                f"max_seq_len {self.max_seq_len}: its KV rows would not "
+                f"fit the slab")
+        # resilience (serving/resilience.py): the generative tier uses
+        # p99 decode-step time for TTFT estimates (ISSUE 15 / Orca-style
+        # step scheduling makes tail steps the binding constraint)
+        if resilience is True:
+            resilience = ResilienceConfig(percentile=99.0)
+        self.resilience = ResilienceConfig.normalize(resilience)
+        self.admission: Optional[AdmissionController] = None
+        if self.resilience is not None and self.resilience.admission:
+            self.admission = AdmissionController(
+                window=self.resilience.window,
+                percentile=self.resilience.percentile,
+                min_samples=self.resilience.min_exec_samples)
+        self._queue = RequestQueue(
+            self.max_queue_len,
+            on_timeout=lambda req: self.metrics.record_timeout("deadline"))
+        self._exec_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._shapes_seen: set = set()
+        self._req_id = 0
+        self._id_lock = threading.Lock()
+        self._closed = False
+        self._dirty = False          # a respawned worker must reset state
+        self._mem_every = (max(1, int(memory_sample_every))
+                           if memory_sample_every else None)
+        # parameters: by-name sync from the training graph, cached as
+        # one dict so every dispatch shares the same device arrays
+        self._params = dict(spec.params())
+        # KV slabs: allocated ONCE, headroom-guarded, donated through
+        # every dispatch (docs/serving.md "Generative serving")
+        shape = tuple(spec.kv_shape(self.max_slots, self.max_seq_len))
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.memory import AllocationsTracker
+        from deeplearning4j_tpu.monitor import memstats
+        from deeplearning4j_tpu.ndarray.dtype import DataType
+        self._kv_dtype = DataType.from_any(spec.kv_dtype).jnp
+        itemsize = jnp.zeros((), self._kv_dtype).dtype.itemsize
+        self.kv_slab_bytes = 2 * int(np.prod(shape)) * itemsize
+        memstats.check_headroom(
+            self.kv_slab_bytes,
+            f"generative KV slabs ({self.max_slots} slots x "
+            f"{self.max_seq_len} positions)")
+        self._kc = jnp.zeros(shape, self._kv_dtype)
+        self._vc = jnp.zeros(shape, self._kv_dtype)
+        AllocationsTracker.get_instance().allocate("kv_slab",
+                                                   self.kv_slab_bytes)
+        # host-side slot state (the worker thread owns mutation)
+        self._slots = SlotAllocator(self.max_slots)
+        self._slot_reqs: List[Optional[GenerationRequest]] = \
+            [None] * self.max_slots
+        self._tokens = np.zeros(self.max_slots, np.int32)
+        self._positions = np.zeros(self.max_slots, np.int32)
+        self._active = np.zeros(self.max_slots, bool)
+        # dispatchers: lazy jit + AOT executables keyed by io shapes;
+        # slabs (args 1, 2) donated so KV updates are in place. Shared
+        # per (spec, slab shape): a second server over the same model
+        # and geometry — a restart, a canary — reuses every compiled
+        # program instead of paying XLA again
+        disp = _spec_dispatchers(spec, shape)
+        self._decode_disp = disp["decode"]
+        self._prefill_disp = disp["prefill"]
+        self.telemetry = None
+        if telemetry_port is not None:
+            from deeplearning4j_tpu.monitor.server import TelemetryServer
+            self.telemetry = TelemetryServer(storage=stats_storage,
+                                             port=telemetry_port)
+            self.telemetry.add_scrape_hook(
+                lambda reg: reg.fold_serving(self.metrics))
+            self.telemetry.add_health_provider("generative",
+                                               self._telemetry_health)
+        self.warmup_report: Optional[dict] = None
+        if warmup:
+            self.warmup()
+        self._workers: List[threading.Thread] = []
+        self._supervisor: Optional[WorkerSupervisor] = None
+        # gate on the CONFIG, not self._supervisor: the supervisor's
+        # constructor spawns the worker before the attribute assignment
+        # completes (the PR-9 construction race)
+        self._supervised = (self.resilience is not None
+                            and self.resilience.supervise)
+        self._cur_slot: Optional[InflightSlot] = None
+        self._started = False
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Start the decode worker (a no-op when already started).
+        ``GenerativeServer(..., start=False)`` + queued submits + a late
+        ``start()`` makes admission order deterministic for tests."""
+        if self._started or self._closed:
+            return
+        self._started = True
+        if self._supervised:
+            self._supervisor = WorkerSupervisor(
+                spawn=self._spawn_worker, n_workers=1, queue=self._queue,
+                metrics=self.metrics,
+                backoff_base_s=self.resilience.worker_backoff_base_s,
+                backoff_max_s=self.resilience.worker_backoff_max_s,
+                publish=self._publish_fault)
+        else:
+            self._workers.append(self._spawn_worker(0, InflightSlot()))
+
+    def _next_id(self) -> int:
+        with self._id_lock:
+            self._req_id += 1
+            return self._req_id
+
+    # -- AOT warmup (compilecache/, docs/cold_start.md) -----------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """AOT-precompile the decode program and every prefill bucket so
+        live traffic never waits on XLA: one decode shape + ≤
+        log2(max_seq)+1 prefill shapes. With a persistent compilation
+        cache configured every entry is a cache hit on a warm restart
+        and warmup is ~free. Returns (and stores as ``warmup_report``)
+        the shape list, wall seconds and the compile/cache-hit deltas."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.compilecache import (COMPILE_STATS,
+                                                     install_compile_watcher)
+        from deeplearning4j_tpu.environment import environment
+        from deeplearning4j_tpu.monitor import memstats
+        environment().apply_compilation_cache()
+        install_compile_watcher()
+        bucket_list = sorted({int(b) for b in buckets}) \
+            if buckets is not None else list(self._buckets.buckets)
+        params_abs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for n, a in self._params.items()}
+        kv_abs = jax.ShapeDtypeStruct(tuple(self._kc.shape),
+                                      self._kc.dtype)
+        S = self.max_slots
+        mark = COMPILE_STATS.mark()
+        t0 = _time.perf_counter()
+
+        def _build(disp, io_abs, label):
+            sig = ph_shape_sig(io_abs)
+            with self._exec_lock:
+                if sig not in disp.aot:
+                    with _tracer.span("compile.precompile", cat="compile",
+                                      target=label):
+                        disp.aot[sig] = disp.lower(
+                            params_abs, kv_abs, kv_abs, io_abs).compile()
+                    memstats.capture_plan(label, sig,
+                                          compiled=disp.aot[sig])
+                # mark INSIDE the lock hold: a live dispatch between
+                # compile and mark must not count a spurious lazy
+                # compile for a just-warmed shape (PR-6 round-6 rule)
+                if sig not in self._shapes_seen:
+                    self._shapes_seen.add(sig)
+                    self.metrics.inc("warmup_compiles")
+
+        _build(self._decode_disp,
+               {"tokens": jax.ShapeDtypeStruct((S,), jnp.int32),
+                "positions": jax.ShapeDtypeStruct((S,), jnp.int32),
+                "active": jax.ShapeDtypeStruct((S,), jnp.bool_)},
+               f"generative_decode_s{S}")
+        for b in bucket_list:
+            _build(self._prefill_disp,
+                   {"tokens": jax.ShapeDtypeStruct((int(b),), jnp.int32),
+                    "length": jax.ShapeDtypeStruct((), jnp.int32),
+                    "slot": jax.ShapeDtypeStruct((), jnp.int32)},
+                   f"generative_prefill_b{int(b)}")
+        self.warmup_report = {
+            "decode_slots": S,
+            "prefill_buckets": bucket_list,
+            "seconds": round(_time.perf_counter() - t0, 4),
+            **{k: v for k, v in COMPILE_STATS.delta(mark).items()
+               if k in ("backend_compiles", "cache_hits",
+                        "cache_misses")}}
+        return self.warmup_report
+
+    # -- client API -----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16,
+               timeout_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               eos_id: Optional[int] = None) -> GenerationHandle:
+        """Enqueue one generation; returns a :class:`GenerationHandle`
+        streaming tokens as they decode. Sheds typed at the call site:
+        :class:`ServerOverloadedError` when the queue is full or the
+        estimated TTFT (queue depth × rolling p99 decode-step time)
+        already exceeds the deadline."""
+        if self._closed:
+            raise ServerClosedError("GenerativeServer is shut down")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size > self.max_seq_len - 1:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"generate within max_seq_len {self.max_seq_len}")
+        if prompt.min() < 0 or prompt.max() >= self.spec.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.spec.vocab_size})")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.metrics.inc("requests_submitted")
+        timeout_ms = timeout_ms if timeout_ms is not None \
+            else self.default_timeout_ms
+        self._admit_check(timeout_ms)
+        deadline = time.monotonic() + timeout_ms / 1000.0 \
+            if timeout_ms is not None else None
+        from concurrent.futures import Future
+        req = GenerationRequest(
+            x=[prompt], future=Future(), rows=1, deadline=deadline,
+            id=self._next_id(), prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id if eos_id is not None else self.eos_id,
+            on_token=on_token)
+        with _tracer.span("serving.enqueue", cat="serving", id=req.id,
+                          prompt=int(prompt.size)):
+            try:
+                self._queue.put(req)
+            except ServerOverloadedError:
+                self.metrics.inc("requests_rejected")
+                raise
+        return GenerationHandle(req)
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 timeout_ms: Optional[float] = None) -> List[int]:
+        """Blocking convenience around :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens,
+                           timeout_ms=timeout_ms).result()
+
+    def _admit_check(self, timeout_ms: Optional[float]) -> None:
+        """SLO admission: TTFT estimate = (queue depth + 1) × rolling
+        p99 decode-step time. A deadline the estimate already exceeds
+        is shed typed, with the estimate as the backoff hint."""
+        if self.admission is None or timeout_ms is None:
+            return
+        est = self.admission.estimate_wait_ms(self._queue.pending() + 1, 1)
+        if est is not None and est > timeout_ms:
+            self.metrics.inc("requests_shed")
+            raise ServerOverloadedError(
+                f"estimated TTFT {est:.1f} ms exceeds the "
+                f"{timeout_ms:.1f} ms deadline — shed at admission "
+                f"(queue depth x p{self.admission.percentile:g} "
+                f"decode-step time)", retry_after_s=round(est / 1000.0, 3))
+
+    def update_model(self) -> None:
+        """Re-pull trained parameters from the spec's source graph
+        between dispatches (the ``ParallelInference.update_model``
+        analogue)."""
+        fresh = dict(self.spec.params())
+        with self._exec_lock:
+            self._params = fresh
+
+    # -- worker ---------------------------------------------------------
+    def _spawn_worker(self, index: int, slot: InflightSlot
+                      ) -> threading.Thread:
+        t = threading.Thread(target=self._worker_main, args=(slot,),
+                             name=f"GenerativeServer-{index}", daemon=True)
+        t.start()
+        return t
+
+    def _worker_main(self, slot: InflightSlot) -> None:
+        self._cur_slot = slot
+        try:
+            if self._dirty:
+                # a respawned worker after a crash: the in-flight
+                # requests were requeued (they re-enter at prefill) and
+                # the donated slabs may be mid-dispatch garbage — start
+                # from fresh slabs + a clean slot table
+                self._reset_state()
+            self._dirty = True
+            self._worker_loop(slot)
+            slot.exited = True
+        except BaseException as e:      # noqa: BLE001 — supervisor's cue
+            slot.crashed = e
+            if not self._supervised:
+                # no supervisor to requeue them: in-flight generations
+                # must not hang their clients forever
+                for r in list(slot.requests or []):
+                    r.fail(e)
+                self.metrics.record_failure(
+                    e, cause="worker_crash",
+                    n=max(1, len(slot.requests or [])))
+
+    def _reset_state(self) -> None:
+        import jax.numpy as jnp
+        shape = tuple(self.spec.kv_shape(self.max_slots, self.max_seq_len))
+        self._kc = jnp.zeros(shape, self._kv_dtype)
+        self._vc = jnp.zeros(shape, self._kv_dtype)
+        self._slots.reset()
+        self._slot_reqs = [None] * self.max_slots
+        self._tokens[:] = 0
+        self._positions[:] = 0
+        self._active[:] = False
+
+    def _worker_loop(self, slot: InflightSlot) -> None:
+        while True:
+            progressed = self._step(slot)
+            if progressed:
+                slot.progressed = True
+            elif self._queue.finished and not self._active.any():
+                return
+
+    def _n_active(self) -> int:
+        return int(self._active.sum())
+
+    def _sync_inflight(self, slot: InflightSlot) -> None:
+        """Keep the supervisor's crash-requeue window exact: every
+        popped-but-unresolved generation, at all times."""
+        reqs = [r for r in self._slot_reqs if r is not None]
+        slot.requests = reqs or None
+
+    def _step(self, slot: InflightSlot) -> bool:
+        progressed = self._admit(slot)
+        if not self._active.any():
+            return progressed
+        self._decode_once(slot)
+        return True
+
+    def _admit(self, slot: InflightSlot) -> bool:
+        """Step-boundary admission: fill free slots from the queue
+        (continuous batching). In ``static`` mode a new wave is only
+        admitted when every slot is free — the wait-for-full-batch
+        baseline the benchmark compares against."""
+        # static (wait-for-full-batch) baseline: a new WAVE is only
+        # admitted once every slot is free — decided once per boundary,
+        # then the whole wave fills (not one request per boundary)
+        if self.admit_mode == "static" and self._n_active() > 0:
+            return False
+        admitted = False
+        while self._slots.free_count() > 0:
+            # block briefly only when idle — an active decode batch
+            # must not stall at the boundary waiting for new work
+            block = not self._active.any() and not admitted
+            reqs = self._queue.take(1, timeout=0.05 if block else 0.0)
+            if not reqs:
+                break
+            req = reqs[0]
+            if req.cancelled:
+                # same accounting as a slot-occupying cancel (_retire):
+                # cancelled, not served
+                req.future.set_result(list(req.generated))
+                req.close_stream()
+                self.metrics.inc("requests_cancelled")
+                continue
+            s = self._slots.alloc()
+            self._slot_reqs[s] = req
+            self._sync_inflight(slot)
+            try:
+                self._prefill(s, req)
+                admitted = True
+            except Exception as e:      # noqa: BLE001 — per-request fail
+                # already OOM-wrapped by _dispatch; a failing prompt
+                # fails ITS request, not the decode worker
+                self._retire(s, error=e)
+        return admitted
+
+    def _prefill(self, s: int, req: GenerationRequest) -> None:
+        prefix = req.prefix()
+        L = int(prefix.size)
+        if L > self.max_seq_len - 1:
+            # a crash-requeued request whose prefix already fills the
+            # sequence: nothing left to decode — finish with what it has
+            self._retire(s)
+            return
+        bucket = self._buckets.bucket_for(L)
+        padded = np.zeros(bucket, np.int32)
+        padded[:L] = prefix
+        io = {"tokens": padded, "length": np.int32(L), "slot": np.int32(s)}
+        t0 = time.perf_counter()
+        tok = int(self._dispatch(self._prefill_disp, io, "serving.prefill",
+                                 bucket=bucket, slot=s)[2])
+        self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
+        self._positions[s] = L
+        self._tokens[s] = tok
+        self._active[s] = True
+        self._emit(s, req, tok)
+
+    def _decode_once(self, slot: InflightSlot) -> None:
+        n_active = self._n_active()
+        io = {"tokens": self._tokens.copy(),
+              "positions": self._positions.copy(),
+              "active": self._active.copy()}
+        t0 = time.perf_counter()
+        nxt = np.asarray(self._dispatch(self._decode_disp, io,
+                                        "serving.decode",
+                                        active=n_active)[2])
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe_decode_step(n_active, ms)
+        if self.admission is not None:
+            self.admission.observe(ms)
+        self._maybe_memory_record()
+        for s in np.flatnonzero(io["active"]):
+            req = self._slot_reqs[int(s)]
+            if req is None:
+                continue
+            s = int(s)
+            tok = int(nxt[s])
+            self._positions[s] += 1
+            self._tokens[s] = tok
+            self._emit(s, req, tok)
+
+    def _dispatch(self, disp: AOTDispatch, io: dict, span: str, **attrs):
+        """One device dispatch of prefill/decode with the shared
+        plumbing: exec lock, span, stall-watchdog guard, compile
+        accounting, OOM forensics, and slab rebinding (the old slab
+        buffers are donated into the call)."""
+        sig = ph_shape_sig(io)
+        with self._exec_lock, _tracer.span(span, cat="serving", **attrs):
+            first = sig not in self._shapes_seen
+            if first:
+                self._shapes_seen.add(sig)
+                self.metrics.inc("compiles")
+            from deeplearning4j_tpu.integrity.watchdog import \
+                guard as _wd_guard
+            try:
+                with _wd_guard("generative_step", first=first):
+                    kc, vc, nxt, logits = disp(self._params, self._kc,
+                                               self._vc, io)
+            except Exception as e:
+                raise self._wrap_exec_error(e, span) from e
+            self._kc, self._vc = kc, vc
+        return kc, vc, nxt, logits
+
+    def _wrap_exec_error(self, e: BaseException, what: str):
+        from deeplearning4j_tpu.monitor import memstats
+        if memstats.is_resource_exhausted(e):
+            err = memstats.oom_error(e, program=f"generative_{what}")
+            self._publish_fault("oom", program=f"generative_{what}",
+                                error=repr(e))
+            return err
+        return e
+
+    def _maybe_memory_record(self) -> None:
+        if self._mem_every is None or self.stats_storage is None:
+            return
+        if self.metrics.counters["decode_steps"] % self._mem_every != 0:
+            return
+        from deeplearning4j_tpu.monitor import memstats
+        try:
+            self.stats_storage.put(memstats.memory_record(source="serving"))
+        except Exception:
+            pass            # a broken stats sink must not fail requests
+
+    # -- token delivery + retirement ------------------------------------
+    def _emit(self, s: int, req: GenerationRequest, tok: int) -> None:
+        """Deliver one decoded token to its request's stream at the
+        step boundary it resolved, then retire the slot if this token
+        finished the generation (EOS / budget / capacity / deadline /
+        cancel) — a freed slot is admissible on the very next step."""
+        now = time.monotonic()
+        # deadline re-checked at DELIVERY time (the serving tier's
+        # reply-time deadline rule): a generation that outlived its
+        # deadline mid-decode surfaces as a timeout, not a stale stream
+        if req.expired(now):
+            err = ServingTimeoutError(
+                f"generation {req.id} missed its deadline after "
+                f"{len(req.generated)} tokens")
+            err.tokens = list(req.generated)
+            self.metrics.record_timeout("deadline")
+            self._retire(s, error=err, timed_out=True)
+            return
+        if req.cancelled:
+            self._retire(s, cancelled=True)
+            return
+        with _tracer.span("serving.reply", cat="serving", id=req.id):
+            req.emit(tok)
+        self.metrics.inc("tokens_generated")
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self.metrics.observe_ttft((now - req.enqueue_t) * 1000.0)
+        else:
+            self.metrics.observe_intertoken(
+                (now - req.last_token_t) * 1000.0)
+        req.last_token_t = now
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception as e:      # noqa: BLE001 — user callback
+                self._retire(s, error=e)
+                return
+        done = (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)
+                or int(self._positions[s]) + 1 >= self.max_seq_len)
+        if done:
+            self._retire(s)
+
+    def _retire(self, s: int, error: Optional[BaseException] = None,
+                timed_out: bool = False, cancelled: bool = False) -> None:
+        """Free slot ``s`` exactly once and resolve its request."""
+        req = self._slot_reqs[s]
+        self._slot_reqs[s] = None
+        self._active[s] = False
+        self._slots.free(s)
+        if req is not None:
+            now = time.monotonic()
+            if error is not None:
+                req.fail(error)
+                if not timed_out:
+                    self.metrics.record_failure(error)
+            elif cancelled:
+                # resolve the future BEFORE closing the stream: a
+                # consumer that sees the stream end must find the
+                # result already set (no result(timeout=0) race)
+                if not req.future.done():
+                    req.future.set_result(list(req.generated))
+                req.close_stream(GenerationCancelled(
+                    f"generation {req.id} cancelled",
+                    tokens=req.generated))
+                self.metrics.inc("requests_cancelled")
+            else:
+                req.succeed()
+                self.metrics.observe_request(
+                    queue_wait_ms=((req.first_token_t or now)
+                                   - req.enqueue_t) * 1000.0,
+                    e2e_ms=(now - req.enqueue_t) * 1000.0)
+        # keep the supervisor's crash-requeue window exact
+        if self._cur_slot is not None:
+            self._sync_inflight(self._cur_slot)
+
+    # -- observability --------------------------------------------------
+    def memory_report(self) -> dict:
+        """KV slab accounting for /memory + capacity planning."""
+        per_slot = self.kv_slab_bytes // max(1, self.max_slots)
+        return {"kv_slab_bytes": self.kv_slab_bytes,
+                "kv_slab_shape": list(self._kc.shape),
+                "kv_bytes_per_slot": per_slot,
+                "max_slots": self.max_slots,
+                "max_seq_len": self.max_seq_len,
+                "active_slots": self._n_active()}
+
+    def _publish_fault(self, event: str, **fields) -> None:
+        if self.stats_storage is None:
+            return
+        try:
+            self.stats_storage.put({"type": "faults", "event": event,
+                                    "t": time.time(), "origin": "serving",
+                                    **fields})
+        except Exception:
+            pass        # a broken stats sink must not take a worker down
+
+    def _telemetry_health(self) -> dict:
+        depth = self._queue.pending()
+        healthy = not self._closed
+        return {"queue_depth": depth,
+                "queue_capacity": self.max_queue_len,
+                "active_slots": self._n_active(),
+                "max_slots": self.max_slots,
+                "ready": healthy and depth < self.max_queue_len,
+                "healthy": healthy}
+
+    # -- lifecycle ------------------------------------------------------
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop intake; with ``drain`` (default) finish queued and
+        in-flight generations, otherwise fail queued futures
+        immediately (in-flight slots still finish their current
+        generation). Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        # a server that was never start()ed has no worker to drain —
+        # leaving queued futures pending would hang their clients
+        # forever, so they fail typed instead
+        self._queue.close(drain=drain and self._started)
+        if self._supervisor is not None:
+            self._supervisor.stop(timeout=timeout)
+        for t in self._workers:
+            t.join(timeout=timeout)
+        from deeplearning4j_tpu.memory import AllocationsTracker
+        AllocationsTracker.get_instance().release("kv_slab",
+                                                  self.kv_slab_bytes)
+        if self.stats_storage is not None:
+            self.metrics.publish(self.stats_storage)
+        if self.telemetry is not None:
+            self.telemetry.close()
+
+    def __enter__(self) -> "GenerativeServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.shutdown(drain=exc_type is None)
+
+
+def greedy_decode(spec: GenerativeSpec, prompt, max_new_tokens: int = 16,
+                  eos_id: Optional[int] = None,
+                  max_seq_len: Optional[int] = None,
+                  buckets: Optional[Sequence[int]] = None) -> List[int]:
+    """Unbatched single-request greedy decode — the REFERENCE the
+    continuous-batching server is pinned against: fresh one-slot slabs,
+    the same pow2 prefill bucketing (bucket choice is a deterministic
+    function of the prompt length, so both paths run the same prefill
+    program), then one decode step per token. Greedy tokens from the
+    server match this for every request in a mixed run
+    (tests/test_generative.py)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    msl = int(max_seq_len or spec.max_seq_len)
+    bspec = BucketSpec(buckets if buckets is not None
+                       else pow2_buckets(msl, n_buckets=msl.bit_length()))
+    dt = DataType.from_any(spec.kv_dtype).jnp
+    kc = jnp.zeros(spec.kv_shape(1, msl), dt)
+    vc = jnp.zeros(spec.kv_shape(1, msl), dt)
+    params = dict(spec.params())
+    disp = _spec_dispatchers(spec, tuple(spec.kv_shape(1, msl)))
+    prefill_j, decode_j = disp["prefill"], disp["decode"]
+    L = int(prompt.size)
+    if not 1 <= L <= msl - 1:
+        raise ValueError(f"prompt length {L} not in [1, {msl - 1}]")
+    bucket = bspec.bucket_for(L)
+    padded = np.zeros(bucket, np.int32)
+    padded[:L] = prompt
+    kc, vc, nxt, _ = prefill_j(params, kc, vc,
+                               {"tokens": padded, "length": np.int32(L),
+                                "slot": np.int32(0)})
+    out = [int(nxt)]
+    pos = L
+    while (len(out) < int(max_new_tokens)
+           and not (eos_id is not None and out[-1] == eos_id)
+           and pos + 1 < msl):
+        io = {"tokens": np.asarray([out[-1]], np.int32),
+              "positions": np.asarray([pos], np.int32),
+              "active": np.asarray([True])}
+        kc, vc, nxt, _ = decode_j(params, kc, vc, io)
+        pos += 1
+        out.append(int(np.asarray(nxt)[0]))
+    return out
+
+
+__all__ = ["GenerativeSpec", "GenerativeServer", "GenerativeMetrics",
+           "GenerationHandle", "GenerationRequest", "GenerationCancelled",
+           "SlotAllocator", "greedy_decode"]

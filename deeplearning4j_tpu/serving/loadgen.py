@@ -10,6 +10,15 @@ standard serving-benchmark pair:
   completion — the arrival process a real fleet produces; exposes
   queueing collapse (rejections/timeouts) that closed loops hide.
 
+:class:`GenerativeLoadGenerator` is the autoregressive twin over a
+``serving.generative.GenerativeServer``: mixed prompt/output lengths
+sampled from a **seeded per-request distribution** (request ``i`` is
+identical across runs and concurrency settings, so continuous- and
+static-batching servers can be compared on the SAME trace), optional
+per-request deadlines, and TTFT + inter-token percentiles on
+:class:`LoadResult` — one driver shared by the acceptance tests
+(tests/test_generative.py) and ``bench.py generative``.
+
 Used by tests/test_serving.py and examples/serving_mnist.py.
 """
 from __future__ import annotations
@@ -35,6 +44,11 @@ class LoadResult:
     n_failed: int = 0               # anything else
     duration_s: float = 0.0
     latencies_ms: List[float] = field(default_factory=list)
+    # generative traffic (GenerativeLoadGenerator): per-request time to
+    # first streamed token, per-gap inter-token latencies, token total
+    ttft_ms: List[float] = field(default_factory=list)
+    intertoken_ms: List[float] = field(default_factory=list)
+    tokens_total: int = 0
 
     @property
     def n_issued(self) -> int:
@@ -44,19 +58,41 @@ class LoadResult:
     def throughput_rps(self) -> float:
         return self.n_ok / self.duration_s if self.duration_s > 0 else 0.0
 
-    def percentile(self, p: float) -> float:
-        if not self.latencies_ms:
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens_total / self.duration_s \
+            if self.duration_s > 0 else 0.0
+
+    @staticmethod
+    def _pct(values: List[float], p: float) -> float:
+        if not values:
             return 0.0
-        return float(np.percentile(np.asarray(self.latencies_ms), p))
+        return float(np.percentile(np.asarray(values), p))
+
+    def percentile(self, p: float) -> float:
+        return self._pct(self.latencies_ms, p)
+
+    def ttft_percentile(self, p: float) -> float:
+        return self._pct(self.ttft_ms, p)
+
+    def intertoken_percentile(self, p: float) -> float:
+        return self._pct(self.intertoken_ms, p)
 
     def stats(self) -> str:
-        return (f"LoadResult: {self.n_ok}/{self.n_issued} ok "
-                f"({self.n_rejected} rejected, {self.n_timed_out} timed "
-                f"out, {self.n_failed} failed) in {self.duration_s:.2f}s "
-                f"-> {self.throughput_rps:.1f} req/s; latency p50 "
-                f"{self.percentile(50):.2f} ms, p95 "
-                f"{self.percentile(95):.2f} ms, p99 "
-                f"{self.percentile(99):.2f} ms")
+        s = (f"LoadResult: {self.n_ok}/{self.n_issued} ok "
+             f"({self.n_rejected} rejected, {self.n_timed_out} timed "
+             f"out, {self.n_failed} failed) in {self.duration_s:.2f}s "
+             f"-> {self.throughput_rps:.1f} req/s; latency p50 "
+             f"{self.percentile(50):.2f} ms, p95 "
+             f"{self.percentile(95):.2f} ms, p99 "
+             f"{self.percentile(99):.2f} ms")
+        if self.tokens_total:
+            s += (f"; {self.tokens_total} tokens -> "
+                  f"{self.tokens_per_sec:.1f} tok/s; TTFT p50 "
+                  f"{self.ttft_percentile(50):.2f} ms, p99 "
+                  f"{self.ttft_percentile(99):.2f} ms; inter-token p50 "
+                  f"{self.intertoken_percentile(50):.2f} ms")
+        return s
 
 
 class LoadGenerator:
@@ -167,5 +203,170 @@ class LoadGenerator:
                 fut.exception()     # wait for completion; counted above
             except Exception:
                 pass
+        result.duration_s = time.monotonic() - t_start
+        return result
+
+
+class GenerativeLoadGenerator:
+    """Drives a ``serving.generative.GenerativeServer`` with a seeded
+    mixed-length autoregressive trace.
+
+    Request ``i`` is a pure function of ``(seed, i)`` — prompt tokens,
+    prompt length (uniform in ``prompt_len``), output budget (uniform
+    in ``new_tokens``) and optional deadline (uniform in
+    ``deadline_ms``) — regardless of loop mode or concurrency, so two
+    servers (e.g. continuous vs static admission) can be benchmarked on
+    the SAME trace. Per-token timings land on the LoadResult as
+    ``ttft_ms`` / ``intertoken_ms``; ``tokens_total``/``tokens_per_sec``
+    are the generative throughput."""
+
+    def __init__(self, server, seed: int = 0,
+                 prompt_len=(1, 16), new_tokens=(4, 32),
+                 deadline_ms=None, vocab_size: Optional[int] = None):
+        self.server = server
+        self.seed = int(seed)
+        # (lo, hi) = uniform inclusive; a callable(rng) -> int models
+        # the long-tailed output lengths real LLM traffic has (the
+        # distribution continuous batching exists for)
+        self.prompt_len = prompt_len
+        self.new_tokens = new_tokens
+        self.deadline_ms = deadline_ms
+        self.vocab_size = int(vocab_size if vocab_size is not None
+                              else server.spec.vocab_size)
+
+    @staticmethod
+    def _sample_len(spec, rng) -> int:
+        if callable(spec):
+            return max(1, int(spec(rng)))
+        lo, hi = spec
+        return int(rng.integers(int(lo), int(hi) + 1))
+
+    def request(self, i: int):
+        """The i-th trace entry: ``(prompt, max_new_tokens,
+        deadline_ms)`` — deterministic in ``(seed, i)``."""
+        rng = np.random.default_rng((self.seed, int(i)))
+        plen = self._sample_len(self.prompt_len, rng)
+        prompt = rng.integers(0, self.vocab_size, plen).astype(np.int32)
+        n_new = self._sample_len(self.new_tokens, rng)
+        deadline = None
+        if self.deadline_ms is not None:
+            dlo, dhi = (self.deadline_ms
+                        if isinstance(self.deadline_ms, (tuple, list))
+                        else (self.deadline_ms, self.deadline_ms))
+            deadline = float(rng.uniform(dlo, dhi))
+        return prompt, n_new, deadline
+
+    def _consume(self, handle, t0: float, result: LoadResult,
+                 lock: threading.Lock) -> None:
+        """Drain one generation's token stream, recording TTFT and
+        inter-token gaps; classify the outcome like the fixed-shape
+        loops do."""
+        ttft = None
+        gaps: List[float] = []
+        n_tokens = 0
+        last = t0
+        try:
+            for _tok in handle.tokens():
+                now = time.monotonic()
+                if ttft is None:
+                    ttft = (now - t0) * 1000.0
+                else:
+                    gaps.append((now - last) * 1000.0)
+                last = now
+                n_tokens += 1
+            handle.result(timeout=0)   # surfaces a non-stream failure
+        except RequestTimeoutError:
+            with lock:
+                result.n_timed_out += 1
+                result.tokens_total += n_tokens
+                if ttft is not None:
+                    result.ttft_ms.append(ttft)
+                result.intertoken_ms.extend(gaps)
+            return
+        except Exception:
+            with lock:
+                result.n_failed += 1
+                result.tokens_total += n_tokens
+            return
+        with lock:
+            result.n_ok += 1
+            result.tokens_total += n_tokens
+            result.latencies_ms.append((last - t0) * 1000.0)
+            if ttft is not None:
+                result.ttft_ms.append(ttft)
+            result.intertoken_ms.extend(gaps)
+
+    # -- closed loop ----------------------------------------------------
+    def run_closed(self, n_requests: int = 64,
+                   concurrency: int = 4) -> LoadResult:
+        result = LoadResult()
+        lock = threading.Lock()
+        counter = {"next": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = counter["next"]
+                    if i >= n_requests:
+                        return
+                    counter["next"] = i + 1
+                prompt, n_new, deadline = self.request(i)
+                t0 = time.monotonic()
+                try:
+                    handle = self.server.submit(prompt, n_new,
+                                                timeout_ms=deadline)
+                except ServerOverloadedError:
+                    with lock:
+                        result.n_rejected += 1
+                    continue
+                except ServerClosedError:
+                    with lock:
+                        result.n_failed += 1
+                    continue
+                self._consume(handle, t0, result, lock)
+
+        t_start = time.monotonic()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, int(concurrency)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        result.duration_s = time.monotonic() - t_start
+        return result
+
+    # -- open loop ------------------------------------------------------
+    def run_open(self, n_requests: int = 64,
+                 rate_rps: float = 50.0) -> LoadResult:
+        result = LoadResult()
+        lock = threading.Lock()
+        interval = 1.0 / max(rate_rps, 1e-9)
+        consumers: List[threading.Thread] = []
+        t_start = time.monotonic()
+        for i in range(n_requests):
+            target = t_start + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            prompt, n_new, deadline = self.request(i)
+            t0 = time.monotonic()
+            try:
+                handle = self.server.submit(prompt, n_new,
+                                            timeout_ms=deadline)
+            except ServerOverloadedError:
+                with lock:
+                    result.n_rejected += 1
+                continue
+            except ServerClosedError:
+                with lock:
+                    result.n_failed += 1
+                continue
+            t = threading.Thread(target=self._consume,
+                                 args=(handle, t0, result, lock),
+                                 daemon=True)
+            t.start()
+            consumers.append(t)
+        for t in consumers:
+            t.join()
         result.duration_s = time.monotonic() - t_start
         return result

@@ -41,6 +41,12 @@ class LatencyHistogram:
         self.max_ms = 0.0
 
     def record(self, ms: float) -> None:
+        # NaN-free by construction: a non-finite sample (a clock glitch,
+        # a 0-row dispatch timed as 0/0 upstream) records as 0.0 instead
+        # of poisoning total_ms/max_ms and every later mean()
+        ms = float(ms)
+        if not np.isfinite(ms):
+            ms = 0.0
         self.counts[int(np.searchsorted(self.edges, ms, side="left"))] += 1
         self.count += 1
         self.total_ms += ms
@@ -48,7 +54,8 @@ class LatencyHistogram:
 
     def percentile(self, p: float) -> float:
         """p in [0, 100]; returns the upper edge of the bucket holding
-        the p-th sample (a conservative estimate), 0.0 when empty."""
+        the p-th sample (a conservative estimate), 0.0 when empty —
+        never NaN (the guard dashboards divide/alert on)."""
         if self.count == 0:
             return 0.0
         target = max(1, int(np.ceil(p / 100.0 * self.count)))
@@ -64,7 +71,12 @@ class LatencyHistogram:
         return self.total_ms / self.count if self.count else 0.0
 
     def summary(self) -> Dict[str, float]:
+        """Stats dict; ``count`` rides along and ``low_sample`` flags a
+        histogram whose tail percentiles are read from fewer than 32
+        samples (a p99 of 3 requests is the max, not a p99 — consumers
+        should render it with that caveat)."""
         return {"count": int(self.count),
+                "low_sample": bool(self.count < 32),
                 "mean": round(self.mean(), 4),
                 "p50": round(self.percentile(50), 4),
                 "p95": round(self.percentile(95), 4),
@@ -140,6 +152,11 @@ class ServingMetrics:
             self.resilience.update(fields)
 
     def observe_batch(self, rows: int, padding: int, exec_ms: float) -> None:
+        """Negative/zero rows and non-finite exec times record as
+        zeros (``LatencyHistogram.record`` guards the time): an
+        empty/degenerate dispatch must not put NaN into the padding-
+        waste or mean-size divisions downstream."""
+        rows, padding = max(0, int(rows)), max(0, int(padding))
         with self._lock:
             self.counters["batches_dispatched"] += 1
             self.counters["rows_served"] += rows

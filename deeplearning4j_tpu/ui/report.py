@@ -781,15 +781,31 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
             f"{c.get('batches_dispatched', 0)} batches, "
             f"{c.get('compiles', 0)} compiled shapes "
             f"({c.get('warmup_compiles', 0)} prewarmed)</p>")
+        gen = s.get("generative") or {}
+        if gen:
+            parts.append(
+                f"<p>generative: {gen.get('tokens_generated', 0)} tokens "
+                f"({gen.get('tokens_per_sec', 0.0)} tok/s lifetime), "
+                f"{gen.get('prefills', 0)} prefills, "
+                f"{gen.get('decode_steps', 0)} decode steps, slot "
+                f"occupancy {gen.get('slot_occupancy', 0.0):.1%} of "
+                f"{gen.get('max_slots', 0)} slots "
+                f"(docs/serving.md \"Generative serving\")</p>")
         lat = s.get("latency_ms", {})
         if lat:
             parts.append("<table><tr><th>lane</th><th>count</th>"
                          "<th>mean</th><th>p50</th><th>p95</th>"
                          "<th>p99</th><th>max (ms)</th></tr>")
-            for lane in ("queue_wait", "e2e", "exec"):
-                v = lat.get(lane, {})
+            for lane in ("queue_wait", "e2e", "exec", "ttft",
+                         "intertoken", "prefill"):
+                v = lat.get(lane)
+                if v is None:
+                    continue
+                low = " ⚠" if v.get("low_sample") and \
+                    v.get("count") else ""
                 parts.append(
-                    f"<tr><td>{lane}</td><td>{v.get('count', 0)}</td>"
+                    f"<tr><td>{lane}</td><td>{v.get('count', 0)}{low}"
+                    f"</td>"
                     + "".join(f"<td>{v.get(k, 0.0):.3f}</td>"
                               for k in ("mean", "p50", "p95", "p99",
                                         "max"))

@@ -151,3 +151,210 @@ def build_gpt(cfg: GPTConfig, batch: int, seq_len: int, seed: int = 0):
 def _null_ctx():
     import contextlib
     return contextlib.nullcontext()
+
+
+# ----------------------------------------------------------------------
+# decode-mode graph hook (serving/generative.py — continuous batching)
+# ----------------------------------------------------------------------
+def gpt_param_names(cfg: GPTConfig):
+    """The trained-variable names :func:`build_gpt` creates — the
+    contract between the training graph and the decode functions below
+    (the generative spec pulls arrays from the SameDiff by these
+    names, the same by-name convention as ``ServingSpec.sync`` /
+    ``ParallelInference.reload_from``)."""
+    names = ["wte", "wpe", "ln_f/gamma", "ln_f/beta"]
+    for i in range(cfg.num_layers):
+        sc = f"h{i}"
+        for part in ("ln_1/gamma", "ln_1/beta",
+                     "attn/qkv/kernel", "attn/qkv/bias",
+                     "attn/proj/kernel", "attn/proj/bias",
+                     "ln_2/gamma", "ln_2/beta",
+                     "mlp/fc/kernel", "mlp/fc/bias",
+                     "mlp/proj/kernel", "mlp/proj/bias"):
+            names.append(f"{sc}/{part}")
+    if not cfg.tie_embeddings:
+        names.append("lm_head")
+    return names
+
+
+def gpt_decode_fns(cfg: GPTConfig):
+    """Pure-jax ``(prefill_fn, decode_fn)`` mirroring :func:`build_gpt`'s
+    math op-for-op (one-pass layer norm with ``rsqrt``, per-head-block
+    fused qkv layout, f32 attention scores/softmax, tanh-gelu, tied
+    logits) but in DECODE MODE: attention reads/writes preallocated
+    per-slot KV cache slabs instead of recomputing the full sequence.
+
+    KV slab layout (one array each for K and V, shared by every layer so
+    a serving step donates exactly two buffers)::
+
+        [num_layers, max_slots, heads, max_seq, head_dim]
+
+    - ``prefill_fn(params, kc, vc, io)`` with
+      ``io = {"tokens": [L] int32, "length": () int32, "slot": () int32}``
+      runs the full causal forward over one request's (bucket-padded)
+      prompt, writes its K/V rows into cache slot ``io["slot"]`` and
+      returns ``(kc, vc, next_token, last_logits)`` — the greedy first
+      generated token from the last REAL prompt position
+      (``length - 1``; padded rows never influence it, causal mask).
+    - ``decode_fn(params, kc, vc, io)`` with
+      ``io = {"tokens": [S] int32, "positions": [S] int32,
+      "active": [S] bool}`` advances EVERY active slot one token in one
+      dispatch: per-slot KV written in place at that slot's position
+      (inactive slots' caches untouched), attention masked to
+      ``index <= position`` with masked V rows zeroed under the mask —
+      so a retired slot's stale (even poisoned/NaN) cache rows can
+      never leak into its successor, bit-exactly (tested). Returns
+      ``(kc, vc, next_tokens, logits)``.
+
+    Both are shape-static per (bucket, max_slots): the serving tier
+    compiles ONE decode program plus one prefill program per pow2
+    prompt bucket (docs/serving.md "Generative serving").
+    """
+    import jax
+    import jax.numpy as jnp
+
+    H, A, D, L = (cfg.hidden_size, cfg.num_heads, cfg.head_size,
+                  cfg.num_layers)
+    eps = cfg.layer_norm_eps
+    scale = 1.0 / np.sqrt(D)        # matches ops scaled_dot_product_attention
+
+    def _ln(x, g, b):
+        # one-pass moments + rsqrt, exactly ops/nn_ops.py layer_norm's
+        # f32 path (x is f32 here)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        m2 = jnp.mean(x * x, axis=-1, keepdims=True)
+        var = jnp.maximum(m2 - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        return (x - mean) * inv * g + b
+
+    def _mlp(p, sc, x):
+        y = x @ p[f"{sc}/mlp/fc/kernel"] + p[f"{sc}/mlp/fc/bias"]
+        y = jax.nn.gelu(y, approximate=True)    # ops gelu default
+        return y @ p[f"{sc}/mlp/proj/kernel"] + p[f"{sc}/mlp/proj/bias"]
+
+    def _logits(p, x):
+        if cfg.tie_embeddings:
+            return jnp.einsum("sh,vh->sv", x, p["wte"])
+        return x @ p["lm_head"]
+
+    def prefill_fn(params, kc, vc, io):
+        p = params
+        tokens, length, slot = io["tokens"], io["length"], io["slot"]
+        Lb = tokens.shape[0]
+        x = jnp.take(p["wte"], tokens, axis=0) + p["wpe"][:Lb]   # [Lb, H]
+        cm = jnp.tril(jnp.ones((Lb, Lb), bool))
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            # per-head blocks [q_a|k_a|v_a] — build_gpt's fused layout
+            qkv = jnp.transpose(qkv.reshape(Lb, A, 3 * D), (1, 0, 2))
+            q, k, v = jnp.split(qkv, 3, axis=-1)        # [A, Lb, D]
+            scores = jnp.einsum(
+                "aqd,akd->aqk", q, k,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(cm, scores, jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            att = jnp.einsum("aqk,akd->aqd", probs, v)
+            # write this slot's prompt K/V rows (positions 0..Lb-1);
+            # rows past the real length hold padding-token K/V — decode
+            # masks them until its own writes land there. All start
+            # indices int32 (dynamic_update_slice requires one type;
+            # x64 mode would make bare python ints int64)
+            z = jnp.asarray(0, jnp.int32)
+            starts = (jnp.asarray(i, jnp.int32),
+                      jnp.asarray(slot, jnp.int32), z, z, z)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[None, None].astype(kc.dtype), starts)
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[None, None].astype(vc.dtype), starts)
+            att = jnp.transpose(att, (1, 0, 2)).reshape(Lb, H)
+            att = att @ p[f"{sc}/attn/proj/kernel"] \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        h_last = jax.lax.dynamic_slice_in_dim(
+            x, jnp.maximum(length - 1, 0), 1, axis=0)       # [1, H]
+        logits = _logits(p, h_last)[0]                      # [vocab]
+        return kc, vc, jnp.argmax(logits).astype(jnp.int32), logits
+
+    def decode_fn(params, kc, vc, io):
+        p = params
+        tokens, active = io["tokens"], io["active"]
+        S, T = kc.shape[1], kc.shape[3]
+        pos = jnp.clip(io["positions"], 0, T - 1)
+        x = jnp.take(p["wte"], tokens, axis=0) \
+            + jnp.take(p["wpe"], pos, axis=0)               # [S, H]
+        si = jnp.arange(S)[:, None]
+        ai = jnp.arange(A)[None, :]
+        # attend to indices <= position; everything later in the slab
+        # is a future write or a retired occupant's stale rows
+        mask = jnp.arange(T)[None, None, :] <= pos[:, None, None]
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            q, k, v = jnp.split(qkv.reshape(S, A, 3 * D), 3, axis=-1)
+            # in-place per-slot writes at each slot's own position;
+            # inactive slots keep their existing rows (forensics — and
+            # a free slot's cache is fully rewritten by prefill anyway)
+            cur_k = kc[i, si, ai, pos[:, None]]
+            cur_v = vc[i, si, ai, pos[:, None]]
+            kc = kc.at[i, si, ai, pos[:, None]].set(
+                jnp.where(active[:, None, None], k.astype(kc.dtype),
+                          cur_k))
+            vc = vc.at[i, si, ai, pos[:, None]].set(
+                jnp.where(active[:, None, None], v.astype(vc.dtype),
+                          cur_v))
+            scores = jnp.einsum(
+                "sad,satd->sat", q, kc[i],
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask, scores, jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+            # zero masked V rows: a softmax weight of exactly 0 times a
+            # NaN/Inf stale row would still be NaN — the where makes
+            # slot reuse provably independent of retired-cache contents
+            v_safe = jnp.where(mask[..., None], vc[i], 0)
+            att = jnp.einsum("sat,satd->sad", probs, v_safe)
+            att = att.reshape(S, H)
+            att = att @ p[f"{sc}/attn/proj/kernel"] \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        logits = _logits(p, x)                              # [S, vocab]
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            logits
+
+    return prefill_fn, decode_fn
+
+
+def gpt_generative_spec(sd, cfg: GPTConfig):
+    """The decode-mode graph hook: a
+    :class:`~deeplearning4j_tpu.serving.generative.GenerativeSpec` over
+    a trained :func:`build_gpt` graph — what
+    ``serving.generative.GenerativeServer`` consumes. Parameters are
+    pulled from the SameDiff BY NAME at sync time, so further ``fit()``
+    followed by ``server.update_model()`` serves the new weights."""
+    from deeplearning4j_tpu.serving.generative import GenerativeSpec
+
+    names = gpt_param_names(cfg)
+    missing = [n for n in names if n not in sd._arrays]
+    if missing:
+        raise ValueError(
+            f"graph is missing decode parameters {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''} — was it built by "
+            f"zoo.gpt.build_gpt with this config?")
+    prefill_fn, decode_fn = gpt_decode_fns(cfg)
+    return GenerativeSpec(
+        params=lambda: {n: sd._arrays[n] for n in names},
+        prefill=prefill_fn,
+        decode=decode_fn,
+        kv_shape=lambda max_slots, max_seq: (
+            cfg.num_layers, int(max_slots), cfg.num_heads, int(max_seq),
+            cfg.head_size),
+        vocab_size=cfg.vocab_size,
+        max_seq_len=cfg.max_seq_len)

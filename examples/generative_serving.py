@@ -1,0 +1,77 @@
+"""Continuous-batching generative serving of a GPT decoder LM.
+
+What this shows (docs/serving.md "Generative serving"):
+
+1. train a tiny GPT with the normal fit rail, then hand the SAME graph
+   to the generative serving tier via the decode-mode hook
+   (``zoo.gpt.gpt_generative_spec``);
+2. AOT warmup: ONE decode program + pow2 prefill buckets compile before
+   the first request (0 compiles under traffic — with a persistent
+   compilation cache a warm restart serves immediately);
+3. mixed-length concurrent requests admitted into KV slots at decode
+   step boundaries, tokens STREAMED per request as they resolve;
+4. greedy output bit-identical to the unbatched single-request
+   reference (`greedy_decode`);
+5. the serving metrics: TTFT / inter-token latency lanes, slot
+   occupancy, tokens/sec.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import TrainingConfig
+from deeplearning4j_tpu.dataset import DeviceCachedIterator
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.serving.generative import (GenerativeServer,
+                                                   greedy_decode)
+from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                        gpt_generative_spec)
+
+VOCAB, SEQ = 96, 16
+cfg = GPTConfig(vocab_size=VOCAB, hidden_size=48, num_layers=2,
+                num_heads=4, intermediate_size=96, max_seq_len=48)
+
+# -- 1. train briefly on random token sequences -------------------------
+sd = build_gpt(cfg, batch=4, seq_len=SEQ, seed=0)
+sd.training_config = TrainingConfig(
+    updater=Adam(1e-3),
+    data_set_feature_mapping=["input_ids"],
+    data_set_label_mapping=["targets"])
+rng = np.random.default_rng(0)
+ids = rng.integers(0, VOCAB, (8, SEQ)).astype(np.int32)
+tgt = rng.integers(0, VOCAB, (8, SEQ)).astype(np.int32)
+hist = sd.fit(DeviceCachedIterator([ids], [tgt], batch_size=4),
+              epochs=3)
+print(f"trained 3 epochs; final loss "
+      f"{hist.loss_curve.losses[-1]:.4f}")
+
+# -- 2. serve it: decode-mode spec + continuous-batching server ---------
+spec = gpt_generative_spec(sd, cfg)
+server = GenerativeServer(spec, max_slots=4, max_seq_len=48,
+                          warmup=True)
+print(f"warmup: {server.warmup_report['prefill_buckets']} prefill "
+      f"buckets + 1 decode program in "
+      f"{server.warmup_report['seconds']:.2f}s")
+print(f"KV slabs: {server.memory_report()['kv_slab_bytes'] / 1024:.0f} "
+      f"KiB for {server.max_slots} slots x 48 positions")
+
+# -- 3. mixed-length concurrent requests, streamed ----------------------
+prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 12)))
+           .astype(np.int32) for _ in range(6)]
+budgets = [4, 12, 6, 9, 3, 10]
+handles = [server.submit(p, max_new_tokens=n)
+           for p, n in zip(prompts, budgets)]
+streamed = []
+for i, h in enumerate(handles):
+    toks = list(h.tokens(timeout=120))      # arrives token by token
+    streamed.append(toks)
+    print(f"request {i}: prompt len {prompts[i].size:2d} -> "
+          f"{len(toks):2d} tokens: {toks}")
+
+# -- 4. bit-identical to the unbatched reference ------------------------
+for i, (p, n) in enumerate(zip(prompts, budgets)):
+    ref = greedy_decode(spec, p, n, max_seq_len=48)
+    assert streamed[i] == ref, (i, streamed[i], ref)
+print("all 6 continuous-batched generations == unbatched greedy_decode")
+
+# -- 5. the serving metrics ---------------------------------------------
+print(server.metrics.stats())
+server.shutdown()

@@ -16,7 +16,7 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            "early_stopping_holdout.py", "serving_mnist.py",
            "checkpoint_resume.py", "self_healing_fit.py",
            "observability_demo.py", "analyze_model.py",
-           "streaming_fit.py"]
+           "streaming_fit.py", "generative_serving.py"]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)

@@ -392,7 +392,9 @@ def test_latency_histogram_percentiles():
     assert h.percentile(99) <= h.max_ms
     assert h.mean() == pytest.approx(26.5)
     s = h.summary()
-    assert set(s) == {"count", "mean", "p50", "p95", "p99", "max"}
+    assert set(s) == {"count", "low_sample", "mean", "p50", "p95", "p99",
+                      "max"}
+    assert s["low_sample"] is True      # 4 samples: tails are suspect
 
 
 def test_metrics_record_through_stats_storage(tmp_path):
