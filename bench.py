@@ -575,6 +575,154 @@ def bench_generative(n_requests=32, max_slots=8, max_seq_len=160,
             "continuous": cont, "static": stat}
 
 
+def bench_serving_paged(n_requests=32, dense_slots=4, max_seq_len=256,
+                        block_size=16, prompt_len=(2, 16),
+                        concurrency=16, seed=13):
+    """Paged KV vs dense slabs at EQUAL HBM (serving/paged/, ISSUE 16).
+
+    The dense server preallocates ``max_seq`` KV rows per slot, so its
+    concurrent capacity at a fixed HBM budget is ``budget /
+    (max_seq_row_bytes)`` regardless of how short requests actually
+    are. The paged server spends the SAME budget as a block pool and
+    reserves each request's own worst case, so a mixed-length trace
+    (mostly short chats, a 20% long tail) fits several times the
+    concurrent requests — the acceptance bar is >= 4x. Also records
+    the prefix-caching TTFT win (a repeated prompt prefills only its
+    suffix: hit TTFT ~ one decode step, vs the cold full-prompt
+    prefill) and the tp=2 greedy bit-identity bit."""
+    import jax
+
+    from deeplearning4j_tpu.serving.generative import (GenerativeServer,
+                                                       greedy_decode)
+    from deeplearning4j_tpu.serving.loadgen import GenerativeLoadGenerator
+    from deeplearning4j_tpu.serving.paged import (PagedGenerativeServer,
+                                                  blocks_for_tokens)
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_generative_spec,
+                                            gpt_paged_spec)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=8, intermediate_size=512,
+                    max_seq_len=max_seq_len)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    dense_spec = gpt_generative_spec(sd, cfg)
+    paged_spec = gpt_paged_spec(sd, cfg)
+    # the shared budget: what the SMALL dense deployment preallocates
+    dense_bytes = 2 * int(np.prod(
+        dense_spec.kv_shape(dense_slots, max_seq_len))) * 4
+
+    def new_tokens(rng):
+        # mostly short answers, a 20% long tail (same shape as the
+        # continuous-batching bench, scaled into this max_seq)
+        return int(rng.integers(2, 9)) if rng.random() < 0.8 \
+            else int(rng.integers(64, 97))
+
+    # -- concurrent capacity at equal HBM (worst-case commitment) ------
+    rng = np.random.default_rng(seed)
+    trace = [(int(rng.integers(prompt_len[0], prompt_len[1] + 1)),
+              new_tokens(rng)) for _ in range(max(n_requests, 64))]
+    bytes_per_block = 2 * int(np.prod(
+        paged_spec.kv_shape(1, block_size))) * 4
+    pool_capacity = dense_bytes // bytes_per_block - 1   # null block
+    committed = admitted = 0
+    for p, n in trace:
+        need = blocks_for_tokens(min(p + n, max_seq_len), block_size)
+        if committed + need > pool_capacity:
+            break
+        committed += need
+        admitted += 1
+    capacity_ratio = admitted / dense_slots if dense_slots else 0.0
+
+    # -- same trace through both servers at the same HBM budget --------
+    out = {}
+    servers = {
+        "dense": lambda: GenerativeServer(
+            dense_spec, max_slots=dense_slots, max_seq_len=max_seq_len,
+            warmup=True),
+        "paged": lambda: PagedGenerativeServer(
+            paged_spec, max_slots=concurrency, max_seq_len=max_seq_len,
+            block_size=block_size, kv_hbm_bytes=dense_bytes,
+            warmup=True)}
+    for name, build in servers.items():
+        srv = build()
+        try:
+            lg = GenerativeLoadGenerator(srv, seed=seed,
+                                         prompt_len=prompt_len,
+                                         new_tokens=new_tokens)
+            res = lg.run_closed(n_requests=n_requests,
+                                concurrency=concurrency)
+        finally:
+            srv.shutdown()
+        rec = srv.metrics.to_record()
+        out[name] = {
+            "tokens_per_sec": round(res.tokens_per_sec, 1),
+            "ttft_p50_ms": round(res.ttft_percentile(50), 3),
+            "n_ok": res.n_ok, "n_rejected": res.n_rejected,
+            "kv_bytes": srv.kv_slab_bytes,
+            "compiles": rec["counters"]["compiles"]}
+        if name == "paged":
+            out[name]["pool_occupancy"] = rec["paged"]["pool_occupancy"]
+            out[name]["blocks_per_request"] = \
+                rec["paged"]["blocks_per_request"]
+
+    # -- prefix-hit TTFT: repeat prompt prefills only its suffix -------
+    prompt = (np.arange(64, dtype=np.int32) * 5) % cfg.vocab_size
+    srv = PagedGenerativeServer(paged_spec, max_slots=4,
+                                max_seq_len=max_seq_len,
+                                block_size=block_size,
+                                kv_hbm_bytes=dense_bytes, warmup=True)
+    try:
+        def ttft(h):
+            t0 = time.perf_counter()
+            next(iter(h.tokens(timeout=60)))
+            dt = (time.perf_counter() - t0) * 1000.0
+            h.result(timeout=60)
+            return dt
+        ttft_cold = ttft(srv.submit(prompt, max_new_tokens=8))
+        ttft_hit = ttft(srv.submit(prompt, max_new_tokens=8))
+        step_p50 = srv.metrics.exec_ms.summary()["p50"]
+        hit_rate = srv.metrics.to_record()["paged"]["prefix_hit_rate"]
+    finally:
+        srv.shutdown()
+
+    # -- tp=2 greedy bit-identity (the mesh exists on 2+ devices) ------
+    tp_match = None
+    if len(jax.devices()) >= 2:
+        tp_srv = PagedGenerativeServer(paged_spec, max_slots=4,
+                                       max_seq_len=max_seq_len,
+                                       block_size=block_size,
+                                       kv_hbm_bytes=dense_bytes,
+                                       tp=2, warmup=True)
+        try:
+            probes = [(np.arange(L, dtype=np.int32) * 3) % cfg.vocab_size
+                      for L in (3, 17, 40)]
+            got = [tp_srv.submit(p, max_new_tokens=8).result(timeout=120)
+                   for p in probes]
+        finally:
+            tp_srv.shutdown()
+        tp_match = got == [greedy_decode(dense_spec, p, 8,
+                                         max_seq_len=max_seq_len)
+                           for p in probes]
+
+    return {"samples_per_sec": out["paged"]["tokens_per_sec"],
+            "tokens_per_sec": out["paged"]["tokens_per_sec"],
+            "dense_tokens_per_sec": out["dense"]["tokens_per_sec"],
+            "kv_budget_bytes": dense_bytes,
+            "dense_concurrent_capacity": dense_slots,
+            "paged_concurrent_capacity": admitted,
+            "capacity_ratio_equal_hbm": round(capacity_ratio, 2),
+            "pool_blocks": pool_capacity,
+            "block_size": block_size,
+            "ttft_cold_ms": round(ttft_cold, 3),
+            "ttft_prefix_hit_ms": round(ttft_hit, 3),
+            "decode_step_p50_ms": round(step_p50, 3),
+            "ttft_hit_vs_step": round(ttft_hit / step_p50, 2)
+            if step_p50 else None,
+            "prefix_hit_rate": hit_rate,
+            "tp2_greedy_match": tp_match,
+            "n_requests": n_requests,
+            "dense": out["dense"], "paged": out["paged"]}
+
+
 def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
                       worker_counts=(1, 2, 4)):
     """Disk-backed streaming training vs the device-cached window bench
@@ -979,6 +1127,11 @@ def main():
                      # p50/p99 TTFT, inter-token p50, slot occupancy —
                      # serving/generative.py) for BENCH_r10
                      ("generative", bench_generative),
+                     # paged KV vs dense at equal HBM: concurrent
+                     # capacity ratio (≥4x bar), prefix-hit TTFT vs
+                     # decode-step p50, tp=2 greedy bit-identity
+                     # (serving/paged/) for BENCH_r11
+                     ("serving_paged", bench_serving_paged),
                      # the integrity rail's cost (state fingerprints +
                      # stall-watchdog guards on the fused K=8 listener
                      # path, ≤2% bar) for BENCH_r10

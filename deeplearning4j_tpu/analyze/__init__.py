@@ -40,6 +40,7 @@ from deeplearning4j_tpu.analyze.findings import (RULES, SEVERITIES,
                                                  GraphAnalysisWarning,
                                                  Rule, finding)
 from deeplearning4j_tpu.analyze import configpass, graphpass, numerics
+from deeplearning4j_tpu.analyze.servingpass import analyze_generative_config
 
 
 def _graph_size(sd):
@@ -58,6 +59,11 @@ _INFERENCE_RULES = frozenset({
     "numerics.unguarded_div"})
 
 _CONFIG_RULES = frozenset(r for r in RULES if r.startswith("config."))
+
+#: serving-capacity rules (analyze/servingpass.py) run only under
+#: :func:`analyze_generative_config` — never part of a training or
+#: graph-inference report's executed-rule count.
+_SERVING_RULES = frozenset(r for r in RULES if r.startswith("serving."))
 
 
 def analyze_training(sd, tc=None, has_listeners: Optional[bool] = None,
@@ -80,8 +86,8 @@ def analyze_training(sd, tc=None, has_listeners: Optional[bool] = None,
     # executed-rule count, not the catalog size: with no config the 8
     # config rules are skipped, and claiming they ran would read as
     # "config lint passed" on a record where it never executed
-    report.rules_run = len(RULES) - (len(_CONFIG_RULES)
-                                     if tc is None else 0)
+    report.rules_run = (len(RULES) - len(_SERVING_RULES)
+                        - (len(_CONFIG_RULES) if tc is None else 0))
 
     # resolve the analysis outputs the way the train step will
     loss_names: Sequence[str] = ()
@@ -165,4 +171,5 @@ def analyze_model(model, **kw) -> AnalysisReport:
 
 __all__ = ["RULES", "SEVERITIES", "Rule", "Finding", "finding",
            "AnalysisReport", "GraphAnalysisError", "GraphAnalysisWarning",
-           "analyze_training", "analyze_inference", "analyze_model"]
+           "analyze_training", "analyze_inference", "analyze_model",
+           "analyze_generative_config"]

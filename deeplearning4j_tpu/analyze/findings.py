@@ -117,6 +117,12 @@ RULES: Dict[str, Rule] = _catalog(
          "tensorstats is configured but this fit has no listeners — "
          "stats are silently skipped, and attaching listeners later "
          "retraces the step program"),
+    # -- serving/config passes (analyze/servingpass.py) -----------------
+    Rule("serving.dense_kv_exceeds_headroom", "warn",
+         "a generative serving config's dense KV slab estimate "
+         "(max_slots x max_seq rows) exceeds the device headroom "
+         "guard — construction would be refused; paged KV "
+         "(serving/paged) sizes by tokens actually held"),
 )
 
 

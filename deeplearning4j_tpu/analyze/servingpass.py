@@ -1,0 +1,85 @@
+"""Serving-config lint: capacity checks a generative server would only
+surface at construction time (or worse, as a backend OOM), run as pure
+arithmetic over the spec + knobs — the serving analogue of
+analyze/configpass.py.
+
+One rule today: ``serving.dense_kv_exceeds_headroom`` — the dense
+continuous-batching server preallocates ``2 x max_slots x max_seq``
+rows of KV up front, so a capacity plan that looks innocuous
+("max_slots=64, max_seq=8192") can exceed the chip's free HBM before a
+single request arrives. ``GenerativeServer`` refuses such a config at
+construction (monitor/memstats.check_headroom); this pass flags it at
+LINT time instead, with the fix the refusal cannot suggest by itself:
+the paged server (serving/paged) allocates the same budget as a block
+pool, so capacity scales with tokens actually held rather than the
+worst case — docs/serving.md "Paged KV & prefix caching".
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.analyze.findings import AnalysisReport, finding
+
+
+def dense_kv_slab_bytes(spec, max_slots: int,
+                        max_seq_len: Optional[int] = None) -> int:
+    """Bytes of the dense server's two KV slabs for this spec + knobs
+    (``kv_shape(max_slots, max_seq)`` twice, in ``spec.kv_dtype``)."""
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+    msl = int(max_seq_len or spec.max_seq_len)
+    shape = tuple(spec.kv_shape(int(max_slots), msl))
+    itemsize = DataType.from_any(
+        getattr(spec, "kv_dtype", "float32")).np.itemsize
+    return 2 * int(np.prod(shape)) * itemsize
+
+
+def check_dense_kv_headroom(spec, max_slots: int,
+                            max_seq_len: Optional[int] = None,
+                            headroom_bytes: Optional[int] = None):
+    """Findings for one dense serving config. ``headroom_bytes`` is
+    the capacity-plan budget; None reads the live device headroom
+    (monitor/memstats.projected_headroom — None again on CPU, where the
+    check is a no-op exactly like the construction-time guard)."""
+    if headroom_bytes is None:
+        from deeplearning4j_tpu.monitor import memstats
+        headroom_bytes = memstats.projected_headroom()
+    if headroom_bytes is None:
+        return []
+    need = dense_kv_slab_bytes(spec, max_slots, max_seq_len)
+    if need <= int(headroom_bytes):
+        return []
+    msl = int(max_seq_len or spec.max_seq_len)
+    return [finding(
+        "serving.dense_kv_exceeds_headroom",
+        f"kv_slab[{max_slots}x{msl}]",
+        f"dense KV slabs need ~{need / 2**20:.1f} MiB "
+        f"({max_slots} slots x {msl} positions preallocated) but the "
+        f"headroom guard allows {int(headroom_bytes) / 2**20:.1f} MiB "
+        f"— GenerativeServer would refuse this config at construction",
+        fix_hint="serve paged: serving.paged.PagedGenerativeServer("
+                 "spec, kv_hbm_bytes=<budget>) sizes the pool by "
+                 "tokens actually held (+ prefix caching), or lower "
+                 "max_slots/max_seq_len")]
+
+
+def analyze_generative_config(spec, max_slots: int,
+                              max_seq_len: Optional[int] = None,
+                              headroom_bytes: Optional[int] = None
+                              ) -> AnalysisReport:
+    """Lint one generative serving capacity plan (spec + knobs) without
+    constructing a server or touching a device — the entry point the
+    serving rules run under (``context="serving_config"``)."""
+    t0 = _time.perf_counter()
+    report = AnalysisReport(context="serving_config")
+    report.rules_run = 1
+    report.extend(check_dense_kv_headroom(
+        spec, max_slots, max_seq_len, headroom_bytes))
+    report.seconds = _time.perf_counter() - t0
+    return report
+
+
+__all__ = ["analyze_generative_config", "check_dense_kv_headroom",
+           "dense_kv_slab_bytes"]

@@ -286,6 +286,20 @@ class MetricsRegistry:
                         "serving_latency_ms", summ[stat],
                         help="serving latency summary", lane=lane,
                         stat=stat)
+            # low-sample propagation (serving/metrics.py summary()):
+            # a p99 read from < 32 samples is the max, not a p99 —
+            # dashboards alerting on serving_latency_ms must be able
+            # to gate on this flag per lane instead of paging on a
+            # cold-start artifact
+            if "count" in summ:
+                self.set_gauge("serving_latency_count", summ["count"],
+                               help="samples behind the latency "
+                                    "summary", lane=lane)
+            if "low_sample" in summ:
+                self.set_gauge("serving_latency_low_sample",
+                               1 if summ["low_sample"] else 0,
+                               help="1 when the lane's percentiles "
+                                    "rest on < 32 samples", lane=lane)
         batch = rec.get("batch", {})
         if batch:
             self.set_gauge("serving_batch_mean_size",
@@ -312,6 +326,35 @@ class MetricsRegistry:
             self.set_gauge("serving_max_slots",
                            gen.get("max_slots", 0),
                            help="KV cache slots")
+        # paged KV tier (serving/paged/): pool + prefix-cache gauges;
+        # every ratio is safe_ratio'd at the source (0.0 at cold start,
+        # never NaN — satellite rule for the new series)
+        paged = rec.get("paged") or {}
+        if paged:
+            self.set_gauge("serving_pool_blocks",
+                           paged.get("num_blocks", 0),
+                           help="usable KV blocks in the pool")
+            self.set_gauge("serving_pool_block_size",
+                           paged.get("block_size", 0),
+                           help="tokens per KV block")
+            self.set_gauge("serving_pool_occupancy_ratio",
+                           paged.get("pool_occupancy", 0.0),
+                           help="mean held blocks / pool capacity per "
+                                "decode step")
+            self.set_gauge("serving_prefix_hit_rate",
+                           paged.get("prefix_hit_rate", 0.0),
+                           help="prefix-cache hits / lookups")
+            self.set_gauge("serving_blocks_per_request",
+                           paged.get("blocks_per_request", 0.0),
+                           help="mean KV blocks held per retired "
+                                "request")
+            self.set_gauge("serving_pool_cached_blocks",
+                           paged.get("cached_blocks", 0),
+                           help="prefix-cache registered blocks")
+            self.set_gauge("serving_pool_evictions_total",
+                           paged.get("evictions", 0),
+                           help="prefix-cache blocks reclaimed under "
+                                "pool pressure")
         res = rec.get("resilience") or {}
         state = res.get("breaker_state")
         if state is not None:

@@ -409,14 +409,7 @@ class GenerativeServer:
                  admit: str = "continuous",
                  memory_sample_every: Optional[int] = 64,
                  start: bool = True):
-        if not isinstance(spec, GenerativeSpec):
-            if hasattr(spec, "generative_spec"):
-                spec = spec.generative_spec()
-            else:
-                raise TypeError(
-                    f"{type(spec).__name__} is not generatively servable: "
-                    f"pass a GenerativeSpec (e.g. from "
-                    f"zoo.gpt.gpt_generative_spec)")
+        spec = self._coerce_spec(spec)
         if admit not in ("continuous", "static"):
             raise ValueError(f"admit= must be 'continuous' or 'static', "
                              f"got {admit!r}")
@@ -432,7 +425,7 @@ class GenerativeServer:
         self.default_timeout_ms = default_timeout_ms
         self.max_queue_len = int(max_queue_len)
         self.stats_storage = stats_storage
-        self.metrics = GenerativeMetrics(self.max_slots)
+        self.metrics = self._make_metrics()
         # pow2 prefill bucket ladder (serving/batching.py machinery):
         # halving down from max_seq_len to 1 — ≤ log2(max_seq)+1
         # compiled prefill shapes for ANY prompt-length mix
@@ -472,8 +465,58 @@ class GenerativeServer:
         # parameters: by-name sync from the training graph, cached as
         # one dict so every dispatch shares the same device arrays
         self._params = dict(spec.params())
-        # KV slabs: allocated ONCE, headroom-guarded, donated through
-        # every dispatch (docs/serving.md "Generative serving")
+        # KV slabs + host scheduler state + dispatchers — the memory
+        # tier. Overridden by serving/paged's PagedGenerativeServer,
+        # which replaces the dense per-slot slabs with a block pool and
+        # admits on free BLOCKS rather than free slots
+        self._init_kv()
+        self.telemetry = None
+        if telemetry_port is not None:
+            from deeplearning4j_tpu.monitor.server import TelemetryServer
+            self.telemetry = TelemetryServer(storage=stats_storage,
+                                             port=telemetry_port)
+            self.telemetry.add_scrape_hook(
+                lambda reg: reg.fold_serving(self.metrics))
+            self.telemetry.add_health_provider("generative",
+                                               self._telemetry_health)
+        self.warmup_report: Optional[dict] = None
+        if warmup:
+            self.warmup()
+        self._workers: List[threading.Thread] = []
+        self._supervisor: Optional[WorkerSupervisor] = None
+        # gate on the CONFIG, not self._supervisor: the supervisor's
+        # constructor spawns the worker before the attribute assignment
+        # completes (the PR-9 construction race)
+        self._supervised = (self.resilience is not None
+                            and self.resilience.supervise)
+        self._cur_slot: Optional[InflightSlot] = None
+        self._started = False
+        if start:
+            self.start()
+
+    # -- subclass hooks (serving/paged/server.py overrides) -------------
+    def _coerce_spec(self, spec):
+        if not isinstance(spec, GenerativeSpec):
+            if hasattr(spec, "generative_spec"):
+                spec = spec.generative_spec()
+            else:
+                raise TypeError(
+                    f"{type(spec).__name__} is not generatively servable: "
+                    f"pass a GenerativeSpec (e.g. from "
+                    f"zoo.gpt.gpt_generative_spec)")
+        return spec
+
+    def _make_metrics(self) -> GenerativeMetrics:
+        return GenerativeMetrics(self.max_slots)
+
+    def _init_kv(self) -> None:
+        """Allocate the KV memory tier + host scheduler state.
+
+        Dense layout: two ``[layers, max_slots, heads, max_seq,
+        head_dim]`` slabs allocated ONCE, headroom-guarded, donated
+        through every dispatch (docs/serving.md "Generative serving").
+        """
+        spec = self.spec
         shape = tuple(spec.kv_shape(self.max_slots, self.max_seq_len))
         import jax.numpy as jnp
         from deeplearning4j_tpu.memory import AllocationsTracker
@@ -505,29 +548,14 @@ class GenerativeServer:
         disp = _spec_dispatchers(spec, shape)
         self._decode_disp = disp["decode"]
         self._prefill_disp = disp["prefill"]
-        self.telemetry = None
-        if telemetry_port is not None:
-            from deeplearning4j_tpu.monitor.server import TelemetryServer
-            self.telemetry = TelemetryServer(storage=stats_storage,
-                                             port=telemetry_port)
-            self.telemetry.add_scrape_hook(
-                lambda reg: reg.fold_serving(self.metrics))
-            self.telemetry.add_health_provider("generative",
-                                               self._telemetry_health)
-        self.warmup_report: Optional[dict] = None
-        if warmup:
-            self.warmup()
-        self._workers: List[threading.Thread] = []
-        self._supervisor: Optional[WorkerSupervisor] = None
-        # gate on the CONFIG, not self._supervisor: the supervisor's
-        # constructor spawns the worker before the attribute assignment
-        # completes (the PR-9 construction race)
-        self._supervised = (self.resilience is not None
-                            and self.resilience.supervise)
-        self._cur_slot: Optional[InflightSlot] = None
-        self._started = False
-        if start:
-            self.start()
+
+    def _can_place(self, req: GenerationRequest) -> bool:
+        """Whether the memory tier can hold ``req``'s prefill right
+        now. Dense slabs: a free slot IS the capacity (the ``_admit``
+        loop already gates on one). The paged subclass gates on free
+        KV *blocks* — a request it cannot place goes back to the front
+        of the queue until a retirement frees blocks."""
+        return True
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -785,6 +813,13 @@ class GenerativeServer:
                 req.close_stream()
                 self.metrics.inc("requests_cancelled")
                 continue
+            if not self._can_place(req):
+                # memory-tier backpressure (paged: not enough free KV
+                # blocks): back to the FRONT — it keeps its place in
+                # line — and stop admitting until a retirement frees
+                # capacity. Does not consume the crash-requeue budget
+                self._queue.requeue(req)
+                break
             s = self._slots.alloc()
             self._slot_reqs[s] = req
             self._sync_inflight(slot)

@@ -28,6 +28,17 @@ import numpy as np
 _EDGES = np.geomspace(0.01, 60_000.0, 82)
 
 
+def safe_ratio(num: float, den: float) -> float:
+    """``num / den`` with 0.0 (not NaN/inf) on a zero denominator — the
+    cold-start rule for every exported gauge ratio: a dashboard reading
+    prefix-hit-rate or pool-occupancy before the first sample must see
+    a number it can plot/alert on."""
+    den = float(den)
+    if den == 0.0 or not np.isfinite(den):
+        return 0.0
+    return float(num) / den
+
+
 class LatencyHistogram:
     """Fixed-bin log-scale latency histogram with percentile readout."""
 

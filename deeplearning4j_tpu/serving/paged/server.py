@@ -1,0 +1,702 @@
+"""Paged-KV generative serving: block pool + prefix cache +
+tensor-parallel dispatch over the continuous-batching scheduler.
+
+The memory tier vLLM proved out (PagedAttention, Kwon et al. SOSP '23)
+under the Orca-style step scheduler PR 15 built: instead of one dense
+``[layers, max_slots, heads, max_seq, head_dim]`` row per slot, K/V
+live in fixed-size token BLOCKS carved from one preallocated slab
+``[layers, num_blocks, heads, block_size, head_dim]``, and each request
+holds a BLOCK TABLE grown one block at a time at decode-step
+boundaries. Capacity is proportional to tokens actually held — a
+12-token chat costs one block, not a ``max_seq`` row — so the same HBM
+serves several times the concurrent requests (bench.py serving_paged).
+
+Three layers, all riding :class:`GenerativeServer`'s scheduler/queue/
+resilience plumbing unchanged:
+
+- **block pool** (``pool.py``) — refcounted free-list allocator with
+  the null-block-0 convention; admission is gated on BLOCKS two ways:
+  ``submit`` reserves each request's worst-case block footprint against
+  pool capacity (shedding typed :class:`PoolExhaustedError` with a
+  ``retry_after_s`` hint when the pool cannot ever hold it — the
+  reservation is released exactly once via the request future's done
+  callback), and ``_can_place`` holds a queued request at the FRONT
+  until enough blocks are actually free. The conservative reservation
+  means a placed request can never fail a block allocation mid-decode.
+- **prefix caching** — full prompt blocks are content-addressed by
+  chain hash; a repeated system prompt/few-shot prefix prefills only
+  its SUFFIX (``hist`` cached tokens skip straight to reused blocks),
+  so repeated-prefix TTFT approaches one decode step. Refcounts release
+  exactly once on completion, shed, cancel AND crash-recovery requeue
+  (``pool.reset()`` on worker respawn — the slab is mid-dispatch
+  garbage, so the cache addressing its contents drops wholesale).
+- **tensor parallel** — ``tp > 1`` builds a ``{model: tp}`` mesh from
+  the PR-7 :class:`~deeplearning4j_tpu.parallel.sharding.ShardingSpec`
+  ("transformer" preset: qkv/fc column, proj row, wte vocab-sharded),
+  shards both KV slabs on the HEADS axis, replicates the tiny host io
+  (tables, tokens, positions), and lets GSPMD propagate through the
+  jitted step — a model larger than one chip's HBM serves, and greedy
+  tokens still match the single-chip server (tests/test_paged.py).
+
+Correctness contract: with ``max_blocks_per_req * block_size ==
+max_seq`` the gathered paged context is elementwise identical to the
+dense slab context (zoo/gpt.py ``gpt_paged_decode_fns``), so greedy
+output is bit-identical to :func:`~deeplearning4j_tpu.serving.
+generative.greedy_decode` — paged vs dense is a memory-layout change,
+not a numerics change. See docs/serving.md "Paged KV & prefix caching".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.compilecache.aot import AOTDispatch, ph_shape_sig
+from deeplearning4j_tpu.serving.generative import (GenerationHandle,
+                                                   GenerationRequest,
+                                                   GenerativeMetrics,
+                                                   GenerativeServer,
+                                                   SlotAllocator)
+from deeplearning4j_tpu.serving.metrics import safe_ratio
+from deeplearning4j_tpu.serving.paged.pool import (NULL_BLOCK, BlockPool,
+                                                   PoolExhaustedError,
+                                                   blocks_for_tokens,
+                                                   prefix_block_hashes)
+
+
+@dataclass
+class PagedGenerativeSpec:
+    """A model's PAGED generative-serving contract (produced by e.g.
+    ``zoo.gpt.gpt_paged_spec``) — the block-table analogue of
+    :class:`~deeplearning4j_tpu.serving.generative.GenerativeSpec`.
+
+    - ``params()`` pulls the current trained parameter arrays by name.
+    - ``make_fns(block_size, max_blocks_per_req)`` builds the pure
+      ``(prefill_fn, decode_fn)`` pair for one block geometry (the
+      server memoizes the jitted dispatchers per geometry, so every
+      server over the same model + geometry shares one compile set).
+      Io contracts are documented on ``zoo.gpt.gpt_paged_decode_fns``.
+    - ``kv_shape(num_blocks, block_size)`` is the shape of ONE slab —
+      required layout ``[layers, num_blocks, heads, block_size,
+      head_dim]`` (the tensor-parallel path shards axis 2, the heads).
+    """
+
+    params: Callable[[], Dict[str, object]]
+    make_fns: Callable[[int, int], tuple]
+    kv_shape: Callable[[int, int], tuple]
+    vocab_size: int
+    max_seq_len: int
+    num_heads: int
+    kv_dtype: str = "float32"
+    eos_id: Optional[int] = None
+
+
+def _paged_dispatchers(spec: PagedGenerativeSpec, kv_shape: tuple,
+                       block_size: int, max_blocks: int,
+                       mesh_key) -> Dict[str, AOTDispatch]:
+    """One (decode, prefill) dispatcher pair per (spec, slab geometry,
+    mesh), memoized on the spec object — the paged analogue of
+    ``generative._spec_dispatchers``. ``make_fns`` builds fresh closure
+    objects each call, so without this memo a second server (a restart,
+    a canary) would recompile every program; the mesh key keeps AOT
+    executables lowered for one device layout from colliding with a
+    differently-sharded server's identical io signature."""
+    cache = getattr(spec, "_disp_cache", None)
+    if cache is None:
+        cache = {}
+        spec._disp_cache = cache
+    key = (tuple(int(d) for d in kv_shape), int(block_size),
+           int(max_blocks), mesh_key)
+    pair = cache.get(key)
+    if pair is None:
+        import jax
+        prefill_fn, decode_fn = spec.make_fns(int(block_size),
+                                              int(max_blocks))
+        pair = {
+            "decode": AOTDispatch(
+                jax.jit(decode_fn, donate_argnums=(1, 2)), ph_arg=3),
+            "prefill": AOTDispatch(
+                jax.jit(prefill_fn, donate_argnums=(1, 2)), ph_arg=3)}
+        cache[key] = pair
+    return pair
+
+
+class PagedMetrics(GenerativeMetrics):
+    """GenerativeMetrics plus the paged lanes: pool occupancy (held
+    blocks per decode step over capacity), prefix-cache hit rate,
+    blocks-per-retired-request, alloc/release/eviction counters. All
+    ratios are :func:`~deeplearning4j_tpu.serving.metrics.safe_ratio`
+    — 0.0 at cold start, never NaN (the fold_serving/ui contract)."""
+
+    def __init__(self, max_slots: int = 0, num_blocks: int = 0,
+                 block_size: int = 0):
+        super().__init__(max_slots)
+        self.num_blocks = int(num_blocks)     # usable (non-null) blocks
+        self.block_size = int(block_size)
+        for c in ("prefix_lookups", "prefix_hits", "prefix_blocks_hit",
+                  "blocks_allocated", "blocks_released",
+                  "blocks_held_sum", "pool_samples",
+                  "request_blocks_sum", "requests_retired"):
+            self.counters[c] = 0
+        self._pool_stats: Dict[str, int] = {}
+
+    def observe_pool(self, held: int, stats: Optional[dict] = None) -> None:
+        """One per-decode-step occupancy sample (held blocks)."""
+        with self._lock:
+            self.counters["blocks_held_sum"] += int(held)
+            self.counters["pool_samples"] += 1
+            if stats is not None:
+                self._pool_stats = dict(stats)
+
+    def observe_prefix(self, looked_up: bool, blocks_hit: int) -> None:
+        with self._lock:
+            if looked_up:
+                self.counters["prefix_lookups"] += 1
+            if blocks_hit > 0:
+                self.counters["prefix_hits"] += 1
+                self.counters["prefix_blocks_hit"] += int(blocks_hit)
+
+    def observe_blocks(self, allocated: int = 0, released: int = 0) -> None:
+        with self._lock:
+            self.counters["blocks_allocated"] += int(allocated)
+            self.counters["blocks_released"] += int(released)
+
+    def observe_request_blocks(self, n: int) -> None:
+        with self._lock:
+            self.counters["request_blocks_sum"] += int(n)
+            self.counters["requests_retired"] += 1
+
+    def to_record(self) -> dict:
+        rec = super().to_record()
+        with self._lock:
+            c = self.counters
+            rec["paged"] = {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "pool_occupancy": round(safe_ratio(
+                    c["blocks_held_sum"],
+                    c["pool_samples"] * self.num_blocks), 4),
+                "prefix_hit_rate": round(safe_ratio(
+                    c["prefix_hits"], c["prefix_lookups"]), 4),
+                "prefix_blocks_hit": c["prefix_blocks_hit"],
+                "blocks_per_request": round(safe_ratio(
+                    c["request_blocks_sum"], c["requests_retired"]), 3),
+                "blocks_allocated": c["blocks_allocated"],
+                "blocks_released": c["blocks_released"],
+                "evictions": self._pool_stats.get("evictions", 0),
+                "cached_blocks": self._pool_stats.get("cached", 0),
+                "held_blocks": self._pool_stats.get("held", 0)}
+        return rec
+
+    def stats(self) -> str:
+        rec = self.to_record()
+        p = rec["paged"]
+        return "\n".join([
+            super().stats(),
+            f"  paged: {p['num_blocks']} blocks x {p['block_size']} "
+            f"tokens, occupancy {p['pool_occupancy']:.1%}, prefix hit "
+            f"rate {p['prefix_hit_rate']:.1%} "
+            f"({p['prefix_blocks_hit']} blocks), "
+            f"{p['blocks_per_request']} blocks/request, "
+            f"{p['evictions']} evictions"])
+
+
+class PagedGenerativeServer(GenerativeServer):
+    """Continuous-batching server over a paged KV block pool.
+
+    ::
+
+        spec = zoo.gpt.gpt_paged_spec(sd, cfg)
+        srv = PagedGenerativeServer(spec, max_slots=8, block_size=16,
+                                    kv_hbm_bytes=1 << 30)
+        tokens = srv.generate([1, 2, 3], max_new_tokens=32)
+
+    - ``block_size``: tokens per KV block (16 is the vLLM default —
+      small enough that a short chat wastes < block_size rows, large
+      enough that table gathers stay coarse).
+    - ``num_blocks`` / ``kv_hbm_bytes``: pool size, directly or as an
+      HBM budget (``num_blocks = budget // bytes_per_block``). Default:
+      the dense-equivalent worst case (``max_slots`` requests at full
+      ``max_seq``) — same capacity floor as the dense server, but
+      short requests release what they don't use.
+    - ``tp``: tensor-parallel ways over the ``model`` mesh axis
+      (params sharded per the "transformer" preset, KV slabs sharded
+      on heads; requires ``num_heads % tp == 0``).
+    - ``prefix_cache=False`` disables content-addressed block reuse
+      (every prefill allocates fresh blocks).
+    - ``debug_leaks=True`` runs the pool's full accounting invariant
+      against the live block tables after EVERY decode step (test/CI
+      flag; O(blocks) per step).
+
+    Everything else (admission, queueing, SLO shed, streaming,
+    supervision, crash requeue) is inherited from
+    :class:`GenerativeServer` unchanged.
+    """
+
+    def __init__(self, spec, max_slots: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 kv_hbm_bytes: Optional[int] = None,
+                 max_blocks_per_req: Optional[int] = None,
+                 tp: int = 1, devices: Optional[Sequence] = None,
+                 prefix_cache: bool = True, debug_leaks: bool = False,
+                 **kw):
+        if int(block_size) < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if int(tp) < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        # subclass knobs FIRST: super().__init__ calls the _make_metrics
+        # and _init_kv hooks below, which read them
+        self.block_size = int(block_size)
+        self._num_blocks_arg = num_blocks
+        self._kv_hbm_bytes_arg = kv_hbm_bytes
+        self._maxb_arg = max_blocks_per_req
+        self.tp = int(tp)
+        self._devices_arg = devices
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self.debug_leaks = bool(debug_leaks)
+        self._strategy = None
+        self._kv_sharding = None
+        self._commit_lock = threading.Lock()
+        self._committed = 0          # reserved worst-case blocks
+        super().__init__(spec, max_slots=max_slots, **kw)
+
+    # -- hook overrides -------------------------------------------------
+    def _coerce_spec(self, spec):
+        if not isinstance(spec, PagedGenerativeSpec):
+            if hasattr(spec, "paged_spec"):
+                spec = spec.paged_spec()
+            else:
+                raise TypeError(
+                    f"{type(spec).__name__} is not paged-servable: pass "
+                    f"a PagedGenerativeSpec (e.g. from "
+                    f"zoo.gpt.gpt_paged_spec)")
+        return spec
+
+    def _make_metrics(self) -> PagedMetrics:
+        # pool geometry is resolved later in _init_kv, which backfills
+        # num_blocks/block_size on this instance
+        return PagedMetrics(self.max_slots, 0, self.block_size)
+
+    def _init_kv(self) -> None:
+        """Allocate the paged memory tier: one K + one V slab shaped
+        ``[layers, num_blocks, heads, block_size, head_dim]`` (block 0
+        reserved as the null block), the block pool, per-slot block
+        tables, and the geometry-memoized dispatchers. With ``tp > 1``
+        also builds the mesh and shards params + slabs."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.memory import AllocationsTracker
+        from deeplearning4j_tpu.monitor import memstats
+        from deeplearning4j_tpu.ndarray.dtype import DataType
+        spec = self.spec
+        BS = self.block_size
+        self._maxb = int(self._maxb_arg) if self._maxb_arg is not None \
+            else blocks_for_tokens(self.max_seq_len, BS)
+        if self._maxb * BS < self.max_seq_len:
+            raise ValueError(
+                f"max_blocks_per_req {self._maxb} x block_size {BS} "
+                f"cannot hold max_seq_len {self.max_seq_len}")
+        self._kv_dtype = DataType.from_any(spec.kv_dtype).jnp
+        itemsize = jnp.zeros((), self._kv_dtype).dtype.itemsize
+        per_block_shape = tuple(spec.kv_shape(1, BS))
+        self.bytes_per_block = 2 * int(np.prod(per_block_shape)) * itemsize
+        if self._num_blocks_arg is not None:
+            num_blocks = int(self._num_blocks_arg)
+        elif self._kv_hbm_bytes_arg is not None:
+            num_blocks = max(2, int(self._kv_hbm_bytes_arg)
+                             // self.bytes_per_block)
+        else:
+            # dense-equivalent floor: every slot at full max_seq fits
+            num_blocks = 1 + self.max_slots * self._maxb
+        shape = tuple(spec.kv_shape(num_blocks, BS))
+        self.kv_slab_bytes = 2 * int(np.prod(shape)) * itemsize
+        memstats.check_headroom(
+            self.kv_slab_bytes,
+            f"paged KV slabs ({num_blocks} blocks x {BS} tokens)")
+        mesh_key = None
+        if self.tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+            from deeplearning4j_tpu.parallel.sharding import ShardingSpec
+            if spec.num_heads % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide num_heads "
+                    f"{spec.num_heads} (the KV slab shards on the "
+                    f"heads axis)")
+            devices = list(self._devices_arg
+                           if self._devices_arg is not None
+                           else jax.devices())
+            sspec = ShardingSpec(axes={MODEL_AXIS: self.tp},
+                                 preset="transformer", batch_axes=())
+            sspec.validate(
+                params={n: tuple(np.shape(a))
+                        for n, a in self._params.items()},
+                device_count=len(devices))
+            self._strategy = strat = sspec.build(devices=devices)
+            self._params = {
+                n: jax.device_put(a, strat.param_sharding(n, np.ndim(a)))
+                for n, a in self._params.items()}
+            # slab layout contract: axis 2 is heads
+            self._kv_sharding = NamedSharding(
+                strat.mesh.mesh,
+                PartitionSpec(None, None, MODEL_AXIS, None, None))
+            self._io_sharding = NamedSharding(strat.mesh.mesh,
+                                              PartitionSpec())
+            mesh_key = (self.tp,
+                        tuple(str(d) for d in strat.mesh.mesh.devices.flat))
+        self._kc = self._fresh_slab(shape)
+        self._vc = self._fresh_slab(shape)
+        AllocationsTracker.get_instance().allocate("kv_slab",
+                                                   self.kv_slab_bytes)
+        # host scheduler state (worker thread owns mutation)
+        self.pool = BlockPool(num_blocks, BS)
+        self.metrics.num_blocks = self.pool.capacity
+        self.metrics.block_size = BS
+        self._slots = SlotAllocator(self.max_slots)
+        self._slot_reqs: List[Optional[GenerationRequest]] = \
+            [None] * self.max_slots
+        self._tokens = np.zeros(self.max_slots, np.int32)
+        self._positions = np.zeros(self.max_slots, np.int32)
+        self._active = np.zeros(self.max_slots, bool)
+        self._tables = np.zeros((self.max_slots, self._maxb), np.int32)
+        self._nblocks = np.zeros(self.max_slots, np.int32)
+        disp = _paged_dispatchers(spec, shape, BS, self._maxb, mesh_key)
+        self._decode_disp = disp["decode"]
+        self._prefill_disp = disp["prefill"]
+
+    def _fresh_slab(self, shape=None):
+        import jax
+        import jax.numpy as jnp
+        if shape is None:
+            shape = tuple(self._kc.shape)
+        slab = jnp.zeros(shape, self._kv_dtype)
+        if self._kv_sharding is not None:
+            slab = jax.device_put(slab, self._kv_sharding)
+        return slab
+
+    # -- block-commitment admission (submit thread) ---------------------
+    def _worst_case_blocks(self, prompt_len: int,
+                           max_new_tokens: int) -> int:
+        return blocks_for_tokens(
+            min(int(prompt_len) + int(max_new_tokens), self.max_seq_len),
+            self.block_size)
+
+    def _uncommit(self, n: int) -> None:
+        with self._commit_lock:
+            self._committed -= int(n)
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               **kw) -> GenerationHandle:
+        """:meth:`GenerativeServer.submit` plus block-pool admission:
+        the request's WORST-CASE block footprint (prompt + full token
+        budget) is reserved against pool capacity up front, so a placed
+        request can never fail a block allocation mid-decode. A request
+        the pool cannot ever hold alongside the committed load sheds
+        typed — :class:`PoolExhaustedError` with a ``retry_after_s``
+        backoff hint — instead of crashing a worker later. The
+        reservation is released exactly once, whenever the request's
+        future resolves (success, failure, timeout, shed, cancel, or a
+        second-crash fail — every resolution path sets the future)."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        need = self._worst_case_blocks(p.size, max_new_tokens)
+        with self._commit_lock:
+            if self._committed + need > self.pool.capacity:
+                self.metrics.inc("requests_submitted")
+                self.metrics.inc("requests_shed")
+                hint = (self.admission.retry_hint_s(
+                            self._queue.pending() + 1)
+                        if self.admission is not None else 0.25)
+                raise PoolExhaustedError(
+                    f"KV block pool cannot hold the request: needs "
+                    f"{need} blocks worst-case, {self._committed} of "
+                    f"{self.pool.capacity} already committed — shed at "
+                    f"admission", retry_after_s=hint)
+            self._committed += need
+        try:
+            handle = super().submit(prompt, max_new_tokens, **kw)
+        except BaseException:
+            self._uncommit(need)
+            raise
+        handle._req.future.add_done_callback(
+            lambda _f, n=need: self._uncommit(n))
+        return handle
+
+    def _can_place(self, req: GenerationRequest) -> bool:
+        """Step-boundary gate: hold a queued request at the FRONT until
+        its prefill's blocks are actually free (free list + evictable
+        cached blocks). The submit-side commitment makes this
+        eventually true without failing anything."""
+        need = blocks_for_tokens(int(req.prefix().size), self.block_size)
+        return self.pool.usable_free_count() >= need
+
+    # -- worker: prefill / decode / retire ------------------------------
+    def _prefill(self, s: int, req: GenerationRequest) -> None:
+        prefix = req.prefix()
+        L = int(prefix.size)
+        if L > self.max_seq_len - 1:
+            # crash-requeued request whose prefix already fills the
+            # sequence: nothing left to decode
+            self._retire(s)
+            return
+        BS = self.block_size
+        hashes: List[bytes] = []
+        hit: List[int] = []
+        if self.prefix_cache_enabled:
+            hashes = prefix_block_hashes(prefix, BS)
+            # reuse is capped one block short of the full prefix: at
+            # least one suffix token must run through prefill (the
+            # logits at the LAST prompt position produce the first
+            # generated token)
+            hit = self.pool.lookup(hashes, max_blocks=(L - 1) // BS)
+            self.metrics.observe_prefix(True, len(hit))
+        hist = len(hit) * BS
+        suffix = prefix[hist:]
+        Ls = L - hist
+        fresh: List[int] = []
+        try:
+            for _ in range(blocks_for_tokens(L, BS) - len(hit)):
+                fresh.append(self.pool.alloc())
+        except PoolExhaustedError:
+            # roll back BOTH the fresh allocations and the cache-hit
+            # retains — the request fails typed without leaking a block
+            for b in fresh + hit:
+                self.pool.release(b)
+            raise
+        blocks = hit + fresh
+        self.metrics.observe_blocks(allocated=len(fresh))
+        self._tables[s, :] = NULL_BLOCK
+        self._tables[s, :len(blocks)] = blocks
+        self._nblocks[s] = len(blocks)
+        bucket = self._buckets.bucket_for(Ls)
+        padded = np.zeros(bucket, np.int32)
+        padded[:Ls] = suffix
+        io = {"tokens": padded, "length": np.int32(Ls),
+              "hist": np.int32(hist), "table": self._tables[s].copy()}
+        t0 = time.perf_counter()
+        tok = int(self._dispatch(self._prefill_disp, io, "serving.prefill",
+                                 bucket=bucket, slot=s, hist=hist)[2])
+        self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
+        if self.prefix_cache_enabled:
+            # content-address the freshly FILLED full blocks (indices
+            # [len(hit), L // BS) — the trailing partial block is still
+            # being appended to and never registers)
+            for u in range(len(hit), min(len(hashes), L // BS)):
+                self.pool.register(hashes[u], int(blocks[u]))
+        self._positions[s] = L
+        self._tokens[s] = tok
+        self._active[s] = True
+        self._emit(s, req, tok)
+
+    def _decode_once(self, slot) -> None:
+        BS = self.block_size
+        # block-table growth at the step boundary: a lane whose next
+        # write position crosses into an unallocated block gets one.
+        # The submit-side commitment guarantees this cannot fail for a
+        # placed request; the typed retire is the defensive belt
+        for s in np.flatnonzero(self._active):
+            s = int(s)
+            u = int(self._positions[s]) // BS
+            if u >= int(self._nblocks[s]):
+                try:
+                    b = self.pool.alloc()
+                except PoolExhaustedError as e:   # pragma: no cover
+                    self._retire(s, error=e)
+                    continue
+                self._tables[s, u] = b
+                self._nblocks[s] = u + 1
+                self.metrics.observe_blocks(allocated=1)
+        if not self._active.any():
+            return
+        n_active = self._n_active()
+        act = self._active.copy()
+        wb = np.full(self.max_slots, NULL_BLOCK, np.int32)
+        wo = np.zeros(self.max_slots, np.int32)
+        for s in np.flatnonzero(act):
+            s = int(s)
+            pos = int(self._positions[s])
+            wb[s] = self._tables[s, pos // BS]
+            wo[s] = pos % BS
+        io = {"tokens": self._tokens.copy(),
+              "positions": self._positions.copy(),
+              "active": act,
+              "tables": self._tables.copy(),
+              "write_block": wb, "write_off": wo}
+        t0 = time.perf_counter()
+        nxt = np.asarray(self._dispatch(self._decode_disp, io,
+                                        "serving.decode",
+                                        active=n_active)[2])
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe_decode_step(n_active, ms)
+        self.metrics.observe_pool(self.pool.held_count(),
+                                  stats=self.pool.stats())
+        if self.admission is not None:
+            self.admission.observe(ms)
+        self._maybe_memory_record()
+        for s in np.flatnonzero(act):
+            req = self._slot_reqs[int(s)]
+            if req is None:
+                continue
+            s = int(s)
+            tok = int(nxt[s])
+            self._positions[s] += 1
+            self._tokens[s] = tok
+            self._emit(s, req, tok)
+        if self.debug_leaks:
+            self.pool.check_invariant(tables=[
+                self._tables[s, :int(self._nblocks[s])]
+                for s in range(self.max_slots)
+                if self._slot_reqs[s] is not None])
+
+    def _retire(self, s: int, error: Optional[BaseException] = None,
+                timed_out: bool = False, cancelled: bool = False) -> None:
+        """Release slot ``s``'s blocks (decrementing shared prefix
+        refcounts) exactly once, then the base retirement. Exactness
+        rides the same free-list discipline as slots: a second release
+        of any block raises in the pool."""
+        req = self._slot_reqs[s]
+        if req is not None:
+            n = int(self._nblocks[s])
+            for u in range(n):
+                self.pool.release(int(self._tables[s, u]))
+            self.metrics.observe_blocks(released=n)
+            self.metrics.observe_request_blocks(n)
+            self._tables[s, :] = NULL_BLOCK
+            self._nblocks[s] = 0
+        super()._retire(s, error=error, timed_out=timed_out,
+                        cancelled=cancelled)
+
+    def _reset_state(self) -> None:
+        """Crash-recovery respawn: fresh slabs, a hard pool reset
+        (every held block released ONCE, the prefix cache dropped — it
+        content-addresses slab rows that are now garbage), clean
+        tables. The requeued requests keep their submit-side block
+        commitment (their futures are unresolved) and re-enter at
+        prefill."""
+        self._kc = self._fresh_slab()
+        self._vc = self._fresh_slab()
+        self.pool.reset()
+        self._slots.reset()
+        self._slot_reqs = [None] * self.max_slots
+        self._tokens[:] = 0
+        self._positions[:] = 0
+        self._active[:] = False
+        self._tables[:] = NULL_BLOCK
+        self._nblocks[:] = 0
+
+    # -- AOT warmup -----------------------------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """Paged analogue of :meth:`GenerativeServer.warmup`: one
+        decode shape + one prefill shape per bucket, lowered with the
+        mesh shardings when ``tp > 1`` so the AOT executables match the
+        live sharded arguments (a mismatch would silently fall back to
+        lazy jit — the AOTDispatch ValueError path)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.compilecache import (COMPILE_STATS,
+                                                     install_compile_watcher)
+        from deeplearning4j_tpu.environment import environment
+        from deeplearning4j_tpu.monitor import memstats
+        from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
+        environment().apply_compilation_cache()
+        install_compile_watcher()
+        bucket_list = sorted({int(b) for b in buckets}) \
+            if buckets is not None else list(self._buckets.buckets)
+
+        def _abs(shape, dtype, sharding=None):
+            if sharding is not None:
+                return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                            sharding=sharding)
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+        io_sh = self._io_sharding if self.tp > 1 else None
+        params_abs = {
+            n: _abs(np.shape(a), a.dtype,
+                    self._strategy.param_sharding(n, np.ndim(a))
+                    if self._strategy is not None else None)
+            for n, a in self._params.items()}
+        kv_abs = _abs(self._kc.shape, self._kc.dtype, self._kv_sharding)
+        S, MAXB = self.max_slots, self._maxb
+        mark = COMPILE_STATS.mark()
+        t0 = _time.perf_counter()
+
+        def _build(disp, io_abs, label):
+            sig = ph_shape_sig(io_abs)
+            with self._exec_lock:
+                if sig not in disp.aot:
+                    with _tracer.span("compile.precompile", cat="compile",
+                                      target=label):
+                        disp.aot[sig] = disp.lower(
+                            params_abs, kv_abs, kv_abs, io_abs).compile()
+                    memstats.capture_plan(label, sig,
+                                          compiled=disp.aot[sig])
+                if sig not in self._shapes_seen:
+                    self._shapes_seen.add(sig)
+                    self.metrics.inc("warmup_compiles")
+
+        _build(self._decode_disp,
+               {"tokens": _abs((S,), jnp.int32, io_sh),
+                "positions": _abs((S,), jnp.int32, io_sh),
+                "active": _abs((S,), jnp.bool_, io_sh),
+                "tables": _abs((S, MAXB), jnp.int32, io_sh),
+                "write_block": _abs((S,), jnp.int32, io_sh),
+                "write_off": _abs((S,), jnp.int32, io_sh)},
+               f"paged_decode_s{S}")
+        for b in bucket_list:
+            _build(self._prefill_disp,
+                   {"tokens": _abs((int(b),), jnp.int32, io_sh),
+                    "length": _abs((), jnp.int32, io_sh),
+                    "hist": _abs((), jnp.int32, io_sh),
+                    "table": _abs((MAXB,), jnp.int32, io_sh)},
+                   f"paged_prefill_b{int(b)}")
+        self.warmup_report = {
+            "decode_slots": S,
+            "prefill_buckets": bucket_list,
+            "seconds": round(_time.perf_counter() - t0, 4),
+            **{k: v for k, v in COMPILE_STATS.delta(mark).items()
+               if k in ("backend_compiles", "cache_hits",
+                        "cache_misses")}}
+        return self.warmup_report
+
+    def update_model(self) -> None:
+        """Re-pull trained parameters; under ``tp > 1`` the fresh
+        arrays are re-placed onto the mesh with the same shardings."""
+        fresh = dict(self.spec.params())
+        if self._strategy is not None:
+            import jax
+            fresh = {n: jax.device_put(
+                         a, self._strategy.param_sharding(n, np.ndim(a)))
+                     for n, a in fresh.items()}
+        with self._exec_lock:
+            self._params = fresh
+
+    # -- observability --------------------------------------------------
+    def memory_report(self) -> dict:
+        """Pool accounting for /memory + capacity planning — block
+        granularity instead of the dense per-slot rows."""
+        st = self.pool.stats()
+        return {"kv_slab_bytes": self.kv_slab_bytes,
+                "kv_slab_shape": list(self._kc.shape),
+                "kv_bytes_per_block": self.bytes_per_block,
+                "block_size": self.block_size,
+                "num_blocks": self.pool.capacity,
+                "blocks_free": st["free"],
+                "blocks_held": st["held"],
+                "blocks_evictable": st["evictable"],
+                "blocks_cached": st["cached"],
+                "blocks_committed": self._committed,
+                "pool_evictions": st["evictions"],
+                "tensor_parallel": self.tp,
+                "max_slots": self.max_slots,
+                "max_seq_len": self.max_seq_len,
+                "active_slots": self._n_active()}
+
+
+__all__ = ["PagedGenerativeSpec", "PagedGenerativeServer", "PagedMetrics"]

@@ -292,6 +292,20 @@ class AdmissionController:
                                    / max(1, int(rows_per_dispatch)))
             return dispatches * self._pcts.percentile(self.percentile)
 
+    def retry_hint_s(self, pending_rows: int = 1,
+                     rows_per_dispatch: int = 1,
+                     floor_s: float = 0.05) -> float:
+        """Backoff hint (seconds) for a typed capacity shed — the
+        ``retry_after_s`` a ``ServerOverloadedError`` (queue full, KV
+        block pool exhausted) carries to the client. Derived from the
+        rolling exec percentile when warm, clamped to ``floor_s`` so a
+        cold estimator still tells clients to back off rather than
+        hot-loop."""
+        est = self.estimate_wait_ms(pending_rows, rows_per_dispatch)
+        if est is None:
+            return float(floor_s)
+        return round(max(float(floor_s), est / 1000.0), 3)
+
 
 class InflightSlot:
     """Per-worker visibility into popped-but-unresolved requests — what

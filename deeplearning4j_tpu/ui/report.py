@@ -791,6 +791,17 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 f"occupancy {gen.get('slot_occupancy', 0.0):.1%} of "
                 f"{gen.get('max_slots', 0)} slots "
                 f"(docs/serving.md \"Generative serving\")</p>")
+        paged = s.get("paged") or {}
+        if paged:
+            parts.append(
+                f"<p>paged KV: {paged.get('num_blocks', 0)} blocks x "
+                f"{paged.get('block_size', 0)} tokens, pool occupancy "
+                f"{paged.get('pool_occupancy', 0.0):.1%}, prefix hit "
+                f"rate {paged.get('prefix_hit_rate', 0.0):.1%} "
+                f"({paged.get('prefix_blocks_hit', 0)} blocks reused), "
+                f"{paged.get('blocks_per_request', 0.0)} blocks/request, "
+                f"{paged.get('evictions', 0)} cache evictions "
+                f"(docs/serving.md \"Paged KV &amp; prefix caching\")</p>")
         lat = s.get("latency_ms", {})
         if lat:
             parts.append("<table><tr><th>lane</th><th>count</th>"

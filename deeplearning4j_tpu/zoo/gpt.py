@@ -332,6 +332,218 @@ def gpt_decode_fns(cfg: GPTConfig):
     return prefill_fn, decode_fn
 
 
+def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
+                         max_blocks_per_req: int):
+    """Pure-jax ``(prefill_fn, decode_fn)`` over PAGED KV slabs — the
+    same math as :func:`gpt_decode_fns` op-for-op, but attention
+    reads/writes fixed-size token BLOCKS addressed through per-request
+    block tables (vLLM's PagedAttention layout, Kwon et al. SOSP '23)
+    instead of one contiguous ``max_seq`` row per slot.
+
+    KV slab layout (one array each for K and V)::
+
+        [num_layers, num_blocks, heads, block_size, head_dim]
+
+    Block 0 is the NULL block: never handed out by the pool, the target
+    of every unused table entry and every inactive decode lane's write —
+    so inactive-lane scatters are harmless by construction and gathered
+    trash is provably masked (V rows zeroed under the mask, the same
+    poisoned-cache discipline as the slotted decode).
+
+    - ``prefill_fn(params, kc, vc, io)`` with ``io = {"tokens": [Lb]
+      int32 (the bucket-padded prompt SUFFIX after any prefix-cache
+      hit), "length": () int32 (real suffix length), "hist": () int32
+      (cached-prefix length, a multiple of block_size), "table": [MAXB]
+      int32}`` scatters the suffix K/V into its table's blocks, attends
+      causally over the WHOLE table (cached prefix + fresh suffix) and
+      returns ``(kc, vc, next_token, last_logits)`` — the greedy token
+      from global position ``hist + length - 1``. ONE program shape
+      serves both the cold path (``hist = 0``) and every prefix hit.
+    - ``decode_fn(params, kc, vc, io)`` with ``io = {"tokens": [S],
+      "positions": [S], "active": [S] bool, "tables": [S, MAXB] int32,
+      "write_block": [S] int32, "write_off": [S] int32}`` advances
+      every active lane one token in ONE dispatch: the new K/V lands at
+      host-computed ``(write_block, write_off)`` (inactive lanes write
+      the null block), each lane attends over its own gathered table
+      masked to ``index <= position``.
+
+    Because a table slot ``u`` covers exactly global positions
+    ``[u * block_size, (u+1) * block_size)``, the gathered context is
+    position-ordered — with ``max_blocks_per_req * block_size ==
+    max_seq`` it is ELEMENTWISE identical to the dense slab's context,
+    so greedy outputs match the dense server bit-for-bit
+    (tests/test_paged.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    H, A, D, L = (cfg.hidden_size, cfg.num_heads, cfg.head_size,
+                  cfg.num_layers)
+    BS = int(block_size)
+    MAXB = int(max_blocks_per_req)
+    T = MAXB * BS                   # gathered context length per request
+    eps = cfg.layer_norm_eps
+    scale = 1.0 / np.sqrt(D)
+
+    def _ln(x, g, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        m2 = jnp.mean(x * x, axis=-1, keepdims=True)
+        var = jnp.maximum(m2 - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        return (x - mean) * inv * g + b
+
+    def _mlp(p, sc, x):
+        y = x @ p[f"{sc}/mlp/fc/kernel"] + p[f"{sc}/mlp/fc/bias"]
+        y = jax.nn.gelu(y, approximate=True)
+        return y @ p[f"{sc}/mlp/proj/kernel"] + p[f"{sc}/mlp/proj/bias"]
+
+    def _logits(p, x):
+        if cfg.tie_embeddings:
+            return jnp.einsum("sh,vh->sv", x, p["wte"])
+        return x @ p["lm_head"]
+
+    def prefill_fn(params, kc, vc, io):
+        p = params
+        tokens, length = io["tokens"], io["length"]
+        hist, table = io["hist"], io["table"]
+        Lb = tokens.shape[0]
+        ai = jnp.arange(A)
+        # global positions of the suffix rows; clip keeps the padded
+        # tail's wpe lookups in range (those rows never reach logits)
+        g = hist + jnp.arange(Lb, dtype=jnp.int32)
+        gpos = jnp.clip(g, 0, cfg.max_seq_len - 1)
+        x = jnp.take(p["wte"], tokens, axis=0) \
+            + jnp.take(p["wpe"], gpos, axis=0)               # [Lb, H]
+        # scatter targets: suffix row j lands in table slot g//BS at
+        # offset g%BS; padding rows (j >= length) land in null block 0
+        slot_of = jnp.clip(g // BS, 0, MAXB - 1)
+        blk = jnp.where(jnp.arange(Lb) < length, table[slot_of], 0)
+        off = jnp.clip(g, 0, T - 1) % BS
+        # causal mask over the gathered context: key index t is a
+        # GLOBAL position (table slot u holds positions [u*BS,(u+1)*BS))
+        cm = jnp.arange(T)[None, :] <= g[:, None]            # [Lb, T]
+        # rows past hist+length are unwritten blocks / null-block trash
+        valid = jnp.arange(T)[None, :] < hist + length       # [1, T]
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            qkv = jnp.transpose(qkv.reshape(Lb, A, 3 * D), (1, 0, 2))
+            q, k, v = jnp.split(qkv, 3, axis=-1)             # [A, Lb, D]
+            # write the suffix K/V FIRST, then gather the whole table —
+            # suffix self-attention reads its own fresh rows
+            kc = kc.at[i, blk[None, :], ai[:, None], off[None, :]].set(
+                k.astype(kc.dtype))
+            vc = vc.at[i, blk[None, :], ai[:, None], off[None, :]].set(
+                v.astype(vc.dtype))
+            ctx_k = jnp.transpose(kc[i][table], (1, 0, 2, 3)) \
+                .reshape(A, T, D)
+            ctx_v = jnp.transpose(vc[i][table], (1, 0, 2, 3)) \
+                .reshape(A, T, D)
+            # zero unwritten rows BEFORE the matmuls: null-block trash
+            # (even NaN-poisoned) must not reach any reduction
+            ctx_k = jnp.where(valid[0][:, None], ctx_k, 0)
+            ctx_v = jnp.where(valid[0][:, None], ctx_v, 0)
+            scores = jnp.einsum(
+                "aqd,akd->aqk", q, ctx_k,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(cm[None], scores, jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
+            att = jnp.einsum("aqk,akd->aqd", probs, ctx_v)
+            att = jnp.transpose(att, (1, 0, 2)).reshape(Lb, H)
+            att = att @ p[f"{sc}/attn/proj/kernel"] \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        h_last = jax.lax.dynamic_slice_in_dim(
+            x, jnp.maximum(length - 1, 0), 1, axis=0)        # [1, H]
+        logits = _logits(p, h_last)[0]
+        return kc, vc, jnp.argmax(logits).astype(jnp.int32), logits
+
+    def decode_fn(params, kc, vc, io):
+        p = params
+        tokens, active = io["tokens"], io["active"]
+        tables = io["tables"]                                # [S, MAXB]
+        wb, wo = io["write_block"], io["write_off"]
+        S = tokens.shape[0]
+        pos = jnp.clip(io["positions"], 0, cfg.max_seq_len - 1)
+        x = jnp.take(p["wte"], tokens, axis=0) \
+            + jnp.take(p["wpe"], pos, axis=0)                # [S, H]
+        ai = jnp.arange(A)
+        # attend to global index <= position; later table rows are
+        # unwritten blocks or another layer of the null block
+        mask = jnp.arange(T)[None, None, :] <= pos[:, None, None]
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            q, k, v = jnp.split(qkv.reshape(S, A, 3 * D), 3, axis=-1)
+            # unconditional scatter: the host points inactive lanes at
+            # the null block, so no active request's rows are touched
+            # (active lanes own disjoint blocks — no write collisions)
+            kc = kc.at[i, wb[:, None], ai[None, :], wo[:, None]].set(
+                k.astype(kc.dtype))
+            vc = vc.at[i, wb[:, None], ai[None, :], wo[:, None]].set(
+                v.astype(vc.dtype))
+            ctx_k = jnp.transpose(kc[i][tables], (0, 2, 1, 3, 4)) \
+                .reshape(S, A, T, D)
+            ctx_v = jnp.transpose(vc[i][tables], (0, 2, 1, 3, 4)) \
+                .reshape(S, A, T, D)
+            scores = jnp.einsum(
+                "sad,satd->sat", q, ctx_k,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask, scores, jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
+            # zero masked V rows — same poisoned-slab-reuse discipline
+            # as the slotted decode: weight 0 x NaN trash is still NaN
+            v_safe = jnp.where(mask[..., None], ctx_v, 0)
+            att = jnp.einsum("sat,satd->sad", probs, v_safe)
+            att = att.reshape(S, H)
+            att = att @ p[f"{sc}/attn/proj/kernel"] \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        logits = _logits(p, x)                               # [S, vocab]
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            logits
+
+    return prefill_fn, decode_fn
+
+
+def gpt_paged_spec(sd, cfg: GPTConfig):
+    """The PAGED decode-mode graph hook: a
+    :class:`~deeplearning4j_tpu.serving.paged.PagedGenerativeSpec` over
+    a trained :func:`build_gpt` graph — what
+    ``serving.paged.PagedGenerativeServer`` consumes. Same by-name
+    parameter sync as :func:`gpt_generative_spec`; the decode functions
+    are built per (block_size, max_blocks_per_req) geometry by the
+    server (and memoized, so every server over the same model and
+    geometry shares one compile set)."""
+    from deeplearning4j_tpu.serving.paged import PagedGenerativeSpec
+
+    names = gpt_param_names(cfg)
+    missing = [n for n in names if n not in sd._arrays]
+    if missing:
+        raise ValueError(
+            f"graph is missing decode parameters {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''} — was it built by "
+            f"zoo.gpt.build_gpt with this config?")
+    return PagedGenerativeSpec(
+        params=lambda: {n: sd._arrays[n] for n in names},
+        make_fns=lambda block_size, max_blocks: gpt_paged_decode_fns(
+            cfg, block_size, max_blocks),
+        kv_shape=lambda num_blocks, block_size: (
+            cfg.num_layers, int(num_blocks), cfg.num_heads,
+            int(block_size), cfg.head_size),
+        vocab_size=cfg.vocab_size,
+        max_seq_len=cfg.max_seq_len,
+        num_heads=cfg.num_heads)
+
+
 def gpt_generative_spec(sd, cfg: GPTConfig):
     """The decode-mode graph hook: a
     :class:`~deeplearning4j_tpu.serving.generative.GenerativeSpec` over

@@ -26,8 +26,8 @@ from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
                                         gpt_generative_spec)
 
 VOCAB, SEQ = 96, 16
-cfg = GPTConfig(vocab_size=VOCAB, hidden_size=48, num_layers=2,
-                num_heads=4, intermediate_size=96, max_seq_len=48)
+cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                num_heads=2, intermediate_size=64, max_seq_len=32)
 
 # -- 1. train briefly on random token sequences -------------------------
 sd = build_gpt(cfg, batch=4, seq_len=SEQ, seed=0)
@@ -39,19 +39,19 @@ rng = np.random.default_rng(0)
 ids = rng.integers(0, VOCAB, (8, SEQ)).astype(np.int32)
 tgt = rng.integers(0, VOCAB, (8, SEQ)).astype(np.int32)
 hist = sd.fit(DeviceCachedIterator([ids], [tgt], batch_size=4),
-              epochs=3)
-print(f"trained 3 epochs; final loss "
+              epochs=2)
+print(f"trained 2 epochs; final loss "
       f"{hist.loss_curve.losses[-1]:.4f}")
 
 # -- 2. serve it: decode-mode spec + continuous-batching server ---------
 spec = gpt_generative_spec(sd, cfg)
-server = GenerativeServer(spec, max_slots=4, max_seq_len=48,
+server = GenerativeServer(spec, max_slots=4, max_seq_len=32,
                           warmup=True)
 print(f"warmup: {server.warmup_report['prefill_buckets']} prefill "
       f"buckets + 1 decode program in "
       f"{server.warmup_report['seconds']:.2f}s")
 print(f"KV slabs: {server.memory_report()['kv_slab_bytes'] / 1024:.0f} "
-      f"KiB for {server.max_slots} slots x 48 positions")
+      f"KiB for {server.max_slots} slots x 32 positions")
 
 # -- 3. mixed-length concurrent requests, streamed ----------------------
 prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 12)))
@@ -68,7 +68,7 @@ for i, h in enumerate(handles):
 
 # -- 4. bit-identical to the unbatched reference ------------------------
 for i, (p, n) in enumerate(zip(prompts, budgets)):
-    ref = greedy_decode(spec, p, n, max_seq_len=48)
+    ref = greedy_decode(spec, p, n, max_seq_len=32)
     assert streamed[i] == ref, (i, streamed[i], ref)
 print("all 6 continuous-batched generations == unbatched greedy_decode")
 

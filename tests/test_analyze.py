@@ -231,6 +231,27 @@ def _seed_tensorstats_unobserved():
             "tensorstats", "no listeners")
 
 
+def _seed_dense_kv_exceeds_headroom():
+    from deeplearning4j_tpu.analyze import analyze_generative_config
+    from deeplearning4j_tpu.serving.generative import GenerativeSpec
+    spec = GenerativeSpec(
+        params=dict, prefill=None, decode=None,
+        kv_shape=lambda slots, seq: (2, slots, 2, seq, 16),
+        vocab_size=64, max_seq_len=4096)
+    # 64 slots x 4096 positions of f32 KV = 128 MiB vs a 64 MiB budget
+    rep = analyze_generative_config(spec, max_slots=64,
+                                    headroom_bytes=64 * 2**20)
+    assert rep.context == "serving_config" and rep.rules_run == 1
+    # the same plan under a roomy budget is clean, and CPU (no device
+    # limit -> headroom None) is a no-op like the construction guard
+    assert not analyze_generative_config(
+        spec, max_slots=64, headroom_bytes=1 << 40).findings
+    f = [x for x in rep.findings
+         if x.rule_id == "serving.dense_kv_exceeds_headroom"][0]
+    assert "paged" in f.fix_hint         # the hint IS the point
+    return rep, "kv_slab[64x4096]", "headroom guard"
+
+
 CORPUS = {
     "graph.shape_mismatch": _seed_shape_mismatch,
     "graph.undefined_input": _seed_undefined_input,
@@ -252,6 +273,7 @@ CORPUS = {
     "config.sharding_unmatched_rule": _seed_sharding_unmatched_rule,
     "config.chaos_armed": _seed_chaos_armed,
     "config.tensorstats_unobserved": _seed_tensorstats_unobserved,
+    "serving.dense_kv_exceeds_headroom": _seed_dense_kv_exceeds_headroom,
 }
 
 
@@ -416,11 +438,13 @@ class TestModelSweep:
         from deeplearning4j_tpu.analyze import _INFERENCE_RULES
         assert rep.rules_run == len(_INFERENCE_RULES) == 9
         # ... and a config-less training analysis skips config rules
+        # (and the serving-capacity rules, which only run under
+        # analyze_generative_config)
         bare = SameDiff()
         p = bare.placeholder("p", shape=(-1, 4))
         p.mean(name="loss")
         bare.set_loss_variables(["loss"])
-        assert analyze_training(bare).rules_run == len(RULES) - 8
+        assert analyze_training(bare).rules_run == len(RULES) - 8 - 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,499 @@
+"""Paged KV serving (serving/paged/, ISSUE 16).
+
+Pinned contracts:
+
+- allocator discipline: refcounted blocks freed exactly once (a second
+  release raises), pool exhaustion under admission pressure sheds TYPED
+  (:class:`PoolExhaustedError` with ``retry_after_s``) instead of
+  crashing a worker, and the full accounting invariant (free + held +
+  evictable == capacity, refcounts == live-table occurrences) holds
+  after every scheduler step under ``debug_leaks=True`` — through
+  completion, shed, cancel AND crash-recovery requeue;
+- block tables grow on demand at decode-step boundaries across every
+  pow2 prefill bucket;
+- prefix caching: chain-hashed full blocks are shared by refcount,
+  survive interleaved admit/complete churn, skip their prefill (a hit
+  dispatches the small SUFFIX bucket, not the full-prompt bucket), and
+  never change greedy output;
+- greedy tokens are IDENTICAL to the dense server's reference
+  (:func:`greedy_decode`) — paged vs dense is a memory-layout change,
+  not a numerics change — including under tensor parallelism (tp=2 on
+  the virtual 8-device CPU mesh).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.generative import greedy_decode
+from deeplearning4j_tpu.serving.paged import (NULL_BLOCK, BlockPool,
+                                              PagedGenerativeServer,
+                                              PagedMetrics,
+                                              PoolExhaustedError,
+                                              blocks_for_tokens,
+                                              prefix_block_hashes)
+from deeplearning4j_tpu.serving.queue import ServerOverloadedError
+from deeplearning4j_tpu.serving.resilience import ResilienceConfig
+from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                        gpt_generative_spec,
+                                        gpt_paged_spec)
+
+CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_seq_len=32)
+MSL = 32
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def gpt_sd():
+    return build_gpt(CFG, batch=2, seq_len=8, seed=0)
+
+
+@pytest.fixture(scope="module")
+def spec(gpt_sd):
+    # one spec for the whole module: the jitted programs are memoized
+    # per (spec, geometry), so every server below shares one compile set
+    return gpt_paged_spec(gpt_sd, CFG)
+
+
+@pytest.fixture(scope="module")
+def dense_spec(gpt_sd):
+    return gpt_generative_spec(gpt_sd, CFG)
+
+
+def make_server(spec, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", MSL)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("warmup", False)
+    kw.setdefault("debug_leaks", True)
+    return PagedGenerativeServer(spec, **kw)
+
+
+def ref_tokens(dense_spec, prompt, n):
+    return greedy_decode(dense_spec, prompt, n, max_seq_len=MSL)
+
+
+def mixed_prompts(n=6, seed=0, max_len=12):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size,
+                         int(rng.integers(1, max_len + 1)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def wait_uncommitted(srv, timeout=10.0):
+    """Block-commitment release rides the request future's done
+    callback, which CPython fires AFTER result() waiters wake — give
+    the callbacks a moment before asserting on ``_committed``."""
+    deadline = time.monotonic() + timeout
+    while srv._committed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return srv._committed
+
+
+# ----------------------------------------------------------------------
+class TestBlockPool:
+    def test_alloc_release_cycle(self):
+        p = BlockPool(5, 4)
+        assert p.capacity == 4 and p.free_count() == 4
+        blocks = [p.alloc() for _ in range(4)]
+        assert NULL_BLOCK not in blocks
+        assert len(set(blocks)) == 4 and p.free_count() == 0
+        with pytest.raises(PoolExhaustedError) as ei:
+            p.alloc()
+        assert ei.value.retry_after_s > 0
+        assert isinstance(ei.value, ServerOverloadedError)
+        for b in blocks:
+            p.release(b)
+        assert p.free_count() == 4
+        p.check_invariant(tables=[])
+
+    def test_double_free_raises(self):
+        p = BlockPool(4, 2)
+        b = p.alloc()
+        p.release(b)
+        with pytest.raises(RuntimeError, match="released twice"):
+            p.release(b)
+
+    def test_refcount_shared_block(self):
+        p = BlockPool(4, 2)
+        b = p.alloc()
+        p.retain(b)
+        p.release(b)
+        assert p.held_count() == 1          # still held by one reader
+        p.release(b)
+        assert p.held_count() == 0 and p.free_count() == 3
+        with pytest.raises(RuntimeError):
+            p.retain(b)                      # retaining a free block
+
+    def test_null_block_never_allocated(self):
+        p = BlockPool(8, 2)
+        got = {p.alloc() for _ in range(p.capacity)}
+        assert NULL_BLOCK not in got
+        with pytest.raises(ValueError):
+            p.retain(NULL_BLOCK)
+
+    def test_prefix_register_lookup_evict_lru(self):
+        p = BlockPool(4, 2)                  # 3 usable blocks
+        toks = np.arange(6, dtype=np.int32)
+        hashes = prefix_block_hashes(toks, 2)
+        assert len(hashes) == 3
+        blocks = [p.alloc() for _ in range(3)]
+        for h, b in zip(hashes, blocks):
+            assert p.register(h, b)
+        # a second registration of the same hash leaves the cache alone
+        assert not p.register(hashes[0], blocks[1])
+        for b in blocks:
+            p.release(b)                     # refcount 0 -> evictable
+        assert p.free_count() == 0 and p.usable_free_count() == 3
+        hit = p.lookup(hashes)               # revives all three
+        assert hit == blocks
+        for b in hit:
+            p.release(b)
+        # pool pressure reclaims the LRU-released cached block first
+        fresh = p.alloc()
+        assert fresh == blocks[0] and p.evictions == 1
+        # its hash is gone, and a chain lookup stops at the first miss
+        assert p.lookup(hashes) == []
+        p.release(fresh)
+        p.check_invariant()
+
+    def test_chain_hashes_depend_on_prefix(self):
+        a = prefix_block_hashes(np.array([1, 2, 3, 4], np.int32), 2)
+        b = prefix_block_hashes(np.array([9, 9, 3, 4], np.int32), 2)
+        assert a[0] != b[0]
+        assert a[1] != b[1]        # same block tokens, different prefix
+
+    def test_partial_trailing_block_never_hashed(self):
+        assert len(prefix_block_hashes(np.arange(7), 2)) == 3
+        assert len(prefix_block_hashes(np.arange(1), 2)) == 0
+
+    def test_reset_clears_everything(self):
+        p = BlockPool(4, 2)
+        b = p.alloc()
+        p.register(prefix_block_hashes(np.arange(2), 2)[0], b)
+        p.reset()
+        assert p.free_count() == 3 and p.cached_count() == 0
+        p.check_invariant(tables=[])
+
+    def test_invariant_catches_seeded_leak(self):
+        p = BlockPool(4, 2)
+        b = p.alloc()
+        with pytest.raises(AssertionError, match="diverge"):
+            p.check_invariant(tables=[])     # held block in no table
+        p.check_invariant(tables=[[b]])
+
+    def test_blocks_for_tokens(self):
+        assert blocks_for_tokens(1, 8) == 1
+        assert blocks_for_tokens(8, 8) == 1
+        assert blocks_for_tokens(9, 8) == 2
+
+
+# ----------------------------------------------------------------------
+class TestGreedyParity:
+    @pytest.mark.slow
+    def test_mixed_lengths_match_dense_reference(self, spec, dense_spec):
+        # random mixed lengths + the degenerate/bucket-edge prompts the
+        # tier-1 growth test drops for wall budget (1 token, exact
+        # bucket edges 3 -> 4 and 16 -> 16)
+        prompts = mixed_prompts(6) + [
+            np.arange(L, dtype=np.int32) % CFG.vocab_size
+            for L in (1, 3, 16)]
+        with make_server(spec, num_blocks=64) as srv:
+            handles = [srv.submit(p, max_new_tokens=8) for p in prompts]
+            got = [h.result(timeout=60) for h in handles]
+        assert got == [ref_tokens(dense_spec, p, 8) for p in prompts]
+
+    def test_table_growth_across_buckets(self, spec, dense_spec):
+        """Prompts landing in every pow2 prefill bucket, each decoding
+        across at least one block boundary — growth at the step
+        boundary keeps tokens identical to the dense reference.
+        (The full per-bucket matrix incl. the degenerate 1-token and
+        exact-bucket-edge prompts lives in the slow-tier mixed-lengths
+        test; this keeps one spanning set inside the tier-1 budget.)"""
+        lengths = [2, 5, 9, 17]                # buckets 2, 8, 16, 32
+        prompts = [np.arange(L, dtype=np.int32) % CFG.vocab_size
+                   for L in lengths]
+        with make_server(spec, num_blocks=64) as srv:
+            handles = [srv.submit(p, max_new_tokens=10) for p in prompts]
+            got = [h.result(timeout=60) for h in handles]
+        assert got == [ref_tokens(dense_spec, p, 10) for p in prompts]
+
+    def test_pool_drains_clean_after_traffic(self, spec):
+        srv = make_server(spec, num_blocks=64)
+        hs = [srv.submit(p, max_new_tokens=6) for p in mixed_prompts(8)]
+        for h in hs:
+            h.result(timeout=60)
+        srv.shutdown()
+        st = srv.pool.stats()
+        assert st["held"] == 0, st
+        assert wait_uncommitted(srv) == 0
+        srv.pool.check_invariant(tables=[])
+
+
+# ----------------------------------------------------------------------
+class TestPrefixCache:
+    @pytest.mark.slow
+    def test_repeat_prefix_hits_and_matches(self, spec, dense_spec):
+        sys_prompt = (np.arange(17, dtype=np.int32) * 3) % CFG.vocab_size
+        with make_server(spec) as srv:
+            a = srv.submit(sys_prompt, max_new_tokens=6).result(timeout=60)
+            b = srv.submit(sys_prompt, max_new_tokens=6).result(timeout=60)
+        ref = ref_tokens(dense_spec, sys_prompt, 6)
+        assert a == ref and b == ref
+        rec = srv.metrics.to_record()["paged"]
+        # 17 tokens = 2 full blocks of 8; the repeat reuses both (reuse
+        # is capped at (L-1)//BS so >= 1 suffix token still prefills)
+        assert rec["prefix_hit_rate"] > 0
+        assert rec["prefix_blocks_hit"] == 2
+
+    def test_hit_skips_prefill_to_suffix_bucket(self, spec):
+        """A prefix hit dispatches the SUFFIX bucket (near-one-decode-
+        step TTFT on repeats), not the full-prompt bucket — observable
+        in the prefill shapes the server actually ran."""
+        prompt = (np.arange(17, dtype=np.int32) * 5) % CFG.vocab_size
+        with make_server(spec) as srv:
+            srv.submit(prompt, max_new_tokens=2).result(timeout=60)
+            before = set(srv._shapes_seen)
+            srv.submit(prompt, max_new_tokens=2).result(timeout=60)
+            new_shapes = srv._shapes_seen - before
+        # 17 tokens cold runs bucket 32; the repeat reuses 2 blocks and
+        # prefills only its 1-token suffix -> the ONLY new prefill
+        # shape is bucket 1 ("hist" marks prefill signatures)
+        new_buckets = {dict(s)["tokens"][0] for s in new_shapes
+                       if "hist" in dict(s)}
+        assert new_buckets == {1}
+
+    @pytest.mark.slow
+    def test_refcount_churn_interleaved_admit_complete(
+            self, spec, dense_spec):
+        """Many concurrent requests sharing one prefix, admitted and
+        retired in interleaved waves through 3 slots: the shared
+        blocks' refcounts drain to exactly zero, under the every-step
+        invariant check."""
+        shared = (np.arange(16, dtype=np.int32) * 7) % CFG.vocab_size
+        rng = np.random.default_rng(3)
+        prompts = [np.concatenate([shared,
+                                   rng.integers(0, CFG.vocab_size,
+                                                int(rng.integers(1, 6)))
+                                   .astype(np.int32)])
+                   for _ in range(10)]
+        budgets = [int(rng.integers(1, 8)) for _ in prompts]
+        with make_server(spec, max_slots=3, num_blocks=64) as srv:
+            handles = [srv.submit(p, max_new_tokens=n)
+                       for p, n in zip(prompts, budgets)]
+            got = [h.result(timeout=120) for h in handles]
+        assert got == [ref_tokens(dense_spec, p, n)
+                       for p, n in zip(prompts, budgets)]
+        st = srv.pool.stats()
+        assert st["held"] == 0, st
+        srv.pool.check_invariant(tables=[])
+
+    def test_disabled_cache_never_hits(self, spec):
+        prompt = (np.arange(17, dtype=np.int32) * 3) % CFG.vocab_size
+        with make_server(spec, prefix_cache=False) as srv:
+            srv.submit(prompt, max_new_tokens=2).result(timeout=60)
+            srv.submit(prompt, max_new_tokens=2).result(timeout=60)
+        rec = srv.metrics.to_record()["paged"]
+        assert rec["prefix_hit_rate"] == 0.0
+        assert rec["cached_blocks"] == 0
+
+
+# ----------------------------------------------------------------------
+class TestPoolPressure:
+    def test_exhaustion_sheds_typed_not_crash(self, spec):
+        """A pool too small for the offered worst-case load sheds at
+        SUBMIT with a retry_after_s hint — no worker crash — and the
+        shed client's retry succeeds once completions release their
+        commitment."""
+        # capacity 8 blocks; each request commits ceil((12+8)/8) = 3
+        # blocks worst-case -> two fit, the third sheds. start=False
+        # keeps the accounting deterministic (nothing completes early)
+        srv = make_server(spec, max_slots=4, num_blocks=9, start=False)
+        try:
+            p = np.arange(12, dtype=np.int32)
+            h1 = srv.submit(p, max_new_tokens=8)
+            h2 = srv.submit(p + 1, max_new_tokens=8)
+            with pytest.raises(PoolExhaustedError) as ei:
+                srv.submit(p + 2, max_new_tokens=8)
+            assert ei.value.retry_after_s > 0
+            srv.start()
+            assert h1.result(timeout=60) and h2.result(timeout=60)
+            # completions released their commitment: the retry now fits
+            assert wait_uncommitted(srv) == 0
+            h3 = srv.submit(p + 2, max_new_tokens=8)
+            assert h3.result(timeout=60)
+        finally:
+            srv.shutdown()
+        assert srv.metrics.counters["requests_shed"] >= 1
+        assert wait_uncommitted(srv) == 0
+
+    @pytest.mark.slow
+    def test_shed_clients_retrying_all_complete(self, spec, dense_spec):
+        """Admission-pressure end-to-end: 8 clients against a pool
+        that holds ~3 requests' worst case, each retrying on typed
+        shed with the server's own backoff hint — everything completes
+        with reference tokens and the pool drains clean."""
+        prompts = mixed_prompts(8, seed=5, max_len=8)
+        with make_server(spec, max_slots=3, num_blocks=7) as srv:
+            handles = []
+            deadline = time.monotonic() + 120
+            for p in prompts:
+                while True:
+                    assert time.monotonic() < deadline, "retry wedged"
+                    try:
+                        handles.append(srv.submit(p, max_new_tokens=4))
+                        break
+                    except PoolExhaustedError as e:
+                        time.sleep(min(e.retry_after_s, 0.05))
+            got = [h.result(timeout=120) for h in handles]
+        assert got == [ref_tokens(dense_spec, p, 4) for p in prompts]
+        assert srv.pool.stats()["held"] == 0
+        assert wait_uncommitted(srv) == 0
+
+    def test_failed_submit_rolls_back_commitment(self, spec):
+        with make_server(spec, max_slots=4, num_blocks=9) as srv:
+            with pytest.raises(ValueError):     # out-of-vocab prompt
+                srv.submit(np.asarray([999]), max_new_tokens=4)
+            assert srv._committed == 0
+
+
+# ----------------------------------------------------------------------
+class TestLifecycleRelease:
+    def test_cancel_releases_blocks_once(self, spec):
+        with make_server(spec) as srv:
+            h = srv.submit(np.arange(9, dtype=np.int32),
+                           max_new_tokens=30)
+            next(iter(h.tokens(timeout=30)))      # it is in flight
+            h.cancel()
+            h.result(timeout=30)                  # partial token list
+        assert srv.pool.stats()["held"] == 0
+        assert wait_uncommitted(srv) == 0
+        srv.pool.check_invariant(tables=[])
+
+    def test_deadline_expiry_releases_blocks(self, spec):
+        with make_server(spec) as srv:
+            h = srv.submit(np.arange(6, dtype=np.int32),
+                           max_new_tokens=25, timeout_ms=30.0)
+            try:
+                h.result(timeout=60)
+            except Exception:
+                pass     # timed out or not — either way nothing leaks
+        assert srv.pool.stats()["held"] == 0
+        assert wait_uncommitted(srv) == 0
+
+    @pytest.mark.chaos
+    def test_crash_requeue_releases_blocks_exactly_once(
+            self, spec, dense_spec):
+        """Kill the decode worker mid-generation: the pool hard-resets
+        (every held block back exactly once, the prefix cache — which
+        addresses now-garbage slab rows — dropped wholesale), the
+        in-flight requests requeue at prefill exactly once, tokens
+        still match the reference, and the accounting invariant holds
+        on the respawned worker's every step."""
+        prompts = mixed_prompts(4, seed=7)
+        srv = make_server(spec, start=False,
+                          resilience=ResilienceConfig(
+                              worker_backoff_base_s=0.01,
+                              worker_backoff_max_s=0.05))
+        real = srv._decode_disp
+        state = {"calls": 0, "fired": False}
+
+        class CrashOnce:
+            def __call__(self, *args):
+                state["calls"] += 1
+                if not state["fired"] and state["calls"] > 2:
+                    state["fired"] = True
+                    raise RuntimeError("chaos: decode worker dies")
+                return real(*args)
+
+        srv._decode_disp = CrashOnce()
+        try:
+            srv.start()
+            handles = [srv.submit(p, max_new_tokens=8) for p in prompts]
+            got = [h.result(timeout=120) for h in handles]
+        finally:
+            srv.shutdown()
+        assert state["fired"]
+        assert got == [ref_tokens(dense_spec, p, 8) for p in prompts]
+        assert srv.metrics.counters["worker_restarts"] >= 1
+        assert srv.metrics.counters["requests_requeued"] >= 1
+        assert srv.pool.stats()["held"] == 0, srv.pool.stats()
+        assert wait_uncommitted(srv) == 0
+        srv.pool.check_invariant(tables=[])
+
+
+# ----------------------------------------------------------------------
+class TestTensorParallel:
+    @pytest.mark.slow
+    def test_tp2_bit_identical_greedy(self, spec, dense_spec):
+        """gpt served with tp=2 over the virtual CPU mesh produces the
+        dense single-chip reference tokens, with sharded params + KV
+        slabs and ZERO traffic compiles after the sharded AOT warmup."""
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        prompts = mixed_prompts(5, seed=9)
+        with make_server(spec, tp=2, num_blocks=64, warmup=True) as srv:
+            assert srv._strategy is not None
+            handles = [srv.submit(p, max_new_tokens=8) for p in prompts]
+            got = [h.result(timeout=120) for h in handles]
+            assert srv.metrics.counters["compiles"] == 0
+        assert got == [ref_tokens(dense_spec, p, 8) for p in prompts]
+
+    def test_tp_must_divide_heads(self, spec):
+        with pytest.raises(ValueError, match="num_heads"):
+            make_server(spec, tp=3)            # 2 heads % 3 != 0
+
+
+# ----------------------------------------------------------------------
+class TestMetricsAndReports:
+    def test_paged_record_cold_start_no_nans(self):
+        rec = PagedMetrics(4, 16, 8).to_record()
+        p = rec["paged"]
+        for k, v in p.items():
+            assert v == v, f"NaN in cold paged record: {k}"
+        assert p["pool_occupancy"] == 0.0
+        assert p["prefix_hit_rate"] == 0.0
+        assert p["blocks_per_request"] == 0.0
+
+    def test_fold_serving_exports_paged_and_low_sample(self):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        m = PagedMetrics(4, 16, 8)
+        m.observe_pool(4, stats={"cached": 1, "evictions": 0})
+        m.observe_prefix(True, 2)
+        m.observe_ttft(5.0)                  # 1 sample -> low_sample
+        reg = MetricsRegistry()
+        reg.fold_serving(m)
+        text = reg.to_prometheus_text()
+        for needle in ("dl4j_serving_pool_blocks",
+                       "dl4j_serving_pool_occupancy_ratio",
+                       "dl4j_serving_prefix_hit_rate",
+                       "dl4j_serving_blocks_per_request",
+                       "dl4j_serving_pool_cached_blocks",
+                       "dl4j_serving_latency_count",
+                       "dl4j_serving_latency_low_sample"):
+            assert needle in text, needle
+        assert "nan" not in text.lower()
+
+    def test_report_renders_paged_panel(self, spec):
+        from deeplearning4j_tpu.ui.report import render_report
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        storage = StatsStorage()
+        with make_server(spec, stats_storage=storage) as srv:
+            srv.generate(np.arange(9, dtype=np.int32), max_new_tokens=4)
+        html = render_report(storage)
+        assert "paged KV" in html
+        assert "prefix hit" in html
+
+    @pytest.mark.slow
+    def test_memory_report_block_accounting(self, spec):
+        with make_server(spec, num_blocks=32) as srv:
+            srv.submit(np.arange(9, dtype=np.int32),
+                       max_new_tokens=2).result(timeout=60)
+            rep = srv.memory_report()
+        assert rep["num_blocks"] == 31
+        assert rep["block_size"] == BS
+        assert rep["kv_bytes_per_block"] > 0
+        assert rep["blocks_free"] + rep["blocks_held"] \
+            + rep["blocks_evictable"] == 31

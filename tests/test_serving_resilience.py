@@ -738,7 +738,18 @@ def test_hot_reload_mid_traffic_drops_nothing(tmp_path):
         t.join(timeout=10)
         assert report["step"] == 1 and report["arrays_swapped"] > 0
         assert report["rolled_back"] is False
-        assert _ulp_equal(pi.output(x), ckpt_out)
+        # the streamer may have filled the queue faster than workers
+        # drain on a loaded machine — the probe backs off like any
+        # well-behaved client instead of failing on the typed shed
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                probe = pi.output(x)
+                break
+            except ServerOverloadedError:
+                assert time.monotonic() < deadline, "queue never drained"
+                time.sleep(0.01)
+        assert _ulp_equal(probe, ckpt_out)
         # zero dropped: every streamed request resolved with a real
         # answer (pre-swap params or post-swap params, nothing else)
         assert results
