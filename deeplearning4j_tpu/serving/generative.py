@@ -647,15 +647,13 @@ class GenerativeServer:
         return self.warmup_report
 
     # -- client API -----------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int = 16,
-               timeout_ms: Optional[float] = None,
-               on_token: Optional[Callable[[int], None]] = None,
-               eos_id: Optional[int] = None) -> GenerationHandle:
-        """Enqueue one generation; returns a :class:`GenerationHandle`
-        streaming tokens as they decode. Sheds typed at the call site:
-        :class:`ServerOverloadedError` when the queue is full or the
-        estimated TTFT (queue depth × rolling p99 decode-step time)
-        already exceeds the deadline."""
+    def _validate_submit(self, prompt, max_new_tokens: int) -> np.ndarray:
+        """The cheap permanent-error checks every submit path runs
+        BEFORE any capacity accounting, returning the coerced prompt.
+        Shared so the paged subclass can validate ahead of its block
+        commitment: an invalid request must surface its ValueError (a
+        permanent rejection) even under pool pressure, never a
+        retryable overload shed."""
         if self._closed:
             raise ServerClosedError("GenerativeServer is shut down")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -670,6 +668,18 @@ class GenerativeServer:
                 f"prompt token ids must be in [0, {self.spec.vocab_size})")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        return prompt
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               timeout_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               eos_id: Optional[int] = None) -> GenerationHandle:
+        """Enqueue one generation; returns a :class:`GenerationHandle`
+        streaming tokens as they decode. Sheds typed at the call site:
+        :class:`ServerOverloadedError` when the queue is full or the
+        estimated TTFT (queue depth × rolling p99 decode-step time)
+        already exceeds the deadline."""
+        prompt = self._validate_submit(prompt, max_new_tokens)
         self.metrics.inc("requests_submitted")
         timeout_ms = timeout_ms if timeout_ms is not None \
             else self.default_timeout_ms

@@ -214,6 +214,23 @@ class BlockPool:
         if h is not None:
             self._by_hash.pop(h, None)
 
+    def flush_cache(self) -> int:
+        """Drop every prefix-cache registration — the hot-reload path:
+        cached blocks content-address K/V computed with superseded
+        weights, so no FUTURE lookup may reuse them. Evictable blocks
+        (refcount 0, kept alive only by their registration) return to
+        the free list; held shared blocks keep their refcounts so
+        in-flight readers finish — the same accepted in-flight
+        staleness as the dense server's ``update_model`` — and, now
+        unregistered, go straight back to the free list on their last
+        release. Returns the number of registrations dropped."""
+        dropped = len(self._by_hash)
+        self._by_hash.clear()
+        self._hash_of.clear()
+        self._free.extend(self._evictable)
+        self._evictable.clear()
+        return dropped
+
     # -- lifecycle ------------------------------------------------------
     def reset(self) -> None:
         """Forget everything — the crash-recovery path: a respawned

@@ -29,7 +29,10 @@ resilience plumbing unchanged:
   so repeated-prefix TTFT approaches one decode step. Refcounts release
   exactly once on completion, shed, cancel AND crash-recovery requeue
   (``pool.reset()`` on worker respawn — the slab is mid-dispatch
-  garbage, so the cache addressing its contents drops wholesale).
+  garbage, so the cache addressing its contents drops wholesale); a
+  hot reload (``update_model``) fences the cache too — cached K/V
+  belong to the superseded weights, so the worker flushes every
+  registration at its next step boundary before admitting anyone.
 - **tensor parallel** — ``tp > 1`` builds a ``{model: tp}`` mesh from
   the PR-7 :class:`~deeplearning4j_tpu.parallel.sharding.ShardingSpec`
   ("transformer" preset: qkv/fc column, proj row, wte vocab-sharded),
@@ -137,6 +140,7 @@ class PagedMetrics(GenerativeMetrics):
         self.num_blocks = int(num_blocks)     # usable (non-null) blocks
         self.block_size = int(block_size)
         for c in ("prefix_lookups", "prefix_hits", "prefix_blocks_hit",
+                  "prefix_cache_flushes",
                   "blocks_allocated", "blocks_released",
                   "blocks_held_sum", "pool_samples",
                   "request_blocks_sum", "requests_retired"):
@@ -186,6 +190,7 @@ class PagedMetrics(GenerativeMetrics):
                     c["request_blocks_sum"], c["requests_retired"]), 3),
                 "blocks_allocated": c["blocks_allocated"],
                 "blocks_released": c["blocks_released"],
+                "prefix_cache_flushes": c["prefix_cache_flushes"],
                 "evictions": self._pool_stats.get("evictions", 0),
                 "cached_blocks": self._pool_stats.get("cached", 0),
                 "held_blocks": self._pool_stats.get("held", 0)}
@@ -261,6 +266,9 @@ class PagedGenerativeServer(GenerativeServer):
         self._kv_sharding = None
         self._commit_lock = threading.Lock()
         self._committed = 0          # reserved worst-case blocks
+        # hot-reload fence: set by update_model(), consumed by the
+        # worker at its next step boundary (the pool is worker-owned)
+        self._prefix_flush_pending = threading.Event()
         super().__init__(spec, max_slots=max_slots, **kw)
 
     # -- hook overrides -------------------------------------------------
@@ -401,8 +409,14 @@ class PagedGenerativeServer(GenerativeServer):
         backoff hint — instead of crashing a worker later. The
         reservation is released exactly once, whenever the request's
         future resolves (success, failure, timeout, shed, cancel, or a
-        second-crash fail — every resolution path sets the future)."""
-        p = np.asarray(prompt, np.int32).reshape(-1)
+        second-crash fail — every resolution path sets the future).
+
+        Validation runs BEFORE the commitment: a request that could
+        never run (empty/over-long/out-of-vocab prompt, zero token
+        budget) raises its permanent ValueError even when the pool is
+        fully committed, instead of masquerading as a retryable
+        overload shed."""
+        p = self._validate_submit(prompt, max_new_tokens)
         need = self._worst_case_blocks(p.size, max_new_tokens)
         with self._commit_lock:
             if self._committed + need > self.pool.capacity:
@@ -418,7 +432,7 @@ class PagedGenerativeServer(GenerativeServer):
                     f"admission", retry_after_s=hint)
             self._committed += need
         try:
-            handle = super().submit(prompt, max_new_tokens, **kw)
+            handle = super().submit(p, max_new_tokens, **kw)
         except BaseException:
             self._uncommit(need)
             raise
@@ -435,6 +449,25 @@ class PagedGenerativeServer(GenerativeServer):
         return self.pool.usable_free_count() >= need
 
     # -- worker: prefill / decode / retire ------------------------------
+    def _consume_prefix_flush(self) -> None:
+        """Hot-reload fence, worker side: update_model() swapped the
+        weights, so every cached block addresses K/V the OLD model
+        computed. Consumed on the worker thread (which owns the pool)
+        at every step boundary AND immediately before each prefill's
+        cache lookup — the lookup check matters because ``_admit``
+        blocks on the queue *inside* a step, so a request submitted
+        after the reload can reach prefill before the next boundary.
+        In-flight holders keep their refcounts and finish (the same
+        accepted in-flight staleness as the dense update_model)."""
+        if self._prefix_flush_pending.is_set():
+            self._prefix_flush_pending.clear()
+            self.pool.flush_cache()
+            self.metrics.inc("prefix_cache_flushes")
+
+    def _step(self, slot) -> bool:
+        self._consume_prefix_flush()
+        return super()._step(slot)
+
     def _prefill(self, s: int, req: GenerationRequest) -> None:
         prefix = req.prefix()
         L = int(prefix.size)
@@ -447,6 +480,7 @@ class PagedGenerativeServer(GenerativeServer):
         hashes: List[bytes] = []
         hit: List[int] = []
         if self.prefix_cache_enabled:
+            self._consume_prefix_flush()
             hashes = prefix_block_hashes(prefix, BS)
             # reuse is capped one block short of the full prefix: at
             # least one suffix token must run through prefill (the
@@ -580,6 +614,9 @@ class PagedGenerativeServer(GenerativeServer):
         self._kc = self._fresh_slab()
         self._vc = self._fresh_slab()
         self.pool.reset()
+        # the wholesale reset already dropped the prefix cache — a
+        # pending hot-reload flush is thereby satisfied
+        self._prefix_flush_pending.clear()
         self._slots.reset()
         self._slot_reqs = [None] * self.max_slots
         self._tokens[:] = 0
@@ -667,7 +704,18 @@ class PagedGenerativeServer(GenerativeServer):
 
     def update_model(self) -> None:
         """Re-pull trained parameters; under ``tp > 1`` the fresh
-        arrays are re-placed onto the mesh with the same shardings."""
+        arrays are re-placed onto the mesh with the same shardings.
+
+        Also fences the prefix cache: cached blocks are
+        content-addressed by token ids alone, but their K/V were
+        computed with the weights being replaced — reusing them would
+        silently mix old-model keys/values with the new model for
+        every repeated prefix. The pool is worker-thread-owned, so the
+        flush is flagged here and consumed at the next step boundary
+        (:meth:`_step`): evictable cached blocks return to the free
+        list, held shared blocks just lose their registration so
+        in-flight requests finish (dense's accepted staleness
+        window)."""
         fresh = dict(self.spec.params())
         if self._strategy is not None:
             import jax
@@ -676,6 +724,7 @@ class PagedGenerativeServer(GenerativeServer):
                      for n, a in fresh.items()}
         with self._exec_lock:
             self._params = fresh
+        self._prefix_flush_pending.set()
 
     # -- observability --------------------------------------------------
     def memory_report(self) -> dict:

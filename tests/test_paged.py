@@ -15,6 +15,13 @@ Pinned contracts:
   survive interleaved admit/complete churn, skip their prefill (a hit
   dispatches the small SUFFIX bucket, not the full-prompt bucket), and
   never change greedy output;
+- hot reload: ``update_model()`` flushes the prefix cache (its blocks
+  hold K/V computed with the superseded weights) before any later
+  lookup — a repeated prompt after a reload re-prefills from scratch
+  and matches the NEW model's reference;
+- permanent errors stay permanent: an invalid request raises
+  ValueError even with the pool fully committed (validation precedes
+  the block commitment), never a retryable PoolExhaustedError;
 - greedy tokens are IDENTICAL to the dense server's reference
   (:func:`greedy_decode`) — paged vs dense is a memory-layout change,
   not a numerics change — including under tensor parallelism (tp=2 on
@@ -168,6 +175,28 @@ class TestBlockPool:
         assert len(prefix_block_hashes(np.arange(7), 2)) == 3
         assert len(prefix_block_hashes(np.arange(1), 2)) == 0
 
+    def test_flush_cache_drops_registrations_keeps_held(self):
+        """The hot-reload flush: every registration drops (no future
+        lookup reuses stale K/V), evictable blocks return to the free
+        list, held shared blocks keep their refcounts for in-flight
+        readers — and free straight to the free list on release."""
+        p = BlockPool(6, 2)                  # 5 usable blocks
+        h1, h2 = prefix_block_hashes(np.arange(4, dtype=np.int32), 2)
+        held = p.alloc()
+        p.register(h1, held)
+        ev = p.alloc()
+        p.register(h2, ev)
+        p.release(ev)                        # refcount 0 -> evictable
+        assert p.flush_cache() == 2
+        assert p.cached_count() == 0
+        assert p.lookup([h1, h2]) == []
+        assert p.free_count() == 4           # the evictable one freed
+        assert p.held_count() == 1           # in-flight reader intact
+        p.check_invariant(tables=[[held]])
+        p.release(held)                      # unregistered -> free, not
+        assert p.free_count() == 5           # evictable
+        p.check_invariant(tables=[])
+
     def test_reset_clears_everything(self):
         p = BlockPool(4, 2)
         b = p.alloc()
@@ -289,6 +318,35 @@ class TestPrefixCache:
         assert st["held"] == 0, st
         srv.pool.check_invariant(tables=[])
 
+    def test_update_model_flushes_prefix_cache(self, spec, dense_spec,
+                                               gpt_sd):
+        """A hot reload must invalidate the prefix cache: the cached
+        blocks' K/V were computed with the OLD weights, so a repeated
+        prompt after update_model() re-prefills from scratch (zero
+        hits) and its tokens match the NEW model's reference — no
+        silent old/new mixing."""
+        import jax.numpy as jnp
+        prompt = (np.arange(17, dtype=np.int32) * 3) % CFG.vocab_size
+        with make_server(spec) as srv:
+            srv.submit(prompt, max_new_tokens=4).result(timeout=60)
+            assert srv.pool.cached_count() > 0   # 2 full blocks cached
+            old = gpt_sd._arrays["wte"]
+            try:
+                gpt_sd._arrays["wte"] = old + jnp.asarray(0.5)
+                srv.update_model()
+                after = srv.submit(prompt,
+                                   max_new_tokens=4).result(timeout=60)
+                want = ref_tokens(dense_spec, prompt, 4)
+            finally:
+                gpt_sd._arrays["wte"] = old
+                srv.update_model()
+        assert after == want        # the reference reads live params too
+        rec = srv.metrics.to_record()["paged"]
+        # the repeat ran AFTER the flush: nothing to hit
+        assert rec["prefix_blocks_hit"] == 0
+        assert srv.metrics.counters["prefix_cache_flushes"] >= 1
+        srv.pool.check_invariant(tables=[])
+
     def test_disabled_cache_never_hits(self, spec):
         prompt = (np.arange(17, dtype=np.int32) * 3) % CFG.vocab_size
         with make_server(spec, prefix_cache=False) as srv:
@@ -356,6 +414,35 @@ class TestPoolPressure:
             with pytest.raises(ValueError):     # out-of-vocab prompt
                 srv.submit(np.asarray([999]), max_new_tokens=4)
             assert srv._committed == 0
+
+    def test_invalid_request_raises_valueerror_under_pressure(self, spec):
+        """Permanent errors stay permanent under pool pressure: with
+        the pool fully committed, an invalid request raises ValueError
+        (validation runs BEFORE the block commitment) — not a
+        retryable PoolExhaustedError telling the client to back off
+        and resubmit something that can never run — and is not
+        counted as shed."""
+        srv = make_server(spec, max_slots=4, num_blocks=9, start=False)
+        try:
+            p = np.arange(12, dtype=np.int32)
+            srv.submit(p, max_new_tokens=8)
+            srv.submit(p + 1, max_new_tokens=8)     # 6 of 8 committed
+            with pytest.raises(PoolExhaustedError):
+                srv.submit(p + 2, max_new_tokens=8)  # valid -> typed shed
+            shed = srv.metrics.counters["requests_shed"]
+            with pytest.raises(ValueError):          # empty prompt
+                srv.submit(np.asarray([], np.int32), 4)
+            with pytest.raises(ValueError):          # out-of-vocab
+                srv.submit(np.asarray([CFG.vocab_size], np.int32), 4)
+            with pytest.raises(ValueError):          # zero budget
+                srv.submit(np.asarray([1], np.int32), 0)
+            with pytest.raises(ValueError):          # over-long prompt
+                srv.submit(np.arange(MSL, dtype=np.int32) % CFG.vocab_size,
+                           4)
+            assert srv.metrics.counters["requests_shed"] == shed
+            assert srv._committed == 6
+        finally:
+            srv.shutdown()
 
 
 # ----------------------------------------------------------------------
