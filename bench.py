@@ -723,6 +723,124 @@ def bench_serving_paged(n_requests=32, dense_slots=4, max_seq_len=256,
             "dense": out["dense"], "paged": out["paged"]}
 
 
+def bench_serving_fleet(n_replicas=3, n_requests=48, rate_rps=40.0,
+                        ttft_slo_ms=2000.0, block_size=8, seed=17):
+    """Fleet chaos drill + affinity win (serving/fleet/, ISSUE 17).
+
+    One open-loop repeated-prefix trace against a 3-replica fleet
+    while (a) a replica is KILLED mid-traffic (no drain) and (b) a
+    rolling canaried deploy reloads the survivors — the acceptance bar
+    is ZERO failed healthy requests and fleet p99 TTFT inside the SLO
+    through both events. Then the affinity column: the SAME trace
+    routed with prefix affinity vs uniformly at random, scored on the
+    replicas' actual prefix-cache hit rate — affinity must beat
+    random (it concentrates each shared prefix on its rendezvous home,
+    so the cache warms once instead of once per replica)."""
+    import threading
+    from types import SimpleNamespace
+
+    from deeplearning4j_tpu.serving.fleet import (FleetReplica,
+                                                  FleetRouter,
+                                                  RollingDeploy)
+    from deeplearning4j_tpu.serving.loadgen import FleetLoadGenerator
+    from deeplearning4j_tpu.serving.paged import PagedGenerativeServer
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_paged_spec)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_seq_len=64)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    spec = gpt_paged_spec(sd, cfg)     # shared -> one compile set
+
+    def replica(name, warm=False):
+        return FleetReplica(name, server=PagedGenerativeServer(
+            spec, max_slots=4, block_size=block_size, max_seq_len=64,
+            warmup=warm))
+
+    pool = [(np.arange(block_size, dtype=np.int32) * k + k)
+            % cfg.vocab_size for k in (1, 3)]
+
+    def loadgen(front_door, gen_seed):
+        return FleetLoadGenerator(front_door,
+                                  vocab_size=cfg.vocab_size,
+                                  seed=gen_seed, prompt_len=(1, 8),
+                                  new_tokens=(2, 8), prefix_pool=pool,
+                                  prefix_p=0.75)
+
+    # -- the drill: kill + rolling reload under open-loop load ---------
+    replicas = [replica(f"r{i}", warm=(i == 0))
+                for i in range(n_replicas)]
+    router = FleetRouter(replicas, retry_budget=4,
+                         poll_interval_s=0.05)
+    deploy_report = {}
+
+    def mid_run():
+        replicas[-1].kill()            # no drain: the chaos kill
+        deploy_report.update(RollingDeploy(
+            router, probes=[(np.arange(6, dtype=np.int32), 4, None)],
+            drain_timeout_s=60.0).run(canary="r0"))
+    chaos = threading.Timer(0.3, mid_run)
+    chaos.start()
+    res = loadgen(router.generate, seed).run_open(
+        n_requests=n_requests, rate_rps=rate_rps)
+    chaos.join()
+    rec = router.metrics.to_record()
+    for r in replicas:
+        if r.alive:
+            r.stop(drain=True)
+
+    # -- affinity vs random placement, scored on REAL prefix hits ------
+    def prefix_hit_rate(route_random):
+        reps = [replica(f"h{i}") for i in range(n_replicas)]
+        rt = FleetRouter(reps, poll_interval_s=0.05)
+        rng = np.random.default_rng(seed + 1)
+
+        def random_door(prompt, max_new_tokens=16, timeout_ms=None):
+            rep = reps[int(rng.integers(len(reps)))]
+            h = rep.submit(prompt, max_new_tokens=max_new_tokens,
+                           timeout_ms=timeout_ms)
+            return SimpleNamespace(tokens=h.result(), replica=rep.name,
+                                   retries=0, routed="random",
+                                   ttft_ms=None, intertoken_ms=[])
+        door = random_door if route_random else rt.generate
+        r = loadgen(door, seed + 2).run_open(n_requests=32,
+                                             rate_rps=rate_rps)
+        hits = sum(rep.server.metrics.counters["prefix_hits"]
+                   for rep in reps)
+        lookups = sum(rep.server.metrics.counters["prefix_lookups"]
+                      for rep in reps)
+        for rep in reps:
+            rep.stop(drain=True)
+        return (hits / lookups if lookups else 0.0), r.n_failed
+    affinity_rate, aff_failed = prefix_hit_rate(route_random=False)
+    random_rate, rnd_failed = prefix_hit_rate(route_random=True)
+
+    ttft_p99 = res.ttft_percentile(99)
+    return {"samples_per_sec": round(res.tokens_per_sec, 1),
+            "tokens_per_sec": round(res.tokens_per_sec, 1),
+            "n_replicas": n_replicas,
+            "n_requests": n_requests,
+            "rate_rps": rate_rps,
+            "n_ok": res.n_ok,
+            # the acceptance bar: nothing healthy failed through a
+            # kill AND a rolling reload
+            "n_failed_through_chaos": res.n_failed + aff_failed
+            + rnd_failed,
+            "retries_absorbed": res.retries_total,
+            "deploy_ok": bool(deploy_report.get("ok")),
+            "deploy_rolled": deploy_report.get("rolled"),
+            "ttft_p50_ms": round(res.ttft_percentile(50), 3),
+            "ttft_p99_ms": round(ttft_p99, 3),
+            "ttft_slo_ms": ttft_slo_ms,
+            "ttft_p99_within_slo": bool(ttft_p99 <= ttft_slo_ms),
+            "affinity_prefix_hit_rate": round(affinity_rate, 4),
+            "random_prefix_hit_rate": round(random_rate, 4),
+            "affinity_beats_random": bool(affinity_rate > random_rate),
+            "replica_deaths_seen":
+                rec["counters"]["replica_deaths_seen"],
+            "fleet_affinity_hit_rate":
+                rec["fleet"]["affinity_hit_rate"]}
+
+
 def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
                       worker_counts=(1, 2, 4)):
     """Disk-backed streaming training vs the device-cached window bench
@@ -1132,6 +1250,12 @@ def main():
                      # decode-step p50, tp=2 greedy bit-identity
                      # (serving/paged/) for BENCH_r11
                      ("serving_paged", bench_serving_paged),
+                     # fleet chaos drill: kill a replica + rolling
+                     # reload under open-loop load (zero failed healthy
+                     # requests, p99 TTFT inside the SLO) and the
+                     # affinity-vs-random prefix-hit-rate column
+                     # (serving/fleet/) for BENCH_r12
+                     ("serving_fleet", bench_serving_fleet),
                      # the integrity rail's cost (state fingerprints +
                      # stall-watchdog guards on the fused K=8 listener
                      # path, ≤2% bar) for BENCH_r10

@@ -123,6 +123,11 @@ RULES: Dict[str, Rule] = _catalog(
          "(max_slots x max_seq rows) exceeds the device headroom "
          "guard — construction would be refused; paged KV "
          "(serving/paged) sizes by tokens actually held"),
+    Rule("serving.fleet_slo_unreachable", "warn",
+         "a fleet capacity plan (replicas x slots x p99 decode-step "
+         "estimate) cannot meet its TTFT SLO at the stated arrival "
+         "rate — queues grow without bound under Little's law and "
+         "every request is eventually shed or late"),
 )
 
 

@@ -3,16 +3,23 @@ surface at construction time (or worse, as a backend OOM), run as pure
 arithmetic over the spec + knobs — the serving analogue of
 analyze/configpass.py.
 
-One rule today: ``serving.dense_kv_exceeds_headroom`` — the dense
-continuous-batching server preallocates ``2 x max_slots x max_seq``
-rows of KV up front, so a capacity plan that looks innocuous
-("max_slots=64, max_seq=8192") can exceed the chip's free HBM before a
-single request arrives. ``GenerativeServer`` refuses such a config at
-construction (monitor/memstats.check_headroom); this pass flags it at
-LINT time instead, with the fix the refusal cannot suggest by itself:
-the paged server (serving/paged) allocates the same budget as a block
-pool, so capacity scales with tokens actually held rather than the
-worst case — docs/serving.md "Paged KV & prefix caching".
+- ``serving.dense_kv_exceeds_headroom`` — the dense continuous-batching
+  server preallocates ``2 x max_slots x max_seq`` rows of KV up front,
+  so a capacity plan that looks innocuous ("max_slots=64,
+  max_seq=8192") can exceed the chip's free HBM before a single request
+  arrives. ``GenerativeServer`` refuses such a config at construction
+  (monitor/memstats.check_headroom); this pass flags it at LINT time
+  instead, with the fix the refusal cannot suggest by itself: the paged
+  server (serving/paged) allocates the same budget as a block pool, so
+  capacity scales with tokens actually held rather than the worst case
+  — docs/serving.md "Paged KV & prefix caching".
+- ``serving.fleet_slo_unreachable`` — the fleet-plan twin
+  (:func:`analyze_fleet_config`): pure admission math over ``replicas
+  × slots × p99 decode-step estimate`` vs the TTFT SLO at the stated
+  arrival rate. A plan that cannot meet its deadline under Little's
+  law will shed/queue forever no matter how the router places — the
+  lint says so before a replica is started, with the two fixes the
+  runtime cannot apply itself (more replicas, or a relaxed deadline).
 """
 from __future__ import annotations
 
@@ -65,6 +72,78 @@ def check_dense_kv_headroom(spec, max_slots: int,
                  "max_slots/max_seq_len")]
 
 
+def check_fleet_slo(replicas: int, max_slots: int,
+                    p99_decode_step_ms: float, ttft_slo_ms: float,
+                    arrival_rate_rps: float,
+                    mean_new_tokens: float = 16.0):
+    """Findings for one fleet capacity plan — worst-case admission
+    arithmetic, no servers constructed.
+
+    Two ways a plan is unreachable:
+
+    - **floor**: serving the FIRST token takes at least one decode
+      step, so ``p99_decode_step_ms > ttft_slo_ms`` fails even an idle
+      fleet;
+    - **saturation**: a request occupies a slot for ``mean_new_tokens
+      × p99_decode_step_ms``; by Little's law the offered load needs
+      ``arrival_rate × service_s`` concurrent slots. When that exceeds
+      ``replicas × max_slots`` the queue grows without bound and p99
+      TTFT diverges — every admission estimate the servers shed on
+      (``(queue_depth + 1) × p99 step``) eventually exceeds any
+      deadline.
+    """
+    step_ms = float(p99_decode_step_ms)
+    slo_ms = float(ttft_slo_ms)
+    rate = float(arrival_rate_rps)
+    service_s = float(mean_new_tokens) * step_ms / 1000.0
+    slots_needed = rate * service_s
+    capacity = int(replicas) * int(max_slots)
+    subject = f"fleet[{int(replicas)}x{int(max_slots)}]"
+    out = []
+    if step_ms > slo_ms:
+        out.append(finding(
+            "serving.fleet_slo_unreachable", subject,
+            f"one p99 decode step ({step_ms:.1f} ms) already exceeds "
+            f"the TTFT SLO ({slo_ms:.1f} ms) — no replica count can "
+            f"serve a first token inside the deadline",
+            fix_hint="relax the TTFT deadline past one decode step, "
+                     "or make the step faster (smaller model, fewer "
+                     "active slots per step)"))
+    elif slots_needed > capacity:
+        need_replicas = int(np.ceil(slots_needed / max(1, max_slots)))
+        out.append(finding(
+            "serving.fleet_slo_unreachable", subject,
+            f"offered load needs ~{slots_needed:.1f} concurrent slots "
+            f"({arrival_rate_rps:g} req/s x {mean_new_tokens:g} tokens "
+            f"x {step_ms:.1f} ms p99 step) but the fleet has "
+            f"{capacity} ({replicas} replicas x {max_slots} slots) — "
+            f"queues grow without bound and p99 TTFT diverges past "
+            f"the {slo_ms:.1f} ms SLO",
+            fix_hint=f"raise the fleet to >= {need_replicas} replicas "
+                     f"(or add slots/relax the deadline/shed at a "
+                     f"lower arrival rate)"))
+    return out
+
+
+def analyze_fleet_config(replicas: int, max_slots: int,
+                         p99_decode_step_ms: float, ttft_slo_ms: float,
+                         arrival_rate_rps: float,
+                         mean_new_tokens: float = 16.0
+                         ) -> AnalysisReport:
+    """Lint one fleet capacity plan (replica count + per-replica knobs
+    + SLO + offered load) — the entry point
+    ``serving.fleet_slo_unreachable`` runs under
+    (``context="serving_config"``, like the per-server lint)."""
+    t0 = _time.perf_counter()
+    report = AnalysisReport(context="serving_config")
+    report.rules_run = 1
+    report.extend(check_fleet_slo(replicas, max_slots,
+                                  p99_decode_step_ms, ttft_slo_ms,
+                                  arrival_rate_rps, mean_new_tokens))
+    report.seconds = _time.perf_counter() - t0
+    return report
+
+
 def analyze_generative_config(spec, max_slots: int,
                               max_seq_len: Optional[int] = None,
                               headroom_bytes: Optional[int] = None
@@ -81,5 +160,6 @@ def analyze_generative_config(spec, max_slots: int,
     return report
 
 
-__all__ = ["analyze_generative_config", "check_dense_kv_headroom",
+__all__ = ["analyze_fleet_config", "analyze_generative_config",
+           "check_dense_kv_headroom", "check_fleet_slo",
            "dense_kv_slab_bytes"]

@@ -370,6 +370,54 @@ class MetricsRegistry:
                            1 if res.get("last_reload_failed") else 0,
                            help="1 when the last hot reload rolled back")
 
+    def fold_fleet(self, metrics_or_record) -> None:
+        """Fold a ``serving.fleet.FleetMetrics`` (or its
+        ``to_record()`` dict / a stored ``{"type": "fleet"}`` record)
+        into ``fleet_*`` metrics — the cluster-tier dashboard: routing
+        mix + affinity hit rate, retry/shed/death pressure, deploy and
+        autoscale events, and a per-replica gauge set labeled by
+        replica name (occupancy / queue depth / readiness)."""
+        rec = metrics_or_record
+        if hasattr(rec, "to_record"):
+            rec = rec.to_record()
+        for name, v in rec.get("counters", {}).items():
+            self.set_gauge(f"fleet_{name}_total", v,
+                           help="fleet lifetime counter")
+        agg = rec.get("fleet") or {}
+        self.set_gauge("fleet_replicas", agg.get("n_replicas", 0),
+                       help="replicas known to the router")
+        self.set_gauge("fleet_replicas_ready", agg.get("n_ready", 0),
+                       help="replicas ready at the last scrape")
+        self.set_gauge("fleet_affinity_hit_rate",
+                       agg.get("affinity_hit_rate", 0.0),
+                       help="affinity-eligible requests placed on "
+                            "their rendezvous home replica")
+        self.set_gauge("fleet_retries_per_request",
+                       agg.get("retries_per_request", 0.0),
+                       help="mean retries per routed request")
+        for name, rep in (rec.get("replicas") or {}).items():
+            labels = {"replica": name}
+            self.set_gauge("fleet_replica_ready",
+                           1 if rep.get("ready") else 0,
+                           help="1 when the replica scraped ready",
+                           **labels)
+            self.set_gauge("fleet_replica_queue_depth",
+                           rep.get("queue_depth", 0),
+                           help="queued requests at the last scrape",
+                           **labels)
+            self.set_gauge("fleet_replica_occupancy",
+                           rep.get("occupancy", 0.0),
+                           help="max(slot, pool) occupancy at the "
+                                "last scrape", **labels)
+            self.set_gauge("fleet_replica_p99_decode_step_ms",
+                           rep.get("p99_decode_step_ms", 0.0),
+                           help="replica's rolling p99 decode step",
+                           **labels)
+            self.set_gauge("fleet_replica_routed_total",
+                           rep.get("routed", 0),
+                           help="requests the router placed here",
+                           **labels)
+
     def fold_dispatch(self, stats: Optional[dict],
                       epoch: Optional[int] = None) -> None:
         """Fold a fit tier's dispatch accounting (``sd.last_fit_stats``
@@ -675,6 +723,8 @@ class MetricsRegistry:
         t = rec.get("type")
         if t == "serving":
             self.fold_serving(rec)
+        elif t == "fleet":
+            self.fold_fleet(rec)
         elif t == "dispatch":
             self.fold_dispatch(rec, epoch=rec.get("epoch"))
         elif t == "checkpoint":

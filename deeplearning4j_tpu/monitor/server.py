@@ -104,6 +104,10 @@ def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
     dict; a provider raising is reported (and makes the snapshot
     unhealthy — a dead introspection hook is itself a symptom);
     ``healthy: False`` / ``ready: False`` keys gate the aggregate.
+    A provider's ``load`` sub-dict (queue depth, slot/pool occupancy,
+    rolling p99 decode-step ms — see ``GenerativeServer``'s provider)
+    is merged into a top-level ``load`` key, so a fleet router reads
+    readiness AND load in ONE ``/readyz`` scrape.
 
     ``cache``: an opaque dict the caller keeps between calls — only
     records appended since the last call are walked, so a per-second
@@ -147,6 +151,7 @@ def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
     prov_out: Dict[str, dict] = {}
     healthy = state == "ok"
     ready = True
+    load: Dict[str, object] = {}
     last_step_t: Optional[float] = None
     for name, fn in (providers or {}).items():
         try:
@@ -158,6 +163,8 @@ def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
             healthy = False
         if p.get("ready") is False:
             ready = False
+        if isinstance(p.get("load"), dict):
+            load.update(p["load"])
         t = p.get("last_step_t")
         if t is not None and (last_step_t is None or t > last_step_t):
             last_step_t = float(t)
@@ -171,6 +178,8 @@ def health_snapshot(storage=None, providers: Dict[str, Callable] = None,
             "ready": healthy and ready, "rollbacks": rollbacks,
             "last_step_age_s": None if age is None else round(age, 3),
             "providers": prov_out}
+    if load:
+        snap["load"] = load
     if last_event is not None:
         snap["last_fault_event"] = last_event
     if stale_after_s is not None:
@@ -255,8 +264,11 @@ class TelemetryServer:
         """Register a ``fn() -> dict`` merged into /healthz and
         /readyz. Recognized keys: ``healthy``/``ready`` (False gates
         the aggregate), ``last_step_t`` (wall clock of the last unit of
-        progress — feeds last-step age); everything else is reported
-        verbatim (queue depths, iteration counters, ...)."""
+        progress — feeds last-step age), ``load`` (a sub-dict of load
+        signals — queue depth, occupancy, rolling p99 decode-step ms —
+        merged into the snapshot's top-level ``load`` key for one-scrape
+        fleet routing); everything else is reported verbatim (queue
+        depths, iteration counters, ...)."""
         self._providers[str(name)] = fn
 
     def add_scrape_hook(self, fn: Callable) -> None:

@@ -42,7 +42,8 @@ from deeplearning4j_tpu.serving.generative import (
     GenerativeServer, GenerativeSpec, SlotAllocator, greedy_decode)
 from deeplearning4j_tpu.serving.inference import (
     InferenceMode, ParallelInference, ServingSpec)
-from deeplearning4j_tpu.serving.loadgen import LoadGenerator, LoadResult
+from deeplearning4j_tpu.serving.loadgen import (
+    FleetLoadGenerator, GenerativeLoadGenerator, LoadGenerator, LoadResult)
 from deeplearning4j_tpu.serving.metrics import (
     LatencyHistogram, ServingMetrics)
 from deeplearning4j_tpu.serving.queue import (
@@ -50,19 +51,21 @@ from deeplearning4j_tpu.serving.queue import (
     ServerOverloadedError, ServingError, ServingTimeoutError)
 from deeplearning4j_tpu.serving.resilience import (
     AdmissionController, CircuitBreaker, PoisonedRequestError,
-    ReloadFailedError, ResilienceConfig, WorkerSupervisor)
+    ReloadFailedError, ResilienceConfig, RetryableServingError,
+    WorkerSupervisor)
 
 __all__ = [
     "ParallelInference", "InferenceMode", "ServingSpec",
     "DynamicBatcher", "Batch", "BucketSpec", "pow2_buckets",
     "pad_to_bucket",
     "RequestQueue", "InferenceRequest",
-    "ServingError", "ServerOverloadedError", "RequestTimeoutError",
-    "ServerClosedError", "ServingTimeoutError",
+    "ServingError", "RetryableServingError", "ServerOverloadedError",
+    "RequestTimeoutError", "ServerClosedError", "ServingTimeoutError",
     "ServingMetrics", "LatencyHistogram",
     "ResilienceConfig", "AdmissionController", "CircuitBreaker",
     "WorkerSupervisor", "PoisonedRequestError", "ReloadFailedError",
-    "LoadGenerator", "LoadResult",
+    "LoadGenerator", "LoadResult", "GenerativeLoadGenerator",
+    "FleetLoadGenerator",
     "GenerativeServer", "GenerativeSpec", "GenerativeMetrics",
     "GenerationHandle", "GenerationCancelled", "SlotAllocator",
     "greedy_decode",

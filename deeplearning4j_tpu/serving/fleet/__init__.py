@@ -1,0 +1,46 @@
+"""serving.fleet — the multi-replica front door (ROADMAP item 3).
+
+The cluster tier over ``PagedGenerativeServer``/``GenerativeServer``
+replicas — the reference's ``ParallelInference`` fan-out role scaled
+from threads-in-one-JVM to a fleet of serving processes:
+
+- ``replica``: :class:`FleetReplica` — one server + its telemetry as a
+  fleet citizen: scrapeable load (``/readyz`` + the merged ``load``
+  sub-dict), lifecycle (start / quiesce / stop / kill), hot reload
+  with snapshot/rollback.
+- ``router``: :class:`FleetRouter` — least-loaded-among-ready dispatch
+  with staleness cutoffs, rendezvous prefix-affinity routing keyed on
+  the SAME chain hashes the paged prefix cache uses, and retry-on-
+  shed/death honoring the typed ``retry_after_s`` contract within a
+  per-request budget (permanent errors never retried).
+- ``deploy``: :class:`RollingDeploy` — canary → shadow-eval token-match
+  gate → one-at-a-time roll, drain-before-reload, snapshot rollback on
+  any failed gate; zero in-flight failures by construction.
+- ``autoscale``: :class:`FleetAutoscaler` — SLO-headroom signal (fleet
+  p99 TTFT estimate vs deadline + queue trend) starting/draining
+  replicas with hysteresis, cooldown and min/max bounds.
+- ``metrics``: :class:`FleetMetrics` — ``{"type": "fleet"}`` records →
+  ``dl4j_fleet_*`` gauges (``registry.fold_fleet``) and the ui/report
+  "Fleet" panel.
+
+See docs/serving.md ("Fleet") for semantics and the retry table.
+"""
+from deeplearning4j_tpu.serving.fleet.autoscale import FleetAutoscaler
+from deeplearning4j_tpu.serving.fleet.deploy import (RollingDeploy,
+                                                     rolling_deploy)
+from deeplearning4j_tpu.serving.fleet.metrics import (FLEET_COUNTERS,
+                                                      FleetMetrics)
+from deeplearning4j_tpu.serving.fleet.replica import (REPLICA_STATES,
+                                                      FleetReplica,
+                                                      ReplicaLoad)
+from deeplearning4j_tpu.serving.fleet.router import (FleetResult,
+                                                     FleetRouter,
+                                                     FleetUnavailableError)
+
+__all__ = [
+    "FleetAutoscaler",
+    "FleetMetrics", "FLEET_COUNTERS",
+    "FleetReplica", "ReplicaLoad", "REPLICA_STATES",
+    "FleetResult", "FleetRouter", "FleetUnavailableError",
+    "RollingDeploy", "rolling_deploy",
+]

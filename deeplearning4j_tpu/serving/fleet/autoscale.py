@@ -1,0 +1,185 @@
+"""SLO-headroom autoscaling: start/drain replicas on TTFT pressure.
+
+The scaling signal is the same admission math every replica sheds on
+(docs/serving.md "Admission math"): estimated TTFT on a replica is
+``(queue_depth + 1) × rolling p99 decode-step ms``. The fleet-level
+p99 TTFT estimate is the WORST ready replica's estimate — a router
+places on the least-loaded replica, but under sustained pressure the
+worst replica is where the next unlucky request lands.
+
+- **scale up** when estimated TTFT eats past ``scale_up_headroom`` of
+  the SLO (default: est > 70% of the deadline), or mean queue depth
+  reaches ``queue_high``, or the queue trend is strictly rising from a
+  nonzero base (pressure building faster than the fleet drains it).
+- **scale down** when estimated TTFT is below ``scale_down_headroom``
+  of the SLO AND queues are empty — capacity is provably idle.
+- **hysteresis** — a signal must repeat ``hysteresis`` consecutive
+  evaluations before acting, and ``cooldown_s`` must have elapsed
+  since the last action; flapping traffic changes the signal, not the
+  fleet.
+- **bounds** — never below ``min_replicas`` or above ``max_replicas``.
+
+Scale-down drains through the replica's existing quiesce + drain-on-
+shutdown path: the victim (the least-loaded ready replica) leaves the
+routing set, finishes its in-flight work, then stops — zero failed
+requests, same as a deploy drain.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from deeplearning4j_tpu.serving.fleet.metrics import FleetMetrics
+from deeplearning4j_tpu.serving.fleet.replica import FleetReplica, ReplicaLoad
+from deeplearning4j_tpu.serving.fleet.router import FleetRouter
+
+
+class FleetAutoscaler:
+    """Evaluate the SLO-headroom signal and act on a router's fleet.
+
+    ``factory(name) -> FleetReplica`` builds (and starts) a fresh
+    replica for scale-up. ``evaluate`` is side-effect-free given a
+    loads dict (tests drive it with synthetic loads); ``step`` applies
+    hysteresis/cooldown/bounds and actually scales."""
+
+    def __init__(self, router: FleetRouter,
+                 factory: Callable[[str], FleetReplica], *,
+                 ttft_slo_ms: float = 500.0,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 scale_up_headroom: float = 0.7,
+                 scale_down_headroom: float = 0.2,
+                 queue_high: int = 4, hysteresis: int = 2,
+                 cooldown_s: float = 10.0,
+                 drain_timeout_s: float = 30.0,
+                 metrics: Optional[FleetMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 0.0 < scale_down_headroom < scale_up_headroom:
+            raise ValueError("need 0 < scale_down_headroom < "
+                             "scale_up_headroom")
+        self.router = router
+        self.factory = factory
+        self.ttft_slo_ms = float(ttft_slo_ms)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_headroom = float(scale_up_headroom)
+        self.scale_down_headroom = float(scale_down_headroom)
+        self.queue_high = int(queue_high)
+        self.hysteresis = int(hysteresis)
+        self.cooldown_s = float(cooldown_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.metrics = metrics if metrics is not None else router.metrics
+        self._clock = clock
+        self._streak_signal = "hold"
+        self._streak = 0
+        self._last_action_t = float("-inf")
+        self._prev_mean_queue: Optional[float] = None
+        self._next_id = 0
+
+    # -- signal ---------------------------------------------------------
+    def fleet_ttft_estimate_ms(self,
+                               loads: Dict[str, ReplicaLoad]) -> float:
+        """Worst ready replica's ``(queue_depth + 1) × p99 step``."""
+        ests = [(l.queue_depth + 1) * l.p99_decode_step_ms
+                for l in loads.values() if l.ready]
+        return max(ests) if ests else float("inf")
+
+    def evaluate(self,
+                 loads: Optional[Dict[str, ReplicaLoad]] = None) -> str:
+        """``scale_up`` / ``scale_down`` / ``hold`` from the current
+        (or given) loads. Pure in ``loads`` apart from the queue-trend
+        memory."""
+        if loads is None:
+            loads = self.router.snapshot_loads()
+        ready = [l for l in loads.values() if l.ready]
+        if not ready:
+            return "scale_up"           # nothing can serve: grow or die
+        est = self.fleet_ttft_estimate_ms(loads)
+        mean_queue = sum(l.queue_depth for l in ready) / len(ready)
+        prev = self._prev_mean_queue
+        self._prev_mean_queue = mean_queue
+        rising = prev is not None and prev > 0 and mean_queue > prev
+        if (est > self.scale_up_headroom * self.ttft_slo_ms
+                or mean_queue >= self.queue_high or rising):
+            return "scale_up"
+        if (est < self.scale_down_headroom * self.ttft_slo_ms
+                and mean_queue == 0):
+            return "scale_down"
+        return "hold"
+
+    # -- actuation ------------------------------------------------------
+    def _n_live(self) -> int:
+        with self.router._lock:
+            return sum(1 for r in self.router.replicas.values()
+                       if r.alive)
+
+    def step(self,
+             loads: Optional[Dict[str, ReplicaLoad]] = None) -> dict:
+        """One control-loop tick: evaluate, apply hysteresis/cooldown/
+        bounds, act. Returns ``{"signal", "acted", "replicas", ...}``."""
+        signal = self.evaluate(loads)
+        if signal == self._streak_signal:
+            self._streak += 1
+        else:
+            self._streak_signal, self._streak = signal, 1
+        out = {"signal": signal, "acted": False,
+               "streak": self._streak, "replicas": self._n_live()}
+        if signal == "hold" or self._streak < self.hysteresis:
+            return out
+        if (self._clock() - self._last_action_t) < self.cooldown_s:
+            out["reason"] = "cooldown"
+            return out
+        n = self._n_live()
+        if signal == "scale_up":
+            if n >= self.max_replicas:
+                out["reason"] = "at max_replicas"
+                return out
+            name = self._fresh_name()
+            replica = self.factory(name)
+            replica.start()
+            self.router.add_replica(replica)
+            self.metrics.inc("scale_up_events")
+            out.update(acted=True, replica=name, replicas=n + 1)
+        else:
+            if n <= self.min_replicas:
+                out["reason"] = "at min_replicas"
+                return out
+            victim = self._pick_victim(loads)
+            if victim is None:
+                out["reason"] = "no drainable replica"
+                return out
+            victim.quiesce(timeout_s=self.drain_timeout_s)
+            self.router.remove_replica(victim.name)
+            victim.stop(drain=True)
+            self.metrics.inc("scale_down_events")
+            out.update(acted=True, replica=victim.name, replicas=n - 1)
+        self._last_action_t = self._clock()
+        self._streak = 0
+        return out
+
+    def _fresh_name(self) -> str:
+        with self.router._lock:
+            taken = set(self.router.replicas)
+        while True:
+            name = f"scaled-{self._next_id}"
+            self._next_id += 1
+            if name not in taken:
+                return name
+
+    def _pick_victim(self,
+                     loads: Optional[Dict[str, ReplicaLoad]] = None
+                     ) -> Optional[FleetReplica]:
+        """Least-loaded ready replica — cheapest to drain."""
+        if loads is None:
+            loads = self.router.snapshot_loads()
+        with self.router._lock:
+            candidates = [(r, loads.get(r.name))
+                          for r in self.router.replicas.values()
+                          if r.routable]
+        candidates = [(r, l) for r, l in candidates
+                      if l is not None and l.ready]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda rl: rl[1].score())[0]
+
+
+__all__ = ["FleetAutoscaler"]

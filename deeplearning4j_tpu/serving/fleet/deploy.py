@@ -1,0 +1,135 @@
+"""Rolling canaried deploys: reload a fleet one replica at a time.
+
+The checkpoint-follows-training story at fleet scale. A deploy never
+takes the front door down and never fails an in-flight request:
+
+1. **canary** — ONE replica is quiesced (drain-before-reload: the
+   router stops placing work on it, every queued + in-flight
+   generation finishes), its parameters snapshotted, then reloaded via
+   ``update_model`` (prefix-cache fencing included on paged servers).
+2. **gate** — the canary must scrape healthy+ready AND pass the
+   shadow-eval probes: each probe prompt is generated on the canary
+   and token-matched against its expected tokens (when given) —
+   greedy decode is deterministic, so one mismatched token means the
+   new parameters changed behavior. Probes without expected tokens
+   capture the canary's output as the fleet reference: every later
+   replica must match the canary bit-exactly, or the fleet would serve
+   two models at once.
+3. **roll** — the remaining replicas repeat quiesce → reload → gate
+   one at a time; the rest of the fleet keeps serving throughout.
+4. **rollback** — any failed gate restores that replica's snapshot
+   (``restore_params`` — the paged server re-fences its prefix cache)
+   and aborts the deploy with a typed report. Already-rolled replicas
+   keep the new parameters; the report says exactly how far the roll
+   got (``rolled``/``failed_at``/``reason``) so an operator — or a
+   retry loop — can decide.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.serving.fleet.metrics import FleetMetrics
+from deeplearning4j_tpu.serving.fleet.replica import FleetReplica
+from deeplearning4j_tpu.serving.fleet.router import FleetRouter
+
+
+class RollingDeploy:
+    """Drive a canaried rolling reload over a router's replicas.
+
+    ``probes`` is a sequence of ``(prompt, max_new_tokens,
+    expected_tokens_or_None)``. ``drain_timeout_s`` bounds each
+    replica's quiesce; a replica that cannot drain in time aborts the
+    deploy with NOTHING reloaded on it (it resumes serving the old
+    parameters)."""
+
+    def __init__(self, router: FleetRouter,
+                 probes: Sequence[Tuple] = (),
+                 drain_timeout_s: float = 30.0,
+                 probe_timeout_s: float = 60.0,
+                 metrics: Optional[FleetMetrics] = None):
+        self.router = router
+        self.probes = [(p, int(n), None if exp is None else
+                        [int(t) for t in exp]) for p, n, exp in probes]
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.metrics = metrics if metrics is not None else router.metrics
+
+    # ------------------------------------------------------------------
+    def _gate(self, replica: FleetReplica,
+              reference: List[Optional[List[int]]]) -> Tuple[bool, str]:
+        """Health + shadow-eval check on a freshly reloaded (still
+        draining) replica. Mutates ``reference`` in place: probes with
+        no expected tokens adopt this replica's output as the fleet
+        reference (the canary defines truth for the roll)."""
+        load = replica.scrape()
+        if not load.healthy:
+            return False, "unhealthy after reload"
+        for i, (prompt, n_new, expected) in enumerate(self.probes):
+            try:
+                handle = replica.submit(prompt, max_new_tokens=n_new)
+                got = [int(t) for t in
+                       handle.result(timeout=self.probe_timeout_s)]
+            except Exception as e:      # noqa: BLE001 — any raise fails the gate
+                return False, f"probe {i} raised {type(e).__name__}: {e}"
+            want = expected if expected is not None else reference[i]
+            if want is not None and got != want:
+                return False, (f"probe {i} token mismatch: "
+                               f"got {got[:8]}..., want {want[:8]}...")
+            if reference[i] is None:
+                reference[i] = got
+        return True, "ok"
+
+    def run(self, canary: Optional[str] = None) -> dict:
+        """Execute the deploy. Returns the report dict; ``ok`` is True
+        only when EVERY replica reloaded and passed its gate."""
+        t0 = time.monotonic()
+        with self.router._lock:
+            replicas = [r for r in self.router.replicas.values()
+                        if r.alive]
+        if not replicas:
+            return {"ok": False, "reason": "no live replicas",
+                    "rolled": [], "seconds": 0.0}
+        if canary is not None:
+            replicas.sort(key=lambda r: (r.name != canary, r.name))
+        report = {"ok": False, "canary": replicas[0].name,
+                  "rolled": [], "probes": len(self.probes)}
+        reference: List[Optional[List[int]]] = [
+            exp for _, _, exp in self.probes]
+        for replica in replicas:
+            if not replica.quiesce(timeout_s=self.drain_timeout_s):
+                replica.resume()
+                report.update(failed_at=replica.name,
+                              reason=f"drain timed out after "
+                                     f"{self.drain_timeout_s:g}s")
+                break
+            snapshot = replica.params_snapshot()
+            try:
+                replica.reload_from()
+                ok, why = self._gate(replica, reference)
+            except Exception as e:      # noqa: BLE001 — reload itself failed
+                ok, why = False, f"reload raised {type(e).__name__}: {e}"
+            if not ok:
+                replica.restore_params(snapshot)
+                replica.resume()
+                self.metrics.inc("deploy_rollbacks")
+                report.update(failed_at=replica.name, reason=why,
+                              rolled_back=True)
+                break
+            replica.resume()
+            report["rolled"].append(replica.name)
+        else:
+            report["ok"] = True
+            self.metrics.inc("deploys")
+        report["seconds"] = round(time.monotonic() - t0, 3)
+        return report
+
+
+def rolling_deploy(router: FleetRouter, probes: Sequence[Tuple] = (),
+                   canary: Optional[str] = None, **kw) -> dict:
+    """Functional shorthand for ``RollingDeploy(router, probes,
+    **kw).run(canary)``."""
+    return RollingDeploy(router, probes=probes, **kw).run(canary=canary)
+
+
+__all__ = ["RollingDeploy", "rolling_deploy"]

@@ -1,0 +1,119 @@
+"""Fleet-tier metrics: routing, retry, deploy and autoscale accounting.
+
+One :class:`FleetMetrics` instance is shared by the router, the deploy
+driver and the autoscaler, so a single ``{"type": "fleet"}`` record (or
+one ``registry.fold_fleet`` scrape → ``dl4j_fleet_*`` gauges) tells the
+whole cluster story: how traffic was placed (affinity home vs spill vs
+least-loaded), how often sheds/deaths forced retries, what each replica
+looked like at the last scrape, and every deploy/scale event.
+
+The affinity hit rate is defined over affinity-ELIGIBLE requests only
+(prompts with at least one full hashed block): ``home / (home +
+spill)``. Requests with no affinity key route least-loaded and do not
+dilute the rate — they could never have hit a prefix cache anyway.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.serving.metrics import safe_ratio
+
+#: every counter FleetMetrics tracks (zero-initialized so records and
+#: gauge folds are shape-stable from the first scrape)
+FLEET_COUNTERS = (
+    "requests_routed",          # submits that reached a replica
+    "requests_ok",              # front-door generations that returned
+    "requests_failed",          # permanent/exhausted failures surfaced
+    "requests_timed_out",       # deadline misses (never retried)
+    "routed_affinity",          # placed on the rendezvous home replica
+    "routed_spill",             # had an affinity key, home overloaded
+    "routed_least_loaded",      # no affinity key: pure load balancing
+    "retries",                  # re-attempts after a shed or a death
+    "sheds_seen",               # typed RetryableServingError observed
+    "replica_deaths_seen",      # replicas marked dead mid-request
+    "retry_giveups",            # budgets exhausted, shed re-raised typed
+    "deploys",                  # completed rolling deploys
+    "deploy_rollbacks",         # canary/roll gates that restored params
+    "scale_up_events",
+    "scale_down_events",
+)
+
+
+class FleetMetrics:
+    """Thread-safe counters + per-replica last-scrape snapshots for the
+    fleet front door (mirrors ``ServingMetrics``/``PagedMetrics``:
+    plain ints under one lock, exported via :meth:`to_record`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {k: 0 for k in FLEET_COUNTERS}
+        # name -> {"ready", "queue_depth", "occupancy",
+        #          "p99_decode_step_ms", "routed"} from the last scrape
+        self.replicas: Dict[str, dict] = {}
+
+    def inc(self, name: str, v: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(v)
+
+    def on_routed(self, kind: str, replica: str) -> None:
+        """One placement decision: ``kind`` is ``affinity`` (rendezvous
+        home), ``spill`` (home overloaded → least-loaded) or
+        ``least_loaded`` (no affinity key)."""
+        key = {"affinity": "routed_affinity", "spill": "routed_spill",
+               "least_loaded": "routed_least_loaded"}[kind]
+        with self._lock:
+            self.counters["requests_routed"] += 1
+            self.counters[key] += 1
+            rep = self.replicas.setdefault(replica, {})
+            rep["routed"] = rep.get("routed", 0) + 1
+
+    def observe_replica(self, name: str, load) -> None:
+        """Record a replica's last scraped load (a ``ReplicaLoad``)."""
+        with self._lock:
+            rep = self.replicas.setdefault(name, {})
+            rep.update(ready=bool(load.ready),
+                       queue_depth=int(load.queue_depth),
+                       occupancy=round(float(load.occupancy), 4),
+                       p99_decode_step_ms=round(
+                           float(load.p99_decode_step_ms), 3))
+
+    def forget_replica(self, name: str) -> None:
+        with self._lock:
+            self.replicas.pop(name, None)
+
+    def affinity_hit_rate(self) -> float:
+        with self._lock:
+            home = self.counters["routed_affinity"]
+            spill = self.counters["routed_spill"]
+        return safe_ratio(home, home + spill)
+
+    def to_record(self, now: Optional[float] = None) -> dict:
+        """One ``{"type": "fleet"}`` record for ``StatsStorage`` (the
+        shape ``ui.report`` renders and ``registry.fold_fleet``
+        exports)."""
+        with self._lock:
+            counters = dict(self.counters)
+            replicas = {n: dict(r) for n, r in self.replicas.items()}
+        ready = sum(1 for r in replicas.values() if r.get("ready"))
+        return {
+            "type": "fleet",
+            "t": time.time() if now is None else now,
+            "counters": counters,
+            "fleet": {
+                "n_replicas": len(replicas),
+                "n_ready": ready,
+                "affinity_hit_rate": round(safe_ratio(
+                    counters["routed_affinity"],
+                    counters["routed_affinity"]
+                    + counters["routed_spill"]), 4),
+                "retries_per_request": round(safe_ratio(
+                    counters["retries"],
+                    counters["requests_routed"]), 4),
+            },
+            "replicas": replicas,
+        }
+
+
+__all__ = ["FLEET_COUNTERS", "FleetMetrics"]

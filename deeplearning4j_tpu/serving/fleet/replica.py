@@ -1,0 +1,251 @@
+"""Replica handle: one generative server + its telemetry, fleet-shaped.
+
+A :class:`FleetReplica` wraps one ``GenerativeServer`` /
+``PagedGenerativeServer`` (built lazily by a factory, or adopted
+pre-built) and gives the fleet tier the four things it needs:
+
+- **scrapeable load** — :meth:`scrape` returns a :class:`ReplicaLoad`
+  (ready/healthy + queue depth, occupancy, rolling p99 decode-step ms).
+  When the server runs a TelemetryServer the scrape goes over HTTP
+  ``GET /readyz`` — the real cross-process path, reading the ``load``
+  sub-dict that ``health_snapshot`` merges from the server's health
+  provider; without one it calls the provider in-process. Either way
+  the router sees the same fields.
+- **lifecycle** — :meth:`start` / :meth:`stop` (drain-on-shutdown) /
+  :meth:`kill` (the chaos path: abort without drain, state ``dead``).
+- **drain-before-reload** — :meth:`quiesce` flags the replica
+  ``draining`` (the router stops placing work on it) and waits for the
+  server to go idle; :meth:`resume` re-admits it. The rolling deploy
+  drives reloads exclusively through this window, so zero in-flight
+  requests ever observe a parameter swap mid-drain.
+- **reload** — :meth:`reload_from` re-pulls the spec's parameters
+  (``update_model`` — prefix-cache fencing included on the paged
+  server), bumping ``model_version``; ``params_snapshot()`` /
+  ``restore_params()`` pass through for the deploy gate's rollback.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.serving.queue import ServerClosedError
+
+#: replica lifecycle states (``draining`` still counts as alive — it
+#: finishes in-flight work; ``dead`` vs ``stopped`` distinguishes a
+#: chaos kill/crash from an orderly drain-and-stop)
+REPLICA_STATES = ("new", "ready", "draining", "stopped", "dead")
+
+
+@dataclass
+class ReplicaLoad:
+    """One scrape of a replica's routing signal (the ``/readyz``
+    ``load`` sub-dict plus the readiness verdict). ``t`` is the
+    scraper's monotonic clock — the router's staleness cutoff compares
+    against it, so a replica whose telemetry stops answering ages out
+    of the ready set without any extra liveness machinery."""
+
+    t: float
+    ready: bool
+    healthy: bool
+    queue_depth: int = 0
+    occupancy: float = 0.0              # max(slot, pool) occupancy
+    p99_decode_step_ms: float = 0.0
+
+    def stale(self, now: float, cutoff_s: float) -> bool:
+        return (now - self.t) > cutoff_s
+
+    def score(self) -> tuple:
+        """Least-loaded ordering key: queue depth dominates (queued
+        work is guaranteed wait), occupancy breaks ties."""
+        return (self.queue_depth, self.occupancy)
+
+
+class FleetReplica:
+    """Handle on one serving replica for the fleet router/deployer/
+    autoscaler. Construct with a live ``server`` or a zero-arg
+    ``factory`` (built at :meth:`start` — the autoscaler's scale-up
+    path)."""
+
+    def __init__(self, name: str, server=None,
+                 factory: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if server is None and factory is None:
+            raise ValueError("FleetReplica needs a server or a factory")
+        self.name = str(name)
+        self.server = server
+        self._factory = factory
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "ready" if server is not None else "new"
+        self.model_version = 0
+        self.last_load: Optional[ReplicaLoad] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "FleetReplica":
+        """Build the server (factory mode) and mark the replica ready.
+        Idempotent for an already-ready replica; restarting a stopped/
+        dead replica requires a factory (the old server is gone)."""
+        with self._lock:
+            if self.state == "ready":
+                return self
+            if self.state in ("stopped", "dead") and self._factory is None:
+                raise ServerClosedError(
+                    f"replica {self.name} is {self.state} and has no "
+                    f"factory to rebuild it")
+            if self.server is None or self.state in ("stopped", "dead"):
+                self.server = self._factory()
+            self.state = "ready"
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Orderly shutdown through the server's drain path."""
+        with self._lock:
+            if self.state in ("stopped", "dead", "new"):
+                self.state = "stopped" if self.state == "new" else self.state
+                return
+            self.state = "stopped"
+        self.server.shutdown(drain=drain, timeout=timeout)
+
+    def kill(self) -> None:
+        """Chaos: die without draining — queued requests fail typed,
+        the replica leaves the ready set. What a SIGKILL'd process
+        looks like from the router's side."""
+        with self._lock:
+            if self.state in ("stopped", "dead"):
+                self.state = "dead"
+                return
+            self.state = "dead"
+        self.server.shutdown(drain=False)
+
+    def mark_dead(self) -> None:
+        """Router-side verdict (a submit raised ``ServerClosedError``):
+        stop routing here without touching the server."""
+        with self._lock:
+            if self.state not in ("stopped",):
+                self.state = "dead"
+
+    @property
+    def alive(self) -> bool:
+        return self.state in ("ready", "draining")
+
+    @property
+    def routable(self) -> bool:
+        return self.state == "ready"
+
+    # -- drain-before-reload -------------------------------------------
+    @property
+    def idle(self) -> bool:
+        s = self.server
+        return s is None or (s._queue.pending() == 0
+                             and s._n_active() == 0)
+
+    def quiesce(self, timeout_s: float = 30.0,
+                poll_s: float = 0.005) -> bool:
+        """Stop receiving fleet traffic (state ``draining``) and wait
+        until every queued + in-flight generation finished. Returns
+        False on timeout — the replica STAYS draining so the caller
+        decides (the deploy aborts and resumes it)."""
+        with self._lock:
+            if self.state != "ready":
+                return self.state == "draining" and self.idle
+            self.state = "draining"
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            if self.idle:
+                return True
+            time.sleep(poll_s)
+        return self.idle
+
+    def resume(self) -> None:
+        with self._lock:
+            if self.state == "draining":
+                self.state = "ready"
+
+    # -- reload ---------------------------------------------------------
+    def reload_from(self, version: Optional[int] = None) -> int:
+        """Hot-reload serving parameters from the spec's source graph
+        (``update_model`` — the paged server also fences its prefix
+        cache). Returns the new ``model_version``."""
+        self.server.update_model()
+        self.model_version = (self.model_version + 1
+                              if version is None else int(version))
+        return self.model_version
+
+    def params_snapshot(self):
+        return self.server.params_snapshot()
+
+    def restore_params(self, params) -> None:
+        self.server.restore_params(params)
+
+    # -- traffic --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16, **kw):
+        """Delegate to the server; a dead/stopped replica raises
+        ``ServerClosedError`` exactly like a vanished process would."""
+        if not self.alive or self.server is None:
+            raise ServerClosedError(
+                f"replica {self.name} is {self.state}")
+        return self.server.submit(prompt, max_new_tokens=max_new_tokens,
+                                  **kw)
+
+    def prefix_hits(self) -> int:
+        """The replica's prefix-cache hit counter (0 on servers without
+        a prefix cache) — what affinity routing is trying to maximize,
+        and what the tests assert on."""
+        try:
+            return int(self.server.metrics.counters.get(
+                "prefix_hits", 0))
+        except Exception:
+            return 0
+
+    # -- load scrape ----------------------------------------------------
+    def scrape(self, timeout_s: float = 1.0) -> ReplicaLoad:
+        """Read ready/healthy + load. Over HTTP ``/readyz`` when the
+        server has a TelemetryServer (the cross-process path), else
+        straight from the health provider. Any scrape failure — dead
+        process, refused connection, bad JSON — is itself the answer:
+        not ready, not healthy."""
+        now = self._clock()
+        if not self.alive or self.server is None:
+            load = ReplicaLoad(t=now, ready=False, healthy=False)
+            self.last_load = load
+            return load
+        try:
+            tel = getattr(self.server, "telemetry", None)
+            if tel is not None:
+                try:
+                    with urllib.request.urlopen(tel.url + "/readyz",
+                                                timeout=timeout_s) as resp:
+                        snap = json.loads(resp.read().decode())
+                except urllib.error.HTTPError as e:
+                    # /readyz answers 503 WITH the snapshot body when
+                    # unready — that is load data, not a scrape failure
+                    snap = json.loads(e.read().decode())
+            else:
+                h = self.server._telemetry_health()
+                snap = {"ready": bool(h.get("ready")),
+                        "healthy": bool(h.get("healthy")),
+                        "load": h.get("load") or {}}
+            ld = snap.get("load") or {}
+            occ = max(float(ld.get("slot_occupancy", 0.0)),
+                      float(ld.get("pool_occupancy", 0.0)))
+            load = ReplicaLoad(
+                t=now,
+                ready=bool(snap.get("ready")) and self.routable,
+                healthy=bool(snap.get("healthy")),
+                queue_depth=int(ld.get("queue_depth", 0)),
+                occupancy=occ,
+                p99_decode_step_ms=float(
+                    ld.get("p99_decode_step_ms", 0.0)))
+        except Exception:   # noqa: BLE001 — unreachable replica = unready
+            load = ReplicaLoad(t=now, ready=False, healthy=False)
+        self.last_load = load
+        return load
+
+
+__all__ = ["FleetReplica", "ReplicaLoad", "REPLICA_STATES"]

@@ -1,0 +1,328 @@
+"""FleetRouter: health/load-aware front door with prefix affinity.
+
+The cluster-tier dispatch over N replica handles, composing three
+placement and two failure rules:
+
+- **least-loaded among ready** — replicas are scraped (``/readyz`` +
+  the merged ``load`` sub-dict) at most every ``poll_interval_s``;
+  a scrape older than ``stale_after_s`` disqualifies its replica (a
+  silent process is indistinguishable from a dead one). Among ready
+  replicas the lowest ``(queue_depth, occupancy)`` wins.
+- **prefix affinity (rendezvous)** — the prompt's full-block prefix is
+  chain-hashed with the SAME ``prefix_block_hashes`` the paged server's
+  prefix cache keys on, so "routes to the same replica" and "hits that
+  replica's prefix cache" are literally the same address space. The
+  hash picks its home replica by rendezvous (highest-random-weight)
+  hashing over the CURRENT ready set: replicas joining/leaving remap
+  only their own share of keys, no ring state to persist.
+- **load-aware spill** — an affinity home past ``spill_queue_depth`` or
+  ``spill_occupancy`` forfeits the request to the least-loaded replica:
+  a hot prefix cache is worth one queue slot of patience, not a
+  convoy.
+- **retry on shed/death** — a typed
+  :class:`~deeplearning4j_tpu.serving.resilience.RetryableServingError`
+  is retried up to ``retry_budget`` times, sleeping the error's own
+  ``retry_after_s`` hint (bounded by ``max_backoff_s``); a replica
+  whose submit/result raises ``ServerClosedError`` (or whose worker
+  crashed it into a ``ServingError``) is marked dead and the request
+  moves on immediately. Budget exhausted → the last typed shed
+  re-raises as-is (the caller inherits the backoff hint).
+- **never retried** — permanent ``ValueError`` (bad request),
+  ``PoisonedRequestError`` (the request IS the fault — it would poison
+  the next replica too), and deadline misses (``RequestTimeoutError``:
+  the SLO is already blown; retrying manufactures load, not answers).
+
+See docs/serving.md ("Fleet") for the full semantics table.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.serving.fleet.metrics import FleetMetrics
+from deeplearning4j_tpu.serving.fleet.replica import FleetReplica, ReplicaLoad
+from deeplearning4j_tpu.serving.paged.pool import prefix_block_hashes
+from deeplearning4j_tpu.serving.queue import (RequestTimeoutError,
+                                              ServerClosedError,
+                                              ServingError)
+from deeplearning4j_tpu.serving.resilience import (PoisonedRequestError,
+                                                   RetryableServingError)
+
+
+class FleetUnavailableError(RetryableServingError):
+    """No ready replica can take the request right now (all draining,
+    dead, stale, or shedding). Typed retryable — carries the router's
+    suggested re-poll interval as ``retry_after_s``."""
+
+
+@dataclass
+class FleetResult:
+    """One completed front-door generation, tagged with where and how
+    hard it was to place (what the fleet load generator logs per row)."""
+
+    tokens: List[int]
+    replica: str
+    retries: int = 0
+    routed: str = "least_loaded"        # affinity | spill | least_loaded
+    ttft_ms: Optional[float] = None
+    intertoken_ms: List[float] = field(default_factory=list)
+
+
+class FleetRouter:
+    """Front door over :class:`FleetReplica` handles.
+
+    ``affinity_blocks`` bounds how much of the prompt feeds the
+    affinity key (default 1: the first full block — shared system
+    prompts land together while long distinct tails still spread).
+    ``sleep``/``clock`` are injectable for deterministic tests.
+    """
+
+    def __init__(self, replicas=(), *, block_size: Optional[int] = None,
+                 affinity: bool = True, affinity_blocks: int = 1,
+                 retry_budget: int = 3, max_backoff_s: float = 1.0,
+                 stale_after_s: float = 5.0, poll_interval_s: float = 0.25,
+                 spill_queue_depth: int = 4, spill_occupancy: float = 0.9,
+                 metrics: Optional[FleetMetrics] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.RLock()
+        self.replicas: Dict[str, FleetReplica] = {}
+        self.affinity = bool(affinity)
+        self.affinity_blocks = int(affinity_blocks)
+        self.retry_budget = int(retry_budget)
+        self.max_backoff_s = float(max_backoff_s)
+        self.stale_after_s = float(stale_after_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.spill_queue_depth = int(spill_queue_depth)
+        self.spill_occupancy = float(spill_occupancy)
+        self.metrics = metrics if metrics is not None else FleetMetrics()
+        self._sleep = sleep
+        self._clock = clock
+        self._block_size = block_size
+        self._last_poll = float("-inf")
+        self._loads: Dict[str, ReplicaLoad] = {}
+        for r in replicas:
+            self.add_replica(r)
+
+    # -- membership -----------------------------------------------------
+    def add_replica(self, replica: FleetReplica) -> None:
+        with self._lock:
+            self.replicas[replica.name] = replica
+            self._last_poll = float("-inf")     # force a re-scrape
+
+    def remove_replica(self, name: str) -> Optional[FleetReplica]:
+        with self._lock:
+            rep = self.replicas.pop(name, None)
+            self._loads.pop(name, None)
+        self.metrics.forget_replica(name)
+        return rep
+
+    @property
+    def block_size(self) -> int:
+        if self._block_size is not None:
+            return int(self._block_size)
+        with self._lock:
+            for r in self.replicas.values():
+                bs = getattr(r.server, "block_size", None)
+                if bs:
+                    return int(bs)
+        return 16
+
+    # -- load polling ---------------------------------------------------
+    def poll(self, force: bool = False) -> Dict[str, ReplicaLoad]:
+        """Refresh every replica's load if the cached scrape is older
+        than ``poll_interval_s`` (or ``force``). Dispatch reads the
+        cache — scraping is amortized over requests, not per-request."""
+        with self._lock:
+            now = self._clock()
+            if not force and (now - self._last_poll) < self.poll_interval_s:
+                return dict(self._loads)
+            self._last_poll = now
+            replicas = list(self.replicas.values())
+        for r in replicas:
+            load = r.scrape()
+            with self._lock:
+                self._loads[r.name] = load
+            self.metrics.observe_replica(r.name, load)
+        with self._lock:
+            return dict(self._loads)
+
+    def snapshot_loads(self) -> Dict[str, ReplicaLoad]:
+        """Fresh loads for every replica (forced poll) — what the
+        autoscaler evaluates."""
+        return self.poll(force=True)
+
+    def _ready(self) -> List[Tuple[FleetReplica, ReplicaLoad]]:
+        now = self._clock()
+        out = []
+        with self._lock:
+            for name, rep in self.replicas.items():
+                load = self._loads.get(name)
+                if (rep.routable and load is not None and load.ready
+                        and not load.stale(now, self.stale_after_s)):
+                    out.append((rep, load))
+        return out
+
+    # -- placement ------------------------------------------------------
+    def _affinity_key(self, prompt) -> Optional[bytes]:
+        if not self.affinity:
+            return None
+        hashes = prefix_block_hashes(prompt, self.block_size,
+                                     n_blocks=self.affinity_blocks)
+        return hashes[-1] if hashes else None
+
+    @staticmethod
+    def _rendezvous(key: bytes, candidates) -> FleetReplica:
+        """Highest-random-weight choice: each (key, replica) pair gets
+        a deterministic pseudo-random weight; the max wins. Stable per
+        key while membership holds; a leaving replica re-homes only its
+        own keys."""
+        def weight(rep):
+            h = hashlib.blake2b(key + rep.name.encode("utf-8"),
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big")
+        return max(candidates, key=weight)
+
+    def route(self, prompt) -> Tuple[FleetReplica, str]:
+        """Pick (replica, kind) for ``prompt`` from the current load
+        cache; kind ∈ {affinity, spill, least_loaded}. Raises
+        :class:`FleetUnavailableError` when the ready set is empty."""
+        self.poll()
+        ready = self._ready()
+        if not ready:
+            raise FleetUnavailableError(
+                "no ready replicas in the fleet",
+                retry_after_s=self.poll_interval_s)
+        by_name = {rep.name: (rep, load) for rep, load in ready}
+        key = self._affinity_key(prompt)
+        if key is not None:
+            home = self._rendezvous(key, [rep for rep, _ in ready])
+            load = by_name[home.name][1]
+            if (load.queue_depth < self.spill_queue_depth
+                    and load.occupancy < self.spill_occupancy):
+                return home, "affinity"
+            least = min(ready, key=lambda rl: rl[1].score())[0]
+            return least, "spill"
+        least = min(ready, key=lambda rl: rl[1].score())[0]
+        return least, "least_loaded"
+
+    # -- dispatch -------------------------------------------------------
+    def _backoff(self, err: RetryableServingError) -> float:
+        hint = getattr(err, "retry_after_s", None)
+        if hint is None:
+            hint = self.poll_interval_s
+        return min(max(0.0, float(hint)), self.max_backoff_s)
+
+    def _mark_dead(self, replica: FleetReplica) -> None:
+        replica.mark_dead()
+        with self._lock:
+            self._loads.pop(replica.name, None)
+            self._last_poll = float("-inf")
+        self.metrics.inc("replica_deaths_seen")
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               timeout_ms: Optional[float] = None, **kw):
+        """Place one generation and return ``(handle, replica_name,
+        retries)`` — the streaming entry point. Retries SUBMIT-time
+        sheds/deaths within the budget; once a handle exists, failures
+        surface through it (use :meth:`generate` for end-to-end
+        retry)."""
+        attempts = 0
+        while True:
+            replica, kind = None, "least_loaded"
+            try:
+                replica, kind = self.route(prompt)
+                handle = replica.submit(prompt,
+                                        max_new_tokens=max_new_tokens,
+                                        timeout_ms=timeout_ms, **kw)
+                self.metrics.on_routed(kind, replica.name)
+                return handle, replica.name, attempts
+            except (ValueError, PoisonedRequestError, RequestTimeoutError):
+                self.metrics.inc("requests_failed")
+                raise
+            except RetryableServingError as e:
+                self.metrics.inc("sheds_seen")
+                attempts += 1
+                if attempts > self.retry_budget:
+                    self.metrics.inc("retry_giveups")
+                    raise
+                self.metrics.inc("retries")
+                self._sleep(self._backoff(e))
+            except ServingError:
+                # ServerClosedError / crash-typed failure: the replica
+                # is gone — no sleep, next candidate immediately
+                if replica is not None:
+                    self._mark_dead(replica)
+                attempts += 1
+                if attempts > self.retry_budget:
+                    self.metrics.inc("retry_giveups")
+                    raise FleetUnavailableError(
+                        f"request failed on {attempts} replicas",
+                        retry_after_s=self.poll_interval_s)
+                self.metrics.inc("retries")
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 timeout_ms: Optional[float] = None) -> FleetResult:
+        """The blocking front door: place, stream, and return the full
+        generation — retrying sheds AND mid-generation replica deaths
+        within one shared budget. This is the callable the fleet load
+        generator drives."""
+        t0 = self._clock()
+        attempts = 0
+        while True:
+            replica, kind = None, "least_loaded"
+            marks: List[float] = []
+            try:
+                replica, kind = self.route(prompt)
+                handle = replica.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    timeout_ms=timeout_ms,
+                    on_token=lambda tok: marks.append(self._clock()))
+                tokens = handle.result()
+                self.metrics.on_routed(kind, replica.name)
+                self.metrics.inc("requests_ok")
+                ttft = (marks[0] - t0) * 1000.0 if marks else None
+                inter = [(b - a) * 1000.0
+                         for a, b in zip(marks, marks[1:])]
+                return FleetResult(tokens=list(tokens),
+                                   replica=replica.name,
+                                   retries=attempts, routed=kind,
+                                   ttft_ms=ttft, intertoken_ms=inter)
+            except (ValueError, PoisonedRequestError):
+                self.metrics.inc("requests_failed")
+                raise
+            except RequestTimeoutError:
+                self.metrics.inc("requests_timed_out")
+                raise
+            except RetryableServingError as e:
+                self.metrics.inc("sheds_seen")
+                attempts += 1
+                if attempts > self.retry_budget:
+                    self.metrics.inc("retry_giveups")
+                    self.metrics.inc("requests_failed")
+                    raise
+                self.metrics.inc("retries")
+                self._sleep(self._backoff(e))
+            except ServingError:
+                if replica is not None:
+                    self._mark_dead(replica)
+                attempts += 1
+                if attempts > self.retry_budget:
+                    self.metrics.inc("retry_giveups")
+                    self.metrics.inc("requests_failed")
+                    raise FleetUnavailableError(
+                        f"request failed on {attempts} replicas",
+                        retry_after_s=self.poll_interval_s)
+                self.metrics.inc("retries")
+
+    # -- observability --------------------------------------------------
+    def publish(self, storage) -> None:
+        """Append the current ``{"type": "fleet"}`` record to a
+        ``StatsStorage`` (the report/registry feed)."""
+        storage.put(self.metrics.to_record())
+
+
+__all__ = ["FleetResult", "FleetRouter", "FleetUnavailableError"]

@@ -731,6 +731,20 @@ class GenerativeServer:
         with self._exec_lock:
             self._params = fresh
 
+    def params_snapshot(self) -> dict:
+        """The currently-installed serving parameters — the rollback
+        token a canaried fleet deploy takes BEFORE ``update_model`` so
+        a failed gate can restore exactly what served before."""
+        with self._exec_lock:
+            return self._params
+
+    def restore_params(self, params: dict) -> None:
+        """Install a :meth:`params_snapshot` between dispatches — the
+        fleet-deploy rollback path (same in-flight staleness contract
+        as ``update_model``)."""
+        with self._exec_lock:
+            self._params = dict(params)
+
     # -- worker ---------------------------------------------------------
     def _spawn_worker(self, index: int, slot: InflightSlot
                       ) -> threading.Thread:
@@ -1028,13 +1042,29 @@ class GenerativeServer:
 
     def _telemetry_health(self) -> dict:
         depth = self._queue.pending()
+        active = self._n_active()
         healthy = not self._closed
         return {"queue_depth": depth,
                 "queue_capacity": self.max_queue_len,
-                "active_slots": self._n_active(),
+                "active_slots": active,
                 "max_slots": self.max_slots,
                 "ready": healthy and depth < self.max_queue_len,
-                "healthy": healthy}
+                "healthy": healthy,
+                # the one-scrape routing signal: health_snapshot merges
+                # this sub-dict into /readyz's top-level "load" key
+                "load": self._telemetry_load(depth, active)}
+
+    def _telemetry_load(self, depth: int, active: int) -> dict:
+        step_ms = 0.0
+        if self.admission is not None:
+            try:
+                step_ms = float(self.admission.exec_ms())
+            except Exception:
+                step_ms = 0.0           # cold controller: no samples yet
+        return {"queue_depth": depth,
+                "slot_occupancy": (active / self.max_slots)
+                if self.max_slots else 0.0,
+                "p99_decode_step_ms": round(step_ms, 3)}
 
     # -- lifecycle ------------------------------------------------------
     def shutdown(self, drain: bool = True,

@@ -19,7 +19,20 @@ per-request deadlines, and TTFT + inter-token percentiles on
 :class:`LoadResult` — one driver shared by the acceptance tests
 (tests/test_generative.py) and ``bench.py generative``.
 
-Used by tests/test_serving.py and examples/serving_mnist.py.
+:class:`FleetLoadGenerator` is the multi-target replay: it drives a
+**callable front door** (``serving.fleet.FleetRouter.generate``, or
+any ``fn(prompt, max_new_tokens, timeout_ms)`` returning a
+``FleetResult``-shaped object) instead of one server, tags every
+``LoadResult`` row with the replica that served it and the retries it
+took, and reports fleet-wide TTFT / inter-token percentiles. Request
+``i`` stays a pure function of ``(seed, i)`` — identical traces
+against one replica, a fleet of three, or affinity-vs-random routing.
+An optional ``prefix_pool`` mixes shared prompt prefixes into the
+trace (the repeated-prefix traffic that prefix-affinity routing and
+prefix caching exist for).
+
+Used by tests/test_serving.py, tests/test_fleet.py and
+examples/serving_mnist.py / examples/fleet_serving.py.
 """
 from __future__ import annotations
 
@@ -32,6 +45,7 @@ import numpy as np
 
 from deeplearning4j_tpu.serving.queue import (
     RequestTimeoutError, ServerClosedError, ServerOverloadedError)
+from deeplearning4j_tpu.serving.resilience import RetryableServingError
 
 
 @dataclass
@@ -49,10 +63,26 @@ class LoadResult:
     ttft_ms: List[float] = field(default_factory=list)
     intertoken_ms: List[float] = field(default_factory=list)
     tokens_total: int = 0
+    # fleet traffic (FleetLoadGenerator): one row per request —
+    # ``{"i", "outcome", "replica", "retries", "routed", "ttft_ms"}``
+    # — so a run can be sliced per replica and per retry count
+    rows: List[dict] = field(default_factory=list)
 
     @property
     def n_issued(self) -> int:
         return self.n_ok + self.n_rejected + self.n_timed_out + self.n_failed
+
+    @property
+    def retries_total(self) -> int:
+        return sum(int(r.get("retries") or 0) for r in self.rows)
+
+    def by_replica(self) -> dict:
+        """``{replica: n_ok}`` over the tagged rows (fleet runs)."""
+        out: dict = {}
+        for r in self.rows:
+            if r.get("outcome") == "ok" and r.get("replica"):
+                out[r["replica"]] = out.get(r["replica"], 0) + 1
+        return out
 
     @property
     def throughput_rps(self) -> float:
@@ -92,6 +122,9 @@ class LoadResult:
                   f"{self.ttft_percentile(50):.2f} ms, p99 "
                   f"{self.ttft_percentile(99):.2f} ms; inter-token p50 "
                   f"{self.intertoken_percentile(50):.2f} ms")
+        if self.rows:
+            s += (f"; fleet: {self.retries_total} retries across "
+                  f"{len(self.by_replica())} serving replicas")
         return s
 
 
@@ -369,4 +402,126 @@ class GenerativeLoadGenerator:
         for t in consumers:
             t.join()
         result.duration_s = time.monotonic() - t_start
+        return result
+
+
+class FleetLoadGenerator:
+    """Open-loop replay against a callable front door (the fleet
+    router) — N servers behind one function.
+
+    ``front_door(prompt, max_new_tokens=..., timeout_ms=...)`` must
+    BLOCK until the generation completes and return an object with
+    ``tokens`` / ``replica`` / ``retries`` / ``routed`` / ``ttft_ms`` /
+    ``intertoken_ms`` (``serving.fleet.FleetResult``). Typed sheds the
+    router gave up on (``RetryableServingError``) count as rejected;
+    deadline misses as timed out; anything else as failed. Every
+    request lands one tagged row on ``LoadResult.rows``.
+
+    Request ``i`` is a pure function of ``(seed, i)`` — and of the
+    fixed ``prefix_pool``, when given: with probability ``prefix_p``
+    request ``i`` prepends pool entry ``rng.integers(len(pool))`` to
+    its random tail, producing the repeated-prefix traffic that makes
+    affinity routing measurable (same trace under any routing policy).
+    """
+
+    def __init__(self, front_door: Callable, *, vocab_size: int,
+                 seed: int = 0, prompt_len=(1, 16), new_tokens=(4, 32),
+                 deadline_ms=None, prefix_pool=None,
+                 prefix_p: float = 0.75):
+        self.front_door = front_door
+        self.vocab_size = int(vocab_size)
+        self.seed = int(seed)
+        self.prompt_len = prompt_len
+        self.new_tokens = new_tokens
+        self.deadline_ms = deadline_ms
+        self.prefix_pool = None if prefix_pool is None else [
+            np.asarray(p, np.int32).reshape(-1) for p in prefix_pool]
+        self.prefix_p = float(prefix_p)
+
+    def request(self, i: int):
+        """The i-th trace entry ``(prompt, max_new_tokens,
+        deadline_ms)`` — deterministic in ``(seed, i)``."""
+        rng = np.random.default_rng((self.seed, int(i)))
+        plen = GenerativeLoadGenerator._sample_len(self.prompt_len, rng)
+        tail = rng.integers(0, self.vocab_size, plen).astype(np.int32)
+        prompt = tail
+        if self.prefix_pool and rng.random() < self.prefix_p:
+            prefix = self.prefix_pool[
+                int(rng.integers(len(self.prefix_pool)))]
+            prompt = np.concatenate([prefix, tail])
+        n_new = GenerativeLoadGenerator._sample_len(self.new_tokens, rng)
+        deadline = None
+        if self.deadline_ms is not None:
+            dlo, dhi = (self.deadline_ms
+                        if isinstance(self.deadline_ms, (tuple, list))
+                        else (self.deadline_ms, self.deadline_ms))
+            deadline = float(rng.uniform(dlo, dhi))
+        return prompt, n_new, deadline
+
+    def _issue(self, i: int, result: LoadResult,
+               lock: threading.Lock) -> None:
+        prompt, n_new, deadline = self.request(i)
+        t0 = time.monotonic()
+        row = {"i": int(i), "outcome": None, "replica": None,
+               "retries": 0, "routed": None, "ttft_ms": None}
+        try:
+            res = self.front_door(prompt, max_new_tokens=n_new,
+                                  timeout_ms=deadline)
+        except RetryableServingError:
+            row["outcome"] = "rejected"     # typed give-up: budget spent
+            with lock:
+                result.n_rejected += 1
+                result.rows.append(row)
+            return
+        except RequestTimeoutError:
+            row["outcome"] = "timed_out"
+            with lock:
+                result.n_timed_out += 1
+                result.rows.append(row)
+            return
+        except Exception as e:              # noqa: BLE001 — tally + tag
+            row["outcome"] = f"failed:{type(e).__name__}"
+            with lock:
+                result.n_failed += 1
+                result.rows.append(row)
+            return
+        ms = (time.monotonic() - t0) * 1000.0
+        row.update(outcome="ok",
+                   replica=getattr(res, "replica", None),
+                   retries=int(getattr(res, "retries", 0) or 0),
+                   routed=getattr(res, "routed", None),
+                   ttft_ms=getattr(res, "ttft_ms", None))
+        with lock:
+            result.n_ok += 1
+            result.latencies_ms.append(ms)
+            result.tokens_total += len(getattr(res, "tokens", ()) or ())
+            if row["ttft_ms"] is not None:
+                result.ttft_ms.append(float(row["ttft_ms"]))
+            result.intertoken_ms.extend(
+                getattr(res, "intertoken_ms", ()) or ())
+            result.rows.append(row)
+
+    def run_open(self, n_requests: int = 64,
+                 rate_rps: float = 50.0) -> LoadResult:
+        """Fixed-rate open-loop replay: request ``i`` is issued at
+        ``i / rate_rps`` regardless of completions (each in its own
+        thread — the front door blocks per request)."""
+        result = LoadResult()
+        lock = threading.Lock()
+        interval = 1.0 / max(rate_rps, 1e-9)
+        workers: List[threading.Thread] = []
+        t_start = time.monotonic()
+        for i in range(n_requests):
+            target = t_start + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=self._issue,
+                                 args=(i, result, lock), daemon=True)
+            t.start()
+            workers.append(t)
+        for t in workers:
+            t.join()
+        result.duration_s = time.monotonic() - t_start
+        result.rows.sort(key=lambda r: r["i"])
         return result

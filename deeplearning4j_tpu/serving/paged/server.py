@@ -726,7 +726,25 @@ class PagedGenerativeServer(GenerativeServer):
             self._params = fresh
         self._prefix_flush_pending.set()
 
+    def restore_params(self, params: dict) -> None:
+        """Fleet-deploy rollback: install a ``params_snapshot()`` and
+        fence the prefix cache exactly as :meth:`update_model` does —
+        cached K/V were computed with the weights being replaced in
+        EITHER direction of a swap."""
+        super().restore_params(params)
+        self._prefix_flush_pending.set()
+
     # -- observability --------------------------------------------------
+    def _telemetry_load(self, depth: int, active: int) -> dict:
+        load = super()._telemetry_load(depth, active)
+        # capacity on the paged path is blocks held, not slots filled —
+        # a router balancing on occupancy must see pool pressure
+        load["pool_occupancy"] = round(
+            self.pool.held_count() / self.pool.capacity, 4) \
+            if self.pool.capacity else 0.0
+        load["blocks_committed"] = self._committed
+        return load
+
     def memory_report(self) -> dict:
         """Pool accounting for /memory + capacity planning — block
         granularity instead of the dense per-slot rows."""

@@ -26,23 +26,23 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+# the typed-failure contract lives in serving/resilience.py;
+# ``ServingError`` is re-exported here because this module historically
+# owned it (every ``from serving.queue import ServingError`` stays valid)
+from deeplearning4j_tpu.serving.resilience import (RetryableServingError,
+                                                   ServingError)
 
-class ServingError(RuntimeError):
-    """Base class for typed serving failures."""
 
-
-class ServerOverloadedError(ServingError):
+class ServerOverloadedError(RetryableServingError):
     """Admission rejected: the queue is at ``max_queue_len``, the SLO
     admission controller estimates the request cannot meet its deadline,
     or the circuit breaker is open (serving/resilience.py).
 
-    ``retry_after_s`` — when set — is the structured backoff hint: how
-    long the shedding condition is expected to persist (estimated queue
-    drain, or the breaker's time-to-probe)."""
-
-    def __init__(self, message: str, retry_after_s: Optional[float] = None):
-        super().__init__(message)
-        self.retry_after_s = retry_after_s
+    A :class:`~deeplearning4j_tpu.serving.resilience.RetryableServingError`:
+    ``retry_after_s`` — when set — is the structured backoff hint (how
+    long the shedding condition is expected to persist: estimated queue
+    drain, or the breaker's time-to-probe), and the error round-trips
+    across process boundaries via ``to_wire()``/``from_wire()``."""
 
 
 class RequestTimeoutError(ServingError):

@@ -52,11 +52,68 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.monitor.steptime import RollingPercentiles
-from deeplearning4j_tpu.serving.queue import ServingError
 
 #: breaker states, in escalation order (exported for dashboards:
 #: fold_serving maps them onto the ``dl4j_serving_breaker_state`` gauge)
 BREAKER_STATES = ("closed", "half_open", "open")
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving failures. Defined here (the
+    resilience contract module) and re-exported by ``serving.queue``,
+    which historically owned it — both import paths stay valid."""
+
+
+#: wire-kind registry: class-name -> exception class, populated by
+#: ``RetryableServingError.__init_subclass__`` so every typed shed in
+#: the process round-trips through :meth:`RetryableServingError.from_wire`
+#: to its concrete class. Unknown kinds (a newer replica's error type)
+#: fall back to the base — the retry semantics survive even when the
+#: specific subclass does not.
+_WIRE_KINDS: dict = {}
+
+
+class RetryableServingError(ServingError):
+    """A typed, *retryable* shed: the request was rejected by a
+    transient capacity condition (full queue, exhausted block pool,
+    open breaker, SLO admission), not by anything wrong with the
+    request itself. ``retry_after_s`` — when set — is the structured
+    backoff hint: how long the shedding condition is expected to
+    persist.
+
+    This class is the routing contract the fleet tier keys on: a
+    front door retries anything ``isinstance(e, RetryableServingError)``
+    (honoring the hint) and never retries permanent ``ValueError``s.
+    :meth:`to_wire`/:meth:`from_wire` round-trip the error as a plain
+    dict so a router can transport a shed across a process boundary
+    without losing its type or its ``retry_after_s``."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        _WIRE_KINDS[cls.__name__] = cls
+
+    def to_wire(self) -> dict:
+        """Serialize to a plain dict: ``{"kind", "message",
+        "retry_after_s"}`` — everything a remote caller needs to back
+        off correctly."""
+        return {"kind": type(self).__name__,
+                "message": str(self),
+                "retry_after_s": self.retry_after_s}
+
+    @staticmethod
+    def from_wire(d: dict) -> "RetryableServingError":
+        """Reconstruct a typed shed from :meth:`to_wire` output. The
+        concrete class is looked up by ``kind``; an unknown kind
+        deserializes as the base class so cross-version fleets still
+        agree on "retryable with this hint"."""
+        cls = _WIRE_KINDS.get(str(d.get("kind", "")), RetryableServingError)
+        hint = d.get("retry_after_s")
+        return cls(str(d.get("message", "")),
+                   retry_after_s=None if hint is None else float(hint))
 
 
 class PoisonedRequestError(ServingError):
@@ -375,7 +432,7 @@ class WorkerSupervisor:
 
     # ------------------------------------------------------------------
     def _requeue(self, reqs: List) -> None:
-        from deeplearning4j_tpu.serving.queue import ServingError as _SE
+        _SE = ServingError
         # reversed: requeue() puts each at the FRONT, so walking newest-
         # first leaves the queue in the original FIFO order (oldest at
         # the head, keeping its deadline odds)
@@ -464,4 +521,5 @@ class WorkerSupervisor:
 
 __all__ = ["AdmissionController", "BREAKER_STATES", "CircuitBreaker",
            "InflightSlot", "PoisonedRequestError", "ReloadFailedError",
-           "ResilienceConfig", "WorkerSupervisor"]
+           "ResilienceConfig", "RetryableServingError", "ServingError",
+           "WorkerSupervisor"]

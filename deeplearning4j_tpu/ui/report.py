@@ -224,7 +224,7 @@ _KNOWN_TYPES = frozenset({
     "meta", "score", "perf", "params", "memory", "end", "serving",
     "checkpoint", "dispatch", "faults", "metrics", "steptime", "trace",
     "compile", "reshard", "tensorstats", "memory_plan", "analysis",
-    "datapipe", "integrity"})
+    "datapipe", "integrity", "fleet"})
 
 
 #: memory-plan byte components for the stacked budget chart, mirroring
@@ -312,6 +312,7 @@ def render_report(storage: StatsStorage, title: str = "Training report"
     reshards = storage.of_type("reshard")
     datapipe = storage.of_type("datapipe")
     serving = storage.of_type("serving")
+    fleet = storage.of_type("fleet")
     serving_faults = [r for r in storage.of_type("faults")
                       if r.get("origin") == "serving"]
     integrity = storage.of_type("integrity")
@@ -856,6 +857,52 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 f"<td>{_html.escape(str(detail) if detail else '—')}"
                 f"</td></tr>")
         parts.append("</table>")
+
+    # -- serving fleet: routing / retries / deploys / autoscale ----------
+    if fleet:
+        rec = fleet[-1]
+        c = rec.get("counters", {})
+        agg = rec.get("fleet", {})
+        parts.append(f"<h2>Fleet ({agg.get('n_ready', 0)}/"
+                     f"{agg.get('n_replicas', 0)} replicas ready)</h2>")
+        routing_bits = [
+            f"routed {c.get('requests_routed', 0)}",
+            f"affinity {c.get('routed_affinity', 0)}",
+            f"spill {c.get('routed_spill', 0)}",
+            f"least-loaded {c.get('routed_least_loaded', 0)}",
+            f"affinity hit rate "
+            f"<b>{agg.get('affinity_hit_rate', 0.0):.1%}</b>"]
+        parts.append("<p>routing: " + "; ".join(routing_bits) + "</p>")
+        retry_bits = [f"{k.replace('_', ' ')} {c[k]}" for k in
+                      ("retries", "sheds_seen", "replica_deaths_seen",
+                       "retry_giveups", "requests_failed",
+                       "requests_timed_out") if c.get(k)]
+        if retry_bits:
+            parts.append("<p>resilience: " + "; ".join(retry_bits)
+                         + f" ({c.get('requests_ok', 0)} ok)</p>")
+        ops_bits = [f"{k.replace('_', ' ')} {c[k]}" for k in
+                    ("deploys", "deploy_rollbacks", "scale_up_events",
+                     "scale_down_events") if c.get(k)]
+        if ops_bits:
+            parts.append("<p>operations: " + "; ".join(ops_bits)
+                         + "</p>")
+        replicas = rec.get("replicas", {})
+        if replicas:
+            parts.append(
+                "<table><tr><th>replica</th><th>ready</th>"
+                "<th>queue</th><th>occupancy</th>"
+                "<th>p99 step ms</th><th>routed</th></tr>")
+            for name in sorted(replicas):
+                rep = replicas[name]
+                parts.append(
+                    f"<tr><td>{_html.escape(str(name))}</td>"
+                    f"<td>{'yes' if rep.get('ready') else 'NO'}</td>"
+                    f"<td>{rep.get('queue_depth', 0)}</td>"
+                    f"<td>{rep.get('occupancy', 0.0):.0%}</td>"
+                    f"<td>{rep.get('p99_decode_step_ms', 0.0):.2f}</td>"
+                    f"<td>{rep.get('routed', 0)}</td></tr>")
+            parts.append("</table>")
+        parts.append("<p>(docs/serving.md \"Fleet\")</p>")
 
     # -- observability: unified metrics snapshot -------------------------
     if metrics:

@@ -252,6 +252,31 @@ def _seed_dense_kv_exceeds_headroom():
     return rep, "kv_slab[64x4096]", "headroom guard"
 
 
+def _seed_fleet_slo_unreachable():
+    from deeplearning4j_tpu.analyze import analyze_fleet_config
+    # 100 req/s x 16 tokens x 20ms step = 32 concurrent slots needed,
+    # but 2 replicas x 4 slots = 8 -> saturated, queues diverge
+    rep = analyze_fleet_config(replicas=2, max_slots=4,
+                               p99_decode_step_ms=20.0,
+                               ttft_slo_ms=200.0,
+                               arrival_rate_rps=100.0)
+    assert rep.context == "serving_config" and rep.rules_run == 1
+    f = [x for x in rep.findings
+         if x.rule_id == "serving.fleet_slo_unreachable"][0]
+    assert "replicas" in f.fix_hint      # the hint IS the point
+    # a feasible plan (8 replicas x 8 slots = 64 >= 32 needed) is clean
+    assert not analyze_fleet_config(
+        replicas=8, max_slots=8, p99_decode_step_ms=20.0,
+        ttft_slo_ms=200.0, arrival_rate_rps=100.0).findings
+    # the floor variant: one decode step longer than the whole SLO
+    floor = analyze_fleet_config(replicas=64, max_slots=64,
+                                 p99_decode_step_ms=250.0,
+                                 ttft_slo_ms=200.0,
+                                 arrival_rate_rps=1.0)
+    assert any("no replica count" in x.message for x in floor.findings)
+    return rep, "fleet[2x4]", "concurrent slots"
+
+
 CORPUS = {
     "graph.shape_mismatch": _seed_shape_mismatch,
     "graph.undefined_input": _seed_undefined_input,
@@ -274,6 +299,7 @@ CORPUS = {
     "config.chaos_armed": _seed_chaos_armed,
     "config.tensorstats_unobserved": _seed_tensorstats_unobserved,
     "serving.dense_kv_exceeds_headroom": _seed_dense_kv_exceeds_headroom,
+    "serving.fleet_slo_unreachable": _seed_fleet_slo_unreachable,
 }
 
 
@@ -439,12 +465,15 @@ class TestModelSweep:
         assert rep.rules_run == len(_INFERENCE_RULES) == 9
         # ... and a config-less training analysis skips config rules
         # (and the serving-capacity rules, which only run under
-        # analyze_generative_config)
+        # analyze_generative_config / analyze_fleet_config)
+        from deeplearning4j_tpu.analyze import _SERVING_RULES
         bare = SameDiff()
         p = bare.placeholder("p", shape=(-1, 4))
         p.mean(name="loss")
         bare.set_loss_variables(["loss"])
-        assert analyze_training(bare).rules_run == len(RULES) - 8 - 1
+        assert (analyze_training(bare).rules_run
+                == len(RULES) - 8 - len(_SERVING_RULES))
+        assert len(_SERVING_RULES) == 2
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,11 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            # the paged walkthrough compiles two serving tiers (dense
            # reference + paged, then a tp=2 mesh) — priced out of the
            # tier-1 wall budget, still pinned by the slow tier
-           pytest.param("paged_serving.py", marks=pytest.mark.slow)]
+           pytest.param("paged_serving.py", marks=pytest.mark.slow),
+           # the fleet drill stands up three paged replicas and runs
+           # kill + rolling-deploy chaos under open-loop load — slow
+           # tier for the same wall-budget reason
+           pytest.param("fleet_serving.py", marks=pytest.mark.slow)]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)

@@ -1,0 +1,690 @@
+"""Serving fleet (serving/fleet/, ISSUE 17).
+
+Pinned contracts:
+
+- the retryable-shed WIRE contract round-trips: ``to_wire``/
+  ``from_wire`` reconstruct the concrete error class with its
+  ``retry_after_s`` hint intact, unknown kinds degrade to the base
+  class without losing the hint;
+- ``health_snapshot`` merges provider ``load`` sub-dicts and the
+  replica scrape reads them — over HTTP ``/readyz`` when the server
+  runs a TelemetryServer, in-process otherwise, same fields either way;
+- routing: least-loaded among ready; prefix affinity keeps a repeated
+  prefix on ONE replica (asserted via that replica's prefix-cache hit
+  counter) and spills off an overloaded home; a typed shed is retried
+  honoring its ``retry_after_s`` and re-raises typed once the budget is
+  spent; permanent ``ValueError`` is NEVER retried; a dead replica is
+  failed over immediately (no sleep);
+- rolling deploys drain before reload (zero queued + in-flight work at
+  ``update_model`` time), keep the rest of the fleet serving
+  throughout, and roll BACK the canary's parameters on a failed gate;
+- the autoscaler needs ``hysteresis`` consecutive signals + an elapsed
+  cooldown before acting, and respects min/max bounds;
+- chaos: killing a replica mid-traffic fails ZERO healthy requests —
+  the router retries onto survivors (slow-marked drill).
+"""
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.fleet import (FleetAutoscaler, FleetMetrics,
+                                              FleetReplica, FleetRouter,
+                                              FleetUnavailableError,
+                                              ReplicaLoad, RollingDeploy)
+from deeplearning4j_tpu.serving.paged import (PagedGenerativeServer,
+                                              PoolExhaustedError)
+from deeplearning4j_tpu.serving.queue import (RequestTimeoutError,
+                                              ServerClosedError,
+                                              ServerOverloadedError,
+                                              ServingError)
+from deeplearning4j_tpu.serving.resilience import (PoisonedRequestError,
+                                                   RetryableServingError)
+from deeplearning4j_tpu.zoo.gpt import GPTConfig, build_gpt, gpt_paged_spec
+
+CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_seq_len=32)
+MSL = 32
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def gpt_sd():
+    return build_gpt(CFG, batch=2, seq_len=8, seed=0)
+
+
+@pytest.fixture(scope="module")
+def spec(gpt_sd):
+    # one spec for the whole module: the jitted programs are memoized
+    # per (spec, geometry), so every replica below shares one compile set
+    return gpt_paged_spec(gpt_sd, CFG)
+
+
+def make_server(spec, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", MSL)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("warmup", False)
+    kw.setdefault("debug_leaks", True)
+    return PagedGenerativeServer(spec, **kw)
+
+
+def make_fleet(spec, n=3, router_kw=None, **server_kw):
+    """n paged replicas (shared spec -> shared compile set) + a router."""
+    replicas = [FleetReplica(f"r{i}", server=make_server(spec, **server_kw))
+                for i in range(n)]
+    router = FleetRouter(replicas, **(router_kw or {}))
+    return router, replicas
+
+
+def stop_fleet(replicas):
+    for r in replicas:
+        try:
+            r.stop(drain=False)
+        except Exception:   # noqa: BLE001 — already dead is fine here
+            pass
+
+
+# ----------------------------------------------------------------------
+# stub surface: just enough GenerativeServer for router-logic tests
+# (placement/retry semantics are host-side — no model needed)
+
+class StubHandle:
+    def __init__(self, tokens, fail=None):
+        self._tokens = tokens
+        self._fail = fail
+
+    def result(self, timeout=None):
+        if self._fail is not None:
+            raise self._fail
+        return self._tokens
+
+
+class StubServer:
+    def __init__(self, queue_depth=0, occupancy=0.0, step_ms=1.0,
+                 ready=True, submit_errors=(), result_errors=()):
+        self.block_size = BS
+        self.telemetry = None
+        self.queue_depth = queue_depth
+        self.occupancy = occupancy
+        self.step_ms = step_ms
+        self.ready = ready
+        self.submit_errors = list(submit_errors)
+        self.result_errors = list(result_errors)
+        self.submitted = []
+        self.reloads = 0
+        self.params = {"w": 0}
+        self.metrics = SimpleNamespace(counters={})
+        self._queue = SimpleNamespace(pending=lambda: 0)
+
+    def _n_active(self):
+        return 0
+
+    def _telemetry_health(self):
+        return {"ready": self.ready, "healthy": self.ready,
+                "load": {"queue_depth": self.queue_depth,
+                         "slot_occupancy": self.occupancy,
+                         "p99_decode_step_ms": self.step_ms}}
+
+    def submit(self, prompt, max_new_tokens=16, timeout_ms=None,
+               on_token=None, **kw):
+        if self.submit_errors:
+            raise self.submit_errors.pop(0)
+        self.submitted.append(list(np.asarray(prompt).tolist()))
+        toks = list(range(max_new_tokens))
+        if self.result_errors:
+            return StubHandle(toks, fail=self.result_errors.pop(0))
+        if on_token is not None:
+            for t in toks:
+                on_token(t)
+        return StubHandle(toks)
+
+    def shutdown(self, drain=True, timeout=None):
+        self.ready = False
+
+    def update_model(self):
+        self.reloads += 1
+
+    def params_snapshot(self):
+        return dict(self.params)
+
+    def restore_params(self, params):
+        self.params = dict(params)
+
+
+def stub_fleet(loads, **router_kw):
+    """{name: queue_depth} -> (router, {name: FleetReplica})."""
+    replicas = {name: FleetReplica(name, server=StubServer(queue_depth=d))
+                for name, d in loads.items()}
+    router_kw.setdefault("poll_interval_s", 0.0)   # always fresh loads
+    router = FleetRouter(replicas.values(), **router_kw)
+    return router, replicas
+
+
+# ----------------------------------------------------------------------
+class TestWireContract:
+    def test_round_trip_preserves_kind_and_hint(self):
+        e = ServerOverloadedError("queue full", retry_after_s=0.5)
+        wire = e.to_wire()
+        assert wire == {"kind": "ServerOverloadedError",
+                        "message": "queue full", "retry_after_s": 0.5}
+        back = RetryableServingError.from_wire(wire)
+        assert type(back) is ServerOverloadedError
+        assert isinstance(back, RetryableServingError)
+        assert isinstance(back, ServingError)
+        assert back.retry_after_s == 0.5 and str(back) == "queue full"
+
+    def test_subclasses_auto_register(self):
+        # PoolExhaustedError lives in serving/paged — registered by
+        # __init_subclass__, not by an import-order side table
+        e = PoolExhaustedError("no blocks", retry_after_s=0.25)
+        back = RetryableServingError.from_wire(e.to_wire())
+        assert type(back) is PoolExhaustedError
+        assert back.retry_after_s == 0.25
+
+    def test_unknown_kind_degrades_to_base(self):
+        back = RetryableServingError.from_wire(
+            {"kind": "FutureShedKind", "message": "m",
+             "retry_after_s": 1.5})
+        assert type(back) is RetryableServingError
+        assert back.retry_after_s == 1.5    # the hint survives anyway
+
+    def test_none_hint_round_trips(self):
+        back = RetryableServingError.from_wire(
+            RetryableServingError("m").to_wire())
+        assert back.retry_after_s is None
+
+
+# ----------------------------------------------------------------------
+class TestLoadTelemetry:
+    def test_health_snapshot_merges_load_subdicts(self):
+        from deeplearning4j_tpu.monitor.server import health_snapshot
+        snap = health_snapshot(providers={
+            "a": lambda: {"ready": True,
+                          "load": {"queue_depth": 3}},
+            "b": lambda: {"ready": True,
+                          "load": {"slot_occupancy": 0.5}}})
+        assert snap["load"] == {"queue_depth": 3, "slot_occupancy": 0.5}
+
+    def test_replica_scrape_in_process(self):
+        r = FleetReplica("s", server=StubServer(queue_depth=2,
+                                                occupancy=0.25,
+                                                step_ms=7.0))
+        load = r.scrape()
+        assert load.ready and load.healthy
+        assert load.queue_depth == 2
+        assert load.occupancy == 0.25
+        assert load.p99_decode_step_ms == 7.0
+        assert r.last_load is load
+
+    def test_replica_scrape_over_http_readyz(self, spec):
+        # the real cross-process path: TelemetryServer on an ephemeral
+        # port, load fields travel through GET /readyz JSON
+        srv = make_server(spec, telemetry_port=0)
+        try:
+            r = FleetReplica("net", server=srv)
+            load = r.scrape()
+            assert load.ready and load.healthy
+            assert load.queue_depth == 0
+            assert 0.0 <= load.occupancy <= 1.0
+        finally:
+            srv.shutdown(drain=False)
+
+    def test_scrape_failure_means_unready(self):
+        r = FleetReplica("b", server=StubServer())
+        r.server._telemetry_health = lambda: 1 / 0
+        load = r.scrape()
+        assert not load.ready and not load.healthy
+
+    def test_dead_replica_scrapes_unready(self):
+        r = FleetReplica("d", server=StubServer())
+        r.kill()
+        assert r.state == "dead"
+        assert not r.scrape().ready
+
+
+# ----------------------------------------------------------------------
+class TestRouting:
+    def test_least_loaded_among_ready(self):
+        router, reps = stub_fleet({"a": 5, "b": 0, "c": 2},
+                                  affinity=False)
+        for _ in range(4):
+            res = router.generate(np.arange(3), max_new_tokens=2)
+            assert res.replica == "b" and res.routed == "least_loaded"
+        assert len(reps["b"].server.submitted) == 4
+
+    def test_unready_replicas_are_skipped(self):
+        router, reps = stub_fleet({"a": 0, "b": 9}, affinity=False)
+        reps["a"].server.ready = False
+        res = router.generate(np.arange(3), max_new_tokens=2)
+        assert res.replica == "b"   # worst load but the only ready one
+
+    def test_empty_ready_set_raises_typed(self):
+        router, reps = stub_fleet({"a": 0}, affinity=False,
+                                  retry_budget=0)
+        reps["a"].server.ready = False
+        with pytest.raises(FleetUnavailableError) as ei:
+            router.generate(np.arange(3), max_new_tokens=2)
+        assert ei.value.retry_after_s is not None
+
+    def test_affinity_stable_and_spills_under_load(self):
+        router, reps = stub_fleet({"a": 0, "b": 0, "c": 0})
+        prompt = np.arange(BS + 3)      # one full block -> affinity key
+        homes = {router.generate(prompt, max_new_tokens=2).replica
+                 for _ in range(6)}
+        assert len(homes) == 1          # rendezvous: one home per key
+        home = homes.pop()
+        assert router.metrics.counters["routed_affinity"] == 6
+        # overload the home past spill_queue_depth -> least-loaded wins
+        reps[home].server.queue_depth = router.spill_queue_depth
+        res = router.generate(prompt, max_new_tokens=2)
+        assert res.replica != home and res.routed == "spill"
+        assert router.metrics.counters["routed_spill"] == 1
+        assert 0 < router.metrics.affinity_hit_rate() < 1
+
+    def test_short_prompt_has_no_affinity_key(self):
+        router, _ = stub_fleet({"a": 0, "b": 0})
+        res = router.generate(np.arange(BS - 1), max_new_tokens=2)
+        assert res.routed == "least_loaded"
+
+    def test_membership_change_rehomes_only_lost_keys(self):
+        router, _ = stub_fleet({"a": 0, "b": 0, "c": 0})
+        prompts = [np.concatenate([np.full(BS, i), np.arange(2)])
+                   for i in range(8)]
+        before = {i: router.route(p)[0].name
+                  for i, p in enumerate(prompts)}
+        gone = before[0]
+        router.remove_replica(gone)
+        after = {i: router.route(p)[0].name
+                 for i, p in enumerate(prompts)}
+        for i, name in before.items():
+            if name != gone:
+                assert after[i] == name     # survivors keep their keys
+
+
+class TestRetrySemantics:
+    def test_shed_retry_honors_retry_after_s(self):
+        sleeps = []
+        router, reps = stub_fleet({"a": 0}, sleep=sleeps.append,
+                                  affinity=False, retry_budget=3)
+        reps["a"].server.submit_errors = [
+            ServerOverloadedError("shed", retry_after_s=0.03),
+            ServerOverloadedError("shed", retry_after_s=0.07)]
+        res = router.generate(np.arange(3), max_new_tokens=2)
+        assert res.retries == 2
+        assert sleeps == [0.03, 0.07]   # the error's OWN hint, per shed
+        assert router.metrics.counters["sheds_seen"] == 2
+        assert router.metrics.counters["retries"] == 2
+
+    def test_budget_exhausted_reraises_typed(self):
+        sleeps = []
+        router, reps = stub_fleet({"a": 0}, sleep=sleeps.append,
+                                  affinity=False, retry_budget=2)
+        reps["a"].server.submit_errors = [
+            ServerOverloadedError("shed", retry_after_s=0.01)
+            for _ in range(5)]
+        with pytest.raises(ServerOverloadedError):
+            router.generate(np.arange(3), max_new_tokens=2)
+        assert len(sleeps) == 2         # budget sleeps only, then raise
+        assert router.metrics.counters["retry_giveups"] == 1
+        assert router.metrics.counters["requests_failed"] == 1
+
+    def test_backoff_is_bounded(self):
+        sleeps = []
+        router, reps = stub_fleet({"a": 0}, sleep=sleeps.append,
+                                  affinity=False, max_backoff_s=0.05)
+        reps["a"].server.submit_errors = [
+            ServerOverloadedError("shed", retry_after_s=60.0)]
+        router.generate(np.arange(3), max_new_tokens=2)
+        assert sleeps == [0.05]
+
+    def test_permanent_error_never_retried(self):
+        sleeps = []
+        router, reps = stub_fleet({"a": 0, "b": 0},
+                                  sleep=sleeps.append, affinity=False)
+        reps["a"].server.submit_errors = [ValueError("bad prompt")]
+        with pytest.raises(ValueError):
+            router.generate(np.arange(3), max_new_tokens=2)
+        assert sleeps == []             # no backoff, no second replica
+        assert reps["b"].server.submitted == []
+        assert router.metrics.counters["requests_failed"] == 1
+        assert router.metrics.counters["retries"] == 0
+
+    def test_poisoned_request_never_retried(self):
+        router, reps = stub_fleet({"a": 0, "b": 0}, affinity=False)
+        reps["a"].server.submit_errors = [PoisonedRequestError("poison")]
+        with pytest.raises(PoisonedRequestError):
+            router.generate(np.arange(3), max_new_tokens=2)
+        assert reps["b"].server.submitted == []
+
+    def test_deadline_miss_never_retried(self):
+        router, reps = stub_fleet({"a": 0, "b": 0}, affinity=False)
+        reps["a"].server.result_errors = [RequestTimeoutError("late")]
+        with pytest.raises(RequestTimeoutError):
+            router.generate(np.arange(3), max_new_tokens=2)
+        assert router.metrics.counters["requests_timed_out"] == 1
+        assert reps["b"].server.submitted == []
+
+    def test_replica_death_fails_over_immediately(self):
+        sleeps = []
+        router, reps = stub_fleet({"a": 0, "b": 1},
+                                  sleep=sleeps.append, affinity=False)
+        reps["a"].server.submit_errors = [ServerClosedError("gone")]
+        res = router.generate(np.arange(3), max_new_tokens=2)
+        assert res.replica == "b" and res.retries == 1
+        assert sleeps == []             # death -> no sleep, next replica
+        assert reps["a"].state == "dead"
+        assert router.metrics.counters["replica_deaths_seen"] == 1
+
+    def test_mid_generation_death_fails_over(self):
+        router, reps = stub_fleet({"a": 0, "b": 1}, affinity=False)
+        reps["a"].server.result_errors = [ServerClosedError("gone")]
+        res = router.generate(np.arange(3), max_new_tokens=2)
+        assert res.replica == "b" and res.retries == 1
+
+    def test_all_dead_raises_fleet_unavailable(self):
+        router, reps = stub_fleet({"a": 0}, affinity=False,
+                                  retry_budget=1)
+        reps["a"].server.submit_errors = [ServerClosedError("gone"),
+                                          ServerClosedError("gone")]
+        with pytest.raises(FleetUnavailableError):
+            router.generate(np.arange(3), max_new_tokens=2)
+
+
+# ----------------------------------------------------------------------
+class TestAutoscaler:
+    @staticmethod
+    def synth_loads(queues, step_ms=10.0, t=0.0):
+        return {f"r{i}": ReplicaLoad(t=t, ready=True, healthy=True,
+                                     queue_depth=q,
+                                     p99_decode_step_ms=step_ms)
+                for i, q in enumerate(queues)}
+
+    @staticmethod
+    def make(router, clock, **kw):
+        built = []
+
+        def factory(name):
+            rep = FleetReplica(name, server=StubServer())
+            built.append(rep)
+            return rep
+        kw.setdefault("ttft_slo_ms", 500.0)
+        kw.setdefault("hysteresis", 2)
+        kw.setdefault("cooldown_s", 10.0)
+        sc = FleetAutoscaler(router, factory, clock=clock, **kw)
+        return sc, built
+
+    def test_hysteresis_delays_action(self):
+        router, _ = stub_fleet({"a": 0})
+        now = [0.0]
+        sc, built = self.make(router, lambda: now[0], max_replicas=4)
+        hot = self.synth_loads([8], step_ms=100.0)     # est 900 > 350
+        out1 = sc.step(dict(hot))
+        assert out1["signal"] == "scale_up" and not out1["acted"]
+        out2 = sc.step(dict(hot))
+        assert out2["acted"] and len(built) == 1
+        assert "scaled-0" in router.replicas
+        assert router.metrics.counters["scale_up_events"] == 1
+
+    def test_cooldown_blocks_back_to_back_actions(self):
+        router, _ = stub_fleet({"a": 0})
+        now = [0.0]
+        sc, built = self.make(router, lambda: now[0], max_replicas=8)
+        hot = self.synth_loads([8], step_ms=100.0)
+        sc.step(dict(hot)); sc.step(dict(hot))        # acts once
+        out = sc.step(dict(hot)); out = sc.step(dict(hot))
+        assert not out["acted"] and out.get("reason") == "cooldown"
+        now[0] = 60.0                                  # cooldown elapsed
+        out = sc.step(dict(hot))    # streak already past hysteresis
+        assert out["acted"] and len(built) == 2
+
+    def test_bounds_are_hard(self):
+        router, _ = stub_fleet({"a": 0})
+        now = [0.0]
+        sc, _ = self.make(router, lambda: now[0],
+                          min_replicas=1, max_replicas=1)
+        hot = self.synth_loads([9], step_ms=100.0)
+        sc.step(dict(hot))
+        out = sc.step(dict(hot))
+        assert not out["acted"] and out["reason"] == "at max_replicas"
+        idle = self.synth_loads([0], step_ms=1.0)      # est 1 << 100
+        sc.step(dict(idle))
+        out = sc.step(dict(idle))
+        assert not out["acted"] and out["reason"] == "at min_replicas"
+
+    def test_scale_down_drains_least_loaded(self):
+        router, reps = stub_fleet({"a": 0, "b": 0})
+        now = [0.0]
+        sc, _ = self.make(router, lambda: now[0],
+                          min_replicas=1, max_replicas=4)
+        # scale-down wants provably idle capacity: zero queues, low est;
+        # occupancy breaks the victim tie toward b
+        idle = {"a": ReplicaLoad(t=0.0, ready=True, healthy=True,
+                                 occupancy=0.5, p99_decode_step_ms=1.0),
+                "b": ReplicaLoad(t=0.0, ready=True, healthy=True,
+                                 occupancy=0.0, p99_decode_step_ms=1.0)}
+        sc.step(dict(idle))
+        out = sc.step(dict(idle))
+        assert out["acted"] and out["replica"] == "b"  # least loaded
+        assert "b" not in router.replicas
+        assert reps["b"].state == "stopped"
+        assert router.metrics.counters["scale_down_events"] == 1
+
+    def test_queue_trend_rising_signals_up(self):
+        router, _ = stub_fleet({"a": 0})
+        sc, _ = self.make(router, time.monotonic)
+        assert sc.evaluate(self.synth_loads([1], step_ms=1.0)) == "hold"
+        assert sc.evaluate(self.synth_loads([3], step_ms=1.0)) \
+            == "scale_up"                              # 1 -> 3 rising
+
+    def test_no_ready_replicas_signals_up(self):
+        router, _ = stub_fleet({"a": 0})
+        sc, _ = self.make(router, time.monotonic)
+        assert sc.evaluate({}) == "scale_up"
+
+
+# ----------------------------------------------------------------------
+class TestRollingDeployStubs:
+    def test_drains_before_reload_and_rolls_all(self):
+        router, reps = stub_fleet({"a": 0, "b": 0, "c": 0})
+        seen_idle = []
+        for r in reps.values():
+            orig, rep = r.server.update_model, r
+
+            def wrapped(orig=orig, rep=rep):
+                seen_idle.append((rep.name, rep.idle,
+                                  rep.state == "draining"))
+                orig()
+            r.server.update_model = wrapped
+        report = RollingDeploy(router, probes=[(np.arange(4), 3, None)],
+                               drain_timeout_s=2.0).run(canary="b")
+        assert report["ok"] and report["canary"] == "b"
+        assert report["rolled"] == ["b", "a", "c"]     # canary first
+        for name, idle, draining in seen_idle:
+            assert idle and draining, name
+        assert all(r.server.reloads == 1 for r in reps.values())
+        assert all(r.state == "ready" for r in reps.values())
+        assert all(r.model_version == 1 for r in reps.values())
+        assert router.metrics.counters["deploys"] == 1
+
+    def test_failed_gate_rolls_back_canary(self):
+        router, reps = stub_fleet({"a": 0, "b": 0})
+        # expected tokens the stub can never produce -> canary gate fails
+        report = RollingDeploy(
+            router, probes=[(np.arange(4), 3, [61, 62, 63])],
+            drain_timeout_s=2.0).run(canary="a")
+        assert not report["ok"] and report["failed_at"] == "a"
+        assert report.get("rolled_back")
+        assert "mismatch" in report["reason"]
+        assert report["rolled"] == []
+        assert reps["b"].server.reloads == 0           # roll never started
+        assert reps["a"].state == "ready"              # resumed serving
+        assert router.metrics.counters["deploy_rollbacks"] == 1
+
+    def test_canary_defines_reference_for_the_roll(self):
+        router, reps = stub_fleet({"a": 0, "b": 0})
+        # b's stub output diverges from a's -> the roll must fail at b
+        reps["b"].server.submit = (
+            lambda *a, **kw: StubHandle([9, 9, 9]))
+        report = RollingDeploy(router,
+                               probes=[(np.arange(4), 3, None)],
+                               drain_timeout_s=2.0).run(canary="a")
+        assert not report["ok"] and report["failed_at"] == "b"
+        assert report["rolled"] == ["a"]
+
+    def test_drain_timeout_aborts_with_nothing_reloaded(self):
+        router, reps = stub_fleet({"a": 0})
+        reps["a"].server._queue = SimpleNamespace(pending=lambda: 1)
+        report = RollingDeploy(router, drain_timeout_s=0.05).run()
+        assert not report["ok"] and "drain timed out" in report["reason"]
+        assert reps["a"].server.reloads == 0
+        assert reps["a"].state == "ready"              # resumed
+
+
+# ----------------------------------------------------------------------
+class TestFleetMetrics:
+    def seed(self):
+        m = FleetMetrics()
+        m.on_routed("affinity", "r0")
+        m.on_routed("affinity", "r0")
+        m.on_routed("spill", "r1")
+        m.on_routed("least_loaded", "r1")
+        m.inc("requests_ok", 4)
+        m.inc("retries")
+        m.observe_replica("r0", ReplicaLoad(
+            t=0.0, ready=True, healthy=True, queue_depth=2,
+            occupancy=0.4, p99_decode_step_ms=12.0))
+        m.observe_replica("r1", ReplicaLoad(
+            t=0.0, ready=False, healthy=False))
+        return m
+
+    def test_record_shape(self):
+        rec = self.seed().to_record(now=123.0)
+        assert rec["type"] == "fleet" and rec["t"] == 123.0
+        assert rec["fleet"]["n_replicas"] == 2
+        assert rec["fleet"]["n_ready"] == 1
+        assert rec["fleet"]["affinity_hit_rate"] == round(2 / 3, 4)
+        assert rec["fleet"]["retries_per_request"] == 0.25
+        assert rec["replicas"]["r0"]["routed"] == 2
+        assert rec["counters"]["requests_routed"] == 4
+
+    def test_registry_folds_fleet_gauges(self):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.fold_fleet(self.seed().to_record(now=1.0))
+        text = reg.to_prometheus_text()
+        for needle in ("dl4j_fleet_requests_routed_total",
+                       "dl4j_fleet_affinity_hit_rate",
+                       "dl4j_fleet_replicas_ready",
+                       'dl4j_fleet_replica_queue_depth{replica="r0"}'):
+            assert needle in text, needle
+        assert "nan" not in text.lower()
+
+    def test_report_renders_fleet_panel(self):
+        from deeplearning4j_tpu.ui.report import render_report
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        storage = StatsStorage()
+        storage.put(self.seed().to_record(now=1.0))
+        html = render_report(storage)
+        assert "Fleet (1/2 replicas ready)" in html
+        assert "affinity hit rate" in html
+
+
+# ----------------------------------------------------------------------
+# real servers: affinity hits a prefix cache, deploys serve throughout,
+# chaos kills lose nothing
+
+class TestFleetIntegration:
+    def test_affinity_lands_prefix_cache_hits(self, spec):
+        router, replicas = make_fleet(spec, n=3)
+        try:
+            shared = np.arange(BS, dtype=np.int32)     # one full block
+            prompts = [np.concatenate([shared,
+                                       np.full(2, i, dtype=np.int32)])
+                       for i in range(5)]
+            results = [router.generate(p, max_new_tokens=2)
+                       for p in prompts]
+            homes = {r.replica for r in results}
+            assert homes == {results[0].replica}       # one home replica
+            assert all(r.routed == "affinity" for r in results)
+            hits = {r.name: r.prefix_hits() for r in replicas}
+            home = results[0].replica
+            # every post-first request hit the home's prefix cache; the
+            # other replicas never even saw the prefix
+            assert hits[home] >= len(prompts) - 1
+            assert all(h == 0 for n, h in hits.items() if n != home)
+        finally:
+            stop_fleet(replicas)
+
+    def test_deploy_serves_throughout(self, spec):
+        router, replicas = make_fleet(spec, n=2)
+        failures, done = [], []
+
+        def traffic():
+            rng = np.random.default_rng(7)
+            for _ in range(6):
+                prompt = rng.integers(0, CFG.vocab_size, 5,
+                                      dtype=np.int64).astype(np.int32)
+                try:
+                    res = router.generate(prompt, max_new_tokens=2)
+                    done.append(res)
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(e)
+        try:
+            t = threading.Thread(target=traffic)
+            t.start()
+            report = RollingDeploy(
+                router, probes=[(np.arange(6, dtype=np.int32), 3, None)],
+                drain_timeout_s=30.0).run()
+            t.join(timeout=120)
+            assert not t.is_alive()
+            assert report["ok"], report
+            assert sorted(report["rolled"]) == ["r0", "r1"]
+            assert failures == []                       # zero failed
+            assert len(done) == 6
+            assert all(r.model_version == 1 for r in replicas)
+        finally:
+            stop_fleet(replicas)
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_kill_replica_chaos_drill(self, spec):
+        """The acceptance bar: kill one of three replicas mid-traffic;
+        every healthy request still completes (retried onto survivors),
+        zero failures."""
+        router, replicas = make_fleet(
+            spec, n=3, router_kw={"retry_budget": 4,
+                                  "poll_interval_s": 0.05})
+        failures, done = [], []
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, CFG.vocab_size, 5).astype(np.int32)
+                   for _ in range(18)]
+
+        def one(p):
+            try:
+                done.append(router.generate(p, max_new_tokens=3))
+            except Exception as e:      # noqa: BLE001 — the assertion
+                failures.append(e)
+        try:
+            threads = []
+            for i, p in enumerate(prompts):
+                t = threading.Thread(target=one, args=(p,))
+                t.start()
+                threads.append(t)
+                if i == 5:
+                    replicas[0].kill()  # mid-traffic, no drain
+                time.sleep(0.01)
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+            assert failures == [], failures
+            assert len(done) == len(prompts)
+            survivors = {r.replica for r in done}
+            assert survivors <= {"r0", "r1", "r2"}
+            # post-kill requests all landed on survivors
+            late = {r.replica for r in done[-6:]}
+            assert "r0" not in late
+        finally:
+            stop_fleet(replicas)
