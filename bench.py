@@ -723,6 +723,192 @@ def bench_serving_paged(n_requests=32, dense_slots=4, max_seq_len=256,
             "dense": out["dense"], "paged": out["paged"]}
 
 
+def bench_serving_speculative(n_requests=24, max_slots=4, max_seq_len=256,
+                              speculate_k=8, n_draft_layers=1,
+                              prompt_len=(2, 16), concurrency=8, seed=19):
+    """Speculative decoding vs plain decode on one seeded skewed trace
+    (serving/generative.py ``draft_spec=``, ISSUE 18).
+
+    Self-speculative pairing: the target's DEEP layers get their
+    residual-out projections (``attn/proj``, ``mlp/proj``) zeroed, so
+    those blocks are identity on the residual stream and the
+    ``n_draft_layers``-deep draft computes the target's exact logits.
+    Acceptance then sits at ~1.0, measuring the mechanism's ceiling —
+    every drafted token rides the ONE batched verify dispatch — rather
+    than any particular draft model's quality; the acceptance bar is
+    speculative >= 1.5x plain tokens/sec on the mixed-length trace.
+    The geometry matters: with a 1-of-8-layers draft and K=8, a round
+    costs ~2 target-step-equivalents (8 cheap drafts + one verify,
+    whose window rides the weight bytes one decode step already moves)
+    and lands ~K tokens — the plain path pays K full steps. Also
+    records the temp-0 bit-identity bit (speculation must emit EXACTLY
+    the non-speculative greedy tokens) and both servers'
+    traffic-compile counts (0 after warmup)."""
+    import dataclasses as _dc
+
+    from deeplearning4j_tpu.serving.generative import (GenerativeServer,
+                                                       greedy_decode)
+    from deeplearning4j_tpu.serving.loadgen import GenerativeLoadGenerator
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_generative_spec)
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=8,
+                    num_heads=8, intermediate_size=512,
+                    max_seq_len=max_seq_len)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    for i in range(int(n_draft_layers), cfg.num_layers):
+        for part in ("attn/proj", "mlp/proj"):
+            for leaf in ("kernel", "bias"):
+                n = f"h{i}/{part}/{leaf}"
+                sd._arrays[n] = np.zeros_like(np.asarray(sd._arrays[n]))
+    spec = gpt_generative_spec(sd, cfg)
+    draft = gpt_generative_spec(
+        sd, _dc.replace(cfg, num_layers=int(n_draft_layers)))
+
+    def new_tokens(rng):
+        # the skewed trace continuous batching + speculation both live
+        # for: mostly short answers, a 20% tail of long generations
+        return int(rng.integers(2, 9)) if rng.random() < 0.8 \
+            else int(rng.integers(80, 129))
+
+    out = {}
+    builds = {
+        "plain": lambda: GenerativeServer(
+            spec, max_slots=max_slots, max_seq_len=max_seq_len,
+            warmup=True),
+        "speculative": lambda: GenerativeServer(
+            spec, max_slots=max_slots, max_seq_len=max_seq_len,
+            draft_spec=draft, speculate_k=speculate_k, warmup=True)}
+    for name, build in builds.items():
+        srv = build()
+        try:
+            lg = GenerativeLoadGenerator(srv, seed=seed,
+                                         prompt_len=prompt_len,
+                                         new_tokens=new_tokens)
+            res = lg.run_closed(n_requests=n_requests,
+                                concurrency=concurrency)
+        finally:
+            srv.shutdown()
+        rec = srv.metrics.to_record()
+        gen = rec["generative"]
+        out[name] = {
+            "tokens_per_sec": round(res.tokens_per_sec, 1),
+            "intertoken_p50_ms": round(res.intertoken_percentile(50), 3),
+            "decode_steps": gen["decode_steps"],
+            "n_ok": res.n_ok,
+            "compiles": rec["counters"]["compiles"],
+            "warmup_compiles": rec["counters"]["warmup_compiles"]}
+        if name == "speculative":
+            out[name]["acceptance_rate"] = gen["draft_acceptance_rate"]
+            out[name]["spec_rounds"] = gen["spec_rounds"]
+            out[name]["draft_rejected"] = gen["draft_rejected"]
+
+    # temp-0 bit-identity: the acceptance criterion of the change
+    probes = [(np.arange(L, dtype=np.int32) * 7) % cfg.vocab_size
+              for L in (3, 11, 29)]
+    srv = GenerativeServer(spec, max_slots=2, max_seq_len=max_seq_len,
+                           draft_spec=draft, speculate_k=speculate_k,
+                           warmup=True)
+    try:
+        got = [srv.submit(p, max_new_tokens=12).result(timeout=120)
+               for p in probes]
+    finally:
+        srv.shutdown()
+    greedy_match = got == [greedy_decode(spec, p, 12,
+                                         max_seq_len=max_seq_len)
+                           for p in probes]
+
+    speedup = (out["speculative"]["tokens_per_sec"]
+               / out["plain"]["tokens_per_sec"]) \
+        if out["plain"]["tokens_per_sec"] else 0.0
+    return {"samples_per_sec": out["speculative"]["tokens_per_sec"],
+            "tokens_per_sec": out["speculative"]["tokens_per_sec"],
+            "plain_tokens_per_sec": out["plain"]["tokens_per_sec"],
+            "speculative_speedup": round(speedup, 2),
+            "acceptance_rate": out["speculative"]["acceptance_rate"],
+            "speculate_k": speculate_k,
+            "draft_layers": int(n_draft_layers),
+            "greedy_bit_identical": greedy_match,
+            "n_requests": n_requests,
+            "plain": out["plain"], "speculative": out["speculative"]}
+
+
+def bench_serving_quant(n_requests=24, max_slots=8, max_seq_len=256,
+                        block_size=16, prompt_len=(2, 16),
+                        concurrency=8, seed=23):
+    """int8 weight + KV quantization at equal slab bytes (zoo/gpt.py
+    ``quantize_weights``/``quantize_kv``, ISSUE 18).
+
+    The paged pool is sized in BYTES, and with ISSUE 18 the server
+    derives bytes-per-block from the spec's ``kv_dtype`` itemsize —
+    int8 KV quarters the bytes per block, so the SAME ``kv_hbm_bytes``
+    budget holds ~4x the f32 token capacity (acceptance bar >= 1.9x,
+    read from the live servers' pool sizes, not arithmetic). Also
+    reports f32-vs-int8 decode throughput on one seeded trace and the
+    greedy-token agreement between the two servers on probe prompts
+    (quantization is lossy; the delta is published, not gated)."""
+    from deeplearning4j_tpu.serving.loadgen import GenerativeLoadGenerator
+    from deeplearning4j_tpu.serving.paged import PagedGenerativeServer
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_paged_spec)
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=8, intermediate_size=512,
+                    max_seq_len=max_seq_len)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    specs = {"f32": gpt_paged_spec(sd, cfg),
+             "int8": gpt_paged_spec(sd, cfg, quantize_weights=True,
+                                    quantize_kv=True)}
+    # one fixed byte budget for both servers: 49 f32 blocks' worth
+    # (48 usable + the null block), so the int8 pool's size shows the
+    # dtype-aware sizing rather than a bigger grant
+    f32_block_bytes = 2 * int(np.prod(
+        specs["f32"].kv_shape(1, block_size))) * 4
+    kv_budget = 49 * f32_block_bytes
+
+    out = {}
+    toks = {}
+    probes = [(np.arange(L, dtype=np.int32) * 7) % cfg.vocab_size
+              for L in (3, 11, 29)]
+    for name, spec in specs.items():
+        srv = PagedGenerativeServer(spec, max_slots=max_slots,
+                                    max_seq_len=max_seq_len,
+                                    block_size=block_size,
+                                    kv_hbm_bytes=kv_budget, warmup=True)
+        try:
+            toks[name] = [srv.submit(p, max_new_tokens=10)
+                          .result(timeout=120) for p in probes]
+            lg = GenerativeLoadGenerator(srv, seed=seed,
+                                         prompt_len=prompt_len,
+                                         new_tokens=(4, 24))
+            res = lg.run_closed(n_requests=n_requests,
+                                concurrency=concurrency)
+        finally:
+            srv.shutdown()
+        rec = srv.metrics.to_record()
+        out[name] = {
+            "tokens_per_sec": round(res.tokens_per_sec, 1),
+            "pool_blocks": rec["paged"]["num_blocks"],
+            "token_capacity": rec["paged"]["num_blocks"] * block_size,
+            "kv_bytes": srv.kv_slab_bytes,
+            "n_ok": res.n_ok,
+            "compiles": rec["counters"]["compiles"]}
+    agree = float(np.mean([a == b
+                           for s8, s32 in zip(toks["int8"], toks["f32"])
+                           for a, b in zip(s8, s32)]))
+    ratio = (out["int8"]["token_capacity"] / out["f32"]["token_capacity"]
+             if out["f32"]["token_capacity"] else 0.0)
+    return {"samples_per_sec": out["int8"]["tokens_per_sec"],
+            "tokens_per_sec": out["int8"]["tokens_per_sec"],
+            "f32_tokens_per_sec": out["f32"]["tokens_per_sec"],
+            "kv_budget_bytes": kv_budget,
+            "token_capacity_ratio_equal_bytes": round(ratio, 2),
+            "greedy_token_agreement": round(agree, 4),
+            "block_size": block_size,
+            "n_requests": n_requests,
+            "f32": out["f32"], "int8": out["int8"]}
+
+
 def bench_serving_fleet(n_replicas=3, n_requests=48, rate_rps=40.0,
                         ttft_slo_ms=2000.0, block_size=8, seed=17):
     """Fleet chaos drill + affinity win (serving/fleet/, ISSUE 17).
@@ -1256,6 +1442,16 @@ def main():
                      # affinity-vs-random prefix-hit-rate column
                      # (serving/fleet/) for BENCH_r12
                      ("serving_fleet", bench_serving_fleet),
+                     # speculative decoding vs plain decode on the
+                     # skewed trace: acceptance-ceiling self-draft,
+                     # >= 1.5x tokens/sec bar, temp-0 bit-identity bit
+                     # (serving/generative.py draft_spec) for BENCH_r13
+                     ("serving_speculative", bench_serving_speculative),
+                     # int8 weights + KV: paged-pool token capacity at
+                     # equal slab bytes (>= 1.9x bar, ~4x expected) +
+                     # f32-vs-int8 throughput and greedy-token
+                     # agreement (zoo/gpt.py quantize_*) for BENCH_r13
+                     ("serving_quant", bench_serving_quant),
                      # the integrity rail's cost (state fingerprints +
                      # stall-watchdog guards on the fused K=8 listener
                      # path, ≤2% bar) for BENCH_r10

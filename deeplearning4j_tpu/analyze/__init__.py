@@ -40,8 +40,9 @@ from deeplearning4j_tpu.analyze.findings import (RULES, SEVERITIES,
                                                  GraphAnalysisWarning,
                                                  Rule, finding)
 from deeplearning4j_tpu.analyze import configpass, graphpass, numerics
-from deeplearning4j_tpu.analyze.servingpass import (analyze_fleet_config,
-                                                    analyze_generative_config)
+from deeplearning4j_tpu.analyze.servingpass import (
+    analyze_fleet_config, analyze_generative_config,
+    analyze_speculation_config)
 
 
 def _graph_size(sd):
@@ -62,9 +63,9 @@ _INFERENCE_RULES = frozenset({
 _CONFIG_RULES = frozenset(r for r in RULES if r.startswith("config."))
 
 #: serving-capacity rules (analyze/servingpass.py) run only under
-#: :func:`analyze_generative_config` / :func:`analyze_fleet_config` —
-#: never part of a training or graph-inference report's executed-rule
-#: count.
+#: :func:`analyze_generative_config` / :func:`analyze_fleet_config` /
+#: :func:`analyze_speculation_config` — never part of a training or
+#: graph-inference report's executed-rule count.
 _SERVING_RULES = frozenset(r for r in RULES if r.startswith("serving."))
 
 
@@ -174,4 +175,5 @@ def analyze_model(model, **kw) -> AnalysisReport:
 __all__ = ["RULES", "SEVERITIES", "Rule", "Finding", "finding",
            "AnalysisReport", "GraphAnalysisError", "GraphAnalysisWarning",
            "analyze_training", "analyze_inference", "analyze_model",
-           "analyze_generative_config", "analyze_fleet_config"]
+           "analyze_generative_config", "analyze_fleet_config",
+           "analyze_speculation_config"]

@@ -128,6 +128,12 @@ RULES: Dict[str, Rule] = _catalog(
          "estimate) cannot meet its TTFT SLO at the stated arrival "
          "rate — queues grow without bound under Little's law and "
          "every request is eventually shed or late"),
+    Rule("serving.speculation_misconfig", "error",
+         "a speculative-decoding draft/target pairing is broken "
+         "(vocab or max_seq mismatch — the server would refuse it at "
+         "construction) or pointless (draft at least as large as the "
+         "target, demoted to a warning: verification still yields the "
+         "target's exact tokens, just no speedup)"),
 )
 
 
@@ -163,11 +169,22 @@ class Finding:
 
 
 def finding(rule_id: str, subject: str, message: str, fix_hint: str = "",
-            provenance: Sequence[str] = ()) -> Finding:
-    """Build a Finding for a cataloged rule (severity comes from the
-    catalog — a finding can never disagree with its rule)."""
+            provenance: Sequence[str] = (),
+            severity: str = "") -> Finding:
+    """Build a Finding for a cataloged rule. Severity comes from the
+    catalog by default; a pass may pass ``severity=`` to DEMOTE a
+    dual-severity rule's hit (e.g. ``serving.speculation_misconfig``:
+    a broken pairing is an error, a merely-pointless one a warning) —
+    never to escalate past the catalog, which states the worst case."""
     rule = RULES[rule_id]
-    return Finding(rule_id=rule_id, severity=rule.severity,
+    if severity and severity not in SEVERITIES:
+        raise ValueError(f"{rule_id}: bad severity override {severity!r}")
+    if severity and SEVERITIES.index(severity) < \
+            SEVERITIES.index(rule.severity):
+        raise ValueError(
+            f"{rule_id}: override {severity!r} escalates past the "
+            f"cataloged {rule.severity!r}")
+    return Finding(rule_id=rule_id, severity=severity or rule.severity,
                    subject=subject, message=message, fix_hint=fix_hint,
                    provenance=tuple(provenance))
 

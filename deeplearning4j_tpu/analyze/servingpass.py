@@ -13,6 +13,16 @@ analyze/configpass.py.
   server (serving/paged) allocates the same budget as a block pool, so
   capacity scales with tokens actually held rather than the worst case
   — docs/serving.md "Paged KV & prefix caching".
+- ``serving.speculation_misconfig`` — the speculative-decoding pairing
+  lint (:func:`analyze_speculation_config`): a draft whose vocab
+  differs from the target's, or whose ``max_seq_len`` is shorter than
+  the served window, is refused by ``GenerativeServer`` at
+  construction — flagged here as an **error** at lint time. A draft at
+  least as LARGE (by parameter count) as its target constructs fine
+  and still emits the target's exact tokens, it just cannot speed
+  anything up — drafting costs more than it saves — so that variant is
+  demoted to a **warning** with the fix the runtime cannot pick for
+  you: a smaller zoo config (docs/serving.md "Decode speed").
 - ``serving.fleet_slo_unreachable`` — the fleet-plan twin
   (:func:`analyze_fleet_config`): pure admission math over ``replicas
   × slots × p99 decode-step estimate`` vs the TTFT SLO at the stated
@@ -125,6 +135,82 @@ def check_fleet_slo(replicas: int, max_slots: int,
     return out
 
 
+def _spec_param_count(spec) -> Optional[int]:
+    """Total parameter element count of a GenerativeSpec-shaped object
+    (``spec.params()`` -> name->array mapping); None when the spec
+    carries no params (the size check is then skipped)."""
+    params = getattr(spec, "params", None)
+    if not callable(params):
+        return None
+    try:
+        items = dict(params())
+    except TypeError:
+        return None
+    if not items:
+        return None
+    return int(sum(int(np.prod(np.shape(v)) or 1)
+                   for v in items.values()))
+
+
+def check_speculation(spec, draft_spec, speculate_k: int = 4):
+    """Findings for one draft/target speculation pairing — the checks
+    ``GenerativeServer(draft_spec=...)`` enforces at construction, plus
+    the economics check it deliberately does not."""
+    out = []
+    tv = int(getattr(spec, "vocab_size", 0) or 0)
+    dv = int(getattr(draft_spec, "vocab_size", 0) or 0)
+    if tv and dv and tv != dv:
+        out.append(finding(
+            "serving.speculation_misconfig", "draft_spec.vocab_size",
+            f"draft vocab ({dv}) != target vocab ({tv}) — drafted "
+            f"token ids index a different embedding table, so the "
+            f"server refuses the pairing at construction",
+            fix_hint="draft with a model trained on the SAME "
+                     "vocabulary (e.g. a num_layers-truncated copy of "
+                     "the target config)"))
+    tm = int(getattr(spec, "max_seq_len", 0) or 0)
+    dm = int(getattr(draft_spec, "max_seq_len", 0) or 0)
+    if tm and dm and dm < tm:
+        out.append(finding(
+            "serving.speculation_misconfig", "draft_spec.max_seq_len",
+            f"draft max_seq_len ({dm}) < served max_seq_len ({tm}) — "
+            f"the draft KV cache cannot cover the tail of a "
+            f"full-length generation, so the server refuses the "
+            f"pairing at construction",
+            fix_hint=f"raise the draft config's max_seq_len to >= {tm} "
+                     f"(its KV slab is the cheap one)"))
+    tp = _spec_param_count(spec)
+    dp = _spec_param_count(draft_spec)
+    if tp and dp and dp >= tp:
+        out.append(finding(
+            "serving.speculation_misconfig", "draft_spec",
+            f"draft has {dp} parameters vs the target's {tp} — "
+            f"speculation only pays when drafting is much cheaper "
+            f"than verifying; this pairing still emits the target's "
+            f"exact tokens but each round costs speculate_k="
+            f"{int(speculate_k)} full-size dispatches plus the verify",
+            fix_hint="draft with a much smaller config — e.g. "
+                     "zoo.gpt.GPT_TINY, or dataclasses.replace("
+                     "target_cfg, num_layers=2) fed to "
+                     "gpt_generative_spec",
+            severity="warn"))
+    return out
+
+
+def analyze_speculation_config(spec, draft_spec,
+                               speculate_k: int = 4) -> AnalysisReport:
+    """Lint one speculative-decoding pairing (target spec + draft spec
+    + window) without constructing a server — the entry point
+    ``serving.speculation_misconfig`` runs under
+    (``context="serving_config"``, like the per-server lint)."""
+    t0 = _time.perf_counter()
+    report = AnalysisReport(context="serving_config")
+    report.rules_run = 1
+    report.extend(check_speculation(spec, draft_spec, speculate_k))
+    report.seconds = _time.perf_counter() - t0
+    return report
+
+
 def analyze_fleet_config(replicas: int, max_slots: int,
                          p99_decode_step_ms: float, ttft_slo_ms: float,
                          arrival_rate_rps: float,
@@ -161,5 +247,6 @@ def analyze_generative_config(spec, max_slots: int,
 
 
 __all__ = ["analyze_fleet_config", "analyze_generative_config",
-           "check_dense_kv_headroom", "check_fleet_slo",
+           "analyze_speculation_config", "check_dense_kv_headroom",
+           "check_fleet_slo", "check_speculation",
            "dense_kv_slab_bytes"]

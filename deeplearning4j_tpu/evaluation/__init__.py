@@ -1,10 +1,12 @@
 """Evaluation metrics (reference: org.nd4j.evaluation)."""
 from deeplearning4j_tpu.evaluation.calibration import (
-    EvaluationCalibration, Histogram, ReliabilityDiagram)
+    EvaluationCalibration, Histogram, ReliabilityDiagram, channel_scales,
+    histogram_quantile)
 from deeplearning4j_tpu.evaluation.classification import (
     Evaluation, EvaluationBinary, ROC, ROCBinary, ROCMultiClass)
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 
 __all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration",
            "Histogram", "ReliabilityDiagram", "ROC", "ROCBinary",
-           "ROCMultiClass", "RegressionEvaluation"]
+           "ROCMultiClass", "RegressionEvaluation", "channel_scales",
+           "histogram_quantile"]

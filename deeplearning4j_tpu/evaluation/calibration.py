@@ -57,6 +57,88 @@ class Histogram:
                 f"n={int(self.bin_counts.sum())})")
 
 
+def _quantile_from_counts(counts: np.ndarray, lowers: np.ndarray,
+                          uppers: np.ndarray, q: float) -> np.ndarray:
+    """Value at quantile ``q`` for each row of binned ``counts`` —
+    right-edge convention: the smallest bin upper edge below which at
+    least ``q`` of the mass lies. Shared by :func:`histogram_quantile`
+    (one histogram) and :func:`channel_scales` (one row per channel)."""
+    counts = np.asarray(counts, np.float64)
+    nb = counts.shape[1]
+    total = counts.sum(axis=1)
+    cum = np.cumsum(counts, axis=1)
+    target = max(float(q), 0.0) * total[:, None]
+    b = np.argmax(cum >= target, axis=1)        # first bin reaching q
+    lowers = np.asarray(lowers, np.float64)
+    uppers = np.asarray(uppers, np.float64)
+    return lowers + (b + 1) / nb * (uppers - lowers)
+
+
+def histogram_quantile(hist: Histogram, q: float) -> float:
+    """Value at quantile ``q`` of a :class:`Histogram`'s binned mass
+    (right-edge convention). The binned analogue of ``np.quantile`` for
+    data only available as fixed-range counts."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"quantile must be in (0, 1], got {q}")
+    return float(_quantile_from_counts(
+        hist.bin_counts[None], [hist.lower], [hist.upper], q)[0])
+
+
+def channel_scales(samples, method: str = "absmax", quantile: float = 0.999,
+                   num_bins: int = 512, qmax: float = 127.0) -> np.ndarray:
+    """NaN-safe per-channel symmetric-int quantization scales.
+
+    ``samples``: an array whose LAST axis is the channel axis (leading
+    axes are flattened into observations). Returns ``scales`` of shape
+    ``[channels]`` (float32) such that ``round(x / scale)`` clipped to
+    ``[-qmax, qmax]`` is the int payload and ``payload * scale`` the
+    dequantized value.
+
+    - ``method="absmax"``: scale = max |x| / qmax — exact range cover,
+      the right default for weights (every value representable).
+    - ``method="quantile"``: per-channel |x| is binned into the same
+      fixed-range histogram layout as :class:`Histogram` /
+      :class:`EvaluationCalibration` and the scale is the value at
+      ``quantile`` (right-edge convention, via the shared
+      :func:`_quantile_from_counts`) — clips activation/KV outliers so
+      the int grid spends its codes on the mass, not one spike.
+
+    NaN/Inf observations are ignored; a channel with no positive finite
+    mass (all-zero, all-NaN) gets scale 1.0 — its payload quantizes to
+    0 and dequantizes to 0, never NaN/Inf (tests/test_evaluation.py).
+    """
+    if method not in ("absmax", "quantile"):
+        raise ValueError(f"method must be 'absmax' or 'quantile', "
+                         f"got {method!r}")
+    if not 0.0 < quantile <= 1.0:
+        raise ValueError(f"quantile must be in (0, 1], got {quantile}")
+    if int(num_bins) <= 0:
+        raise ValueError("num_bins must be positive")
+    x = np.asarray(samples, np.float64)
+    if x.ndim == 0:
+        raise ValueError("samples must have a channel axis")
+    c = x.shape[-1]
+    a = np.abs(x.reshape(-1, c))
+    finite = np.isfinite(a)
+    a = np.where(finite, a, 0.0)
+    amax = a.max(axis=0) if a.shape[0] else np.zeros(c)
+    if method == "absmax":
+        peak = amax
+    else:
+        nb = int(num_bins)
+        # the EvaluationCalibration binning pattern: normalize to the
+        # per-channel range, clip into nb bins, one bincount total
+        safe = np.where(amax > 0, amax, 1.0)
+        bins = np.clip((a / safe * nb).astype(np.int64), 0, nb - 1)
+        flat = (np.broadcast_to(np.arange(c), a.shape) * nb + bins)
+        counts = np.bincount(flat.reshape(-1),
+                             weights=finite.reshape(-1).astype(np.float64),
+                             minlength=c * nb).reshape(c, nb)
+        peak = _quantile_from_counts(counts, np.zeros(c), amax, quantile)
+    peak = np.where(np.isfinite(peak) & (peak > 0), peak, float(qmax))
+    return (peak / float(qmax)).astype(np.float32)
+
+
 class ReliabilityDiagram:
     """Mean predicted probability vs observed frequency per confidence bin
     (reference: curves/ReliabilityDiagram.java)."""

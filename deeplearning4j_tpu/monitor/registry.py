@@ -326,6 +326,19 @@ class MetricsRegistry:
             self.set_gauge("serving_max_slots",
                            gen.get("max_slots", 0),
                            help="KV cache slots")
+            # speculative decoding (satellite lane): the acceptance
+            # rate IS the speedup knob — accepted draft tokens ride a
+            # verify dispatch for free; .get() defense keeps records
+            # written before the lane existed folding cleanly
+            if gen.get("spec_rounds"):
+                self.set_gauge("serving_draft_acceptance_rate",
+                               gen.get("draft_acceptance_rate", 0.0),
+                               help="accepted / drafted speculative "
+                                    "tokens (lifetime)")
+                self.set_gauge("serving_draft_tokens_rejected_total",
+                               gen.get("draft_rejected", 0),
+                               help="drafted tokens the target's "
+                                    "verify pass rejected")
         # paged KV tier (serving/paged/): pool + prefix-cache gauges;
         # every ratio is safe_ratio'd at the source (0.0 at cold start,
         # never NaN — satellite rule for the new series)

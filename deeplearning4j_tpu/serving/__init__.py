@@ -53,6 +53,7 @@ from deeplearning4j_tpu.serving.resilience import (
     AdmissionController, CircuitBreaker, PoisonedRequestError,
     ReloadFailedError, ResilienceConfig, RetryableServingError,
     WorkerSupervisor)
+from deeplearning4j_tpu.serving.sampling import sample_token
 
 __all__ = [
     "ParallelInference", "InferenceMode", "ServingSpec",
@@ -68,5 +69,5 @@ __all__ = [
     "FleetLoadGenerator",
     "GenerativeServer", "GenerativeSpec", "GenerativeMetrics",
     "GenerationHandle", "GenerationCancelled", "SlotAllocator",
-    "greedy_decode",
+    "greedy_decode", "sample_token",
 ]

@@ -265,7 +265,7 @@ class FleetRouter:
                 self.metrics.inc("retries")
 
     def generate(self, prompt, max_new_tokens: int = 16,
-                 timeout_ms: Optional[float] = None) -> FleetResult:
+                 timeout_ms: Optional[float] = None, **kw) -> FleetResult:
         """The blocking front door: place, stream, and return the full
         generation — retrying sheds AND mid-generation replica deaths
         within one shared budget. This is the callable the fleet load
@@ -280,7 +280,8 @@ class FleetRouter:
                 handle = replica.submit(
                     prompt, max_new_tokens=max_new_tokens,
                     timeout_ms=timeout_ms,
-                    on_token=lambda tok: marks.append(self._clock()))
+                    on_token=lambda tok: marks.append(self._clock()),
+                    **kw)
                 tokens = handle.result()
                 self.metrics.on_routed(kind, replica.name)
                 self.metrics.inc("requests_ok")

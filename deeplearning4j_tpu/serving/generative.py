@@ -64,7 +64,8 @@ from deeplearning4j_tpu.compilecache.aot import AOTDispatch, ph_shape_sig
 from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.serving.batching import BucketSpec, pow2_buckets
 from deeplearning4j_tpu.serving.metrics import (LatencyHistogram,
-                                                ServingMetrics)
+                                                ServingMetrics, safe_ratio)
+from deeplearning4j_tpu.serving.sampling import sample_token
 from deeplearning4j_tpu.serving.queue import (
     InferenceRequest, RequestQueue, ServerClosedError, ServerOverloadedError,
     ServingError, ServingTimeoutError)
@@ -101,8 +102,15 @@ class GenerativeSpec:
       slot one token and returns ``(kc, vc, next_tokens, logits)``.
     - ``kv_shape(max_slots, max_seq)`` is the shape of ONE slab (K and
       V are two arrays of this shape).
+    - ``verify`` (optional) scores a K-token window per slot in one
+      dispatch for speculative decoding: ``io = {"tokens": [S, W],
+      "positions": [S], "active": [S] bool}`` returns ``(kc, vc,
+      out_tokens [S, W], logits [S, W, vocab])`` where ``out[s, j]`` is
+      the greedy token after consuming window columns ``0..j`` —
+      column 0 is the slot's last emitted token, so ``out[s, 0]`` is
+      bit-identical to what ``decode`` would have produced.
 
-    Both functions must be pure and shape-static so the server can jit
+    All functions must be pure and shape-static so the server can jit
     them with donated slabs and AOT-precompile every shape it will ever
     dispatch (docs/cold_start.md).
     """
@@ -115,6 +123,7 @@ class GenerativeSpec:
     max_seq_len: int
     kv_dtype: str = "float32"
     eos_id: Optional[int] = None
+    verify: Optional[Callable] = None
 
 
 class SlotAllocator:
@@ -168,6 +177,14 @@ class GenerationRequest(InferenceRequest):
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     on_token: Optional[Callable[[int], None]] = None
+    # sampling knobs: temperature 0 = exact greedy (device argmax);
+    # otherwise serving/sampling.py draws from the target logits with
+    # the (seed, absolute-token-index) fold — reproducible per request
+    # whatever shares the batch, including after a crash requeue
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
     generated: List[int] = field(default_factory=list)
     cancelled: bool = False
     first_token_t: Optional[float] = None
@@ -276,7 +293,9 @@ class GenerativeMetrics(ServingMetrics):
         self.intertoken_ms = LatencyHistogram()
         self.prefill_ms = LatencyHistogram()
         for c in ("tokens_generated", "prefills", "decode_steps",
-                  "slots_active_sum", "requests_cancelled"):
+                  "slots_active_sum", "requests_cancelled",
+                  "spec_rounds", "draft_tokens", "draft_accepted",
+                  "draft_rejected"):
             self.counters[c] = 0
 
     def observe_ttft(self, ms: float) -> None:
@@ -291,6 +310,19 @@ class GenerativeMetrics(ServingMetrics):
         with self._lock:
             self.counters["prefills"] += 1
             self.prefill_ms.record(ms)
+
+    def observe_spec_round(self, drafted: int, accepted: int) -> None:
+        """One speculative round: ``drafted`` proposals across the
+        batch, ``accepted`` of them matched by the target. Every
+        EMITTED token (accepted drafts included) is counted in
+        ``tokens_generated`` by the emission path exactly once;
+        rejected drafts only ever land here — they never inflate
+        throughput."""
+        with self._lock:
+            self.counters["spec_rounds"] += 1
+            self.counters["draft_tokens"] += int(drafted)
+            self.counters["draft_accepted"] += int(accepted)
+            self.counters["draft_rejected"] += int(drafted) - int(accepted)
 
     def observe_decode_step(self, active: int, ms: float) -> None:
         with self._lock:
@@ -322,7 +354,14 @@ class GenerativeMetrics(ServingMetrics):
                 "decode_steps": steps,
                 "slot_occupancy": round(occ, 4),
                 "tokens_per_sec": round(
-                    self.counters["tokens_generated"] / uptime, 3)}
+                    self.counters["tokens_generated"] / uptime, 3),
+                "spec_rounds": self.counters["spec_rounds"],
+                "draft_tokens": self.counters["draft_tokens"],
+                "draft_accepted": self.counters["draft_accepted"],
+                "draft_rejected": self.counters["draft_rejected"],
+                "draft_acceptance_rate": round(safe_ratio(
+                    self.counters["draft_accepted"],
+                    self.counters["draft_tokens"]), 4)}
         return rec
 
     def stats(self) -> str:
@@ -334,6 +373,11 @@ class GenerativeMetrics(ServingMetrics):
                  f"{g['prefills']} prefills, {g['decode_steps']} decode "
                  f"steps, slot occupancy {g['slot_occupancy']:.1%} of "
                  f"{g['max_slots']} slots"]
+        if g["spec_rounds"]:
+            lines.append(
+                f"  speculative: {g['spec_rounds']} rounds, acceptance "
+                f"{g['draft_acceptance_rate']:.1%} "
+                f"({g['draft_accepted']}/{g['draft_tokens']} drafts)")
         for name in ("ttft", "intertoken", "prefill"):
             s = rec["latency_ms"][name]
             lines.append(f"  {name:<10} p50 {s['p50']:.3f} ms  "
@@ -366,6 +410,9 @@ def _spec_dispatchers(spec: GenerativeSpec,
                 jax.jit(spec.decode, donate_argnums=(1, 2)), ph_arg=3),
             "prefill": AOTDispatch(
                 jax.jit(spec.prefill, donate_argnums=(1, 2)), ph_arg=3)}
+        if getattr(spec, "verify", None) is not None:
+            pair["verify"] = AOTDispatch(
+                jax.jit(spec.verify, donate_argnums=(1, 2)), ph_arg=3)
         cache[key] = pair
     return pair
 
@@ -408,6 +455,8 @@ class GenerativeServer:
                  warmup: bool = True,
                  admit: str = "continuous",
                  memory_sample_every: Optional[int] = 64,
+                 draft_spec=None,
+                 speculate_k: int = 4,
                  start: bool = True):
         spec = self._coerce_spec(spec)
         if admit not in ("continuous", "static"):
@@ -420,6 +469,41 @@ class GenerativeServer:
             raise ValueError(
                 f"max_seq_len {self.max_seq_len} exceeds the model's "
                 f"positional capacity {spec.max_seq_len}")
+        # speculative decoding: a small DRAFT model proposes K-1 tokens
+        # per slot per round, the target verifies the whole window in
+        # one dispatch. The draft always runs DENSE (its slabs are tiny)
+        # even under a paged target. Misconfigurations that can never
+        # work fail here, not mid-decode (analyze/servingpass.py lints
+        # the same contract statically)
+        self.speculate_k = int(speculate_k)
+        self.draft_spec = None
+        self.draft_slab_bytes = 0
+        if draft_spec is not None:
+            if not isinstance(draft_spec, GenerativeSpec):
+                if hasattr(draft_spec, "generative_spec"):
+                    draft_spec = draft_spec.generative_spec()
+                else:
+                    raise TypeError(
+                        f"{type(draft_spec).__name__} is not usable as "
+                        f"a draft: pass a dense GenerativeSpec (the "
+                        f"draft always runs dense, even under a paged "
+                        f"target)")
+            if int(draft_spec.vocab_size) != int(spec.vocab_size):
+                raise ValueError(
+                    f"draft vocab_size {draft_spec.vocab_size} != "
+                    f"target vocab_size {spec.vocab_size}: speculation "
+                    f"compares token ids, the vocabularies must match")
+            if int(draft_spec.max_seq_len) < self.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_spec.max_seq_len} < "
+                    f"served max_seq_len {self.max_seq_len}: the draft "
+                    f"must cover every position the target can reach")
+            if self.speculate_k < 2:
+                raise ValueError(
+                    f"speculate_k must be >= 2, got {self.speculate_k} "
+                    f"(a window of 1 holds only the already-emitted "
+                    f"token and drafts nothing)")
+            self.draft_spec = draft_spec
         self.admit_mode = admit
         self.eos_id = eos_id if eos_id is not None else spec.eos_id
         self.default_timeout_ms = default_timeout_ms
@@ -470,6 +554,7 @@ class GenerativeServer:
         # which replaces the dense per-slot slabs with a block pool and
         # admits on free BLOCKS rather than free slots
         self._init_kv()
+        self._init_draft()
         self.telemetry = None
         if telemetry_port is not None:
             from deeplearning4j_tpu.monitor.server import TelemetryServer
@@ -548,6 +633,63 @@ class GenerativeServer:
         disp = _spec_dispatchers(spec, shape)
         self._decode_disp = disp["decode"]
         self._prefill_disp = disp["prefill"]
+        self._verify_disp = disp.get("verify")
+
+    def _init_draft(self) -> None:
+        """Speculative-decoding memory + dispatchers: the draft model
+        gets its own DENSE per-slot KV slabs (one row per target slot,
+        kept position-synced with the target through partial
+        acceptance) and its own decode/prefill dispatcher pair. A
+        no-op without ``draft_spec``."""
+        ds = self.draft_spec
+        self._draft_decode_disp = None
+        self._draft_prefill_disp = None
+        self._draft_params = None
+        self._dkc = self._dvc = None
+        if ds is None:
+            return
+        if self._verify_disp is None:
+            raise ValueError(
+                "speculative decoding needs a target spec exposing a "
+                "verify program — rebuild the spec with a current "
+                "zoo.gpt.gpt_generative_spec / gpt_paged_spec")
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.memory import AllocationsTracker
+        from deeplearning4j_tpu.monitor import memstats
+        from deeplearning4j_tpu.ndarray.dtype import DataType
+        shape = tuple(ds.kv_shape(self.max_slots, self.max_seq_len))
+        self._draft_kv_dtype = DataType.from_any(ds.kv_dtype).jnp
+        itemsize = jnp.zeros((), self._draft_kv_dtype).dtype.itemsize
+        self.draft_slab_bytes = 2 * int(np.prod(shape)) * itemsize
+        memstats.check_headroom(
+            self.draft_slab_bytes,
+            f"draft KV slabs (speculative decoding, {self.max_slots} "
+            f"slots x {self.max_seq_len} positions)")
+        self._dkc = jnp.zeros(shape, self._draft_kv_dtype)
+        self._dvc = jnp.zeros(shape, self._draft_kv_dtype)
+        AllocationsTracker.get_instance().allocate("kv_slab",
+                                                   self.draft_slab_bytes)
+        ddisp = _spec_dispatchers(ds, shape)
+        self._draft_decode_disp = ddisp["decode"]
+        self._draft_prefill_disp = ddisp["prefill"]
+        self._draft_params = dict(ds.params())
+
+    def _reset_draft_slabs(self) -> None:
+        if self.draft_spec is None:
+            return
+        import jax.numpy as jnp
+        shape = tuple(self.draft_spec.kv_shape(self.max_slots,
+                                               self.max_seq_len))
+        self._dkc = jnp.zeros(shape, self._draft_kv_dtype)
+        self._dvc = jnp.zeros(shape, self._draft_kv_dtype)
+
+    def _refresh_draft_params(self) -> None:
+        if self.draft_spec is None:
+            return
+        fresh = dict(self.draft_spec.params())
+        with self._exec_lock:
+            self._draft_params = fresh
 
     def _can_place(self, req: GenerationRequest) -> bool:
         """Whether the memory tier can hold ``req``'s prefill right
@@ -609,7 +751,8 @@ class GenerativeServer:
         mark = COMPILE_STATS.mark()
         t0 = _time.perf_counter()
 
-        def _build(disp, io_abs, label):
+        def _build(disp, io_abs, label, params_abs=params_abs,
+                   kv_abs=kv_abs, role="target"):
             sig = ph_shape_sig(io_abs)
             with self._exec_lock:
                 if sig not in disp.aot:
@@ -621,9 +764,11 @@ class GenerativeServer:
                                           compiled=disp.aot[sig])
                 # mark INSIDE the lock hold: a live dispatch between
                 # compile and mark must not count a spurious lazy
-                # compile for a just-warmed shape (PR-6 round-6 rule)
-                if sig not in self._shapes_seen:
-                    self._shapes_seen.add(sig)
+                # compile for a just-warmed shape (PR-6 round-6 rule).
+                # Keyed by role: the draft's decode/prefill signatures
+                # are identical to the target's
+                if (role, sig) not in self._shapes_seen:
+                    self._shapes_seen.add((role, sig))
                     self.metrics.inc("warmup_compiles")
 
         _build(self._decode_disp,
@@ -637,9 +782,36 @@ class GenerativeServer:
                     "length": jax.ShapeDtypeStruct((), jnp.int32),
                     "slot": jax.ShapeDtypeStruct((), jnp.int32)},
                    f"generative_prefill_b{int(b)}")
+        if self.draft_spec is not None:
+            W = self.speculate_k
+            _build(self._verify_disp,
+                   {"tokens": jax.ShapeDtypeStruct((S, W), jnp.int32),
+                    "positions": jax.ShapeDtypeStruct((S,), jnp.int32),
+                    "active": jax.ShapeDtypeStruct((S,), jnp.bool_)},
+                   f"generative_verify_s{S}w{W}")
+            dparams_abs = {n: jax.ShapeDtypeStruct(tuple(np.shape(a)),
+                                                   np.asarray(a).dtype)
+                           for n, a in self._draft_params.items()}
+            dkv_abs = jax.ShapeDtypeStruct(tuple(self._dkc.shape),
+                                           self._dkc.dtype)
+            _build(self._draft_decode_disp,
+                   {"tokens": jax.ShapeDtypeStruct((S,), jnp.int32),
+                    "positions": jax.ShapeDtypeStruct((S,), jnp.int32),
+                    "active": jax.ShapeDtypeStruct((S,), jnp.bool_)},
+                   f"draft_decode_s{S}", params_abs=dparams_abs,
+                   kv_abs=dkv_abs, role="draft")
+            for b in bucket_list:
+                _build(self._draft_prefill_disp,
+                       {"tokens": jax.ShapeDtypeStruct((int(b),),
+                                                       jnp.int32),
+                        "length": jax.ShapeDtypeStruct((), jnp.int32),
+                        "slot": jax.ShapeDtypeStruct((), jnp.int32)},
+                       f"draft_prefill_b{int(b)}", params_abs=dparams_abs,
+                       kv_abs=dkv_abs, role="draft")
         self.warmup_report = {
             "decode_slots": S,
             "prefill_buckets": bucket_list,
+            "speculative": self.draft_spec is not None,
             "seconds": round(_time.perf_counter() - t0, 4),
             **{k: v for k, v in COMPILE_STATS.delta(mark).items()
                if k in ("backend_compiles", "cache_hits",
@@ -673,13 +845,33 @@ class GenerativeServer:
     def submit(self, prompt, max_new_tokens: int = 16,
                timeout_ms: Optional[float] = None,
                on_token: Optional[Callable[[int], None]] = None,
-               eos_id: Optional[int] = None) -> GenerationHandle:
+               eos_id: Optional[int] = None,
+               temperature: float = 0.0,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> GenerationHandle:
         """Enqueue one generation; returns a :class:`GenerationHandle`
         streaming tokens as they decode. Sheds typed at the call site:
         :class:`ServerOverloadedError` when the queue is full or the
         estimated TTFT (queue depth × rolling p99 decode-step time)
-        already exceeds the deadline."""
+        already exceeds the deadline.
+
+        ``temperature`` 0 (default) is exact greedy; > 0 samples from
+        the target logits with optional ``top_k``/``top_p`` truncation,
+        seeded by ``(seed, absolute token index)`` so the continuation
+        is reproducible per request regardless of co-batching or a
+        crash requeue. ``seed`` defaults to the request id (stable for
+        the request's whole lifetime, including requeues)."""
         prompt = self._validate_submit(prompt, max_new_tokens)
+        temperature = float(temperature)
+        if not np.isfinite(temperature) or temperature < 0.0:
+            raise ValueError(
+                f"temperature must be a finite float >= 0, "
+                f"got {temperature}")
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         self.metrics.inc("requests_submitted")
         timeout_ms = timeout_ms if timeout_ms is not None \
             else self.default_timeout_ms
@@ -687,12 +879,17 @@ class GenerativeServer:
         deadline = time.monotonic() + timeout_ms / 1000.0 \
             if timeout_ms is not None else None
         from concurrent.futures import Future
+        rid = self._next_id()
         req = GenerationRequest(
             x=[prompt], future=Future(), rows=1, deadline=deadline,
-            id=self._next_id(), prompt=prompt,
+            id=rid, prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             eos_id=eos_id if eos_id is not None else self.eos_id,
-            on_token=on_token)
+            on_token=on_token,
+            temperature=temperature,
+            top_k=int(top_k) if top_k is not None else None,
+            top_p=float(top_p) if top_p is not None else None,
+            seed=int(seed) if seed is not None else rid)
         with _tracer.span("serving.enqueue", cat="serving", id=req.id,
                           prompt=int(prompt.size)):
             try:
@@ -730,6 +927,7 @@ class GenerativeServer:
         fresh = dict(self.spec.params())
         with self._exec_lock:
             self._params = fresh
+        self._refresh_draft_params()
 
     def params_snapshot(self) -> dict:
         """The currently-installed serving parameters — the rollback
@@ -781,6 +979,7 @@ class GenerativeServer:
         shape = tuple(self.spec.kv_shape(self.max_slots, self.max_seq_len))
         self._kc = jnp.zeros(shape, self._kv_dtype)
         self._vc = jnp.zeros(shape, self._kv_dtype)
+        self._reset_draft_slabs()
         self._slots.reset()
         self._slot_reqs = [None] * self.max_slots
         self._tokens[:] = 0
@@ -808,7 +1007,10 @@ class GenerativeServer:
         progressed = self._admit(slot)
         if not self._active.any():
             return progressed
-        self._decode_once(slot)
+        if self._spec_ready():
+            self._speculate_once(slot)
+        else:
+            self._decode_once(slot)
         return True
 
     def _admit(self, slot: InflightSlot) -> bool:
@@ -869,13 +1071,56 @@ class GenerativeServer:
         padded[:L] = prefix
         io = {"tokens": padded, "length": np.int32(L), "slot": np.int32(s)}
         t0 = time.perf_counter()
-        tok = int(self._dispatch(self._prefill_disp, io, "serving.prefill",
-                                 bucket=bucket, slot=s)[2])
+        out = self._dispatch(self._prefill_disp, io, "serving.prefill",
+                             bucket=bucket, slot=s)
+        tok = self._resolve_token(req, int(out[2]), out[3])
         self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
         self._positions[s] = L
         self._tokens[s] = tok
         self._active[s] = True
         self._emit(s, req, tok)
+        self._draft_prefill(s, prefix, L)
+
+    def _draft_prefill(self, s: int, prefix: np.ndarray, L: int) -> None:
+        """Fill the DRAFT model's KV rows for a freshly admitted slot
+        — always the FULL prefix from scratch (the draft has no prefix
+        cache, even under a paged target). Its first-token output is
+        discarded: the target's prefill already emitted the real one,
+        and the draft only needs its cache position-synced before the
+        first speculative round."""
+        if self.draft_spec is None or not self._active[s]:
+            return
+        bucket = self._buckets.bucket_for(L)
+        padded = np.zeros(bucket, np.int32)
+        padded[:L] = prefix
+        io = {"tokens": padded, "length": np.int32(L),
+              "slot": np.int32(s)}
+        self._dispatch(self._draft_prefill_disp, io, "serving.draft",
+                       draft=True, phase="prefill", bucket=bucket, slot=s)
+
+    def _resolve_token(self, req: GenerationRequest, device_tok: int,
+                       logits_row) -> int:
+        """The target's own next token for one slot: the device argmax
+        at temperature 0 (bit-identical to the greedy-only path),
+        otherwise a seeded host sample from the target logits at this
+        request's absolute token index. The (seed, index) fold makes
+        the draw independent of co-batching, admission order and
+        crash-requeue re-entry; under speculation the emitted token is
+        ALWAYS the target's own, so output never depends on draft
+        quality — only throughput does."""
+        if not req.temperature or req.temperature <= 0.0:
+            return int(device_tok)
+        seed = req.seed if req.seed is not None else req.id
+        return sample_token(np.asarray(logits_row),
+                            temperature=req.temperature,
+                            top_k=req.top_k, top_p=req.top_p,
+                            seed=seed,
+                            index=int(np.asarray(req.prompt).size)
+                            + len(req.generated))
+
+    def _sampled_active(self) -> bool:
+        return any(r is not None and r.temperature > 0
+                   for r in self._slot_reqs)
 
     def _decode_once(self, slot: InflightSlot) -> None:
         n_active = self._n_active()
@@ -883,30 +1128,156 @@ class GenerativeServer:
               "positions": self._positions.copy(),
               "active": self._active.copy()}
         t0 = time.perf_counter()
-        nxt = np.asarray(self._dispatch(self._decode_disp, io,
-                                        "serving.decode",
-                                        active=n_active)[2])
+        _, _, nxt_d, logits_d = self._dispatch(self._decode_disp, io,
+                                               "serving.decode",
+                                               active=n_active)
+        nxt = np.asarray(nxt_d)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.observe_decode_step(n_active, ms)
         if self.admission is not None:
             self.admission.observe(ms)
         self._maybe_memory_record()
+        lg = np.asarray(logits_d) if self._sampled_active() else None
         for s in np.flatnonzero(io["active"]):
             req = self._slot_reqs[int(s)]
             if req is None:
                 continue
             s = int(s)
-            tok = int(nxt[s])
+            tok = self._resolve_token(req, int(nxt[s]),
+                                      lg[s] if lg is not None else None)
             self._positions[s] += 1
             self._tokens[s] = tok
             self._emit(s, req, tok)
 
-    def _dispatch(self, disp: AOTDispatch, io: dict, span: str, **attrs):
-        """One device dispatch of prefill/decode with the shared
+    # -- speculative decoding (draft K, verify once) --------------------
+    def _spec_ready(self) -> bool:
+        """Whether the next round can run speculatively: a draft is
+        armed and every active slot has a full verify window of
+        positions left in the slab. The paged subclass additionally
+        grows block tables to cover the window up front, falling back
+        to a plain step when the pool cannot."""
+        if self._draft_decode_disp is None:
+            return False
+        act = np.flatnonzero(self._active)
+        if act.size == 0:
+            return False
+        return bool(np.all(self._positions[act].astype(np.int64)
+                           + self.speculate_k <= self.max_seq_len))
+
+    def _verify_io(self, window: np.ndarray, positions: np.ndarray,
+                   active: np.ndarray) -> dict:
+        return {"tokens": window, "positions": positions.copy(),
+                "active": active.copy()}
+
+    def _observe_round(self) -> None:
+        """Post-round memory-tier bookkeeping hook (paged: pool
+        occupancy sample + leak invariant)."""
+
+    def _speculate_once(self, slot: InflightSlot) -> None:
+        """One draft-K / verify-once speculative round (Leviathan et
+        al., "Fast Inference from Transformers via Speculative
+        Decoding"): K sequential DRAFT decode dispatches propose a
+        token window per active slot, then the TARGET scores the whole
+        window in ONE batched verify dispatch — one read of the target
+        weights for up to K emitted tokens. Acceptance is exact: every
+        emitted token is the target's own (:meth:`_resolve_token`), so
+        output is independent of draft quality; the draft only decides
+        how many positions the single verify dispatch resolves. A
+        rejected tail needs no KV rollback — positions simply never
+        advance over it, and rows above a slot's position are masked
+        until overwritten (the same discipline that makes slot reuse
+        safe). The draft's own KV stays row-synced because dispatch m
+        feeds window column m-1 (the token that, if the round reaches
+        that column, is exactly what was accepted there)."""
+        W = self.speculate_k
+        active = self._active.copy()
+        positions = self._positions.copy()
+        n_active = int(active.sum())
+        window = np.zeros((self.max_slots, W), np.int32)
+        window[:, 0] = self._tokens
+        reqs = list(self._slot_reqs)
+        act_idx = [int(s) for s in np.flatnonzero(active)
+                   if reqs[int(s)] is not None]
+        sampled = any(reqs[s].temperature > 0 for s in act_idx)
+        t0 = time.perf_counter()
+        # draft loop: dispatch m feeds window column m-1 at position
+        # pos0+m-1, writing that draft-KV row and proposing column m.
+        # The W-th dispatch exists only for its KV write (the draft
+        # cache must cover the full window before the NEXT round); its
+        # proposal is discarded
+        d_tokens = window[:, 0].copy()
+        for m in range(1, W + 1):
+            dio = {"tokens": d_tokens.copy(),
+                   "positions": (positions + np.int32(m - 1)
+                                 * active).astype(np.int32),
+                   "active": active.copy()}
+            _, _, dnxt, dlg = self._dispatch(
+                self._draft_decode_disp, dio, "serving.draft",
+                draft=True, step=m, active=n_active)
+            if m >= W:
+                break
+            dnxt = np.asarray(dnxt)
+            dlg_h = np.asarray(dlg) if sampled else None
+            for s in act_idx:
+                req = reqs[s]
+                d = int(dnxt[s])
+                if req.temperature and req.temperature > 0:
+                    # the draft proposal consumes the SAME (seed,
+                    # index) draw the target will use to resolve this
+                    # position — close distributions then agree on the
+                    # sampled token, maximizing acceptance, while the
+                    # emitted token remains the target's own
+                    d = sample_token(
+                        dlg_h[s], temperature=req.temperature,
+                        top_k=req.top_k, top_p=req.top_p,
+                        seed=req.seed if req.seed is not None
+                        else req.id,
+                        index=int(np.asarray(req.prompt).size)
+                        + len(req.generated) + m - 1)
+                window[s, m] = d
+            d_tokens = window[:, m].copy()
+        vio = self._verify_io(window, positions, active)
+        _, _, out_d, vlg_d = self._dispatch(
+            self._verify_disp, vio, "serving.verify",
+            active=n_active, window=W)
+        out = np.asarray(out_d)
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe_decode_step(n_active, ms)
+        if self.admission is not None:
+            self.admission.observe(ms)
+        self._maybe_memory_record()
+        lg = np.asarray(vlg_d) if sampled else None
+        drafted = accepted = 0
+        for s in act_idx:
+            req = reqs[s]
+            drafted += W - 1
+            pos0 = int(positions[s])
+            for j in range(W):
+                tok = self._resolve_token(
+                    req, int(out[s, j]),
+                    lg[s, j] if lg is not None else None)
+                self._positions[s] = pos0 + j + 1
+                self._tokens[s] = tok
+                self._emit(s, req, tok)
+                if not self._active[s]:
+                    break     # retired: EOS / budget / deadline / cancel
+                if j + 1 >= W:
+                    break
+                if int(window[s, j + 1]) != tok:
+                    break     # draft rejected: the window tail is invalid
+                accepted += 1
+        self.metrics.observe_spec_round(drafted, accepted)
+        self._observe_round()
+
+    def _dispatch(self, disp: AOTDispatch, io: dict, span: str,
+                  draft: bool = False, **attrs):
+        """One device dispatch of prefill/decode/verify with the shared
         plumbing: exec lock, span, stall-watchdog guard, compile
         accounting, OOM forensics, and slab rebinding (the old slab
-        buffers are donated into the call)."""
-        sig = ph_shape_sig(io)
+        buffers are donated into the call). ``draft=True`` routes to
+        the draft model's params + slabs; the shapes-seen key carries
+        the role because draft and target share io signatures."""
+        sig = ("draft" if draft else "target", ph_shape_sig(io))
         with self._exec_lock, _tracer.span(span, cat="serving", **attrs):
             first = sig not in self._shapes_seen
             if first:
@@ -916,11 +1287,18 @@ class GenerativeServer:
                 guard as _wd_guard
             try:
                 with _wd_guard("generative_step", first=first):
-                    kc, vc, nxt, logits = disp(self._params, self._kc,
-                                               self._vc, io)
+                    if draft:
+                        kc, vc, nxt, logits = disp(
+                            self._draft_params, self._dkc, self._dvc, io)
+                    else:
+                        kc, vc, nxt, logits = disp(
+                            self._params, self._kc, self._vc, io)
             except Exception as e:
                 raise self._wrap_exec_error(e, span) from e
-            self._kc, self._vc = kc, vc
+            if draft:
+                self._dkc, self._dvc = kc, vc
+            else:
+                self._kc, self._vc = kc, vc
         return kc, vc, nxt, logits
 
     def _wrap_exec_error(self, e: BaseException, what: str):
@@ -1087,6 +1465,9 @@ class GenerativeServer:
         from deeplearning4j_tpu.memory import AllocationsTracker
         AllocationsTracker.get_instance().release("kv_slab",
                                                   self.kv_slab_bytes)
+        if self.draft_slab_bytes:
+            AllocationsTracker.get_instance().release(
+                "kv_slab", self.draft_slab_bytes)
         if self.stats_storage is not None:
             self.metrics.publish(self.stats_storage)
         if self.telemetry is not None:

@@ -246,8 +246,10 @@ class GenerativeLoadGenerator:
 
     Request ``i`` is a pure function of ``(seed, i)`` — prompt tokens,
     prompt length (uniform in ``prompt_len``), output budget (uniform
-    in ``new_tokens``) and optional deadline (uniform in
-    ``deadline_ms``) — regardless of loop mode or concurrency, so two
+    in ``new_tokens``), optional deadline (uniform in ``deadline_ms``),
+    and a per-request sampling ``(temperature, seed)`` pair (uniform in
+    ``temperature`` when given as a range; 0.0 = greedy) — regardless
+    of loop mode or concurrency, so two
     servers (e.g. continuous vs static admission) can be benchmarked on
     the SAME trace. Per-token timings land on the LoadResult as
     ``ttft_ms`` / ``intertoken_ms``; ``tokens_total``/``tokens_per_sec``
@@ -255,7 +257,8 @@ class GenerativeLoadGenerator:
 
     def __init__(self, server, seed: int = 0,
                  prompt_len=(1, 16), new_tokens=(4, 32),
-                 deadline_ms=None, vocab_size: Optional[int] = None):
+                 deadline_ms=None, vocab_size: Optional[int] = None,
+                 temperature=0.0):
         self.server = server
         self.seed = int(seed)
         # (lo, hi) = uniform inclusive; a callable(rng) -> int models
@@ -264,6 +267,9 @@ class GenerativeLoadGenerator:
         self.prompt_len = prompt_len
         self.new_tokens = new_tokens
         self.deadline_ms = deadline_ms
+        # scalar (every request) or (lo, hi) uniform range; 0.0 keeps
+        # the trace greedy — the historical behaviour
+        self.temperature = temperature
         self.vocab_size = int(vocab_size if vocab_size is not None
                               else server.spec.vocab_size)
 
@@ -274,9 +280,18 @@ class GenerativeLoadGenerator:
         lo, hi = spec
         return int(rng.integers(int(lo), int(hi) + 1))
 
+    @staticmethod
+    def _sample_temperature(spec, rng) -> float:
+        if isinstance(spec, (tuple, list)):
+            lo, hi = spec
+            return float(rng.uniform(float(lo), float(hi)))
+        return float(spec)
+
     def request(self, i: int):
         """The i-th trace entry: ``(prompt, max_new_tokens,
-        deadline_ms)`` — deterministic in ``(seed, i)``."""
+        deadline_ms, temperature, sample_seed)`` — deterministic in
+        ``(seed, i)``, so a sampled trace replays token-identically
+        whatever the concurrency or admission order."""
         rng = np.random.default_rng((self.seed, int(i)))
         plen = self._sample_len(self.prompt_len, rng)
         prompt = rng.integers(0, self.vocab_size, plen).astype(np.int32)
@@ -287,7 +302,9 @@ class GenerativeLoadGenerator:
                         if isinstance(self.deadline_ms, (tuple, list))
                         else (self.deadline_ms, self.deadline_ms))
             deadline = float(rng.uniform(dlo, dhi))
-        return prompt, n_new, deadline
+        temp = self._sample_temperature(self.temperature, rng)
+        sample_seed = int(rng.integers(0, 2 ** 63))
+        return prompt, n_new, deadline, temp, sample_seed
 
     def _consume(self, handle, t0: float, result: LoadResult,
                  lock: threading.Lock) -> None:
@@ -343,11 +360,13 @@ class GenerativeLoadGenerator:
                     if i >= n_requests:
                         return
                     counter["next"] = i + 1
-                prompt, n_new, deadline = self.request(i)
+                prompt, n_new, deadline, temp, sseed = self.request(i)
                 t0 = time.monotonic()
                 try:
                     handle = self.server.submit(prompt, n_new,
-                                                timeout_ms=deadline)
+                                                timeout_ms=deadline,
+                                                temperature=temp,
+                                                seed=sseed)
                 except ServerOverloadedError:
                     with lock:
                         result.n_rejected += 1
@@ -381,11 +400,13 @@ class GenerativeLoadGenerator:
             delay = target - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            prompt, n_new, deadline = self.request(i)
+            prompt, n_new, deadline, temp, sseed = self.request(i)
             t0 = time.monotonic()
             try:
                 handle = self.server.submit(prompt, n_new,
-                                            timeout_ms=deadline)
+                                            timeout_ms=deadline,
+                                            temperature=temp,
+                                            seed=sseed)
             except ServerOverloadedError:
                 with lock:
                     result.n_rejected += 1
@@ -427,7 +448,7 @@ class FleetLoadGenerator:
     def __init__(self, front_door: Callable, *, vocab_size: int,
                  seed: int = 0, prompt_len=(1, 16), new_tokens=(4, 32),
                  deadline_ms=None, prefix_pool=None,
-                 prefix_p: float = 0.75):
+                 prefix_p: float = 0.75, temperature=0.0):
         self.front_door = front_door
         self.vocab_size = int(vocab_size)
         self.seed = int(seed)
@@ -437,10 +458,15 @@ class FleetLoadGenerator:
         self.prefix_pool = None if prefix_pool is None else [
             np.asarray(p, np.int32).reshape(-1) for p in prefix_pool]
         self.prefix_p = float(prefix_p)
+        # scalar or (lo, hi); nonzero traces forward temperature+seed
+        # to the front door (FleetRouter.generate passes them through
+        # to replica submit) — 0.0 keeps the plain greedy contract
+        self.temperature = temperature
 
     def request(self, i: int):
         """The i-th trace entry ``(prompt, max_new_tokens,
-        deadline_ms)`` — deterministic in ``(seed, i)``."""
+        deadline_ms, temperature, sample_seed)`` — deterministic in
+        ``(seed, i)``."""
         rng = np.random.default_rng((self.seed, int(i)))
         plen = GenerativeLoadGenerator._sample_len(self.prompt_len, rng)
         tail = rng.integers(0, self.vocab_size, plen).astype(np.int32)
@@ -456,17 +482,24 @@ class FleetLoadGenerator:
                         if isinstance(self.deadline_ms, (tuple, list))
                         else (self.deadline_ms, self.deadline_ms))
             deadline = float(rng.uniform(dlo, dhi))
-        return prompt, n_new, deadline
+        temp = GenerativeLoadGenerator._sample_temperature(
+            self.temperature, rng)
+        sample_seed = int(rng.integers(0, 2 ** 63))
+        return prompt, n_new, deadline, temp, sample_seed
 
     def _issue(self, i: int, result: LoadResult,
                lock: threading.Lock) -> None:
-        prompt, n_new, deadline = self.request(i)
+        prompt, n_new, deadline, temp, sseed = self.request(i)
         t0 = time.monotonic()
         row = {"i": int(i), "outcome": None, "replica": None,
                "retries": 0, "routed": None, "ttft_ms": None}
+        # sampling kwargs only on sampled traces: plain front doors
+        # keep the documented (prompt, max_new_tokens, timeout_ms)
+        # signature working unchanged
+        kw = {"temperature": temp, "seed": sseed} if temp > 0.0 else {}
         try:
             res = self.front_door(prompt, max_new_tokens=n_new,
-                                  timeout_ms=deadline)
+                                  timeout_ms=deadline, **kw)
         except RetryableServingError:
             row["outcome"] = "rejected"     # typed give-up: budget spent
             with lock:

@@ -78,10 +78,12 @@ class PagedGenerativeSpec:
 
     - ``params()`` pulls the current trained parameter arrays by name.
     - ``make_fns(block_size, max_blocks_per_req)`` builds the pure
-      ``(prefill_fn, decode_fn)`` pair for one block geometry (the
-      server memoizes the jitted dispatchers per geometry, so every
-      server over the same model + geometry shares one compile set).
-      Io contracts are documented on ``zoo.gpt.gpt_paged_decode_fns``.
+      ``(prefill_fn, decode_fn)`` pair — or ``(prefill_fn, decode_fn,
+      verify_fn)`` triple when the model supports speculative decoding
+      — for one block geometry (the server memoizes the jitted
+      dispatchers per geometry, so every server over the same model +
+      geometry shares one compile set). Io contracts are documented on
+      ``zoo.gpt.gpt_paged_decode_fns``.
     - ``kv_shape(num_blocks, block_size)`` is the shape of ONE slab —
       required layout ``[layers, num_blocks, heads, block_size,
       head_dim]`` (the tensor-parallel path shards axis 2, the heads).
@@ -116,13 +118,17 @@ def _paged_dispatchers(spec: PagedGenerativeSpec, kv_shape: tuple,
     pair = cache.get(key)
     if pair is None:
         import jax
-        prefill_fn, decode_fn = spec.make_fns(int(block_size),
-                                              int(max_blocks))
+        fns = spec.make_fns(int(block_size), int(max_blocks))
+        prefill_fn, decode_fn = fns[0], fns[1]
+        verify_fn = fns[2] if len(fns) > 2 else None
         pair = {
             "decode": AOTDispatch(
                 jax.jit(decode_fn, donate_argnums=(1, 2)), ph_arg=3),
             "prefill": AOTDispatch(
                 jax.jit(prefill_fn, donate_argnums=(1, 2)), ph_arg=3)}
+        if verify_fn is not None:
+            pair["verify"] = AOTDispatch(
+                jax.jit(verify_fn, donate_argnums=(1, 2)), ph_arg=3)
         cache[key] = pair
     return pair
 
@@ -376,6 +382,7 @@ class PagedGenerativeServer(GenerativeServer):
         disp = _paged_dispatchers(spec, shape, BS, self._maxb, mesh_key)
         self._decode_disp = disp["decode"]
         self._prefill_disp = disp["prefill"]
+        self._verify_disp = disp.get("verify")
 
     def _fresh_slab(self, shape=None):
         import jax
@@ -512,8 +519,9 @@ class PagedGenerativeServer(GenerativeServer):
         io = {"tokens": padded, "length": np.int32(Ls),
               "hist": np.int32(hist), "table": self._tables[s].copy()}
         t0 = time.perf_counter()
-        tok = int(self._dispatch(self._prefill_disp, io, "serving.prefill",
-                                 bucket=bucket, slot=s, hist=hist)[2])
+        out = self._dispatch(self._prefill_disp, io, "serving.prefill",
+                             bucket=bucket, slot=s, hist=hist)
+        tok = self._resolve_token(req, int(out[2]), out[3])
         self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
         if self.prefix_cache_enabled:
             # content-address the freshly FILLED full blocks (indices
@@ -525,6 +533,9 @@ class PagedGenerativeServer(GenerativeServer):
         self._tokens[s] = tok
         self._active[s] = True
         self._emit(s, req, tok)
+        # the draft has no prefix cache: it prefills the FULL prefix
+        # into its own dense slabs (base-class helper)
+        self._draft_prefill(s, prefix, L)
 
     def _decode_once(self, slot) -> None:
         BS = self.block_size
@@ -561,9 +572,10 @@ class PagedGenerativeServer(GenerativeServer):
               "tables": self._tables.copy(),
               "write_block": wb, "write_off": wo}
         t0 = time.perf_counter()
-        nxt = np.asarray(self._dispatch(self._decode_disp, io,
-                                        "serving.decode",
-                                        active=n_active)[2])
+        _, _, nxt_d, logits_d = self._dispatch(self._decode_disp, io,
+                                               "serving.decode",
+                                               active=n_active)
+        nxt = np.asarray(nxt_d)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.observe_decode_step(n_active, ms)
         self.metrics.observe_pool(self.pool.held_count(),
@@ -571,15 +583,88 @@ class PagedGenerativeServer(GenerativeServer):
         if self.admission is not None:
             self.admission.observe(ms)
         self._maybe_memory_record()
+        lg = np.asarray(logits_d) if self._sampled_active() else None
         for s in np.flatnonzero(act):
             req = self._slot_reqs[int(s)]
             if req is None:
                 continue
             s = int(s)
-            tok = int(nxt[s])
+            tok = self._resolve_token(req, int(nxt[s]),
+                                      lg[s] if lg is not None else None)
             self._positions[s] += 1
             self._tokens[s] = tok
             self._emit(s, req, tok)
+        if self.debug_leaks:
+            self.pool.check_invariant(tables=[
+                self._tables[s, :int(self._nblocks[s])]
+                for s in range(self.max_slots)
+                if self._slot_reqs[s] is not None])
+
+    # -- speculative decoding over the paged tier -----------------------
+    def _spec_ready(self) -> bool:
+        """Paged readiness additionally grows every active lane's block
+        table UP FRONT to cover the verify window's live rows (those
+        within the lane's remaining token budget — rows the submit-side
+        worst-case commitment already reserved blocks for). If the pool
+        defensively cannot (commitment math should make this
+        impossible), the round falls back to plain single-step decode,
+        whose one-block-at-a-time growth path handles it."""
+        if not super()._spec_ready():
+            return False
+        BS = self.block_size
+        W = self.speculate_k
+        for s in np.flatnonzero(self._active):
+            s = int(s)
+            req = self._slot_reqs[s]
+            rem = (req.max_new_tokens - len(req.generated)
+                   if req is not None else 0)
+            usable = min(W, max(rem, 0))
+            if usable < 1:
+                continue
+            last = int(self._positions[s]) + usable - 1
+            need = last // BS + 1
+            while int(self._nblocks[s]) < need:
+                try:
+                    b = self.pool.alloc()
+                except PoolExhaustedError:    # pragma: no cover
+                    return False
+                self._tables[s, int(self._nblocks[s])] = b
+                self._nblocks[s] = int(self._nblocks[s]) + 1
+                self.metrics.observe_blocks(allocated=1)
+        return True
+
+    def _verify_io(self, window: np.ndarray, positions: np.ndarray,
+                   active: np.ndarray) -> dict:
+        """Window write coordinates for the paged verify program:
+        per-slot [S, W] (block, offset) pairs. Window rows beyond a
+        lane's remaining token budget — writes no future step can ever
+        read, because the lane retires exactly at its budget — are
+        dumped to the null block, so speculation never writes a block
+        the submit-side commitment didn't reserve. A rejected tail
+        needs no rollback: the block-table cursor (``_nblocks``) only
+        ever grew to committed rows, and positions simply do not
+        advance over rejected columns."""
+        BS = self.block_size
+        S, W = window.shape
+        wb = np.full((S, W), NULL_BLOCK, np.int32)
+        wo = np.zeros((S, W), np.int32)
+        for s in np.flatnonzero(active):
+            s = int(s)
+            req = self._slot_reqs[s]
+            rem = (req.max_new_tokens - len(req.generated)
+                   if req is not None else 0)
+            usable = min(W, max(rem, 0))
+            for j in range(usable):
+                p = int(positions[s]) + j
+                wb[s, j] = self._tables[s, p // BS]
+                wo[s, j] = p % BS
+        return {"tokens": window, "positions": positions.copy(),
+                "active": active.copy(), "tables": self._tables.copy(),
+                "write_block": wb, "write_off": wo}
+
+    def _observe_round(self) -> None:
+        self.metrics.observe_pool(self.pool.held_count(),
+                                  stats=self.pool.stats())
         if self.debug_leaks:
             self.pool.check_invariant(tables=[
                 self._tables[s, :int(self._nblocks[s])]
@@ -613,6 +698,7 @@ class PagedGenerativeServer(GenerativeServer):
         prefill."""
         self._kc = self._fresh_slab()
         self._vc = self._fresh_slab()
+        self._reset_draft_slabs()
         self.pool.reset()
         # the wholesale reset already dropped the prefix cache — a
         # pending hot-reload flush is thereby satisfied
@@ -664,7 +750,8 @@ class PagedGenerativeServer(GenerativeServer):
         mark = COMPILE_STATS.mark()
         t0 = _time.perf_counter()
 
-        def _build(disp, io_abs, label):
+        def _build(disp, io_abs, label, params_abs=params_abs,
+                   kv_abs=kv_abs, role="target"):
             sig = ph_shape_sig(io_abs)
             with self._exec_lock:
                 if sig not in disp.aot:
@@ -674,8 +761,8 @@ class PagedGenerativeServer(GenerativeServer):
                             params_abs, kv_abs, kv_abs, io_abs).compile()
                     memstats.capture_plan(label, sig,
                                           compiled=disp.aot[sig])
-                if sig not in self._shapes_seen:
-                    self._shapes_seen.add(sig)
+                if (role, sig) not in self._shapes_seen:
+                    self._shapes_seen.add((role, sig))
                     self.metrics.inc("warmup_compiles")
 
         _build(self._decode_disp,
@@ -693,9 +780,39 @@ class PagedGenerativeServer(GenerativeServer):
                     "hist": _abs((), jnp.int32, io_sh),
                     "table": _abs((MAXB,), jnp.int32, io_sh)},
                    f"paged_prefill_b{int(b)}")
+        if self.draft_spec is not None:
+            W = self.speculate_k
+            _build(self._verify_disp,
+                   {"tokens": _abs((S, W), jnp.int32, io_sh),
+                    "positions": _abs((S,), jnp.int32, io_sh),
+                    "active": _abs((S,), jnp.bool_, io_sh),
+                    "tables": _abs((S, MAXB), jnp.int32, io_sh),
+                    "write_block": _abs((S, W), jnp.int32, io_sh),
+                    "write_off": _abs((S, W), jnp.int32, io_sh)},
+                   f"paged_verify_s{S}w{W}")
+            # the draft runs DENSE and unsharded, whatever the target's
+            # layout — its abstract args carry no mesh shardings
+            dparams_abs = {
+                n: _abs(np.shape(a), np.asarray(a).dtype)
+                for n, a in self._draft_params.items()}
+            dkv_abs = _abs(self._dkc.shape, self._dkc.dtype)
+            _build(self._draft_decode_disp,
+                   {"tokens": _abs((S,), jnp.int32),
+                    "positions": _abs((S,), jnp.int32),
+                    "active": _abs((S,), jnp.bool_)},
+                   f"draft_decode_s{S}", params_abs=dparams_abs,
+                   kv_abs=dkv_abs, role="draft")
+            for b in bucket_list:
+                _build(self._draft_prefill_disp,
+                       {"tokens": _abs((int(b),), jnp.int32),
+                        "length": _abs((), jnp.int32),
+                        "slot": _abs((), jnp.int32)},
+                       f"draft_prefill_b{int(b)}", params_abs=dparams_abs,
+                       kv_abs=dkv_abs, role="draft")
         self.warmup_report = {
             "decode_slots": S,
             "prefill_buckets": bucket_list,
+            "speculative": self.draft_spec is not None,
             "seconds": round(_time.perf_counter() - t0, 4),
             **{k: v for k, v in COMPILE_STATS.delta(mark).items()
                if k in ("backend_compiles", "cache_hits",
@@ -724,6 +841,7 @@ class PagedGenerativeServer(GenerativeServer):
                      for n, a in fresh.items()}
         with self._exec_lock:
             self._params = fresh
+        self._refresh_draft_params()
         self._prefix_flush_pending.set()
 
     def restore_params(self, params: dict) -> None:

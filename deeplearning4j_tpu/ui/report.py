@@ -792,6 +792,14 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 f"occupancy {gen.get('slot_occupancy', 0.0):.1%} of "
                 f"{gen.get('max_slots', 0)} slots "
                 f"(docs/serving.md \"Generative serving\")</p>")
+        if gen.get("spec_rounds"):
+            parts.append(
+                f"<p>speculative: {gen.get('draft_accepted', 0)}/"
+                f"{gen.get('draft_tokens', 0)} draft tokens accepted "
+                f"({gen.get('draft_acceptance_rate', 0.0):.1%}) over "
+                f"{gen.get('spec_rounds', 0)} rounds, "
+                f"{gen.get('draft_rejected', 0)} rejected "
+                f"(docs/serving.md \"Decode speed\")</p>")
         paged = s.get("paged") or {}
         if paged:
             parts.append(

@@ -177,12 +177,14 @@ def gpt_param_names(cfg: GPTConfig):
     return names
 
 
-def gpt_decode_fns(cfg: GPTConfig):
-    """Pure-jax ``(prefill_fn, decode_fn)`` mirroring :func:`build_gpt`'s
-    math op-for-op (one-pass layer norm with ``rsqrt``, per-head-block
-    fused qkv layout, f32 attention scores/softmax, tanh-gelu, tied
-    logits) but in DECODE MODE: attention reads/writes preallocated
-    per-slot KV cache slabs instead of recomputing the full sequence.
+def gpt_decode_fns(cfg: GPTConfig, quantize_weights: bool = False,
+                   kv_scales=None):
+    """Pure-jax ``(prefill_fn, decode_fn, verify_fn)`` mirroring
+    :func:`build_gpt`'s math op-for-op (one-pass layer norm with
+    ``rsqrt``, per-head-block fused qkv layout, f32 attention
+    scores/softmax, tanh-gelu, tied logits) but in DECODE MODE:
+    attention reads/writes preallocated per-slot KV cache slabs instead
+    of recomputing the full sequence.
 
     KV slab layout (one array each for K and V, shared by every layer so
     a serving step donates exactly two buffers)::
@@ -205,10 +207,35 @@ def gpt_decode_fns(cfg: GPTConfig):
       so a retired slot's stale (even poisoned/NaN) cache rows can
       never leak into its successor, bit-exactly (tested). Returns
       ``(kc, vc, next_tokens, logits)``.
+    - ``verify_fn(params, kc, vc, io)`` with ``io = {"tokens": [S, W]
+      int32, "positions": [S] int32, "active": [S] bool}`` is the
+      speculative-decoding verifier (Leviathan et al.): column 0 of the
+      window is each slot's last emitted token, columns 1..W-1 a
+      draft's proposals. It writes all W KV rows per active slot
+      (positions ``p0..p0+W-1``) and returns ``(kc, vc, out [S, W],
+      logits [S, W, vocab])`` where ``out[s, j]`` is the target's
+      greedy token AFTER consuming window tokens ``0..j`` — row j of a
+      W-token causal forward, so ``out[s, 0]`` is bit-identical to
+      ``decode_fn`` fed the same token. The host accepts the longest
+      prefix where the drafted column ``j+1`` equals ``out[:, j]`` and
+      rewinds positions past it — the masked-KV discipline (stale rows
+      are masked until overwritten) makes the rollback free.
 
-    Both are shape-static per (bucket, max_slots): the serving tier
-    compiles ONE decode program plus one prefill program per pow2
-    prompt bucket (docs/serving.md "Generative serving").
+    All are shape-static per (bucket, max_slots, window): the serving
+    tier compiles ONE decode program, one verify program per window
+    width, plus one prefill program per pow2 prompt bucket
+    (docs/serving.md "Generative serving" / "Decode speed").
+
+    ``quantize_weights=True`` expects the param dict from
+    :func:`gpt_quantize_params`: matmul weights and embeddings carried
+    as int8 payloads plus per-output-channel f32 ``<name>::scale``
+    arrays; the dequant is applied to the [..., n_out] matmul PRODUCT
+    (or folded into the activation for the tied logits einsum), so the
+    weight bytes read per decode step drop 4x without an f32 copy ever
+    materializing. ``kv_scales={"k": [L, A, D], "v": [L, A, D]}``
+    (from :func:`gpt_kv_scales`) turns the slabs into int8: K/V are
+    quantized per (layer, head, channel) at write and dequantized at
+    gather, inside the same compiled step.
     """
     import jax
     import jax.numpy as jnp
@@ -217,6 +244,11 @@ def gpt_decode_fns(cfg: GPTConfig):
                   cfg.num_layers)
     eps = cfg.layer_norm_eps
     scale = 1.0 / np.sqrt(D)        # matches ops scaled_dot_product_attention
+    QW = bool(quantize_weights)
+    KQ = kv_scales is not None
+    # scales become jaxpr constants at trace time: [L, A, D] each
+    ksc = np.asarray(kv_scales["k"], np.float32) if KQ else None
+    vsc = np.asarray(kv_scales["v"], np.float32) if KQ else None
 
     def _ln(x, g, b):
         # one-pass moments + rsqrt, exactly ops/nn_ops.py layer_norm's
@@ -227,26 +259,61 @@ def gpt_decode_fns(cfg: GPTConfig):
         inv = jax.lax.rsqrt(var + eps)
         return (x - mean) * inv * g + b
 
+    def _matmul(p, n, x):
+        # int8 path: matmul the raw int8 payload upcast in-register,
+        # per-output-channel scale applied to the [..., n_out] product
+        # — the dequant rides the matmul epilogue instead of
+        # materializing an f32 weight copy
+        if QW:
+            return (x @ p[n].astype(jnp.float32)) * p[n + "::scale"]
+        return x @ p[n]
+
     def _mlp(p, sc, x):
-        y = x @ p[f"{sc}/mlp/fc/kernel"] + p[f"{sc}/mlp/fc/bias"]
+        y = _matmul(p, f"{sc}/mlp/fc/kernel", x) + p[f"{sc}/mlp/fc/bias"]
         y = jax.nn.gelu(y, approximate=True)    # ops gelu default
-        return y @ p[f"{sc}/mlp/proj/kernel"] + p[f"{sc}/mlp/proj/bias"]
+        return _matmul(p, f"{sc}/mlp/proj/kernel", y) \
+            + p[f"{sc}/mlp/proj/bias"]
+
+    def _tok_emb(p, tokens):
+        e = jnp.take(p["wte"], tokens, axis=0)
+        if QW:
+            e = e.astype(jnp.float32) * p["wte::scale"]
+        return e
 
     def _logits(p, x):
         if cfg.tie_embeddings:
-            return jnp.einsum("sh,vh->sv", x, p["wte"])
-        return x @ p["lm_head"]
+            if QW:
+                # (wte_i8 * s_h) contracted over h == wte_i8 contracted
+                # with (x * s_h): fold the per-hidden-channel scale into
+                # the small activation, keep the big operand int8
+                return jnp.einsum("...h,vh->...v", x * p["wte::scale"],
+                                  p["wte"].astype(jnp.float32))
+            return jnp.einsum("...h,vh->...v", x, p["wte"])
+        return _matmul(p, "lm_head", x)
+
+    def _q_store(x, dt, s):
+        # symmetric int8 at write: one round+clip per fresh K/V row
+        if s is None:
+            return x.astype(dt)
+        return jnp.clip(jnp.round(x / s), -127, 127).astype(dt)
+
+    def _q_load(x, s):
+        # dequant at gather, fused into the score/att matmul producers
+        if s is None:
+            return x
+        return x.astype(jnp.float32) * s
 
     def prefill_fn(params, kc, vc, io):
         p = params
         tokens, length, slot = io["tokens"], io["length"], io["slot"]
         Lb = tokens.shape[0]
-        x = jnp.take(p["wte"], tokens, axis=0) + p["wpe"][:Lb]   # [Lb, H]
+        x = _tok_emb(p, tokens) + p["wpe"][:Lb]             # [Lb, H]
         cm = jnp.tril(jnp.ones((Lb, Lb), bool))
         for i in range(L):
             sc = f"h{i}"
             y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
-            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
             # per-head blocks [q_a|k_a|v_a] — build_gpt's fused layout
             qkv = jnp.transpose(qkv.reshape(Lb, A, 3 * D), (1, 0, 2))
             q, k, v = jnp.split(qkv, 3, axis=-1)        # [A, Lb, D]
@@ -265,11 +332,15 @@ def gpt_decode_fns(cfg: GPTConfig):
             starts = (jnp.asarray(i, jnp.int32),
                       jnp.asarray(slot, jnp.int32), z, z, z)
             kc = jax.lax.dynamic_update_slice(
-                kc, k[None, None].astype(kc.dtype), starts)
+                kc, _q_store(k, kc.dtype,
+                             ksc[i][:, None, :] if KQ else None)[None, None],
+                starts)
             vc = jax.lax.dynamic_update_slice(
-                vc, v[None, None].astype(vc.dtype), starts)
+                vc, _q_store(v, vc.dtype,
+                             vsc[i][:, None, :] if KQ else None)[None, None],
+                starts)
             att = jnp.transpose(att, (1, 0, 2)).reshape(Lb, H)
-            att = att @ p[f"{sc}/attn/proj/kernel"] \
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
                 + p[f"{sc}/attn/proj/bias"]
             x = x + att
             y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
@@ -285,7 +356,7 @@ def gpt_decode_fns(cfg: GPTConfig):
         tokens, active = io["tokens"], io["active"]
         S, T = kc.shape[1], kc.shape[3]
         pos = jnp.clip(io["positions"], 0, T - 1)
-        x = jnp.take(p["wte"], tokens, axis=0) \
+        x = _tok_emb(p, tokens) \
             + jnp.take(p["wpe"], pos, axis=0)               # [S, H]
         si = jnp.arange(S)[:, None]
         ai = jnp.arange(A)[None, :]
@@ -295,31 +366,34 @@ def gpt_decode_fns(cfg: GPTConfig):
         for i in range(L):
             sc = f"h{i}"
             y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
-            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
             q, k, v = jnp.split(qkv.reshape(S, A, 3 * D), 3, axis=-1)
             # in-place per-slot writes at each slot's own position;
             # inactive slots keep their existing rows (forensics — and
             # a free slot's cache is fully rewritten by prefill anyway)
             cur_k = kc[i, si, ai, pos[:, None]]
             cur_v = vc[i, si, ai, pos[:, None]]
+            k_st = _q_store(k, kc.dtype, ksc[i][None] if KQ else None)
+            v_st = _q_store(v, vc.dtype, vsc[i][None] if KQ else None)
             kc = kc.at[i, si, ai, pos[:, None]].set(
-                jnp.where(active[:, None, None], k.astype(kc.dtype),
-                          cur_k))
+                jnp.where(active[:, None, None], k_st, cur_k))
             vc = vc.at[i, si, ai, pos[:, None]].set(
-                jnp.where(active[:, None, None], v.astype(vc.dtype),
-                          cur_v))
+                jnp.where(active[:, None, None], v_st, cur_v))
+            ctx_k = _q_load(kc[i], ksc[i][None, :, None, :] if KQ else None)
+            ctx_v = _q_load(vc[i], vsc[i][None, :, None, :] if KQ else None)
             scores = jnp.einsum(
-                "sad,satd->sat", q, kc[i],
+                "sad,satd->sat", q, ctx_k,
                 preferred_element_type=jnp.float32) * scale
             scores = jnp.where(mask, scores, jnp.float32(-1e30))
-            probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+            probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
             # zero masked V rows: a softmax weight of exactly 0 times a
             # NaN/Inf stale row would still be NaN — the where makes
             # slot reuse provably independent of retired-cache contents
-            v_safe = jnp.where(mask[..., None], vc[i], 0)
+            v_safe = jnp.where(mask[..., None], ctx_v, 0)
             att = jnp.einsum("sat,satd->sad", probs, v_safe)
             att = att.reshape(S, H)
-            att = att @ p[f"{sc}/attn/proj/kernel"] \
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
                 + p[f"{sc}/attn/proj/bias"]
             x = x + att
             y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
@@ -329,16 +403,80 @@ def gpt_decode_fns(cfg: GPTConfig):
         return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
             logits
 
-    return prefill_fn, decode_fn
+    def verify_fn(params, kc, vc, io):
+        p = params
+        tokens, active = io["tokens"], io["active"]         # [S, W], [S]
+        S, W = tokens.shape
+        T = kc.shape[3]
+        pos = jnp.clip(io["positions"][:, None]
+                       + jnp.arange(W, dtype=jnp.int32)[None, :],
+                       0, T - 1)                            # [S, W]
+        x = _tok_emb(p, tokens) \
+            + jnp.take(p["wpe"], pos, axis=0)               # [S, W, H]
+        si = jnp.arange(S)
+        ai = jnp.arange(A)
+        # window row w attends to global index <= its own position —
+        # the causal mask over history + the in-window prefix
+        mask = jnp.arange(T)[None, None, :] <= pos[:, :, None]  # [S, W, T]
+        # rows beyond each slot's LAST window position are stale
+        # (retired occupants / future writes) and may be poisoned;
+        # in-window rows masked for earlier w are FRESH finite writes
+        # whose -1e30 score gives an exactly-0 weight — so zeroing by
+        # the per-slot upper bound is the same poisoned-slab discipline
+        # as decode_fn's full mask, without a [S,W,T,D] where
+        vmask = jnp.arange(T)[None, :] <= pos[:, -1][:, None]   # [S, T]
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
+            q, k, v = jnp.split(qkv.reshape(S, W, A, 3 * D), 3, axis=-1)
+            # scatter all W rows per slot at positions p0..p0+W-1;
+            # inactive slots keep their existing rows (same contract as
+            # decode_fn)
+            idx = (i, si[:, None, None], ai[None, None, :],
+                   pos[:, :, None])
+            cur_k = kc[idx]
+            cur_v = vc[idx]
+            k_st = _q_store(k, kc.dtype,
+                            ksc[i][None, None] if KQ else None)
+            v_st = _q_store(v, vc.dtype,
+                            vsc[i][None, None] if KQ else None)
+            ok = active[:, None, None, None]
+            kc = kc.at[idx].set(jnp.where(ok, k_st, cur_k))
+            vc = vc.at[idx].set(jnp.where(ok, v_st, cur_v))
+            ctx_k = _q_load(kc[i], ksc[i][None, :, None, :] if KQ else None)
+            ctx_v = _q_load(vc[i], vsc[i][None, :, None, :] if KQ else None)
+            scores = jnp.einsum(
+                "swad,satd->swat", q, ctx_k,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask[:, :, None, :], scores,
+                               jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
+            v_safe = jnp.where(vmask[:, None, :, None], ctx_v, 0)
+            att = jnp.einsum("swat,satd->swad", probs, v_safe)
+            att = att.reshape(S, W, H)
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        logits = _logits(p, x)                          # [S, W, vocab]
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            logits
+
+    return prefill_fn, decode_fn, verify_fn
 
 
 def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
-                         max_blocks_per_req: int):
-    """Pure-jax ``(prefill_fn, decode_fn)`` over PAGED KV slabs — the
-    same math as :func:`gpt_decode_fns` op-for-op, but attention
-    reads/writes fixed-size token BLOCKS addressed through per-request
-    block tables (vLLM's PagedAttention layout, Kwon et al. SOSP '23)
-    instead of one contiguous ``max_seq`` row per slot.
+                         max_blocks_per_req: int,
+                         quantize_weights: bool = False, kv_scales=None):
+    """Pure-jax ``(prefill_fn, decode_fn, verify_fn)`` over PAGED KV
+    slabs — the same math as :func:`gpt_decode_fns` op-for-op, but
+    attention reads/writes fixed-size token BLOCKS addressed through
+    per-request block tables (vLLM's PagedAttention layout, Kwon et al.
+    SOSP '23) instead of one contiguous ``max_seq`` row per slot.
 
     KV slab layout (one array each for K and V)::
 
@@ -366,6 +504,13 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
       host-computed ``(write_block, write_off)`` (inactive lanes write
       the null block), each lane attends over its own gathered table
       masked to ``index <= position``.
+    - ``verify_fn(params, kc, vc, io)`` — the speculative-decoding
+      verifier over paged slabs: ``io`` carries a [S, W] token window
+      plus [S, W] ``write_block``/``write_off`` (host-computed per
+      window position; inactive lanes point every column at the null
+      block) and returns ``(kc, vc, out [S, W], logits [S, W, vocab])``
+      with the same row-j semantics as the dense
+      ``gpt_decode_fns`` verifier.
 
     Because a table slot ``u`` covers exactly global positions
     ``[u * block_size, (u+1) * block_size)``, the gathered context is
@@ -373,6 +518,13 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
     max_seq`` it is ELEMENTWISE identical to the dense slab's context,
     so greedy outputs match the dense server bit-for-bit
     (tests/test_paged.py).
+
+    ``quantize_weights`` / ``kv_scales`` follow the
+    :func:`gpt_decode_fns` contract: int8 weight payloads with
+    ``::scale`` dequant in the matmul epilogue, and int8 KV blocks
+    quantized per (layer, head, channel) at write / dequantized at
+    gather — which DOUBLES vs f16 (4x vs f32) the tokens a fixed-byte
+    ``BlockPool`` holds, compounding with prefix caching.
     """
     import jax
     import jax.numpy as jnp
@@ -384,6 +536,10 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
     T = MAXB * BS                   # gathered context length per request
     eps = cfg.layer_norm_eps
     scale = 1.0 / np.sqrt(D)
+    QW = bool(quantize_weights)
+    KQ = kv_scales is not None
+    ksc = np.asarray(kv_scales["k"], np.float32) if KQ else None
+    vsc = np.asarray(kv_scales["v"], np.float32) if KQ else None
 
     def _ln(x, g, b):
         mean = jnp.mean(x, axis=-1, keepdims=True)
@@ -392,15 +548,40 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         inv = jax.lax.rsqrt(var + eps)
         return (x - mean) * inv * g + b
 
+    def _matmul(p, n, x):
+        if QW:
+            return (x @ p[n].astype(jnp.float32)) * p[n + "::scale"]
+        return x @ p[n]
+
     def _mlp(p, sc, x):
-        y = x @ p[f"{sc}/mlp/fc/kernel"] + p[f"{sc}/mlp/fc/bias"]
+        y = _matmul(p, f"{sc}/mlp/fc/kernel", x) + p[f"{sc}/mlp/fc/bias"]
         y = jax.nn.gelu(y, approximate=True)
-        return y @ p[f"{sc}/mlp/proj/kernel"] + p[f"{sc}/mlp/proj/bias"]
+        return _matmul(p, f"{sc}/mlp/proj/kernel", y) \
+            + p[f"{sc}/mlp/proj/bias"]
+
+    def _tok_emb(p, tokens):
+        e = jnp.take(p["wte"], tokens, axis=0)
+        if QW:
+            e = e.astype(jnp.float32) * p["wte::scale"]
+        return e
 
     def _logits(p, x):
         if cfg.tie_embeddings:
-            return jnp.einsum("sh,vh->sv", x, p["wte"])
-        return x @ p["lm_head"]
+            if QW:
+                return jnp.einsum("...h,vh->...v", x * p["wte::scale"],
+                                  p["wte"].astype(jnp.float32))
+            return jnp.einsum("...h,vh->...v", x, p["wte"])
+        return _matmul(p, "lm_head", x)
+
+    def _q_store(x, dt, s):
+        if s is None:
+            return x.astype(dt)
+        return jnp.clip(jnp.round(x / s), -127, 127).astype(dt)
+
+    def _q_load(x, s):
+        if s is None:
+            return x
+        return x.astype(jnp.float32) * s
 
     def prefill_fn(params, kc, vc, io):
         p = params
@@ -412,7 +593,7 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         # tail's wpe lookups in range (those rows never reach logits)
         g = hist + jnp.arange(Lb, dtype=jnp.int32)
         gpos = jnp.clip(g, 0, cfg.max_seq_len - 1)
-        x = jnp.take(p["wte"], tokens, axis=0) \
+        x = _tok_emb(p, tokens) \
             + jnp.take(p["wpe"], gpos, axis=0)               # [Lb, H]
         # scatter targets: suffix row j lands in table slot g//BS at
         # offset g%BS; padding rows (j >= length) land in null block 0
@@ -427,19 +608,22 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         for i in range(L):
             sc = f"h{i}"
             y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
-            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
             qkv = jnp.transpose(qkv.reshape(Lb, A, 3 * D), (1, 0, 2))
             q, k, v = jnp.split(qkv, 3, axis=-1)             # [A, Lb, D]
             # write the suffix K/V FIRST, then gather the whole table —
             # suffix self-attention reads its own fresh rows
             kc = kc.at[i, blk[None, :], ai[:, None], off[None, :]].set(
-                k.astype(kc.dtype))
+                _q_store(k, kc.dtype, ksc[i][:, None, :] if KQ else None))
             vc = vc.at[i, blk[None, :], ai[:, None], off[None, :]].set(
-                v.astype(vc.dtype))
-            ctx_k = jnp.transpose(kc[i][table], (1, 0, 2, 3)) \
-                .reshape(A, T, D)
-            ctx_v = jnp.transpose(vc[i][table], (1, 0, 2, 3)) \
-                .reshape(A, T, D)
+                _q_store(v, vc.dtype, vsc[i][:, None, :] if KQ else None))
+            ctx_k = _q_load(jnp.transpose(kc[i][table], (1, 0, 2, 3))
+                            .reshape(A, T, D),
+                            ksc[i][:, None, :] if KQ else None)
+            ctx_v = _q_load(jnp.transpose(vc[i][table], (1, 0, 2, 3))
+                            .reshape(A, T, D),
+                            vsc[i][:, None, :] if KQ else None)
             # zero unwritten rows BEFORE the matmuls: null-block trash
             # (even NaN-poisoned) must not reach any reduction
             ctx_k = jnp.where(valid[0][:, None], ctx_k, 0)
@@ -451,7 +635,7 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
             probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
             att = jnp.einsum("aqk,akd->aqd", probs, ctx_v)
             att = jnp.transpose(att, (1, 0, 2)).reshape(Lb, H)
-            att = att @ p[f"{sc}/attn/proj/kernel"] \
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
                 + p[f"{sc}/attn/proj/bias"]
             x = x + att
             y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
@@ -469,7 +653,7 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         wb, wo = io["write_block"], io["write_off"]
         S = tokens.shape[0]
         pos = jnp.clip(io["positions"], 0, cfg.max_seq_len - 1)
-        x = jnp.take(p["wte"], tokens, axis=0) \
+        x = _tok_emb(p, tokens) \
             + jnp.take(p["wpe"], pos, axis=0)                # [S, H]
         ai = jnp.arange(A)
         # attend to global index <= position; later table rows are
@@ -478,19 +662,22 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         for i in range(L):
             sc = f"h{i}"
             y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
-            qkv = y @ p[f"{sc}/attn/qkv/kernel"] + p[f"{sc}/attn/qkv/bias"]
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
             q, k, v = jnp.split(qkv.reshape(S, A, 3 * D), 3, axis=-1)
             # unconditional scatter: the host points inactive lanes at
             # the null block, so no active request's rows are touched
             # (active lanes own disjoint blocks — no write collisions)
             kc = kc.at[i, wb[:, None], ai[None, :], wo[:, None]].set(
-                k.astype(kc.dtype))
+                _q_store(k, kc.dtype, ksc[i][None] if KQ else None))
             vc = vc.at[i, wb[:, None], ai[None, :], wo[:, None]].set(
-                v.astype(vc.dtype))
-            ctx_k = jnp.transpose(kc[i][tables], (0, 2, 1, 3, 4)) \
-                .reshape(S, A, T, D)
-            ctx_v = jnp.transpose(vc[i][tables], (0, 2, 1, 3, 4)) \
-                .reshape(S, A, T, D)
+                _q_store(v, vc.dtype, vsc[i][None] if KQ else None))
+            ctx_k = _q_load(jnp.transpose(kc[i][tables], (0, 2, 1, 3, 4))
+                            .reshape(S, A, T, D),
+                            ksc[i][None, :, None, :] if KQ else None)
+            ctx_v = _q_load(jnp.transpose(vc[i][tables], (0, 2, 1, 3, 4))
+                            .reshape(S, A, T, D),
+                            vsc[i][None, :, None, :] if KQ else None)
             scores = jnp.einsum(
                 "sad,satd->sat", q, ctx_k,
                 preferred_element_type=jnp.float32) * scale
@@ -501,7 +688,7 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
             v_safe = jnp.where(mask[..., None], ctx_v, 0)
             att = jnp.einsum("sat,satd->sad", probs, v_safe)
             att = att.reshape(S, H)
-            att = att @ p[f"{sc}/attn/proj/kernel"] \
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
                 + p[f"{sc}/attn/proj/bias"]
             x = x + att
             y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
@@ -511,10 +698,176 @@ def gpt_paged_decode_fns(cfg: GPTConfig, block_size: int,
         return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
             logits
 
-    return prefill_fn, decode_fn
+    def verify_fn(params, kc, vc, io):
+        p = params
+        tokens, active = io["tokens"], io["active"]          # [S, W]
+        tables = io["tables"]                                # [S, MAXB]
+        wb, wo = io["write_block"], io["write_off"]          # [S, W]
+        S, W = tokens.shape
+        pos = jnp.clip(io["positions"][:, None]
+                       + jnp.arange(W, dtype=jnp.int32)[None, :],
+                       0, cfg.max_seq_len - 1)               # [S, W]
+        x = _tok_emb(p, tokens) \
+            + jnp.take(p["wpe"], pos, axis=0)                # [S, W, H]
+        ai = jnp.arange(A)
+        mask = jnp.arange(T)[None, None, :] <= pos[:, :, None]
+        # per-slot stale-row bound — see the dense verify_fn: in-window
+        # rows masked for earlier w are fresh finite writes, rows past
+        # the window's last position may be poisoned trash
+        vmask = jnp.arange(T)[None, :] <= pos[:, -1][:, None]
+        for i in range(L):
+            sc = f"h{i}"
+            y = _ln(x, p[f"{sc}/ln_1/gamma"], p[f"{sc}/ln_1/beta"])
+            qkv = _matmul(p, f"{sc}/attn/qkv/kernel", y) \
+                + p[f"{sc}/attn/qkv/bias"]
+            q, k, v = jnp.split(qkv.reshape(S, W, A, 3 * D), 3, axis=-1)
+            # unconditional [S, W] scatter: active lanes own disjoint
+            # in-order (block, off) pairs, inactive lanes' W columns all
+            # target the null block (colliding writes there are trash
+            # over trash by construction)
+            kc = kc.at[i, wb[:, :, None], ai[None, None, :],
+                       wo[:, :, None]].set(
+                _q_store(k, kc.dtype,
+                         ksc[i][None, None] if KQ else None))
+            vc = vc.at[i, wb[:, :, None], ai[None, None, :],
+                       wo[:, :, None]].set(
+                _q_store(v, vc.dtype,
+                         vsc[i][None, None] if KQ else None))
+            ctx_k = _q_load(jnp.transpose(kc[i][tables], (0, 2, 1, 3, 4))
+                            .reshape(S, A, T, D),
+                            ksc[i][None, :, None, :] if KQ else None)
+            ctx_v = _q_load(jnp.transpose(vc[i][tables], (0, 2, 1, 3, 4))
+                            .reshape(S, A, T, D),
+                            vsc[i][None, :, None, :] if KQ else None)
+            scores = jnp.einsum(
+                "swad,satd->swat", q, ctx_k,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask[:, :, None, :], scores,
+                               jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(ctx_v.dtype)
+            v_safe = jnp.where(vmask[:, None, :, None], ctx_v, 0)
+            att = jnp.einsum("swat,satd->swad", probs, v_safe)
+            att = att.reshape(S, W, H)
+            att = _matmul(p, f"{sc}/attn/proj/kernel", att) \
+                + p[f"{sc}/attn/proj/bias"]
+            x = x + att
+            y = _ln(x, p[f"{sc}/ln_2/gamma"], p[f"{sc}/ln_2/beta"])
+            x = x + _mlp(p, sc, y)
+        x = _ln(x, p["ln_f/gamma"], p["ln_f/beta"])
+        logits = _logits(p, x)                           # [S, W, vocab]
+        return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            logits
+
+    return prefill_fn, decode_fn, verify_fn
 
 
-def gpt_paged_spec(sd, cfg: GPTConfig):
+def _quantized_param_names(cfg: GPTConfig):
+    """The matmul weights + embeddings that carry int8 payloads under
+    ``quantize_weights`` — the big operands whose bytes dominate decode
+    HBM traffic. Layer norms and biases stay f32 (tiny, precision-
+    critical)."""
+    names = [n for n in gpt_param_names(cfg) if n.endswith("/kernel")]
+    names.append("wte")
+    if not cfg.tie_embeddings:
+        names.append("lm_head")
+    return names
+
+
+def gpt_quantize_params(raw: dict, cfg: GPTConfig) -> dict:
+    """Symmetric per-output-channel int8 of the decode parameters:
+    every ``/kernel`` plus the embedding matrix becomes an int8 payload
+    with a float32 ``<name>::scale`` companion (absmax scales via
+    :func:`evaluation.calibration.channel_scales` — weights have no
+    outlier tail worth clipping, so every value stays representable).
+    ``wte``'s channels are the HIDDEN axis, so the same scale serves
+    the embedding take and the tied-logits einsum. Pure: re-pulling
+    after ``fit()`` + ``update_model()`` re-quantizes the new weights.
+    """
+    from deeplearning4j_tpu.evaluation.calibration import channel_scales
+
+    out = {}
+    qnames = set(_quantized_param_names(cfg))
+    for n, a in raw.items():
+        if n in qnames:
+            w = np.asarray(a, np.float32)
+            s = channel_scales(w, method="absmax")          # [n_out]
+            out[n] = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+            out[n + "::scale"] = s
+        else:
+            out[n] = a
+    return out
+
+
+def gpt_kv_scales(sd, cfg: GPTConfig, prompts=None,
+                  method: str = "quantile", quantile: float = 0.9995):
+    """Calibrate per-(layer, head, channel) int8 scales for the KV
+    cache: run the FULL-PRECISION prefill over calibration prompts on a
+    one-slot slab, read back the K/V rows it wrote, and feed them
+    through :func:`evaluation.calibration.channel_scales` (quantile
+    clipping by default — K/V activations have outlier tails that
+    absmax would let starve the int8 grid). Returns ``{"k": [L, A, D],
+    "v": [L, A, D]}`` float32, the ``kv_scales`` contract of
+    :func:`gpt_decode_fns` / :func:`gpt_paged_decode_fns`.
+
+    ``prompts=None`` synthesizes a small deterministic prompt set —
+    fine for smoke use; real deployments should pass prompts drawn
+    from their actual traffic distribution (docs/serving.md "Decode
+    speed")."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.evaluation.calibration import channel_scales
+
+    names = gpt_param_names(cfg)
+    params = {n: sd._arrays[n] for n in names}
+    prefill_fn, _, _ = gpt_decode_fns(cfg)
+    jit_prefill = jax.jit(prefill_fn)
+    if prompts is None:
+        rng = np.random.default_rng(0)
+        span = min(32, cfg.max_seq_len - 1)
+        prompts = [rng.integers(0, cfg.vocab_size, size=span)
+                   for _ in range(4)]
+    k_rows, v_rows = [], []
+    for pr in prompts:
+        pr = np.asarray(pr, np.int32).reshape(-1)
+        Lp = int(pr.size)
+        shape = (cfg.num_layers, 1, cfg.num_heads, Lp, cfg.head_size)
+        kc, vc, _, _ = jit_prefill(
+            params, jnp.zeros(shape, jnp.float32),
+            jnp.zeros(shape, jnp.float32),
+            {"tokens": pr, "length": np.int32(Lp), "slot": np.int32(0)})
+        k_rows.append(np.asarray(kc)[:, 0])         # [L, A, Lp, D]
+        v_rows.append(np.asarray(vc)[:, 0])
+
+    def _scales(rows):
+        obs = np.concatenate(rows, axis=2)          # [L, A, N, D]
+        flat = np.transpose(obs, (2, 0, 1, 3)).reshape(obs.shape[2], -1)
+        s = channel_scales(flat, method=method, quantile=quantile)
+        return s.reshape(cfg.num_layers, cfg.num_heads, cfg.head_size)
+
+    return {"k": _scales(k_rows), "v": _scales(v_rows)}
+
+
+def _check_decode_params(sd, cfg: GPTConfig):
+    names = gpt_param_names(cfg)
+    missing = [n for n in names if n not in sd._arrays]
+    if missing:
+        raise ValueError(
+            f"graph is missing decode parameters {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''} — was it built by "
+            f"zoo.gpt.build_gpt with this config?")
+    return names
+
+
+def _params_pull(sd, cfg: GPTConfig, names, quantize_weights: bool):
+    if quantize_weights:
+        return lambda: gpt_quantize_params(
+            {n: sd._arrays[n] for n in names}, cfg)
+    return lambda: {n: sd._arrays[n] for n in names}
+
+
+def gpt_paged_spec(sd, cfg: GPTConfig, quantize_weights: bool = False,
+                   quantize_kv: bool = False, calibration_prompts=None):
     """The PAGED decode-mode graph hook: a
     :class:`~deeplearning4j_tpu.serving.paged.PagedGenerativeSpec` over
     a trained :func:`build_gpt` graph — what
@@ -522,51 +875,62 @@ def gpt_paged_spec(sd, cfg: GPTConfig):
     parameter sync as :func:`gpt_generative_spec`; the decode functions
     are built per (block_size, max_blocks_per_req) geometry by the
     server (and memoized, so every server over the same model and
-    geometry shares one compile set)."""
+    geometry shares one compile set).
+
+    ``quantize_weights`` serves int8 weight payloads (4x fewer weight
+    bytes per decode step); ``quantize_kv`` makes the BLOCK POOL int8 —
+    ``kv_dtype`` flips to ``"int8"``, so the server's equal-byte pool
+    holds 4x the f32 token capacity — with scales calibrated via
+    :func:`gpt_kv_scales` over ``calibration_prompts``."""
     from deeplearning4j_tpu.serving.paged import PagedGenerativeSpec
 
-    names = gpt_param_names(cfg)
-    missing = [n for n in names if n not in sd._arrays]
-    if missing:
-        raise ValueError(
-            f"graph is missing decode parameters {missing[:4]}"
-            f"{'...' if len(missing) > 4 else ''} — was it built by "
-            f"zoo.gpt.build_gpt with this config?")
+    names = _check_decode_params(sd, cfg)
+    kv_scales = gpt_kv_scales(sd, cfg, prompts=calibration_prompts) \
+        if quantize_kv else None
     return PagedGenerativeSpec(
-        params=lambda: {n: sd._arrays[n] for n in names},
+        params=_params_pull(sd, cfg, names, quantize_weights),
         make_fns=lambda block_size, max_blocks: gpt_paged_decode_fns(
-            cfg, block_size, max_blocks),
+            cfg, block_size, max_blocks,
+            quantize_weights=quantize_weights, kv_scales=kv_scales),
         kv_shape=lambda num_blocks, block_size: (
             cfg.num_layers, int(num_blocks), cfg.num_heads,
             int(block_size), cfg.head_size),
         vocab_size=cfg.vocab_size,
         max_seq_len=cfg.max_seq_len,
-        num_heads=cfg.num_heads)
+        num_heads=cfg.num_heads,
+        kv_dtype="int8" if quantize_kv else "float32")
 
 
-def gpt_generative_spec(sd, cfg: GPTConfig):
+def gpt_generative_spec(sd, cfg: GPTConfig, quantize_weights: bool = False,
+                        quantize_kv: bool = False,
+                        calibration_prompts=None):
     """The decode-mode graph hook: a
     :class:`~deeplearning4j_tpu.serving.generative.GenerativeSpec` over
     a trained :func:`build_gpt` graph — what
     ``serving.generative.GenerativeServer`` consumes. Parameters are
     pulled from the SameDiff BY NAME at sync time, so further ``fit()``
-    followed by ``server.update_model()`` serves the new weights."""
+    followed by ``server.update_model()`` serves the new weights (the
+    quantized pull re-quantizes them). The spec carries the verify
+    program, so any server over it can act as a speculative-decoding
+    TARGET; a second (smaller) spec passed as ``draft_spec=`` acts as
+    the draft. ``quantize_weights`` / ``quantize_kv`` follow the
+    :func:`gpt_paged_spec` contract (int8 payloads + ``kv_dtype``
+    flip), with KV scales calibrated over ``calibration_prompts``."""
     from deeplearning4j_tpu.serving.generative import GenerativeSpec
 
-    names = gpt_param_names(cfg)
-    missing = [n for n in names if n not in sd._arrays]
-    if missing:
-        raise ValueError(
-            f"graph is missing decode parameters {missing[:4]}"
-            f"{'...' if len(missing) > 4 else ''} — was it built by "
-            f"zoo.gpt.build_gpt with this config?")
-    prefill_fn, decode_fn = gpt_decode_fns(cfg)
+    names = _check_decode_params(sd, cfg)
+    kv_scales = gpt_kv_scales(sd, cfg, prompts=calibration_prompts) \
+        if quantize_kv else None
+    prefill_fn, decode_fn, verify_fn = gpt_decode_fns(
+        cfg, quantize_weights=quantize_weights, kv_scales=kv_scales)
     return GenerativeSpec(
-        params=lambda: {n: sd._arrays[n] for n in names},
+        params=_params_pull(sd, cfg, names, quantize_weights),
         prefill=prefill_fn,
         decode=decode_fn,
         kv_shape=lambda max_slots, max_seq: (
             cfg.num_layers, int(max_slots), cfg.num_heads, int(max_seq),
             cfg.head_size),
         vocab_size=cfg.vocab_size,
-        max_seq_len=cfg.max_seq_len)
+        max_seq_len=cfg.max_seq_len,
+        kv_dtype="int8" if quantize_kv else "float32",
+        verify=verify_fn)

@@ -277,6 +277,40 @@ def _seed_fleet_slo_unreachable():
     return rep, "fleet[2x4]", "concurrent slots"
 
 
+def _seed_speculation_misconfig():
+    from deeplearning4j_tpu.analyze import analyze_speculation_config
+    from deeplearning4j_tpu.serving.generative import GenerativeSpec
+
+    def _fake(vocab, msl, n_params):
+        return GenerativeSpec(
+            params=lambda: {"w": np.zeros((n_params,), np.float32)},
+            prefill=None, decode=None,
+            kv_shape=lambda slots, seq: (2, slots, 2, seq, 16),
+            vocab_size=vocab, max_seq_len=msl)
+
+    target = _fake(64, 128, 1000)
+    # vocab mismatch: the error variant (the server refuses the pairing
+    # at construction; the lint names it without building anything)
+    rep = analyze_speculation_config(target, _fake(48, 128, 10))
+    assert rep.context == "serving_config" and rep.rules_run == 1
+    # a too-short draft window is the other error variant
+    short = analyze_speculation_config(target, _fake(64, 64, 10))
+    assert any(x.severity == "error" and "max_seq_len" in x.subject
+               for x in short.findings)
+    # a draft as LARGE as its target constructs fine and still emits
+    # the target's exact tokens -> DEMOTED to warn, hint names a
+    # smaller config
+    big = analyze_speculation_config(target, _fake(64, 128, 1000))
+    f = [x for x in big.findings
+         if x.rule_id == "serving.speculation_misconfig"][0]
+    assert f.severity == "warn" and "smaller" in f.fix_hint
+    assert not big.errors()
+    # a sane pairing is clean
+    assert not analyze_speculation_config(target,
+                                          _fake(64, 128, 10)).findings
+    return rep, "draft_spec.vocab_size", "embedding table"
+
+
 CORPUS = {
     "graph.shape_mismatch": _seed_shape_mismatch,
     "graph.undefined_input": _seed_undefined_input,
@@ -300,6 +334,7 @@ CORPUS = {
     "config.tensorstats_unobserved": _seed_tensorstats_unobserved,
     "serving.dense_kv_exceeds_headroom": _seed_dense_kv_exceeds_headroom,
     "serving.fleet_slo_unreachable": _seed_fleet_slo_unreachable,
+    "serving.speculation_misconfig": _seed_speculation_misconfig,
 }
 
 
@@ -319,6 +354,21 @@ class TestSeededDefects:
         assert f.severity == RULES[rule_id].severity
         assert subject_sub in f.subject, (f.subject, subject_sub)
         assert message_sub in f.message, (f.message, message_sub)
+
+    def test_severity_override_is_demote_only(self):
+        """finding(severity=...) may demote a dual-severity rule's hit
+        below the catalog, never escalate past it."""
+        from deeplearning4j_tpu.analyze.findings import finding
+        with pytest.raises(ValueError, match="bad severity"):
+            finding("serving.speculation_misconfig", "s", "m",
+                    severity="bogus")
+        with pytest.raises(ValueError, match="escalates"):
+            # the fleet rule is cataloged warn — error would escalate
+            finding("serving.fleet_slo_unreachable", "s", "m",
+                    severity="error")
+        f = finding("serving.speculation_misconfig", "s", "m",
+                    severity="warn")
+        assert f.severity == "warn"
 
     def test_shape_mismatch_provenance_names_producers(self):
         report, _, _ = CORPUS["graph.shape_mismatch"]()
@@ -473,7 +523,7 @@ class TestModelSweep:
         bare.set_loss_variables(["loss"])
         assert (analyze_training(bare).rules_run
                 == len(RULES) - 8 - len(_SERVING_RULES))
-        assert len(_SERVING_RULES) == 2
+        assert len(_SERVING_RULES) == 3
 
 
 # ---------------------------------------------------------------------------

@@ -3,7 +3,9 @@ EvaluationCalibration.java:53-467)."""
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.evaluation import EvaluationCalibration
+from deeplearning4j_tpu.evaluation import (EvaluationCalibration,
+                                           Histogram, channel_scales,
+                                           histogram_quantile)
 
 
 def test_reliability_diagram_hand_computed():
@@ -144,3 +146,67 @@ def test_sequence_index_labels_with_mask():
     np.testing.assert_array_equal(
         a.residual_plot_all_classes().bin_counts,
         b.residual_plot_all_classes().bin_counts)
+
+
+# ---------------------------------------------------------------------------
+# channel_scales / histogram_quantile (ISSUE 18: the int8 weight/KV
+# calibration rides this module's binning machinery)
+
+def test_channel_scales_absmax_exact():
+    x = np.array([[1.0, -2.0], [-4.0, 0.5]])
+    s = channel_scales(x, qmax=127.0)
+    np.testing.assert_allclose(s, [4.0 / 127.0, 2.0 / 127.0], rtol=1e-6)
+    assert s.dtype == np.float32
+    # leading axes flatten into observations: [B, T, C] == [B*T, C]
+    y = np.arange(24, dtype=np.float64).reshape(2, 4, 3)
+    np.testing.assert_allclose(channel_scales(y),
+                               channel_scales(y.reshape(-1, 3)))
+
+
+def test_channel_scales_all_zero_channel_is_identity():
+    x = np.zeros((8, 3))
+    x[:, 1] = 5.0
+    s = channel_scales(x)
+    # no positive mass -> scale 1.0: payload 0, dequant 0, never NaN
+    assert s[0] == 1.0 and s[2] == 1.0
+    assert s[1] == pytest.approx(5.0 / 127.0)
+
+
+def test_channel_scales_nonfinite_masked():
+    x = np.array([[np.nan, 1.0], [np.inf, -3.0], [-np.inf, np.nan]])
+    s = channel_scales(x)
+    assert np.all(np.isfinite(s))
+    assert s[0] == 1.0                     # all-non-finite -> identity
+    assert s[1] == pytest.approx(3.0 / 127.0)
+    # quantile method is NaN-safe through the same mask
+    sq = channel_scales(x, method="quantile", quantile=0.999)
+    assert np.all(np.isfinite(sq)) and sq[0] == 1.0
+
+
+def test_channel_scales_quantile_clips_outliers():
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (4096, 2))
+    x[0, 0] = 1000.0                       # one spike in channel 0
+    s_abs = channel_scales(x, method="absmax")
+    s_q = channel_scales(x, method="quantile", quantile=0.999)
+    assert s_abs[0] == pytest.approx(1000.0 / 127.0)
+    assert s_q[0] < 0.1 * s_abs[0]         # spike does not set the grid
+    # without an outlier, quantile ~= absmax (within bin resolution)
+    assert s_q[1] <= s_abs[1] * 1.01
+
+
+def test_channel_scales_validation():
+    with pytest.raises(ValueError):
+        channel_scales(np.zeros((4, 2)), method="median")
+    with pytest.raises(ValueError):
+        channel_scales(np.zeros((4, 2)), method="quantile", quantile=0.0)
+    with pytest.raises(ValueError):
+        channel_scales(np.float64(3.0))    # scalar: no channel axis
+
+
+def test_histogram_quantile_right_edge():
+    h = Histogram("t", 0.0, 1.0, np.array([1, 1, 1, 1]))
+    assert histogram_quantile(h, 0.5) == pytest.approx(0.5)
+    assert histogram_quantile(h, 1.0) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        histogram_quantile(h, 0.0)

@@ -17,6 +17,13 @@ SCRIPTS = ["mnist_mlp.py", "cnn_with_augmentation.py",
            "checkpoint_resume.py", "self_healing_fit.py",
            "observability_demo.py", "analyze_model.py",
            "streaming_fit.py", "generative_serving.py",
+           # the fast-decode walkthrough trains a target AND a draft,
+           # then compiles the speculative + paged-int8 tiers — priced
+           # out of the tier-1 wall budget, still pinned by the slow
+           # tier (its contracts also ride tests/test_generative.py
+           # TestSpeculative/TestSeededSampling directly)
+           pytest.param("speculative_serving.py",
+                        marks=pytest.mark.slow),
            # the paged walkthrough compiles two serving tiers (dense
            # reference + paged, then a tp=2 mesh) — priced out of the
            # tier-1 wall budget, still pinned by the slow tier

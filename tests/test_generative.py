@@ -16,6 +16,16 @@ Pinned contracts:
   program per pow2 bucket, all AOT-warmable (0 traffic compiles);
 - continuous batching does ≥2x the tokens-per-decode-step of static
   wait-for-full-batch batching on the same skewed trace.
+
+ISSUE 18 (fast decode) grows the contract:
+- draft-model speculation NEVER changes tokens: temp-0 output is
+  bit-identical to the non-speculative server and to greedy_decode —
+  the draft only sets how many tokens land per verify round;
+- seeded sampling replays exactly per (seed, absolute token index):
+  same request, same tokens — whatever shares the batch, whatever the
+  admission order, and across a crash-requeue re-entry;
+- AOT warmup with speculation + int8 weights still leaves 0 traffic
+  compiles (7 plain + verify + draft decode + 6 draft prefill = 15).
 """
 import threading
 import time
@@ -41,6 +51,8 @@ from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
 
 CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
                 intermediate_size=64, max_seq_len=32)
+DRAFT_CFG = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                      num_heads=2, intermediate_size=32, max_seq_len=32)
 MSL = 32
 
 
@@ -54,6 +66,15 @@ def spec(gpt_sd):
     # one spec for the whole module: the jitted decode/prefill programs
     # are memoized on it, so every server here shares one compile set
     return gpt_generative_spec(gpt_sd, CFG)
+
+
+@pytest.fixture(scope="module")
+def draft_spec():
+    # an independently-trained smaller model over the SAME vocab: low
+    # acceptance (it disagrees with the target a lot) is the point —
+    # the rejection/rollback path gets exercised hard
+    dsd = build_gpt(DRAFT_CFG, batch=2, seq_len=8, seed=1)
+    return gpt_generative_spec(dsd, DRAFT_CFG)
 
 
 def make_server(spec, **kw):
@@ -503,6 +524,218 @@ class TestCrashRecovery:
 
 
 # ----------------------------------------------------------------------
+class TestSpeculative:
+    """ISSUE 18 tentpole: the draft never changes tokens — it only
+    changes how many land per verify dispatch."""
+
+    def test_temp0_bit_identical_to_plain_and_reference(self, spec,
+                                                        draft_spec):
+        prompts = mixed_prompts(8, seed=11)
+        budgets = [6 + i % 5 for i in range(8)]
+        with make_server(spec, draft_spec=draft_spec,
+                         speculate_k=4) as srv:
+            hs = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+            got = [h.result(timeout=120) for h in hs]
+            rec = srv.metrics.to_record()["generative"]
+        with make_server(spec) as plain:
+            want = [plain.submit(p, n).result(timeout=120)
+                    for p, n in zip(prompts, budgets)]
+        assert got == want
+        for p, n, g in zip(prompts, budgets, got):
+            assert g == ref_tokens(spec, p, n)
+        assert rec["spec_rounds"] >= 1          # speculation actually ran
+
+    def test_metrics_count_tokens_exactly_once(self, spec, draft_spec):
+        """Accepted draft tokens and the verify-corrected token land in
+        tokens_generated exactly once; the draft ledger balances."""
+        with make_server(spec, draft_spec=draft_spec,
+                         speculate_k=4) as srv:
+            outs = [srv.generate(p, max_new_tokens=6)
+                    for p in mixed_prompts(4, seed=13)]
+            rec = srv.metrics.to_record()["generative"]
+        assert rec["tokens_generated"] == sum(len(o) for o in outs)
+        assert rec["draft_tokens"] == \
+            rec["draft_accepted"] + rec["draft_rejected"]
+        assert rec["draft_tokens"] > 0
+        assert 0.0 <= rec["draft_acceptance_rate"] <= 1.0
+
+    def test_acceptance_lane_folds_and_renders(self, spec, draft_spec):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        from deeplearning4j_tpu.ui.report import render_report
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        storage = StatsStorage()
+        with make_server(spec, draft_spec=draft_spec, speculate_k=4,
+                         stats_storage=storage) as srv:
+            srv.generate(np.asarray([1, 2, 3], np.int32),
+                         max_new_tokens=6)
+            rec = srv.metrics.to_record()
+        reg = MetricsRegistry()
+        reg.fold_serving(rec)
+        text = reg.to_prometheus_text()
+        assert "dl4j_serving_draft_acceptance_rate" in text
+        assert "dl4j_serving_draft_tokens_rejected_total" in text
+        html = render_report(storage)
+        assert "speculative:" in html
+        assert "draft tokens accepted" in html
+        # a non-speculative record must NOT grow the lane
+        with make_server(spec) as plain:
+            plain.generate(np.asarray([1], np.int32), max_new_tokens=3)
+            rec2 = plain.metrics.to_record()
+        reg2 = MetricsRegistry()
+        reg2.fold_serving(rec2)
+        assert "draft_acceptance" not in reg2.to_prometheus_text()
+
+    def test_warmup_covers_draft_and_verify_quantized(self, gpt_sd):
+        """AOT warmup with speculation AND int8 weights enabled leaves
+        0 traffic compiles: 7 plain programs + verify + draft decode +
+        6 draft prefill buckets = 15."""
+        fresh = gpt_generative_spec(gpt_sd, CFG, quantize_weights=True)
+        d_sd = build_gpt(DRAFT_CFG, batch=2, seq_len=8, seed=4)
+        fresh_draft = gpt_generative_spec(d_sd, DRAFT_CFG)
+        with make_server(fresh, draft_spec=fresh_draft, speculate_k=4,
+                         warmup=True) as srv:
+            assert srv.warmup_report["speculative"] is True
+            assert srv.metrics.counters["warmup_compiles"] == 15
+            for i, p in enumerate(mixed_prompts(6, seed=17, max_len=20)):
+                srv.generate(p, max_new_tokens=3 + i % 4)
+            assert srv.metrics.counters["compiles"] == 0
+
+    def test_pairing_validation(self, spec, draft_spec):
+        bad_cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=1,
+                            num_heads=2, intermediate_size=32,
+                            max_seq_len=32)
+        bad = gpt_generative_spec(
+            build_gpt(bad_cfg, batch=2, seq_len=8, seed=2), bad_cfg)
+        with pytest.raises(ValueError, match="vocab"):
+            make_server(spec, draft_spec=bad)
+        short_cfg = GPTConfig(vocab_size=64, hidden_size=16,
+                              num_layers=1, num_heads=2,
+                              intermediate_size=32, max_seq_len=16)
+        short = gpt_generative_spec(
+            build_gpt(short_cfg, batch=2, seq_len=8, seed=2), short_cfg)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            make_server(spec, draft_spec=short)
+        with pytest.raises(ValueError, match="speculate_k"):
+            make_server(spec, draft_spec=draft_spec, speculate_k=1)
+
+
+# ----------------------------------------------------------------------
+class TestSeededSampling:
+    def test_sample_token_contract(self):
+        from deeplearning4j_tpu.serving import sample_token
+        r = np.random.default_rng(21)
+        logits = r.normal(size=64).astype(np.float32)
+        # temp 0 = exact greedy
+        assert sample_token(logits, temperature=0.0) == \
+            int(np.argmax(logits))
+        # pure in (seed, index)
+        a = sample_token(logits, temperature=0.8, seed=5, index=3)
+        assert a == sample_token(logits, temperature=0.8, seed=5,
+                                 index=3)
+        assert 0 <= a < 64
+        # top-k truncation: the draw is one of the k largest
+        t = sample_token(logits, temperature=1.0, top_k=4, seed=9,
+                         index=0)
+        assert t in set(int(i) for i in np.argsort(logits)[-4:])
+        # a vanishing top-p nucleus keeps (at least) the argmax
+        assert sample_token(logits, temperature=1.0, top_p=1e-9,
+                            seed=11, index=0) == int(np.argmax(logits))
+        # NaN-safe: non-finite logits still yield a valid id
+        bad = logits.copy()
+        bad[::3] = np.nan
+        assert 0 <= sample_token(bad, temperature=1.0, seed=1,
+                                 index=1) < 64
+
+    def test_sampled_deterministic_under_cobatching(self, spec):
+        p = np.asarray([3, 7, 1], np.int32)
+        with make_server(spec, max_slots=4) as srv:
+            solo = srv.submit(p, max_new_tokens=8, temperature=0.9,
+                              seed=7).result(timeout=120)
+        with make_server(spec, max_slots=4) as srv:
+            # different co-batch mix AND admission order this time
+            others = [srv.submit(q, max_new_tokens=10, temperature=0.7,
+                                 seed=100 + i)
+                      for i, q in enumerate(mixed_prompts(3, seed=23))]
+            h = srv.submit(p, max_new_tokens=8, temperature=0.9, seed=7)
+            twin = srv.submit(p, max_new_tokens=8, temperature=0.9,
+                              seed=7)
+            got = h.result(timeout=120)
+            assert got == twin.result(timeout=120)
+            for o in others:
+                o.result(timeout=120)
+        assert got == solo
+        # a different seed decouples the stream
+        with make_server(spec) as srv:
+            other = srv.submit(p, max_new_tokens=8, temperature=0.9,
+                               seed=8).result(timeout=120)
+        assert other != solo
+
+    def test_sampled_identical_with_and_without_speculation(
+            self, spec, draft_spec):
+        """The emitted token is ALWAYS the target's sample at that
+        (seed, index) — the draft cannot perturb a sampled stream."""
+        p = np.asarray([5, 9], np.int32)
+        with make_server(spec) as plain:
+            want = plain.submit(p, max_new_tokens=8, temperature=0.8,
+                                seed=42).result(timeout=120)
+        with make_server(spec, draft_spec=draft_spec,
+                         speculate_k=4) as srv:
+            got = srv.submit(p, max_new_tokens=8, temperature=0.8,
+                             seed=42).result(timeout=120)
+        assert got == want
+
+    @pytest.mark.chaos
+    def test_sampled_crash_requeue_replays_identically(self, spec):
+        """The (seed, absolute index) fold survives the requeue
+        re-entry: prompt+generated-so-far re-prefills, the continuation
+        draws land on the SAME indices, the stream is unchanged."""
+        p = np.asarray([2, 4, 6], np.int32)
+        with make_server(spec) as clean:
+            want = clean.submit(p, max_new_tokens=8, temperature=0.9,
+                                seed=13).result(timeout=120)
+        srv = make_server(spec, max_slots=2, start=False,
+                          resilience=ResilienceConfig(
+                              worker_backoff_base_s=0.01,
+                              worker_backoff_max_s=0.05))
+        real = srv._decode_disp
+        state = {"calls": 0, "fired": False}
+
+        class CrashOnce:
+            def __call__(self, *args):
+                state["calls"] += 1
+                if not state["fired"] and state["calls"] > 2:
+                    state["fired"] = True
+                    raise RuntimeError("chaos: decode worker dies")
+                return real(*args)
+
+        srv._decode_disp = CrashOnce()
+        try:
+            srv.start()
+            got = srv.submit(p, max_new_tokens=8, temperature=0.9,
+                             seed=13).result(timeout=120)
+        finally:
+            srv.shutdown()
+        assert state["fired"]
+        assert got == want
+        assert srv.metrics.counters["requests_requeued"] >= 1
+
+    def test_sampling_validation(self, spec):
+        with make_server(spec, start=False) as srv:
+            with pytest.raises(ValueError, match="temperature"):
+                srv.submit(np.asarray([1], np.int32), 4,
+                           temperature=-0.5)
+            with pytest.raises(ValueError, match="temperature"):
+                srv.submit(np.asarray([1], np.int32), 4,
+                           temperature=float("nan"))
+            with pytest.raises(ValueError, match="top_k"):
+                srv.submit(np.asarray([1], np.int32), 4,
+                           temperature=0.5, top_k=0)
+            with pytest.raises(ValueError, match="top_p"):
+                srv.submit(np.asarray([1], np.int32), 4,
+                           temperature=0.5, top_p=0.0)
+
+
+# ----------------------------------------------------------------------
 class TestContinuousVsStatic:
     def test_continuous_2x_tokens_per_step_on_skewed_trace(self, spec):
         """The perf mechanism, pinned deterministically: on a trace of
@@ -539,9 +772,10 @@ class TestContinuousVsStatic:
             lg2 = GenerativeLoadGenerator(srv, seed=3, prompt_len=(1, 8),
                                           new_tokens=(2, 6))
             for i in range(10):
-                p1, n1, d1 = lg1.request(i)
-                p2, n2, d2 = lg2.request(i)
+                p1, n1, d1, t1, s1 = lg1.request(i)
+                p2, n2, d2, t2, s2 = lg2.request(i)
                 assert np.array_equal(p1, p2) and n1 == n2 and d1 == d2
+                assert t1 == t2 and s1 == s2
 
 
 # ----------------------------------------------------------------------
@@ -577,8 +811,32 @@ class TestLoadgenGenerative:
                 prompt_len=lambda rng: 3,
                 new_tokens=lambda rng: 2 + int(rng.integers(0, 3)))
             for i in range(5):
-                p, n, _ = lg.request(i)
+                p, n, _, _, _ = lg.request(i)
                 assert p.size == 3 and 2 <= n <= 4
+
+    def test_request_carries_pure_sampling_fields(self, spec):
+        with make_server(spec, start=False) as srv:
+            lg = GenerativeLoadGenerator(srv, seed=6, prompt_len=(1, 6),
+                                         new_tokens=(2, 4),
+                                         temperature=(0.5, 1.0))
+            a = lg.request(3)
+            lg.request(7)               # interleaved draw
+            b = lg.request(3)           # same i -> same tuple regardless
+            assert np.array_equal(a[0], b[0]) and a[1:] == b[1:]
+            assert 0.5 <= a[3] <= 1.0
+            assert isinstance(a[4], int)
+            # default stays greedy: the pre-ISSUE-18 trace unchanged
+            lg0 = GenerativeLoadGenerator(srv, seed=6, prompt_len=(1, 6),
+                                          new_tokens=(2, 4))
+            assert lg0.request(0)[3] == 0.0
+
+    def test_closed_loop_sampled(self, spec):
+        with make_server(spec, max_slots=2) as srv:
+            lg = GenerativeLoadGenerator(srv, seed=5, prompt_len=(1, 6),
+                                         new_tokens=(2, 4),
+                                         temperature=0.8)
+            res = lg.run_closed(n_requests=6, concurrency=2)
+        assert res.n_ok == 6 and res.tokens_total > 0
 
 
 # ----------------------------------------------------------------------

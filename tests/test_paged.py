@@ -288,9 +288,12 @@ class TestPrefixCache:
             new_shapes = srv._shapes_seen - before
         # 17 tokens cold runs bucket 32; the repeat reuses 2 blocks and
         # prefills only its 1-token suffix -> the ONLY new prefill
-        # shape is bucket 1 ("hist" marks prefill signatures)
-        new_buckets = {dict(s)["tokens"][0] for s in new_shapes
-                       if "hist" in dict(s)}
+        # shape is bucket 1 ("hist" marks prefill signatures; shapes
+        # are keyed (role, sig) since the speculative tier, because
+        # draft and target share io signatures)
+        new_buckets = {dict(sig)["tokens"][0]
+                       for role, sig in new_shapes
+                       if role == "target" and "hist" in dict(sig)}
         assert new_buckets == {1}
 
     @pytest.mark.slow
@@ -584,3 +587,50 @@ class TestMetricsAndReports:
         assert rep["kv_bytes_per_block"] > 0
         assert rep["blocks_free"] + rep["blocks_held"] \
             + rep["blocks_evictable"] == 31
+
+
+# ----------------------------------------------------------------------
+class TestSpeculativeAndQuant:
+    """ISSUE 18 on the paged tier: speculation never changes greedy
+    tokens (rejected tails roll back KV write positions without
+    touching committed blocks — ``debug_leaks=True`` audits the pool
+    invariant after every scheduler step), and int8 KV multiplies the
+    block pool's token capacity at equal slab bytes."""
+
+    def test_paged_speculation_bit_identical(self, spec, dense_spec):
+        dcfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                         num_heads=2, intermediate_size=32,
+                         max_seq_len=32)
+        draft = gpt_generative_spec(
+            build_gpt(dcfg, batch=2, seq_len=8, seed=3), dcfg)
+        prompts = mixed_prompts(6, seed=31)
+        budgets = [4 + i % 5 for i in range(6)]
+        with make_server(spec, draft_spec=draft, speculate_k=4) as srv:
+            hs = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+            got = [h.result(timeout=120) for h in hs]
+            rec = srv.metrics.to_record()["generative"]
+            assert not wait_uncommitted(srv)    # every block released
+        for p, n, g in zip(prompts, budgets, got):
+            assert g == ref_tokens(dense_spec, p, n)
+        assert rec["spec_rounds"] >= 1          # speculation actually ran
+
+    def test_int8_kv_multiplies_pool_capacity_equal_bytes(self, gpt_sd,
+                                                          dense_spec):
+        budget = 1 << 20
+        f32 = make_server(gpt_paged_spec(gpt_sd, CFG),
+                          kv_hbm_bytes=budget)
+        q = make_server(gpt_paged_spec(gpt_sd, CFG,
+                                       quantize_weights=True,
+                                       quantize_kv=True),
+                        kv_hbm_bytes=budget)
+        try:
+            nf = f32.metrics.to_record()["paged"]["num_blocks"]
+            nq = q.metrics.to_record()["paged"]["num_blocks"]
+            assert nq >= 1.9 * nf, (nq, nf)     # the acceptance bar
+            # the quantized tier still serves a full generation
+            p = np.asarray([5, 9, 2], np.int32)
+            got = q.submit(p, max_new_tokens=6).result(timeout=120)
+            assert len(got) == 6
+        finally:
+            f32.shutdown()
+            q.shutdown()

@@ -83,6 +83,8 @@ def test_darknet19_overfit_sanity():
     _overfit(net, X, Y, epochs=8, lr_msg="darknet19")
 
 
+# priced out of the tier-1 wall budget (ROADMAP tier-1 verify runs under timeout 870s); still pinned by the slow tier
+@pytest.mark.slow
 def test_tinyyolo_trains():
     rng = np.random.RandomState(4)
     B, C = 4, 2

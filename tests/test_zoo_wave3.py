@@ -19,6 +19,8 @@ def _overfit(net, X, Y, epochs, msg=""):
     return h
 
 
+# priced out of the tier-1 wall budget (ROADMAP tier-1 verify runs under timeout 870s); still pinned by the slow tier
+@pytest.mark.slow
 def test_vgg19_conf_and_overfit():
     conf = VGG19().conf()
     # 16 conv + 5 pool + 2 dense + 1 output
@@ -33,6 +35,8 @@ def test_vgg19_conf_and_overfit():
     _overfit(net, X, Y, epochs=6, msg="vgg19")
 
 
+# priced out of the tier-1 wall budget (ROADMAP tier-1 verify runs under timeout 870s); still pinned by the slow tier
+@pytest.mark.slow
 def test_inception_resnet_v1_overfit():
     rng = np.random.RandomState(1)
     X = rng.rand(4, 3, 64, 64).astype(np.float32)
@@ -69,6 +73,8 @@ def test_nasnet_overfit():
     _overfit(net, X, Y, epochs=8, msg="nasnet")
 
 
+# priced out of the tier-1 wall budget (ROADMAP tier-1 verify runs under timeout 870s); still pinned by the slow tier
+@pytest.mark.slow
 def test_yolo2_trains_with_passthrough():
     rng = np.random.RandomState(4)
     B, C = 2, 2
