@@ -1027,6 +1027,161 @@ def bench_serving_fleet(n_replicas=3, n_requests=48, rate_rps=40.0,
                 rec["fleet"]["affinity_hit_rate"]}
 
 
+def bench_serving_durability(n_requests=24, rate_rps=60.0, block_size=8,
+                             kill_after=5, seed=23):
+    """Durable generative requests drill (serving/fleet/durable.py,
+    ISSUE 19) for BENCH_r14.
+
+    Three legs. (1) Mid-stream kill: a replica is killed after
+    ``kill_after`` streamed tokens and the router resumes the request
+    on a survivor from the emitted prefix — the bar is tokens_salvaged
+    > 0, an exactly-once stream (the streamed sequence IS the final
+    result, zero dedup drops), and final output bit-identical to an
+    uninterrupted run, greedy AND seeded-sampled. (2) Router
+    kill-and-restart: with a write-ahead journal armed and a zero
+    retry budget the same kill strands the request; a fresh router
+    replays the journal and must finish it bit-identically, exactly
+    once. (3) The journal's price: open-loop throughput with the
+    fsync'd journal armed vs without."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.faults.chaos import ChaosMonkey
+    from deeplearning4j_tpu.serving.fleet import (FleetReplica,
+                                                  FleetRouter,
+                                                  FleetUnavailableError,
+                                                  RequestJournal)
+    from deeplearning4j_tpu.serving.loadgen import FleetLoadGenerator
+    from deeplearning4j_tpu.serving.paged import PagedGenerativeServer
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_paged_spec)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_seq_len=64)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    spec = gpt_paged_spec(sd, cfg)     # shared -> one compile set
+
+    def replica(name):
+        return FleetReplica(name, server=PagedGenerativeServer(
+            spec, max_slots=4, block_size=block_size, max_seq_len=64,
+            warmup=False))
+
+    prompt = [3, 1, 4, 1, 5]
+    n_new = 24
+
+    def baseline(**kw):
+        rep = replica("base")
+        try:
+            return rep.submit(prompt, max_new_tokens=n_new,
+                              **kw).result(timeout=120)
+        finally:
+            rep.stop(drain=False)
+
+    # -- leg 1: kill a replica mid-stream, greedy and sampled ----------
+    def kill_drill(**kw):
+        reps = [replica(f"r{i}") for i in range(2)]
+        router = FleetRouter(reps, retry_budget=3, affinity=False,
+                             poll_interval_s=0.0)
+        ChaosMonkey(seed=seed).kill_mid_stream(reps[0],
+                                               after_tokens=kill_after)
+        streamed = []
+        try:
+            res = router.generate(prompt, max_new_tokens=n_new,
+                                  on_token=streamed.append, **kw)
+        finally:
+            for r in reps:
+                if r.alive:
+                    r.stop(drain=False)
+        return {"tokens": res.tokens, "streamed": streamed,
+                "resumes": res.resumes,
+                "tokens_salvaged": res.tokens_salvaged,
+                "dedup_drops":
+                    router.durability.counters["dedup_drops"]}
+    greedy = kill_drill()
+    sampled_kw = dict(temperature=0.8, top_k=16, seed=seed)
+    sampled = kill_drill(**sampled_kw)
+    greedy_identical = greedy["tokens"] == baseline()
+    sampled_identical = sampled["tokens"] == baseline(**sampled_kw)
+    exactly_once = (greedy["streamed"] == greedy["tokens"]
+                    and sampled["streamed"] == sampled["tokens"]
+                    and greedy["dedup_drops"] == 0
+                    and sampled["dedup_drops"] == 0)
+
+    # -- leg 2: kill the only replica, restart the router, replay -----
+    jdir = tempfile.mkdtemp(prefix="dl4j_durable_journal_")
+    try:
+        journal = RequestJournal(jdir, flush_every=2)
+        r0 = replica("r0")
+        router1 = FleetRouter([r0], retry_budget=0, affinity=False,
+                              poll_interval_s=0.0, journal=journal)
+        ChaosMonkey(seed=seed).kill_mid_stream(r0,
+                                               after_tokens=kill_after)
+        try:
+            router1.generate(prompt, max_new_tokens=n_new)
+            stranded = False
+        except FleetUnavailableError:
+            stranded = True
+        finally:
+            if r0.alive:
+                r0.stop(drain=False)
+        open_entries = journal.incomplete()
+        r1 = replica("r1")
+        router2 = FleetRouter([r1], affinity=False, poll_interval_s=0.0)
+        try:
+            recovered = router2.recover(journal)
+            second_pass = router2.recover()
+        finally:
+            r1.stop(drain=False)
+        replay_identical = (len(recovered) == 1
+                            and next(iter(recovered.values())).tokens
+                            == baseline())
+        recovery = {
+            "stranded_open_entries": len(open_entries),
+            "journal_tokens_salvaged":
+                router2.durability.counters["tokens_salvaged"],
+            "replay_bit_identical": bool(stranded and replay_identical),
+            "replay_exactly_once": bool(len(recovered) == 1
+                                        and second_pass == {}
+                                        and not journal.incomplete())}
+        journal.close()
+
+        # -- leg 3: the journal's price under open-loop load -----------
+        def throughput(jn):
+            reps = [replica(f"t{i}") for i in range(2)]
+            rt = FleetRouter(reps, poll_interval_s=0.05, journal=jn)
+            res = FleetLoadGenerator(
+                rt.generate, vocab_size=cfg.vocab_size, seed=seed,
+                prompt_len=(1, 8), new_tokens=(2, 8)).run_open(
+                    n_requests=n_requests, rate_rps=rate_rps)
+            for r in reps:
+                r.stop(drain=True)
+            return res
+        throughput(None)               # discard: pays the bucket compiles
+        bare = throughput(None)
+        journal2 = RequestJournal(os.path.join(jdir, "load"))
+        journaled = throughput(journal2)
+        fsync_p99 = journal2.metrics.to_dict()["journal_fsync_ms"]["p99"]
+        journal2.close()
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    overhead = (bare.tokens_per_sec / journaled.tokens_per_sec
+                if journaled.tokens_per_sec else 0.0)
+    return {"samples_per_sec": round(journaled.tokens_per_sec, 1),
+            "tokens_per_sec": round(journaled.tokens_per_sec, 1),
+            "bare_tokens_per_sec": round(bare.tokens_per_sec, 1),
+            "journal_overhead_x": round(overhead, 3),
+            "journal_fsync_p99_ms": round(fsync_p99, 3),
+            "n_failed": bare.n_failed + journaled.n_failed,
+            # the acceptance bars
+            "tokens_salvaged": greedy["tokens_salvaged"]
+            + sampled["tokens_salvaged"],
+            "resumes": greedy["resumes"] + sampled["resumes"],
+            "exactly_once_stream": bool(exactly_once),
+            "greedy_bit_identical": bool(greedy_identical),
+            "sampled_bit_identical": bool(sampled_identical),
+            **recovery}
+
+
 def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
                       worker_counts=(1, 2, 4)):
     """Disk-backed streaming training vs the device-cached window bench
@@ -1442,6 +1597,12 @@ def main():
                      # affinity-vs-random prefix-hit-rate column
                      # (serving/fleet/) for BENCH_r12
                      ("serving_fleet", bench_serving_fleet),
+                     # durable requests: mid-stream-kill salvage +
+                     # exactly-once stream + bit-identity (greedy AND
+                     # sampled), router kill/restart journal replay,
+                     # and the fsync'd journal's throughput price
+                     # (serving/fleet/durable.py) for BENCH_r14
+                     ("serving_durability", bench_serving_durability),
                      # speculative decoding vs plain decode on the
                      # skewed trace: acceptance-ceiling self-draft,
                      # >= 1.5x tokens/sec bar, temp-0 bit-identity bit

@@ -85,6 +85,7 @@ from __future__ import annotations
 import contextlib
 import os
 import signal as _signal
+import threading
 import time
 from typing import Iterator, List, Optional
 
@@ -408,6 +409,57 @@ class SigtermListener(Listener):
             self._log.append({"event": "sigterm", "iteration": iteration,
                               "t": time.time()})
             os.kill(os.getpid(), _signal.SIGTERM)
+
+
+class MidStreamKiller:
+    """Serving chaos: kill a fleet replica after it emits ``n`` more
+    tokens — the mid-stream death the durable-request drill needs
+    (``shutdown(drain=False)`` only fails QUEUED work; this aborts the
+    in-flight generations too, typed ``ServerClosedError``, exactly
+    what a SIGKILL looks like to clients holding handles).
+
+    Deterministic: the count is over the server's own ``_emit`` calls,
+    so the same trace kills at the same token every run. The emit hook
+    runs ON the decode worker, which cannot join itself — so it trips
+    the server's ``_killed`` flag (the worker aborts in-flight at its
+    next step boundary) and finishes the kill (``replica.kill()`` →
+    ``server.abort()``) from a side thread. ``fired.wait()`` to
+    synchronize a drill on the kill having landed."""
+
+    def __init__(self, replica, after_tokens: int,
+                 log: Optional[List] = None):
+        self.replica = replica
+        self.after_tokens = int(after_tokens)
+        self.fired = threading.Event()
+        self._remaining = int(after_tokens)
+        self._log = log if log is not None else []
+
+    def arm(self) -> "MidStreamKiller":
+        server = getattr(self.replica, "server", self.replica)
+        orig = server._emit
+
+        def emit(s, req, tok, _orig=orig, _server=server):
+            _orig(s, req, tok)
+            self._remaining -= 1
+            if self._remaining == 0:
+                self._log.append({"event": "kill_mid_stream",
+                                  "replica": getattr(self.replica,
+                                                     "name", "?"),
+                                  "t": time.time()})
+                _server._killed = True
+                threading.Thread(target=self._finish,
+                                 daemon=True).start()
+
+        server._emit = emit
+        return self
+
+    def _finish(self) -> None:
+        kill = getattr(self.replica, "kill", None)
+        if kill is not None:
+            kill()
+        else:
+            self.replica.abort()
+        self.fired.set()
 
 
 class ChaosMonkey:
@@ -940,3 +992,12 @@ class ChaosMonkey:
         """SIGKILL-grade process death at an iteration (multi-process
         dryrun drills; see :class:`HostKiller`)."""
         return HostKiller(at_iteration, exit_code=exit_code)
+
+    def kill_mid_stream(self, replica, after_tokens: int
+                        ) -> MidStreamKiller:
+        """Kill a serving replica after ``after_tokens`` more emitted
+        tokens — in-flight generations fail typed mid-stream (the
+        fleet durability drill; see :class:`MidStreamKiller`). Armed
+        immediately."""
+        return MidStreamKiller(replica, after_tokens,
+                               log=self.log).arm()

@@ -408,6 +408,29 @@ class MetricsRegistry:
         self.set_gauge("fleet_retries_per_request",
                        agg.get("retries_per_request", 0.0),
                        help="mean retries per routed request")
+        dur = rec.get("durability")
+        if dur:
+            for name, h in (
+                    ("resumes", "mid-stream failovers resumed from "
+                                "the emitted prefix"),
+                    ("tokens_salvaged", "already-decoded tokens carried "
+                                        "across resumes instead of "
+                                        "regenerated"),
+                    ("dedup_drops", "duplicate token deliveries the "
+                                    "exactly-once cursor absorbed"),
+                    ("journal_records", "write-ahead journal records "
+                                        "appended"),
+                    ("journal_truncated_bytes", "torn-tail bytes "
+                                                "dropped by recovery "
+                                                "scans"),
+                    ("recovered_requests", "incomplete journal entries "
+                                           "replayed by recover()")):
+                self.set_gauge(f"fleet_durability_{name}_total",
+                               dur.get(name, 0), help=h)
+            fs = dur.get("journal_fsync_ms") or {}
+            self.set_gauge("fleet_durability_journal_fsync_ms_p99",
+                           fs.get("p99", 0.0),
+                           help="p99 journal fsync latency")
         for name, rep in (rec.get("replicas") or {}).items():
             labels = {"replica": name}
             self.set_gauge("fleet_replica_ready",

@@ -19,6 +19,10 @@ from threads-in-one-JVM to a fleet of serving processes:
 - ``autoscale``: :class:`FleetAutoscaler` — SLO-headroom signal (fleet
   p99 TTFT estimate vs deadline + queue trend) starting/draining
   replicas with hysteresis, cooldown and min/max bounds.
+- ``durable``: :class:`RequestJournal` / :class:`StreamCursor` /
+  :class:`DurabilityMetrics` — the write-ahead journal, exactly-once
+  streaming and resume-from-emitted-prefix rail the router's
+  ``generate``/``recover`` compose (docs/serving.md "Durability").
 - ``metrics``: :class:`FleetMetrics` — ``{"type": "fleet"}`` records →
   ``dl4j_fleet_*`` gauges (``registry.fold_fleet``) and the ui/report
   "Fleet" panel.
@@ -28,6 +32,11 @@ See docs/serving.md ("Fleet") for semantics and the retry table.
 from deeplearning4j_tpu.serving.fleet.autoscale import FleetAutoscaler
 from deeplearning4j_tpu.serving.fleet.deploy import (RollingDeploy,
                                                      rolling_deploy)
+from deeplearning4j_tpu.serving.fleet.durable import (DURABILITY_COUNTERS,
+                                                      DurabilityMetrics,
+                                                      JournalCorruptError,
+                                                      RequestJournal,
+                                                      StreamCursor)
 from deeplearning4j_tpu.serving.fleet.metrics import (FLEET_COUNTERS,
                                                       FleetMetrics)
 from deeplearning4j_tpu.serving.fleet.replica import (REPLICA_STATES,
@@ -38,8 +47,10 @@ from deeplearning4j_tpu.serving.fleet.router import (FleetResult,
                                                      FleetUnavailableError)
 
 __all__ = [
+    "DurabilityMetrics", "DURABILITY_COUNTERS",
     "FleetAutoscaler",
     "FleetMetrics", "FLEET_COUNTERS",
+    "JournalCorruptError", "RequestJournal", "StreamCursor",
     "FleetReplica", "ReplicaLoad", "REPLICA_STATES",
     "FleetResult", "FleetRouter", "FleetUnavailableError",
     "RollingDeploy", "rolling_deploy",

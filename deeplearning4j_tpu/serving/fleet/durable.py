@@ -1,0 +1,470 @@
+"""Durable generative requests: the fleet's write-ahead journal rail.
+
+PR 17's router already *retries* a mid-generation replica death — but by
+resubmitting from scratch: every token the dead replica decoded is
+discarded, a streaming consumer has no exactly-once contract across the
+restart, and a router crash loses all in-flight requests with no
+record. This module closes that last unguarded failure domain with
+three pieces the router composes (see ``router.py``):
+
+- :class:`RequestJournal` — an append-only fsync'd JSONL write-ahead
+  log. Every record carries its own sha256 (over the canonical
+  sorted-key JSON), so a recovery scan verifies integrity line by line
+  and truncates a torn tail (a crash mid-append) at the last good
+  record. Segment rotation reuses the checkpoint staging/commit
+  discipline (``checkpoint/atomic.py``): live entries are *compacted*
+  into the next segment via temp-file + fsync + atomic rename + dir
+  fsync, and only then are the older segments deleted — at every
+  instant a crash leaves a readable journal.
+- :class:`StreamCursor` — the exactly-once delivery gate. Caller
+  ``on_token`` callbacks route through it, deduplicated by generated
+  ordinal, so a failover (which resumes from the emitted prefix) is
+  invisible to a streaming consumer: zero duplicated, zero lost tokens.
+- :class:`DurabilityMetrics` — ``resumes``, ``tokens_salvaged``,
+  ``dedup_drops``, ``journal_fsync_ms`` et al., folded into the
+  ``{"type": "fleet"}`` record's ``durability`` sub-dict
+  (``registry.fold_fleet`` → ``dl4j_fleet_durability_*`` gauges).
+
+Why journaling *tokens* is enough for bit-identity: PR 18 keys sampling
+on ``(seed, absolute token index)`` where the index is ``prompt length
++ generated ordinal`` — a continuation prefilled with ``prompt +
+emitted`` lands every remaining draw on exactly the indices the
+uninterrupted run would have used, so the journal only needs the
+submitted record (prompt, sampling kwargs, pinned seed) and the emitted
+prefix; regeneration of anything not yet durable is bit-exact. Token
+records are therefore batched (``flush_every``) without risking
+correctness — an unflushed tail is simply re-decoded identically.
+
+See docs/serving.md ("Durability") for the record format and the
+recovery procedure.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.checkpoint.atomic import (atomic_output_file,
+                                                  fsync_dir)
+from deeplearning4j_tpu.serving.metrics import LatencyHistogram
+from deeplearning4j_tpu.serving.resilience import ServingError
+
+#: every counter DurabilityMetrics tracks (zero-initialized so records
+#: and gauge folds are shape-stable from the first scrape)
+DURABILITY_COUNTERS = (
+    "resumes",                  # failovers resumed from an emitted prefix
+    "tokens_salvaged",          # emitted tokens carried across a resume
+                                # (per resume: the whole prefix a
+                                # restart-from-scratch would regenerate)
+    "dedup_drops",              # duplicate deliveries the cursor absorbed
+    "journal_records",          # records appended (rotation snapshots too)
+    "journal_truncated_bytes",  # torn-tail bytes dropped by recovery scans
+    "recovered_requests",       # incomplete entries replayed by recover()
+)
+
+_SEGMENT_PREFIX = "journal-"
+_SEGMENT_SUFFIX = ".jsonl"
+
+
+class JournalCorruptError(ServingError):
+    """A *sealed* journal segment failed its per-record sha256 scan.
+    Unlike a torn tail on the active segment (a crash mid-append —
+    expected, truncated, survivable), a bad record inside a segment
+    that was committed through the atomic rotation path means the
+    storage itself lied; recovery must not guess, so this is permanent
+    (not retryable)."""
+
+
+class DurabilityMetrics:
+    """Thread-safe counters + fsync latency histogram for the durable
+    request rail (mirrors ``FleetMetrics``: plain ints under one lock,
+    exported as the fleet record's ``durability`` sub-dict)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {k: 0 for k in DURABILITY_COUNTERS}
+        self.journal_fsync_ms = LatencyHistogram()
+
+    def inc(self, name: str, v: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(v)
+
+    def observe_fsync(self, ms: float) -> None:
+        with self._lock:
+            self.journal_fsync_ms.record(ms)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            h = self.journal_fsync_ms
+            out["journal_fsync_ms"] = {
+                "count": int(h.count),
+                "mean": round(h.mean(), 4),
+                "p50": round(h.percentile(50), 4),
+                "p99": round(h.percentile(99), 4),
+                "max": round(h.max_ms, 4),
+            }
+        return out
+
+
+class StreamCursor:
+    """Exactly-once delivery gate for one request's token stream.
+
+    The router routes the caller's ``on_token`` through
+    :meth:`deliver`, keyed by generated ordinal: a token already
+    delivered is dropped (counted ``dedup_drops``) so retries and
+    failovers can never double-stream, and a skipped ordinal raises —
+    a gap would mean the continuation machinery lost a token, which
+    must surface as a loud bug, never as silent stream corruption.
+    ``delivered`` doubles as the resume prefix the next attempt
+    prefills with."""
+
+    def __init__(self, on_token: Optional[Callable[[int], None]] = None,
+                 *, metrics: Optional[DurabilityMetrics] = None,
+                 preload=()):
+        self._on_token = on_token
+        self._metrics = metrics
+        # journal-replayed tokens arrive pre-delivered: the crashed
+        # router's consumer already saw them, so they seed the resume
+        # prefix without re-invoking the callback
+        self.delivered: List[int] = [int(t) for t in preload]
+
+    def deliver(self, index: int, token: int) -> bool:
+        """Deliver the token at generated ordinal ``index`` exactly
+        once. Returns True when this call was the delivery (the caller
+        journals it), False for an absorbed duplicate."""
+        index = int(index)
+        if index < len(self.delivered):
+            if self._metrics is not None:
+                self._metrics.inc("dedup_drops")
+            return False
+        if index > len(self.delivered):
+            raise RuntimeError(
+                f"stream gap: token ordinal {index} delivered with only "
+                f"{len(self.delivered)} tokens streamed — the exactly-"
+                f"once contract is broken upstream")
+        self.delivered.append(int(token))
+        if self._on_token is not None:
+            self._on_token(int(token))
+        return True
+
+
+def _record_sha(rec: dict) -> str:
+    """sha256 over the record's canonical (sorted-key, tight-separator)
+    JSON, excluding the ``sha`` field itself."""
+    body = {k: v for k, v in rec.items() if k != "sha"}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class RequestJournal:
+    """Append-only fsync'd JSONL write-ahead log of fleet generations.
+
+    One directory holds numbered segments (``journal-000001.jsonl``,
+    ...); the highest-numbered segment is active, the rest are sealed.
+    Record types (each line also carries ``rid`` and ``sha``):
+
+    - ``submitted`` — prompt, ``max_new_tokens``, ``timeout_ms`` and
+      the sampling kwargs with the *pinned* seed (the router pins it
+      before journaling: a server-local default would not survive a
+      cross-replica failover).
+    - ``tokens`` — a batch of emitted tokens, ``at`` = the absolute
+      index of the first (prompt length + generated ordinal). Batched
+      ``flush_every`` deep; an unflushed tail is regenerated bit-
+      identically on replay (see the module docstring), so batching
+      trades recovery *work*, never correctness.
+    - ``completed`` / ``failed`` — terminal. A retryable give-up is
+      deliberately NOT terminal: the entry stays open so a restarted
+      router's ``recover()`` replays it.
+
+    Recovery scan (at open): sealed segments must verify clean
+    (:class:`JournalCorruptError` otherwise — they were committed
+    atomically); the active segment truncates at its first torn/corrupt
+    line (a crash mid-append). Rotation compacts live entries into the
+    next segment with the checkpoint staging/commit discipline, then
+    deletes the older segments — terminal entries are how the journal
+    reclaims space."""
+
+    def __init__(self, directory: str, *, fsync: bool = True,
+                 segment_max_bytes: int = 4 << 20, flush_every: int = 8,
+                 metrics: Optional[DurabilityMetrics] = None):
+        self.directory = str(directory)
+        self.fsync = bool(fsync)
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.flush_every = max(1, int(flush_every))
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        self._entries: Dict[int, dict] = {}
+        self._pending: Dict[int, List[int]] = {}    # rid -> unflushed toks
+        self._pending_at: Dict[int, int] = {}       # rid -> batch start idx
+        self._next_rid = 1
+        self._fh = None
+        os.makedirs(self.directory, exist_ok=True)
+        self._seg_index = self._recover()
+        self._open_active()
+
+    # -- segment bookkeeping --------------------------------------------
+    def _seg_path(self, index: int) -> str:
+        return os.path.join(
+            self.directory,
+            f"{_SEGMENT_PREFIX}{index:06d}{_SEGMENT_SUFFIX}")
+
+    def _segments(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if (name.startswith(_SEGMENT_PREFIX)
+                    and name.endswith(_SEGMENT_SUFFIX)):
+                try:
+                    out.append(int(name[len(_SEGMENT_PREFIX):
+                                        -len(_SEGMENT_SUFFIX)]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _open_active(self) -> None:
+        self._fh = open(self._seg_path(self._seg_index), "ab")
+
+    # -- recovery scan ---------------------------------------------------
+    def _recover(self) -> int:
+        """Replay every segment into the in-memory entry table,
+        truncating the active segment's torn tail. Returns the active
+        segment index (1 for a fresh directory)."""
+        segs = self._segments()
+        if not segs:
+            return 1
+        for i, seg in enumerate(segs):
+            sealed = i < len(segs) - 1
+            self._scan_segment(self._seg_path(seg), sealed=sealed)
+        return segs[-1]
+
+    def _scan_segment(self, path: str, sealed: bool) -> None:
+        good_end = 0
+        with open(path, "rb") as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw.decode("utf-8"))
+                    if not isinstance(rec, dict) or \
+                            rec.get("sha") != _record_sha(rec):
+                        raise ValueError("sha mismatch")
+                except (ValueError, UnicodeDecodeError) as e:
+                    if sealed:
+                        raise JournalCorruptError(
+                            f"sealed journal segment {path} fails its "
+                            f"integrity scan at byte {good_end}: {e} — "
+                            f"it was committed atomically, so this is "
+                            f"storage corruption, not a torn tail"
+                        ) from e
+                    break
+                good_end += len(raw)
+                self._apply(rec)
+        size = os.path.getsize(path)
+        if not sealed and good_end < size:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+            if self.metrics is not None:
+                self.metrics.inc("journal_truncated_bytes",
+                                 size - good_end)
+
+    def _apply(self, rec: dict) -> None:
+        kind = rec.get("rec")
+        rid = int(rec.get("rid", 0))
+        self._next_rid = max(self._next_rid, rid + 1)
+        if kind == "hwm":
+            # compaction drops terminal entries, which would otherwise
+            # forget the highest rid ever issued — the snapshot leads
+            # with an explicit high-water mark so ids never reuse
+            self._next_rid = max(self._next_rid, int(rec["next_rid"]))
+        elif kind == "submitted":
+            self._entries[rid] = {
+                "prompt": [int(t) for t in rec["prompt"]],
+                "max_new_tokens": int(rec["max_new_tokens"]),
+                "timeout_ms": rec.get("timeout_ms"),
+                "sampling": dict(rec.get("sampling") or {}),
+                "emitted": [],
+                "status": "open",
+            }
+        elif kind == "tokens":
+            entry = self._entries.get(rid)
+            if entry is None:
+                return
+            # idempotent replay: 'at' is absolute, so a batch that
+            # overlaps what a compaction snapshot already holds only
+            # contributes its fresh suffix
+            start = int(rec["at"]) - len(entry["prompt"])
+            toks = [int(t) for t in rec["toks"]]
+            have = len(entry["emitted"])
+            if start <= have:
+                entry["emitted"].extend(toks[have - start:])
+        elif kind == "completed":
+            entry = self._entries.get(rid)
+            if entry is not None:
+                entry["status"] = "completed"
+        elif kind == "failed":
+            entry = self._entries.get(rid)
+            if entry is not None:
+                entry["status"] = "failed"
+
+    # -- append path -----------------------------------------------------
+    def _append_locked(self, rec: dict) -> None:
+        rec = dict(rec)
+        rec["sha"] = _record_sha(rec)
+        line = (json.dumps(rec, sort_keys=True, separators=(",", ":"))
+                + "\n").encode("utf-8")
+        self._fh.write(line)
+        self._fh.flush()
+        if self.fsync:
+            t0 = time.perf_counter()
+            os.fsync(self._fh.fileno())
+            if self.metrics is not None:
+                self.metrics.observe_fsync(
+                    (time.perf_counter() - t0) * 1000.0)
+        if self.metrics is not None:
+            self.metrics.inc("journal_records")
+        self._apply(rec)
+        if self._fh.tell() >= self.segment_max_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Compact live state into the next segment atomically (temp →
+        fsync → rename → dir fsync — the checkpoint commit discipline),
+        then drop the older segments. Terminal entries do not survive
+        the compaction: that is the reclaim."""
+        nxt = self._seg_index + 1
+        path = self._seg_path(nxt)
+        with atomic_output_file(path) as tmp:
+            with open(tmp, "wb") as f:
+                hwm = {"rec": "hwm", "next_rid": self._next_rid}
+                hwm["sha"] = _record_sha(hwm)
+                f.write((json.dumps(hwm, sort_keys=True,
+                                    separators=(",", ":"))
+                         + "\n").encode("utf-8"))
+                for rid in sorted(self._entries):
+                    entry = self._entries[rid]
+                    if entry["status"] != "open":
+                        continue
+                    for rec in self._snapshot_records(rid, entry):
+                        rec["sha"] = _record_sha(rec)
+                        f.write((json.dumps(rec, sort_keys=True,
+                                            separators=(",", ":"))
+                                 + "\n").encode("utf-8"))
+        fsync_dir(self.directory)
+        old_fh, self._fh = self._fh, None
+        old_fh.close()
+        dropped = [s for s in self._segments() if s < nxt]
+        # terminal entries are gone from disk now — forget them in
+        # memory too, or the table grows forever on a long-lived router
+        self._entries = {r: e for r, e in self._entries.items()
+                         if e["status"] == "open"}
+        self._seg_index = nxt
+        self._open_active()
+        for s in dropped:
+            try:
+                os.unlink(self._seg_path(s))
+            except OSError:
+                pass
+        fsync_dir(self.directory)
+
+    @staticmethod
+    def _snapshot_records(rid: int, entry: dict) -> List[dict]:
+        recs = [{"rec": "submitted", "rid": rid,
+                 "prompt": list(entry["prompt"]),
+                 "max_new_tokens": entry["max_new_tokens"],
+                 "timeout_ms": entry["timeout_ms"],
+                 "sampling": dict(entry["sampling"])}]
+        if entry["emitted"]:
+            recs.append({"rec": "tokens", "rid": rid,
+                         "at": len(entry["prompt"]),
+                         "toks": list(entry["emitted"])})
+        return recs
+
+    # -- the router-facing API -------------------------------------------
+    def next_request_id(self) -> int:
+        """Monotonic across restarts: the recovery scan advances past
+        every rid the journal has ever seen."""
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            return rid
+
+    def log_submitted(self, rid: int, prompt, max_new_tokens: int,
+                      timeout_ms: Optional[float],
+                      sampling: Optional[dict] = None) -> None:
+        with self._lock:
+            self._append_locked({
+                "rec": "submitted", "rid": int(rid),
+                "prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens),
+                "timeout_ms": (None if timeout_ms is None
+                               else float(timeout_ms)),
+                "sampling": dict(sampling or {})})
+
+    def append_token(self, rid: int, at: int, token: int) -> None:
+        """Buffer one emitted token (``at`` = absolute index); a batch
+        record is written every ``flush_every`` tokens. Call
+        :meth:`flush` at a durability point (failover, terminal)."""
+        with self._lock:
+            buf = self._pending.setdefault(int(rid), [])
+            if not buf:
+                self._pending_at[int(rid)] = int(at)
+            buf.append(int(token))
+            if len(buf) >= self.flush_every:
+                self._flush_locked(int(rid))
+
+    def _flush_locked(self, rid: int) -> None:
+        buf = self._pending.pop(rid, None)
+        if not buf:
+            return
+        at = self._pending_at.pop(rid)
+        self._append_locked({"rec": "tokens", "rid": rid,
+                             "at": at, "toks": buf})
+
+    def flush(self, rid: int) -> None:
+        with self._lock:
+            self._flush_locked(int(rid))
+
+    def log_completed(self, rid: int, n_tokens: int) -> None:
+        with self._lock:
+            self._flush_locked(int(rid))
+            self._append_locked({"rec": "completed", "rid": int(rid),
+                                 "n_tokens": int(n_tokens)})
+
+    def log_failed(self, rid: int, error) -> None:
+        with self._lock:
+            self._flush_locked(int(rid))
+            self._append_locked({"rec": "failed", "rid": int(rid),
+                                 "error": str(error)})
+
+    # -- the recovery-facing API -----------------------------------------
+    def incomplete(self) -> Dict[int, dict]:
+        """Every open entry, as ``{rid: {"prompt", "max_new_tokens",
+        "timeout_ms", "sampling", "emitted"}}`` — what
+        ``FleetRouter.recover`` replays as continuations. Completed and
+        failed entries are skipped by construction."""
+        with self._lock:
+            return {rid: {"prompt": list(e["prompt"]),
+                          "max_new_tokens": e["max_new_tokens"],
+                          "timeout_ms": e["timeout_ms"],
+                          "sampling": dict(e["sampling"]),
+                          "emitted": list(e["emitted"])}
+                    for rid, e in self._entries.items()
+                    if e["status"] == "open"}
+
+    def entry(self, rid: int) -> Optional[dict]:
+        with self._lock:
+            e = self._entries.get(int(rid))
+            return None if e is None else dict(e, emitted=list(e["emitted"]))
+
+    def close(self) -> None:
+        with self._lock:
+            for rid in list(self._pending):
+                self._flush_locked(rid)
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+__all__ = ["DURABILITY_COUNTERS", "DurabilityMetrics",
+           "JournalCorruptError", "RequestJournal", "StreamCursor"]

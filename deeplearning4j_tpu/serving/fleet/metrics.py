@@ -52,6 +52,10 @@ class FleetMetrics:
         # name -> {"ready", "queue_depth", "occupancy",
         #          "p99_decode_step_ms", "routed"} from the last scrape
         self.replicas: Dict[str, dict] = {}
+        # attached by a FleetRouter (its DurabilityMetrics): when set,
+        # to_record extends the fleet record with a "durability" sub-
+        # dict — same record type, no new registry/report plumbing
+        self.durability = None
 
     def inc(self, name: str, v: int = 1) -> None:
         with self._lock:
@@ -97,7 +101,9 @@ class FleetMetrics:
             counters = dict(self.counters)
             replicas = {n: dict(r) for n, r in self.replicas.items()}
         ready = sum(1 for r in replicas.values() if r.get("ready"))
-        return {
+        durability = (self.durability.to_dict()
+                      if self.durability is not None else None)
+        rec = {
             "type": "fleet",
             "t": time.time() if now is None else now,
             "counters": counters,
@@ -114,6 +120,9 @@ class FleetMetrics:
             },
             "replicas": replicas,
         }
+        if durability is not None:
+            rec["durability"] = durability
+        return rec
 
 
 __all__ = ["FLEET_COUNTERS", "FleetMetrics"]

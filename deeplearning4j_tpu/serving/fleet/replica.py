@@ -113,15 +113,23 @@ class FleetReplica:
         self.server.shutdown(drain=drain, timeout=timeout)
 
     def kill(self) -> None:
-        """Chaos: die without draining — queued requests fail typed,
-        the replica leaves the ready set. What a SIGKILL'd process
-        looks like from the router's side."""
+        """Chaos: die without draining — queued AND in-flight
+        generations fail typed (``abort``: a killed process completes
+        nothing; the old ``shutdown(drain=False)`` let active slots
+        finish, which no SIGKILL ever would), and the replica leaves
+        the ready set. What a killed process looks like from the
+        router's side — the router's continuation failover resumes the
+        aborted streams from their emitted prefixes."""
         with self._lock:
             if self.state in ("stopped", "dead"):
                 self.state = "dead"
                 return
             self.state = "dead"
-        self.server.shutdown(drain=False)
+        abort = getattr(self.server, "abort", None)
+        if abort is not None:
+            abort()
+        else:
+            self.server.shutdown(drain=False)
 
     def mark_dead(self) -> None:
         """Router-side verdict (a submit raised ``ServerClosedError``):
@@ -192,6 +200,17 @@ class FleetReplica:
                 f"replica {self.name} is {self.state}")
         return self.server.submit(prompt, max_new_tokens=max_new_tokens,
                                   **kw)
+
+    def submit_continuation(self, prompt, emitted,
+                            max_new_tokens: int = 16, **kw):
+        """Delegate a resume-from-emitted-prefix continuation (see
+        ``GenerativeServer.submit_continuation``) — the router's
+        failover/replay path; a dead/stopped replica raises typed."""
+        if not self.alive or self.server is None:
+            raise ServerClosedError(
+                f"replica {self.name} is {self.state}")
+        return self.server.submit_continuation(
+            prompt, emitted, max_new_tokens=max_new_tokens, **kw)
 
     def prefix_hits(self) -> int:
         """The replica's prefix-cache hit counter (0 on servers without

@@ -31,17 +31,35 @@ placement and two failure rules:
   ``PoisonedRequestError`` (the request IS the fault — it would poison
   the next replica too), and deadline misses (``RequestTimeoutError``:
   the SLO is already blown; retrying manufactures load, not answers).
+  The deadline is a TOTAL wall-time budget: every retry attempt sees
+  only what is left of it.
+- **resume, don't restart** — a mid-stream death resumes from the
+  already-emitted prefix (``submit_continuation``: prompt + emitted as
+  the prefill, budget decremented, seed pinned so sampled draws land on
+  identical absolute indices — bit-identical to the uninterrupted run)
+  with caller streaming deduplicated exactly-once through a
+  :class:`~deeplearning4j_tpu.serving.fleet.durable.StreamCursor`.
+  With a :class:`~deeplearning4j_tpu.serving.fleet.durable.
+  RequestJournal` attached, requests are write-ahead logged and a
+  restarted router replays the incomplete ones via :meth:`recover`.
 
-See docs/serving.md ("Fleet") for the full semantics table.
+See docs/serving.md ("Fleet", "Durability") for the full semantics
+table and the journal/recovery contract.
 """
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
+from deeplearning4j_tpu.serving.fleet.durable import (DurabilityMetrics,
+                                                      RequestJournal,
+                                                      StreamCursor)
 from deeplearning4j_tpu.serving.fleet.metrics import FleetMetrics
 from deeplearning4j_tpu.serving.fleet.replica import FleetReplica, ReplicaLoad
 from deeplearning4j_tpu.serving.paged.pool import prefix_block_hashes
@@ -69,6 +87,11 @@ class FleetResult:
     routed: str = "least_loaded"        # affinity | spill | least_loaded
     ttft_ms: Optional[float] = None
     intertoken_ms: List[float] = field(default_factory=list)
+    # durability rail: how many mid-stream failovers resumed from the
+    # emitted prefix, and how many already-decoded tokens they carried
+    # instead of regenerating (0/0 on an uninterrupted request)
+    resumes: int = 0
+    tokens_salvaged: int = 0
 
 
 class FleetRouter:
@@ -86,6 +109,7 @@ class FleetRouter:
                  stale_after_s: float = 5.0, poll_interval_s: float = 0.25,
                  spill_queue_depth: int = 4, spill_occupancy: float = 0.9,
                  metrics: Optional[FleetMetrics] = None,
+                 journal: Optional[RequestJournal] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic):
         self._lock = threading.RLock()
@@ -99,6 +123,15 @@ class FleetRouter:
         self.spill_queue_depth = int(spill_queue_depth)
         self.spill_occupancy = float(spill_occupancy)
         self.metrics = metrics if metrics is not None else FleetMetrics()
+        # the durability rail: resumes/salvage/dedup counters ride the
+        # fleet record as its "durability" sub-dict, and the journal
+        # (when given) times its fsyncs into the same instance
+        self.durability = DurabilityMetrics()
+        self.metrics.durability = self.durability
+        self._journal = journal
+        if journal is not None and journal.metrics is None:
+            journal.metrics = self.durability
+        self._rid = itertools.count(1)      # journal-less fallback ids
         self._sleep = sleep
         self._clock = clock
         self._block_size = block_size
@@ -223,21 +256,65 @@ class FleetRouter:
             self._last_poll = float("-inf")
         self.metrics.inc("replica_deaths_seen")
 
+    def _remaining_ms(self, t0: float,
+                      timeout_ms: Optional[float]) -> Optional[float]:
+        """The deadline budget LEFT for the next attempt: one request
+        gets ``timeout_ms`` of wall time TOTAL, not per retry (the old
+        bug: a retry-heavy request could consume ``retry_budget ×
+        timeout_ms``). Exhausted → typed ``RequestTimeoutError`` (the
+        never-retried class: the SLO is already blown)."""
+        if timeout_ms is None:
+            return None
+        rem = float(timeout_ms) - (self._clock() - t0) * 1000.0
+        if rem <= 0.0:
+            raise RequestTimeoutError(
+                f"retries outlived the request's {float(timeout_ms):.1f}"
+                f" ms deadline before an attempt could finish")
+        return rem
+
+    def _register(self, prompt, max_new_tokens: int,
+                  timeout_ms: Optional[float], kw: dict):
+        """Assign the request id, PIN the sampling seed, and journal
+        the ``submitted`` record. Seed pinning is the bit-identity
+        linchpin: the server defaults an unset seed to its own local
+        request id, which a cross-replica failover would not reproduce
+        — the router pins it to the fleet-wide rid up front so every
+        continuation redraws the same ``(seed, index)`` stream."""
+        rid = (self._journal.next_request_id()
+               if self._journal is not None else next(self._rid))
+        if float(kw.get("temperature") or 0.0) > 0.0 \
+                and kw.get("seed") is None:
+            kw = dict(kw, seed=rid)
+        if self._journal is not None:
+            self._journal.log_submitted(
+                rid, prompt, max_new_tokens, timeout_ms,
+                sampling={k: kw.get(k) for k in
+                          ("temperature", "top_k", "top_p",
+                           "seed", "eos_id")})
+        return rid, kw
+
     def submit(self, prompt, max_new_tokens: int = 16,
-               timeout_ms: Optional[float] = None, **kw):
+               timeout_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int], None]] = None, **kw):
         """Place one generation and return ``(handle, replica_name,
         retries)`` — the streaming entry point. Retries SUBMIT-time
-        sheds/deaths within the budget; once a handle exists, failures
-        surface through it (use :meth:`generate` for end-to-end
-        retry)."""
+        sheds/deaths within the budget (each attempt sees only the
+        deadline budget still left); once a handle exists, failures
+        surface through it (use :meth:`generate` for end-to-end retry
+        and the durable/exactly-once rail). ``on_token`` is an explicit
+        parameter so it composes with router internals instead of
+        colliding in ``**kw``."""
+        t0 = self._clock()
         attempts = 0
         while True:
             replica, kind = None, "least_loaded"
             try:
+                remaining = self._remaining_ms(t0, timeout_ms)
                 replica, kind = self.route(prompt)
                 handle = replica.submit(prompt,
                                         max_new_tokens=max_new_tokens,
-                                        timeout_ms=timeout_ms, **kw)
+                                        timeout_ms=remaining,
+                                        on_token=on_token, **kw)
                 self.metrics.on_routed(kind, replica.name)
                 return handle, replica.name, attempts
             except (ValueError, PoisonedRequestError, RequestTimeoutError):
@@ -265,33 +342,88 @@ class FleetRouter:
                 self.metrics.inc("retries")
 
     def generate(self, prompt, max_new_tokens: int = 16,
-                 timeout_ms: Optional[float] = None, **kw) -> FleetResult:
+                 timeout_ms: Optional[float] = None,
+                 on_token: Optional[Callable[[int], None]] = None,
+                 **kw) -> FleetResult:
         """The blocking front door: place, stream, and return the full
         generation — retrying sheds AND mid-generation replica deaths
-        within one shared budget. This is the callable the fleet load
-        generator drives."""
+        within one shared budget. A death mid-stream RESUMES from the
+        emitted prefix (continuation submit) instead of restarting, and
+        the caller's ``on_token`` is delivered through an exactly-once
+        :class:`StreamCursor`, so a failover is invisible to streaming
+        consumers. With a journal attached, the request is write-ahead
+        logged end to end (a router crash replays it via
+        :meth:`recover`). This is the callable the fleet load generator
+        drives."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rid, kw = self._register(prompt, max_new_tokens, timeout_ms, kw)
+        cursor = StreamCursor(on_token, metrics=self.durability)
+        try:
+            result = self._drive(rid, prompt, max_new_tokens,
+                                 timeout_ms, cursor, kw)
+        except (ValueError, PoisonedRequestError, RequestTimeoutError) as e:
+            # permanent: terminal in the journal so recover() skips it.
+            # A retryable give-up (FleetUnavailableError et al.) is
+            # deliberately NOT terminal — the entry stays open and a
+            # restarted router replays it as a continuation.
+            if self._journal is not None:
+                self._journal.log_failed(rid, e)
+            raise
+        if self._journal is not None:
+            self._journal.log_completed(rid, len(result.tokens))
+        return result
+
+    def _drive(self, rid: int, prompt, max_new_tokens: int,
+               timeout_ms: Optional[float], cursor: StreamCursor,
+               kw: dict) -> FleetResult:
+        """The retry/failover loop behind :meth:`generate` and
+        :meth:`recover`: attempts start from the cursor's delivered
+        prefix (empty on a fresh request, pre-seeded on a journal
+        replay) and every mid-stream death resumes instead of
+        restarting."""
         t0 = self._clock()
+        plen = int(np.asarray(prompt).size)
         attempts = 0
+        resumes = 0
+        salvaged = 0
+        marks: List[float] = []
         while True:
             replica, kind = None, "least_loaded"
-            marks: List[float] = []
             try:
+                remaining = self._remaining_ms(t0, timeout_ms)
                 replica, kind = self.route(prompt)
-                handle = replica.submit(
-                    prompt, max_new_tokens=max_new_tokens,
-                    timeout_ms=timeout_ms,
-                    on_token=lambda tok: marks.append(self._clock()),
-                    **kw)
-                tokens = handle.result()
+                base = len(cursor.delivered)
+                ordinal = itertools.count(base)
+
+                def _deliver(tok, _ord=ordinal):
+                    idx = next(_ord)
+                    if cursor.deliver(idx, tok):
+                        marks.append(self._clock())
+                        if self._journal is not None:
+                            self._journal.append_token(rid, plen + idx,
+                                                       tok)
+
+                if base:
+                    handle = replica.submit_continuation(
+                        prompt, list(cursor.delivered),
+                        max_new_tokens=max_new_tokens,
+                        timeout_ms=remaining, on_token=_deliver, **kw)
+                else:
+                    handle = replica.submit(
+                        prompt, max_new_tokens=max_new_tokens,
+                        timeout_ms=remaining, on_token=_deliver, **kw)
+                handle.result()
                 self.metrics.on_routed(kind, replica.name)
                 self.metrics.inc("requests_ok")
                 ttft = (marks[0] - t0) * 1000.0 if marks else None
                 inter = [(b - a) * 1000.0
                          for a, b in zip(marks, marks[1:])]
-                return FleetResult(tokens=list(tokens),
+                return FleetResult(tokens=list(cursor.delivered),
                                    replica=replica.name,
                                    retries=attempts, routed=kind,
-                                   ttft_ms=ttft, intertoken_ms=inter)
+                                   ttft_ms=ttft, intertoken_ms=inter,
+                                   resumes=resumes,
+                                   tokens_salvaged=salvaged)
             except (ValueError, PoisonedRequestError):
                 self.metrics.inc("requests_failed")
                 raise
@@ -310,6 +442,10 @@ class FleetRouter:
             except ServingError:
                 if replica is not None:
                     self._mark_dead(replica)
+                # durability point: whatever streamed before the death
+                # must be on disk before the continuation goes out
+                if self._journal is not None:
+                    self._journal.flush(rid)
                 attempts += 1
                 if attempts > self.retry_budget:
                     self.metrics.inc("retry_giveups")
@@ -318,6 +454,62 @@ class FleetRouter:
                         f"request failed on {attempts} replicas",
                         retry_after_s=self.poll_interval_s)
                 self.metrics.inc("retries")
+                if cursor.delivered:
+                    # the retry will be a continuation: every already-
+                    # delivered token is decode work the old restart-
+                    # from-scratch path would have thrown away
+                    resumes += 1
+                    salvaged += len(cursor.delivered)
+                    self.durability.inc("resumes")
+                    self.durability.inc("tokens_salvaged",
+                                        len(cursor.delivered))
+
+    def recover(self, journal: Optional[RequestJournal] = None) -> dict:
+        """Router-crash recovery: replay every INCOMPLETE journal entry
+        as a resume-from-emitted-prefix continuation. Idempotent by
+        request id — completed/failed entries are skipped by the
+        journal scan, and each replay is journaled terminal the moment
+        it lands, so a crash DURING recovery re-replays only what is
+        still open. Returns ``{rid: FleetResult}`` for the requests
+        completed by this call; entries that shed retryably stay open
+        for the next recover, permanent failures are journaled
+        ``failed``."""
+        jn = journal if journal is not None else self._journal
+        if jn is None:
+            raise ValueError("recover() needs a journal (pass one or "
+                             "construct the router with journal=...)")
+        if self._journal is None:
+            # adopt: post-recovery traffic journals into the same WAL
+            self._journal = jn
+            if jn.metrics is None:
+                jn.metrics = self.durability
+        elif jn is not self._journal:
+            raise ValueError("recover() got a different journal than "
+                             "the one this router writes to")
+        results: dict = {}
+        for rid, entry in sorted(jn.incomplete().items()):
+            prompt = np.asarray(entry["prompt"], np.int32)
+            emitted = entry["emitted"]
+            cursor = StreamCursor(None, metrics=self.durability,
+                                  preload=emitted)
+            self.durability.inc("recovered_requests")
+            if emitted:
+                self.durability.inc("resumes")
+                self.durability.inc("tokens_salvaged", len(emitted))
+            kw = {k: v for k, v in entry["sampling"].items()
+                  if v is not None}
+            try:
+                res = self._drive(rid, prompt, entry["max_new_tokens"],
+                                  entry["timeout_ms"], cursor, kw)
+            except (ValueError, PoisonedRequestError,
+                    RequestTimeoutError) as e:
+                jn.log_failed(rid, e)
+                continue
+            except RetryableServingError:
+                continue        # still open: the NEXT recover retries
+            jn.log_completed(rid, len(res.tokens))
+            results[rid] = res
+        return results
 
     # -- observability --------------------------------------------------
     def publish(self, storage) -> None:

@@ -543,6 +543,7 @@ class GenerativeServer:
         self._req_id = 0
         self._id_lock = threading.Lock()
         self._closed = False
+        self._killed = False         # abort(): fail in-flight, no drain
         self._dirty = False          # a respawned worker must reset state
         self._mem_every = (max(1, int(memory_sample_every))
                            if memory_sample_every else None)
@@ -899,6 +900,74 @@ class GenerativeServer:
                 raise
         return GenerationHandle(req)
 
+    def submit_continuation(self, prompt, emitted,
+                            max_new_tokens: int = 16,
+                            timeout_ms: Optional[float] = None,
+                            on_token: Optional[Callable[[int], None]]
+                            = None,
+                            eos_id: Optional[int] = None,
+                            temperature: float = 0.0,
+                            top_k: Optional[int] = None,
+                            top_p: Optional[float] = None,
+                            seed: Optional[int] = None
+                            ) -> GenerationHandle:
+        """Resume a generation from its already-emitted prefix — the
+        fleet's failover/replay primitive. ``prompt + emitted`` becomes
+        the prefill (on the paged server that span hits the prefix
+        cache), the token budget is decremented by ``len(emitted)``,
+        and the handle streams/returns only the REMAINING tokens.
+
+        Bit-identity contract: sampling keys on ``(seed, absolute
+        token index)`` and the index is prompt length + generated
+        ordinal, so a continuation prefilled with the emitted prefix
+        lands every remaining draw on exactly the indices the
+        uninterrupted run would have used. That only holds if the seed
+        crosses the hop — a sampled continuation therefore REQUIRES an
+        explicit ``seed`` (the original request's), because the
+        server-local default (the request id) differs per replica.
+
+        A continuation that is already finished (budget spent, EOS
+        emitted, or context full) resolves immediately to an empty
+        token list without occupying a slot."""
+        temperature = float(temperature)
+        if temperature > 0.0 and seed is None:
+            raise ValueError(
+                "a sampled continuation needs the original request's "
+                "seed — without it the remaining draws cannot land on "
+                "the same (seed, index) stream and bit-identity is "
+                "lost")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        emitted = [int(t) for t in
+                   np.asarray(emitted, np.int64).reshape(-1)]
+        remaining = int(max_new_tokens) - len(emitted)
+        eos = eos_id if eos_id is not None else self.eos_id
+        prefix = (np.concatenate([prompt,
+                                  np.asarray(emitted, np.int32)])
+                  if emitted else prompt)
+        done = (remaining < 1
+                or (eos is not None and emitted and emitted[-1] == eos)
+                or int(prefix.size) >= self.max_seq_len)
+        if done:
+            # nothing left to decode: the interrupted generation had in
+            # fact finished — resolve without queueing (an empty-result
+            # handle; the caller stitches it onto the emitted prefix)
+            if self._closed:
+                raise ServerClosedError(
+                    "GenerativeServer is shut down")
+            from concurrent.futures import Future
+            req = GenerationRequest(
+                x=[prefix], future=Future(), rows=1,
+                id=self._next_id(), prompt=prefix,
+                max_new_tokens=max(1, remaining),
+                eos_id=eos, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed)
+            req.succeed()
+            return GenerationHandle(req)
+        return self.submit(prefix, remaining, timeout_ms=timeout_ms,
+                           on_token=on_token, eos_id=eos_id,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
+
     def generate(self, prompt, max_new_tokens: int = 16,
                  timeout_ms: Optional[float] = None) -> List[int]:
         """Blocking convenience around :meth:`submit`."""
@@ -988,11 +1057,26 @@ class GenerativeServer:
 
     def _worker_loop(self, slot: InflightSlot) -> None:
         while True:
+            if self._killed:
+                # abort(): a killed process completes nothing — fail
+                # the in-flight generations typed at this step boundary
+                # and exit CLEANLY (the supervisor must not respawn or
+                # requeue: the futures are already resolved)
+                self._abort_inflight()
+                return
             progressed = self._step(slot)
             if progressed:
                 slot.progressed = True
             elif self._queue.finished and not self._active.any():
                 return
+
+    def _abort_inflight(self) -> None:
+        for s in range(self.max_slots):
+            req = self._slot_reqs[s]
+            if req is not None:
+                self._retire(s, error=ServerClosedError(
+                    f"server killed with generation {req.id} in "
+                    f"flight after {len(req.generated)} tokens"))
 
     def _n_active(self) -> int:
         return int(self._active.sum())
@@ -1445,6 +1529,20 @@ class GenerativeServer:
                 "p99_decode_step_ms": round(step_ms, 3)}
 
     # -- lifecycle ------------------------------------------------------
+    def abort(self, timeout: Optional[float] = None) -> None:
+        """The chaos kill switch: fail queued AND in-flight generations
+        with :class:`ServerClosedError` instead of letting active slots
+        finish — what a SIGKILL looks like to clients holding handles
+        (``shutdown(drain=False)`` only fails the QUEUE; in-flight work
+        still completes). The in-flight failure lands at the worker's
+        next step boundary; tokens already emitted stay emitted — the
+        fleet's continuation failover resumes from exactly those. Must
+        be called from outside the decode worker (it joins the worker
+        thread); the mid-stream chaos injector trips ``_killed`` from
+        the emit hook and calls this from a side thread."""
+        self._killed = True
+        self.shutdown(drain=False, timeout=timeout)
+
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop intake; with ``drain`` (default) finish queued and

@@ -64,9 +64,20 @@ class LoadResult:
     intertoken_ms: List[float] = field(default_factory=list)
     tokens_total: int = 0
     # fleet traffic (FleetLoadGenerator): one row per request —
-    # ``{"i", "outcome", "replica", "retries", "routed", "ttft_ms"}``
-    # — so a run can be sliced per replica and per retry count
+    # ``{"i", "outcome", "replica", "retries", "routed", "ttft_ms",
+    # "resumes", "tokens_salvaged"}`` — so a run can be sliced per
+    # replica, per retry count and per durability resume
     rows: List[dict] = field(default_factory=list)
+
+    @property
+    def resumes_total(self) -> int:
+        """Mid-stream failovers resumed from the emitted prefix across
+        the run (fleet rows; 0 without the durability rail)."""
+        return sum(int(r.get("resumes") or 0) for r in self.rows)
+
+    @property
+    def tokens_salvaged_total(self) -> int:
+        return sum(int(r.get("tokens_salvaged") or 0) for r in self.rows)
 
     @property
     def n_issued(self) -> int:
@@ -492,7 +503,8 @@ class FleetLoadGenerator:
         prompt, n_new, deadline, temp, sseed = self.request(i)
         t0 = time.monotonic()
         row = {"i": int(i), "outcome": None, "replica": None,
-               "retries": 0, "routed": None, "ttft_ms": None}
+               "retries": 0, "routed": None, "ttft_ms": None,
+               "resumes": 0, "tokens_salvaged": 0}
         # sampling kwargs only on sampled traces: plain front doors
         # keep the documented (prompt, max_new_tokens, timeout_ms)
         # signature working unchanged
@@ -523,7 +535,10 @@ class FleetLoadGenerator:
                    replica=getattr(res, "replica", None),
                    retries=int(getattr(res, "retries", 0) or 0),
                    routed=getattr(res, "routed", None),
-                   ttft_ms=getattr(res, "ttft_ms", None))
+                   ttft_ms=getattr(res, "ttft_ms", None),
+                   resumes=int(getattr(res, "resumes", 0) or 0),
+                   tokens_salvaged=int(
+                       getattr(res, "tokens_salvaged", 0) or 0))
         with lock:
             result.n_ok += 1
             result.latencies_ms.append(ms)

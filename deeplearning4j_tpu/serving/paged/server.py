@@ -679,6 +679,9 @@ class PagedGenerativeServer(GenerativeServer):
         of any block raises in the pool."""
         req = self._slot_reqs[s]
         if req is not None:
+            if (error is None and not cancelled
+                    and self.prefix_cache_enabled and req.generated):
+                self._register_generated(s, req)
             n = int(self._nblocks[s])
             for u in range(n):
                 self.pool.release(int(self._tables[s, u]))
@@ -688,6 +691,28 @@ class PagedGenerativeServer(GenerativeServer):
             self._nblocks[s] = 0
         super()._retire(s, error=error, timed_out=timed_out,
                         cancelled=cancelled)
+
+    def _register_generated(self, s: int, req) -> None:
+        """Content-address the GENERATED span's full blocks at clean
+        retirement, not just the prompt's (the prefill path already
+        registered those): a resume-from-emitted-prefix continuation
+        (fleet failover / journal replay) prefills ``prompt + emitted``
+        and now hits cache over the whole already-decoded span. Must
+        run BEFORE the release loop — registration requires the block
+        held. Only blocks whose every position was written to KV
+        qualify: the written region is ``[0, positions[s])`` (the final
+        emitted token is never written back — the slot retires before
+        its decode step), so exactly ``positions // block_size`` blocks
+        are full. Blocks already registered (a prefill cache hit, or a
+        concurrent fill of the same prefix) are left as-is."""
+        BS = self.block_size
+        n_full = min(int(self._positions[s]) // BS,
+                     int(self._nblocks[s]))
+        if n_full <= 0:
+            return
+        hashes = prefix_block_hashes(req.prefix(), BS, n_blocks=n_full)
+        for u, h in enumerate(hashes):
+            self.pool.register(h, int(self._tables[s, u]))
 
     def _reset_state(self) -> None:
         """Crash-recovery respawn: fresh slabs, a hard pool reset

@@ -894,6 +894,17 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
         if ops_bits:
             parts.append("<p>operations: " + "; ".join(ops_bits)
                          + "</p>")
+        dur = rec.get("durability")
+        if dur:
+            fs = dur.get("journal_fsync_ms") or {}
+            parts.append(
+                f"<p>durability: <b>{dur.get('resumes', 0)}</b> resumes"
+                f" salvaging <b>{dur.get('tokens_salvaged', 0)}</b> "
+                f"tokens; {dur.get('dedup_drops', 0)} duplicate "
+                f"deliveries absorbed; "
+                f"{dur.get('recovered_requests', 0)} journal replays; "
+                f"{dur.get('journal_records', 0)} journal records "
+                f"(fsync p99 {fs.get('p99', 0.0):.2f} ms)</p>")
         replicas = rec.get("replicas", {})
         if replicas:
             parts.append(

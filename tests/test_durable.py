@@ -1,0 +1,624 @@
+"""Durable generative requests (serving/fleet/durable.py, ISSUE 19).
+
+Pinned contracts:
+
+- the RequestJournal is a real WAL: per-record sha256, torn-tail
+  truncation on the recovery scan (a crash mid-append drops exactly the
+  torn bytes), :class:`JournalCorruptError` on a bad SEALED segment,
+  compacting segment rotation through the atomic staging/commit
+  discipline, request ids monotonic across reopen;
+- StreamCursor delivers exactly once: duplicates absorb (counted),
+  gaps raise, preloaded replay tokens never re-invoke the callback;
+- ``FleetRouter.generate`` composes a caller ``on_token`` with its
+  internals (the old duplicate-keyword TypeError), deducts elapsed
+  time from the deadline per retry attempt (the old ``retry_budget ×
+  timeout_ms`` hole → typed ``RequestTimeoutError``), and resumes a
+  mid-stream death from the emitted prefix — same seed, decremented
+  budget — instead of restarting;
+- chaos drills: kill a replica mid-stream → the streamed sequence has
+  zero duplicates/gaps and the final generation is bit-identical to an
+  uninterrupted run, greedy AND sampled; kill-and-restart the router →
+  ``recover(journal)`` replays every incomplete request exactly once
+  (idempotent: completed entries skip, a second recover is a no-op);
+- the paged server registers the GENERATED span's full blocks at clean
+  retirement, so a continuation prefilling prompt + emitted hits the
+  prefix cache beyond the prompt;
+- every registered wire kind round-trips ``to_wire``/``from_wire``
+  (FleetUnavailableError included), and the durability sub-dict flows
+  fleet record → ``dl4j_fleet_durability_*`` gauges → report line.
+"""
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.faults.chaos import ChaosMonkey
+from deeplearning4j_tpu.serving.fleet import (FleetReplica, FleetRouter,
+                                              FleetUnavailableError,
+                                              JournalCorruptError,
+                                              RequestJournal, StreamCursor)
+from deeplearning4j_tpu.serving.fleet.durable import DurabilityMetrics
+from deeplearning4j_tpu.serving.generative import greedy_decode
+from deeplearning4j_tpu.serving.paged import PagedGenerativeServer
+from deeplearning4j_tpu.serving.queue import (RequestTimeoutError,
+                                              ServerClosedError,
+                                              ServerOverloadedError)
+from deeplearning4j_tpu.serving.resilience import (_WIRE_KINDS,
+                                                   RetryableServingError)
+from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                        gpt_generative_spec,
+                                        gpt_paged_spec)
+
+CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_seq_len=32)
+MSL = 32
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def gpt_sd():
+    return build_gpt(CFG, batch=2, seq_len=8, seed=0)
+
+
+@pytest.fixture(scope="module")
+def spec(gpt_sd):
+    return gpt_paged_spec(gpt_sd, CFG)
+
+
+@pytest.fixture(scope="module")
+def dense_spec(gpt_sd):
+    # greedy_decode's dense reference: paged vs dense is a memory-layout
+    # change only, so it doubles as the bit-identity oracle here too
+    return gpt_generative_spec(gpt_sd, CFG)
+
+
+def make_server(spec, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", MSL)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("warmup", False)
+    kw.setdefault("debug_leaks", True)
+    return PagedGenerativeServer(spec, **kw)
+
+
+def stop_all(replicas):
+    for r in replicas:
+        try:
+            r.stop(drain=False)
+        except Exception:   # noqa: BLE001 — already dead is fine here
+            pass
+
+
+# ----------------------------------------------------------------------
+# stub surface: a server that streams tokens and can die mid-stream,
+# with the continuation path the real server grew (router-logic tests)
+
+class _Handle:
+    def __init__(self, tokens, fail=None):
+        self._tokens = tokens
+        self._fail = fail
+
+    def result(self, timeout=None):
+        if self._fail is not None:
+            raise self._fail
+        return self._tokens
+
+
+class StreamingStub:
+    """Emits ``base + i`` tokens via ``on_token``; ``die_after=k``
+    fails the handle (once) after k tokens TOTAL have streamed."""
+
+    def __init__(self, name="s", die_after=None, submit_errors=()):
+        self.name = name
+        self.block_size = BS
+        self.telemetry = None
+        self.die_after = die_after
+        self.submit_errors = list(submit_errors)
+        self.submits = []           # (prompt, n, timeout_ms, seed)
+        self.continuations = []     # (prompt, emitted, n, timeout_ms, seed)
+        self._queue = SimpleNamespace(pending=lambda: 0)
+
+    def _n_active(self):
+        return 0
+
+    def _telemetry_health(self):
+        return {"ready": True, "healthy": True,
+                "load": {"queue_depth": 0, "slot_occupancy": 0.0,
+                         "p99_decode_step_ms": 1.0}}
+
+    def _run(self, start, n, on_token):
+        emitted = []
+        for i in range(n):
+            if self.die_after is not None and start + i >= self.die_after:
+                self.die_after = None
+                return _Handle(None, fail=ServerClosedError("crashed"))
+            tok = 100 + start + i
+            if on_token is not None:
+                on_token(tok)
+            emitted.append(tok)
+        return _Handle(emitted)
+
+    def submit(self, prompt, max_new_tokens=16, timeout_ms=None,
+               on_token=None, **kw):
+        if self.submit_errors:
+            raise self.submit_errors.pop(0)
+        self.submits.append((list(np.asarray(prompt).tolist()),
+                             max_new_tokens, timeout_ms, kw.get("seed")))
+        return self._run(0, max_new_tokens, on_token)
+
+    def submit_continuation(self, prompt, emitted, max_new_tokens=16,
+                            timeout_ms=None, on_token=None, **kw):
+        self.continuations.append((list(np.asarray(prompt).tolist()),
+                                   list(emitted), max_new_tokens,
+                                   timeout_ms, kw.get("seed")))
+        return self._run(len(emitted), max_new_tokens - len(emitted),
+                         on_token)
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def stub_fleet(servers, **router_kw):
+    replicas = [FleetReplica(s.name, server=s) for s in servers]
+    router_kw.setdefault("poll_interval_s", 0.0)
+    router_kw.setdefault("affinity", False)
+    return FleetRouter(replicas, **router_kw), replicas
+
+
+# ----------------------------------------------------------------------
+class TestRequestJournal:
+    def test_round_trip_and_monotonic_ids(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        rid = j.next_request_id()
+        j.log_submitted(rid, [1, 2, 3], 8, 500.0,
+                        sampling={"temperature": 0.5, "seed": rid})
+        for i, t in enumerate([9, 8, 7]):
+            j.append_token(rid, 3 + i, t)
+        j.flush(rid)
+        done = j.next_request_id()
+        j.log_submitted(done, [4], 2, None, sampling={})
+        j.log_completed(done, 2)
+        j.close()
+
+        j2 = RequestJournal(tmp_path)
+        inc = j2.incomplete()
+        assert list(inc) == [rid]           # completed entry skipped
+        assert inc[rid]["emitted"] == [9, 8, 7]
+        assert inc[rid]["max_new_tokens"] == 8
+        assert inc[rid]["timeout_ms"] == 500.0
+        assert inc[rid]["sampling"]["seed"] == rid
+        assert j2.next_request_id() == done + 1
+        j2.close()
+
+    def test_token_batching_flushes_every_n(self, tmp_path):
+        m = DurabilityMetrics()
+        j = RequestJournal(tmp_path, flush_every=4, metrics=m)
+        rid = j.next_request_id()
+        j.log_submitted(rid, [1], 8, None, sampling={})
+        for i in range(3):                  # below the batch threshold
+            j.append_token(rid, 1 + i, i)
+        assert m.counters["journal_records"] == 1   # submitted only
+        j.append_token(rid, 4, 3)                   # 4th token: batch out
+        assert m.counters["journal_records"] == 2
+        j.close()
+        j2 = RequestJournal(tmp_path)
+        assert j2.incomplete()[rid]["emitted"] == [0, 1, 2, 3]
+        j2.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        m = DurabilityMetrics()
+        j = RequestJournal(tmp_path, metrics=m)
+        rid = j.next_request_id()
+        j.log_submitted(rid, [1, 2], 4, None, sampling={})
+        j.append_token(rid, 2, 42)
+        j.flush(rid)
+        path = j._seg_path(j._seg_index)
+        j.close()
+        with open(path, "ab") as f:         # a crash mid-append
+            f.write(b'{"rec":"tokens","rid":1,"at":3,"toks":[7],'
+                    b'"sha":"forged"}\n')
+            f.write(b'{"rec":"comp')
+        j2 = RequestJournal(tmp_path, metrics=m)
+        assert j2.incomplete()[rid]["emitted"] == [42]   # torn tail gone
+        assert m.counters["journal_truncated_bytes"] > 0
+        # the truncation is durable: a third open sees a clean file
+        j2.close()
+        j3 = RequestJournal(tmp_path)
+        assert j3.incomplete()[rid]["emitted"] == [42]
+        j3.close()
+
+    def test_sealed_segment_corruption_raises(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        rid = j.next_request_id()
+        j.log_submitted(rid, [1], 4, None, sampling={})
+        sealed = j._seg_path(j._seg_index)
+        newer = j._seg_path(j._seg_index + 1)
+        j.close()
+        # a crash between rotation commit and old-segment unlink leaves
+        # the sealed segment behind; sealed bytes were committed through
+        # the atomic staging path, so bit-rot there is a storage lie —
+        # no torn-tail forgiveness, the journal refuses to open
+        open(newer, "wb").close()
+        with open(sealed, "r+b") as f:
+            f.seek(5)
+            f.write(b"X")
+        with pytest.raises(JournalCorruptError):
+            RequestJournal(tmp_path)
+
+    def test_rotation_compacts_and_drops_terminal(self, tmp_path):
+        j = RequestJournal(tmp_path, segment_max_bytes=1)
+        keep = j.next_request_id()
+        j.log_submitted(keep, [5, 6], 8, None, sampling={"seed": keep})
+        j.append_token(keep, 2, 11)
+        j.flush(keep)
+        gone = j.next_request_id()
+        j.log_submitted(gone, [7], 2, None, sampling={})
+        j.log_completed(gone, 2)
+        segs = j._segments()
+        assert len(segs) == 1               # old segments deleted
+        j.close()
+        j2 = RequestJournal(tmp_path)
+        inc = j2.incomplete()
+        assert list(inc) == [keep]          # terminal entry reclaimed
+        assert inc[keep]["emitted"] == [11]
+        assert inc[keep]["sampling"]["seed"] == keep
+        assert j2.next_request_id() > gone  # ids survive compaction
+        j2.close()
+
+    def test_overlapping_token_replay_is_idempotent(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        rid = j.next_request_id()
+        j.log_submitted(rid, [1, 2], 8, None, sampling={})
+        j.append_token(rid, 2, 10)
+        j.flush(rid)
+        # a batch overlapping what is already durable (e.g. a flush
+        # raced by a failover) contributes only its fresh suffix
+        with j._lock:
+            j._append_locked({"rec": "tokens", "rid": rid, "at": 2,
+                              "toks": [10, 11]})
+        assert j.entry(rid)["emitted"] == [10, 11]
+        j.close()
+
+
+class TestStreamCursor:
+    def test_exactly_once(self):
+        m = DurabilityMetrics()
+        got = []
+        c = StreamCursor(got.append, metrics=m)
+        assert c.deliver(0, 5) and c.deliver(1, 6)
+        assert not c.deliver(0, 5)          # duplicate absorbed
+        assert not c.deliver(1, 6)
+        assert got == [5, 6] and c.delivered == [5, 6]
+        assert m.counters["dedup_drops"] == 2
+
+    def test_gap_raises(self):
+        c = StreamCursor()
+        c.deliver(0, 1)
+        with pytest.raises(RuntimeError, match="stream gap"):
+            c.deliver(2, 3)
+
+    def test_preload_does_not_reinvoke_callback(self):
+        got = []
+        c = StreamCursor(got.append, preload=[1, 2, 3])
+        assert got == []                    # replay: already delivered
+        assert c.deliver(3, 4)
+        assert got == [4] and c.delivered == [1, 2, 3, 4]
+
+
+# ----------------------------------------------------------------------
+class TestWireKinds:
+    @pytest.mark.parametrize("kind", sorted(_WIRE_KINDS))
+    def test_every_registered_kind_round_trips(self, kind):
+        # FleetUnavailableError (and any future journal/continuation-
+        # typed shed) must survive the process boundary with its class
+        # and hint intact — the cross-replica retry contract
+        cls = _WIRE_KINDS[kind]
+        e = cls("gone away", retry_after_s=0.75)
+        back = RetryableServingError.from_wire(e.to_wire())
+        assert type(back) is cls
+        assert back.retry_after_s == 0.75 and str(back) == "gone away"
+
+    def test_fleet_unavailable_is_registered(self):
+        assert _WIRE_KINDS["FleetUnavailableError"] is FleetUnavailableError
+
+
+# ----------------------------------------------------------------------
+class TestRouterComposition:
+    def test_caller_on_token_composes_with_router_internals(self):
+        # the satellite bug: on_token in **kw used to TypeError against
+        # the router's internal TTFT lambda
+        router, _ = stub_fleet([StreamingStub("a")])
+        got = []
+        res = router.generate([1, 2], max_new_tokens=4,
+                              on_token=got.append)
+        assert got == res.tokens == [100, 101, 102, 103]
+        assert res.ttft_ms is not None      # internals still measured
+
+    def test_submit_takes_on_token_explicitly(self):
+        router, _ = stub_fleet([StreamingStub("a")])
+        got = []
+        handle, name, retries = router.submit([1, 2], max_new_tokens=3,
+                                              on_token=got.append)
+        assert handle.result() == got == [100, 101, 102]
+
+    def test_retry_deadline_budget_is_total_not_per_attempt(self):
+        t = [0.0]
+        sleeps = []
+
+        def clock():
+            return t[0]
+
+        def sleep(s):
+            sleeps.append(s)
+            t[0] += s
+
+        shed = ServerOverloadedError("full", retry_after_s=2.0)
+        stub = StreamingStub("a", submit_errors=[shed] * 10)
+        router, _ = stub_fleet([stub], retry_budget=8, max_backoff_s=2.0,
+                               clock=clock, sleep=sleep)
+        with pytest.raises(RequestTimeoutError):
+            router.generate([1], max_new_tokens=4, timeout_ms=5000.0)
+        # 2 s per backoff against a 5 s budget: the third attempt finds
+        # the deadline spent BEFORE touching a replica, not after 8
+        # retries × 5 s each
+        assert len(sleeps) == 3
+        assert router.metrics.counters["requests_timed_out"] == 1
+        assert len(stub.submit_errors) == 10 - 3
+
+    def test_attempts_see_shrinking_timeout(self):
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def sleep(s):
+            t[0] += s
+
+        stub = StreamingStub(
+            "a", submit_errors=[ServerOverloadedError("full",
+                                                      retry_after_s=1.0)])
+        router, _ = stub_fleet([stub], retry_budget=2, max_backoff_s=1.0,
+                               clock=clock, sleep=sleep)
+        router.generate([1], max_new_tokens=2, timeout_ms=10000.0)
+        (_, _, timeout, _), = stub.submits
+        assert timeout == pytest.approx(9000.0)     # 1 s backoff deducted
+
+    def test_mid_stream_death_resumes_from_emitted_prefix(self):
+        a = StreamingStub("a", die_after=3)
+        b = StreamingStub("b")
+        router, _ = stub_fleet([a, b])
+        got = []
+        res = router.generate([1, 2, 3, 4], max_new_tokens=8,
+                              on_token=got.append, temperature=0.7)
+        # exactly-once stream, no restart-induced duplicates
+        assert got == res.tokens == [100 + i for i in range(8)]
+        assert res.resumes == 1 and res.tokens_salvaged == 3
+        assert res.retries == 1
+        # the continuation carried the emitted prefix and the PINNED
+        # seed (bit-identity across the hop needs the same draws)
+        (prompt, emitted, n, _, seed), = b.continuations
+        assert prompt == [1, 2, 3, 4] and emitted == [100, 101, 102]
+        assert n == 8 and seed is not None
+        assert a.submits[0][3] == seed      # same seed both attempts
+        assert router.durability.counters["resumes"] == 1
+        assert router.durability.counters["tokens_salvaged"] == 3
+        assert router.durability.counters["dedup_drops"] == 0
+
+    def test_journal_end_to_end_and_recover_idempotent(self, tmp_path):
+        journal = RequestJournal(tmp_path, flush_every=2)
+        # crash scenario: the only replica dies mid-stream and the
+        # budget is 0 — generate gives up RETRYABLY, so the entry
+        # stays open (a permanent failure would be journaled terminal)
+        a = StreamingStub("a", die_after=3)
+        router, _ = stub_fleet([a], retry_budget=0, journal=journal)
+        with pytest.raises(FleetUnavailableError):
+            router.generate([1, 2], max_new_tokens=6, temperature=0.5)
+        inc = journal.incomplete()
+        (rid,) = inc
+        assert inc[rid]["emitted"] == [100, 101, 102]   # flushed at death
+        seed = inc[rid]["sampling"]["seed"]
+        assert seed is not None
+
+        # "restart": a fresh router over a healthy replica replays it
+        b = StreamingStub("b")
+        router2, _ = stub_fleet([b], journal=journal)
+        results = router2.recover()
+        assert list(results) == [rid]
+        assert results[rid].tokens == [100 + i for i in range(6)]
+        (prompt, emitted, n, _, seed2), = b.continuations
+        assert (prompt, emitted, n) == ([1, 2], [100, 101, 102], 6)
+        assert seed2 == seed                # journal carried the pin
+        assert journal.incomplete() == {}   # journaled completed
+        assert router2.recover() == {}      # idempotent: nothing open
+        assert router2.durability.counters["recovered_requests"] == 1
+        assert router2.durability.counters["tokens_salvaged"] >= 3
+        journal.close()
+
+    def test_durability_rides_the_fleet_record(self):
+        router, _ = stub_fleet([StreamingStub("a")])
+        rec = router.metrics.to_record()
+        assert rec["type"] == "fleet"
+        dur = rec["durability"]
+        assert set(dur) >= {"resumes", "tokens_salvaged", "dedup_drops",
+                            "journal_fsync_ms"}
+
+    def test_durability_folds_to_gauges_and_renders(self):
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        from deeplearning4j_tpu.ui.report import render_report
+        from deeplearning4j_tpu.ui.stats import StatsStorage
+        router, _ = stub_fleet([StreamingStub("a", die_after=2),
+                                StreamingStub("b")])
+        router.generate([1], max_new_tokens=4)
+        reg = MetricsRegistry()
+        reg.fold_fleet(router.metrics.to_record())
+        text = reg.to_prometheus_text()
+        assert "dl4j_fleet_durability_resumes_total 1" in text
+        assert "dl4j_fleet_durability_tokens_salvaged_total 2" in text
+        assert "dl4j_fleet_durability_journal_fsync_ms_p99" in text
+        storage = StatsStorage()
+        router.publish(storage)
+        html = render_report(storage)
+        assert "durability:" in html and "salvaging" in html
+
+
+# ----------------------------------------------------------------------
+# real-model drills: the acceptance bar
+
+class TestServerContinuation:
+    def test_sampled_continuation_requires_seed(self, spec):
+        server = make_server(spec)
+        try:
+            with pytest.raises(ValueError, match="seed"):
+                server.submit_continuation([1, 2], [3], max_new_tokens=4,
+                                           temperature=0.8)
+        finally:
+            server.shutdown(drain=False)
+
+    def test_finished_continuation_resolves_without_a_slot(self, spec):
+        server = make_server(spec)
+        try:
+            # budget already spent
+            h = server.submit_continuation([1, 2], [5, 6], max_new_tokens=2)
+            assert h.result(timeout=1) == []
+            # EOS already emitted
+            h = server.submit_continuation([1, 2], [5, 7], max_new_tokens=9,
+                                           eos_id=7)
+            assert h.result(timeout=1) == []
+            assert server._n_active() == 0
+        finally:
+            server.shutdown(drain=False)
+
+    def test_greedy_continuation_is_bit_identical(self, spec, dense_spec):
+        ref = greedy_decode(dense_spec, [3, 1, 4, 1], 12, max_seq_len=MSL)
+        server = make_server(spec)
+        try:
+            cut = 5
+            out = server.submit_continuation(
+                [3, 1, 4, 1], ref[:cut], max_new_tokens=12).result(timeout=60)
+            assert ref[:cut] + out == ref
+        finally:
+            server.shutdown(drain=False)
+
+    def test_continuation_hits_prefix_cache_over_generated_span(
+            self, spec, dense_spec):
+        server = make_server(spec)
+        try:
+            prompt = [2, 7, 2, 7]
+            full = server.submit(prompt, max_new_tokens=20).result(timeout=60)
+            # clean retirement registered the generated span's full
+            # blocks: positions = 4 + 20 - 1 = 23 -> 2 full blocks
+            before = int(server.metrics.counters["prefix_blocks_hit"])
+            out = server.submit_continuation(
+                prompt, full, max_new_tokens=24).result(timeout=60)
+            hit = int(server.metrics.counters["prefix_blocks_hit"]) - before
+            # prompt alone spans 0 full blocks — any hit is generated KV
+            assert hit >= 2
+            assert full + out == greedy_decode(dense_spec, prompt, 24,
+                                               max_seq_len=MSL)
+        finally:
+            server.shutdown(drain=False)
+
+    def test_abort_fails_inflight_typed(self, spec):
+        server = make_server(spec, max_slots=1)
+        try:
+            first = threading.Event()
+            h1 = server.submit([1, 2, 3], max_new_tokens=12,
+                               on_token=lambda t: first.set())
+            assert first.wait(timeout=60)
+            h2 = server.submit([4, 5], max_new_tokens=4)    # queued
+            server.abort(timeout=30)
+            with pytest.raises(ServerClosedError):
+                h1.result(timeout=30)
+            with pytest.raises(ServerClosedError):
+                h2.result(timeout=30)
+            assert len(h1.partial()) >= 1   # emitted tokens stay emitted
+        finally:
+            server.shutdown(drain=False)
+
+
+@pytest.mark.chaos
+class TestChaosDrills:
+    def _drill(self, spec, journal=None, **gen_kw):
+        """Kill replica r0 after 5 streamed tokens of a 12-token
+        generation; the router resumes on r1. Returns (result,
+        streamed, router, replicas)."""
+        replicas = [FleetReplica(f"r{i}", server=make_server(spec))
+                    for i in range(2)]
+        router = FleetRouter(replicas, retry_budget=3,
+                             poll_interval_s=0.0, affinity=False,
+                             journal=journal)
+        chaos = ChaosMonkey(seed=7)
+        chaos.kill_mid_stream(replicas[0], after_tokens=5)
+        streamed = []
+        try:
+            res = router.generate([3, 1, 4, 1], max_new_tokens=12,
+                                  on_token=streamed.append, **gen_kw)
+        finally:
+            stop_all(replicas)
+        assert chaos.log and chaos.log[0]["event"] == "kill_mid_stream"
+        return res, streamed, router
+
+    def test_kill_mid_stream_greedy_bit_identical(self, spec, dense_spec):
+        ref = greedy_decode(dense_spec, [3, 1, 4, 1], 12, max_seq_len=MSL)
+        res, streamed, router = self._drill(spec)
+        # exactly-once: the stream IS the result — no dup, no gap
+        assert streamed == res.tokens == ref
+        assert res.resumes >= 1 and res.tokens_salvaged >= 5
+        assert res.replica == "r1"
+        assert router.durability.counters["dedup_drops"] == 0
+
+    def test_kill_mid_stream_sampled_bit_identical(self, spec):
+        kw = dict(temperature=0.8, top_k=8, seed=20260807)
+        baseline_server = make_server(spec)
+        try:
+            ref = baseline_server.submit([3, 1, 4, 1], max_new_tokens=12,
+                                         **kw).result(timeout=60)
+        finally:
+            baseline_server.shutdown(drain=False)
+        res, streamed, router = self._drill(spec, **kw)
+        # the continuation redraws on the same (seed, absolute index)
+        # stream — the cross-replica failover is invisible in the output
+        assert streamed == res.tokens == ref
+        assert res.resumes >= 1 and res.tokens_salvaged >= 5
+        assert router.durability.counters["dedup_drops"] == 0
+
+    def test_kill_and_restart_router_replays_journal(self, spec, dense_spec,
+                                                     tmp_path):
+        ref = greedy_decode(dense_spec, [3, 1, 4, 1], 12, max_seq_len=MSL)
+        journal = RequestJournal(tmp_path, flush_every=2)
+        # router 1: single replica, zero budget — the mid-stream kill
+        # makes generate() give up retryably, which deliberately leaves
+        # the journal entry OPEN (that is the router-crash analogue:
+        # submitted + partial tokens on disk, no terminal record)
+        r0 = FleetReplica("r0", server=make_server(spec))
+        router1 = FleetRouter([r0], retry_budget=0, poll_interval_s=0.0,
+                              affinity=False, journal=journal)
+        chaos = ChaosMonkey(seed=7)
+        killer = chaos.kill_mid_stream(r0, after_tokens=5)
+        try:
+            with pytest.raises(FleetUnavailableError):
+                router1.generate([3, 1, 4, 1], max_new_tokens=12)
+            assert killer.fired.wait(timeout=60)
+        finally:
+            stop_all([r0])
+        (rid,) = journal.incomplete()
+        salvaged = journal.incomplete()[rid]["emitted"]
+        assert len(salvaged) >= 4           # flushed at the failover point
+        assert salvaged == ref[:len(salvaged)]
+
+        # "restart": a new router + replica adopt the journal and
+        # replay the incomplete entry as a continuation, exactly once
+        r1 = FleetReplica("r1", server=make_server(spec))
+        router2 = FleetRouter([r1], poll_interval_s=0.0, affinity=False)
+        try:
+            results = router2.recover(journal)
+            assert list(results) == [rid]
+            assert results[rid].tokens == ref       # bit-identical
+            assert router2.durability.counters["tokens_salvaged"] > 0
+            assert journal.incomplete() == {}
+            assert router2.recover() == {}          # idempotent
+        finally:
+            stop_all([r1])
+        journal.close()
