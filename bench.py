@@ -1182,6 +1182,87 @@ def bench_serving_durability(n_requests=24, rate_rps=60.0, block_size=8,
             **recovery}
 
 
+def bench_reqtrace_overhead(n_replicas=2, n_requests=32, concurrency=4,
+                            repeats=2, block_size=8, seed=29):
+    """Cost of the request-tracing + SLO rail (monitor/reqtrace.py,
+    ISSUE 20) for BENCH_r15: the fleet loadgen closed loop with span
+    tracing + per-request waterfall assembly + SLO tracking ON vs the
+    whole rail OFF (tracer disabled, ``slo=False``/``reqtrace=False``
+    router). Same best-of-``repeats`` interleaved estimator as
+    tracer_overhead; the acceptance bar is ≤3% tokens/sec (the PR-5
+    discipline — observability must never become the workload). Also
+    records how many traces the run kept and the worst-TTFT waterfall's
+    breakdown (where the slowest request's first token went)."""
+    from deeplearning4j_tpu.monitor import disable_tracing, enable_tracing
+    from deeplearning4j_tpu.serving.fleet import FleetReplica, FleetRouter
+    from deeplearning4j_tpu.serving.loadgen import FleetLoadGenerator
+    from deeplearning4j_tpu.serving.paged import PagedGenerativeServer
+    from deeplearning4j_tpu.zoo.gpt import (GPTConfig, build_gpt,
+                                            gpt_paged_spec)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_seq_len=64)
+    sd = build_gpt(cfg, batch=2, seq_len=8, seed=0)
+    spec = gpt_paged_spec(sd, cfg)     # shared -> one compile set
+
+    def run(traced):
+        reps = [FleetReplica(f"t{i}", server=PagedGenerativeServer(
+            spec, max_slots=4, block_size=block_size, max_seq_len=64,
+            warmup=False)) for i in range(n_replicas)]
+        if traced:
+            enable_tracing(reset=True)
+            rt = FleetRouter(reps, poll_interval_s=0.05,
+                             trace_sample=1.0)
+        else:
+            disable_tracing()
+            rt = FleetRouter(reps, poll_interval_s=0.05,
+                             slo=False, reqtrace=False)
+        try:
+            res = FleetLoadGenerator(
+                rt.generate, vocab_size=cfg.vocab_size, seed=seed,
+                prompt_len=(1, 8), new_tokens=(2, 8)).run_closed(
+                    n_requests=n_requests, concurrency=concurrency)
+        finally:
+            disable_tracing()
+            for r in reps:
+                r.stop(drain=True)
+        return res, rt
+
+    run(False)                         # discard: pays the bucket compiles
+    best = {False: 0.0, True: 0.0}
+    traced_router = None
+    traced_res = None
+    for _ in range(repeats):
+        for flag in (False, True):
+            res, rt = run(flag)
+            if res.tokens_per_sec > best[flag]:
+                best[flag] = res.tokens_per_sec
+                if flag:
+                    traced_router, traced_res = rt, res
+    overhead = (best[False] - best[True]) / best[False] * 100.0 \
+        if best[False] else 0.0
+    kept = traced_router.reqtrace.summaries() if traced_router else []
+    worst = None
+    slo_sub = None
+    if traced_router is not None and traced_router.slo is not None:
+        slo_sub = traced_router.slo.to_dict()
+        worst_list = slo_sub.get("worst_traces") or []
+        if worst_list:
+            worst = worst_list[0]
+    return {"samples_per_sec": round(best[True], 1),
+            "tokens_per_sec": round(best[True], 1),
+            "tokens_per_sec_untraced": round(best[False], 1),
+            "reqtrace_overhead_pct": round(overhead, 2),
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "sampled_traces_kept": len(kept),
+            "worst_ttft_waterfall": worst,
+            "slo_ttft_attainment": (slo_sub or {}).get(
+                "objectives", {}).get("ttft_ms", {}).get("attainment"),
+            "slo_attainment_loadgen_2s": round(
+                traced_res.slo_attainment(2000.0), 4)
+            if traced_res is not None else None}
+
+
 def bench_disk_stream(batch=128, fused_steps=8, n=2048, shard_size=512,
                       worker_counts=(1, 2, 4)):
     """Disk-backed streaming training vs the device-cached window bench
@@ -1603,6 +1684,12 @@ def main():
                      # and the fsync'd journal's throughput price
                      # (serving/fleet/durable.py) for BENCH_r14
                      ("serving_durability", bench_serving_durability),
+                     # the request-tracing + SLO rail's cost on the
+                     # fleet loadgen closed loop (trace tagging +
+                     # waterfall assembly + SLO windows, ≤3% bar) plus
+                     # kept-trace count and the worst-TTFT waterfall
+                     # (monitor/reqtrace.py) for BENCH_r15
+                     ("reqtrace_overhead", bench_reqtrace_overhead),
                      # speculative decoding vs plain decode on the
                      # skewed trace: acceptance-ceiling self-draft,
                      # >= 1.5x tokens/sec bar, temp-0 bit-identity bit

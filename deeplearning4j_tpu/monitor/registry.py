@@ -431,6 +431,39 @@ class MetricsRegistry:
             self.set_gauge("fleet_durability_journal_fsync_ms_p99",
                            fs.get("p99", 0.0),
                            help="p99 journal fsync latency")
+        slo = rec.get("slo")
+        if slo:
+            self.set_gauge("fleet_slo_window", slo.get("window", 0),
+                           help="request outcomes in the rolling SLO "
+                                "window")
+            for outcome, n in (slo.get("outcomes") or {}).items():
+                self.set_gauge("fleet_slo_requests_total", n,
+                               help="request outcomes recorded by the "
+                                    "SLO tracker",
+                               outcome=outcome)
+            for field, obj in (slo.get("objectives") or {}).items():
+                labels = {"objective": field}
+                self.set_gauge("fleet_slo_target_ms",
+                               obj.get("target_ms", 0.0),
+                               help="the objective's latency target",
+                               **labels)
+                self.set_gauge("fleet_slo_attainment",
+                               obj.get("attainment", 1.0),
+                               help="fraction of windowed requests "
+                                    "that met the objective", **labels)
+                self.set_gauge("fleet_slo_burn_rate",
+                               obj.get("burn_rate", 0.0),
+                               help="window miss fraction over the "
+                                    "error budget (1.0 = burning "
+                                    "exactly as provisioned)", **labels)
+                self.set_gauge("fleet_slo_p50_ms",
+                               obj.get("p50_ms", 0.0),
+                               help="windowed p50 of the objective's "
+                                    "measured value", **labels)
+                self.set_gauge("fleet_slo_p99_ms",
+                               obj.get("p99_ms", 0.0),
+                               help="windowed p99 of the objective's "
+                                    "measured value", **labels)
         for name, rep in (rec.get("replicas") or {}).items():
             labels = {"replica": name}
             self.set_gauge("fleet_replica_ready",

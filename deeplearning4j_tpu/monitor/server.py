@@ -31,7 +31,19 @@ route                 payload
                       captured compiled-program memory plan
                       (monitor/memstats.py)
 ``GET /trace``        Chrome/Perfetto trace JSON from the shared tracer
-                      (load at ui.perfetto.dev)
+                      (load at ui.perfetto.dev); ``?since=<seq>`` drains
+                      incrementally from that cursor — the next cursor
+                      comes back in ``otherData.next``, so a polling
+                      collector never re-downloads old spans
+``GET /requesttrace`` per-request waterfalls from an attached
+                      :class:`~deeplearning4j_tpu.monitor.reqtrace.
+                      RequestTracer` — no args lists kept traces,
+                      ``?id=<trace_id>`` returns one assembled
+                      waterfall, ``&chrome=1`` renders it as a Perfetto
+                      lane-per-request timeline
+``GET /slo``          fleet SLO attainment + error-budget burn rate:
+                      the attached SLOTracker live, else the latest
+                      fleet record's ``slo`` sub-dict from storage
 ``GET /stacks``       all-thread Python stack dump (integrity/
                       watchdog.py) — look at a run that seems wedged;
                       the stall watchdog's forensics reuse it
@@ -208,6 +220,11 @@ class TelemetryServer:
         self.tracer = tracer
         self.stale_after_s = stale_after_s
         self.title = title
+        # request-tracing rail (monitor/reqtrace.py): attach via
+        # attach_reqtrace()/attach_slo() — typically a FleetRouter's
+        # .reqtrace and .slo — to light up /requesttrace and /slo
+        self.reqtrace = None
+        self.slo = None
         self._providers: Dict[str, Callable] = {}
         self._scrape_hooks: List[Callable] = []
         # incremental health-state fold (health_snapshot cache=): one
@@ -271,6 +288,18 @@ class TelemetryServer:
         depths, iteration counters, ...)."""
         self._providers[str(name)] = fn
 
+    def attach_reqtrace(self, reqtrace) -> None:
+        """Attach a :class:`~deeplearning4j_tpu.monitor.reqtrace.
+        RequestTracer` (e.g. ``router.reqtrace``) — serves its kept
+        waterfalls at ``/requesttrace``."""
+        self.reqtrace = reqtrace
+
+    def attach_slo(self, slo) -> None:
+        """Attach a :class:`~deeplearning4j_tpu.monitor.reqtrace.
+        SLOTracker` (e.g. ``router.slo``) — serves its live attainment/
+        burn-rate readout at ``/slo``."""
+        self.slo = slo
+
     def add_scrape_hook(self, fn: Callable) -> None:
         """Register ``fn(registry)`` run at the top of every /metrics
         scrape — the pull-model adapter for sources without records
@@ -301,7 +330,11 @@ class TelemetryServer:
         if route == "/memory":
             return self._memory()
         if route == "/trace":
-            return self._trace()
+            return self._trace(qs)
+        if route == "/requesttrace":
+            return self._requesttrace(qs)
+        if route == "/slo":
+            return self._slo()
         if route == "/stacks":
             return self._stacks()
         if route == "/stats":
@@ -358,9 +391,79 @@ class TelemetryServer:
         return 200, "application/json", \
             json.dumps(body, default=str).encode("utf-8")
 
-    def _trace(self):
+    def _trace(self, qs):
+        since = None
+        raw = qs.get("since", [None])[0]
+        if raw is not None:
+            try:
+                since = int(raw)
+            except ValueError:
+                return 400, "application/json", json.dumps(
+                    {"error": f"since must be an integer, got {raw!r}"}
+                ).encode("utf-8")
+        body = self.tracer.to_chrome_trace(since=since)
         return 200, "application/json", \
-            json.dumps(self.tracer.to_chrome_trace()).encode("utf-8")
+            json.dumps(body).encode("utf-8")
+
+    def _requesttrace(self, qs):
+        """Per-request waterfalls (monitor/reqtrace.py): the list of
+        kept traces, one assembled waterfall by id, or its Perfetto
+        lane-per-request rendering with ``chrome=1``."""
+        if self.reqtrace is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no RequestTracer attached "
+                          "(TelemetryServer.attach_reqtrace)"}).encode()
+        # fold any spans still sitting in the ring into open buffers
+        self.reqtrace.collect()
+        raw = qs.get("id", [None])[0]
+        chrome = qs.get("chrome", ["0"])[0] not in ("0", "", "false")
+        if raw is None:
+            if chrome:
+                body = self.reqtrace.to_chrome_trace()
+            else:
+                body = {"traces": self.reqtrace.summaries()}
+            return 200, "application/json", \
+                json.dumps(body, default=str).encode("utf-8")
+        try:
+            tid = int(raw)
+        except ValueError:
+            return 400, "application/json", json.dumps(
+                {"error": f"id must be an integer, got {raw!r}"}
+            ).encode("utf-8")
+        if chrome:
+            body = self.reqtrace.to_chrome_trace(trace_id=tid)
+            if not body.get("traceEvents"):
+                return 404, "application/json", json.dumps(
+                    {"error": f"no kept trace {tid}"}).encode()
+        else:
+            body = self.reqtrace.get(tid)
+            if body is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"no kept trace {tid}"}).encode()
+        return 200, "application/json", \
+            json.dumps(body, default=str).encode("utf-8")
+
+    def _slo(self):
+        """SLO attainment/burn-rate readout: the attached tracker live,
+        else the newest fleet record's ``slo`` sub-dict from storage."""
+        if self.slo is not None:
+            body = {"t": time.time(), "source": "live",
+                    "slo": self.slo.to_dict()}
+        else:
+            sub = None
+            if self.storage is not None:
+                for rec in reversed(self.storage.tail(200, "fleet")):
+                    if rec.get("slo") is not None:
+                        sub = rec.get("slo")
+                        break
+            if sub is None:
+                return 404, "application/json", json.dumps(
+                    {"error": "no SLOTracker attached and no fleet "
+                              "record carries an 'slo' sub-dict"}
+                ).encode()
+            body = {"t": time.time(), "source": "storage", "slo": sub}
+        return 200, "application/json", \
+            json.dumps(body, default=str).encode("utf-8")
 
     def _stacks(self):
         """All-thread Python stack dump (integrity/watchdog.py) — the
@@ -397,7 +500,11 @@ class TelemetryServer:
                 ("/readyz", "readiness (staleness + queue depth)"),
                 ("/report", "training report HTML"),
                 ("/memory", "live HBM snapshot + program memory plans"),
-                ("/trace", "Chrome/Perfetto trace JSON"),
+                ("/trace", "Chrome/Perfetto trace JSON "
+                           "(?since=<seq> drains incrementally)"),
+                ("/requesttrace", "per-request waterfalls "
+                                  "(?id=<trace_id>, &chrome=1)"),
+                ("/slo", "fleet SLO attainment + error-budget burn"),
                 ("/stacks", "all-thread stack dump (wedged-run "
                             "debugging)"),
                 ("/stats", "recent records (?n=500&type=...)")))

@@ -266,12 +266,22 @@ class Tracer:
             return list(self._buf)
 
     # -- export ---------------------------------------------------------
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self, since: Optional[int] = None) -> dict:
         """Chrome Trace Event JSON (the ``{"traceEvents": [...]}``
         object form). Loadable by chrome://tracing and Perfetto.
         Timestamps are microseconds from the tracer epoch; each thread
-        is one lane, named via metadata events."""
-        spans = self.spans()
+        is one lane, named via metadata events.
+
+        With ``since`` (a sequence mark from a previous export's
+        ``otherData["next"]`` or :meth:`mark`), only spans recorded
+        after that mark are exported — the incremental form a polling
+        collector uses instead of re-downloading the whole ring;
+        ``otherData`` then carries the ``next`` cursor and the
+        ``dropped`` eviction count."""
+        if since is None:
+            spans, next_mark, dropped = self.spans(), self.mark(), None
+        else:
+            spans, next_mark, dropped = self.drain(int(since))
         events: List[dict] = []
         threads: Dict[int, str] = {}
         for sp in spans:
@@ -292,10 +302,13 @@ class Tracer:
                                   else repr(v))
                               for k, v in sp.args.items()}
             events.append(ev)
+        other = {"tracer_epoch_unix_s": self._meta_t0,
+                 "spans": len(spans), "recorded_total": next_mark,
+                 "next": next_mark}
+        if dropped is not None:
+            other["dropped"] = dropped
         return {"traceEvents": events, "displayTimeUnit": "ms",
-                "otherData": {"tracer_epoch_unix_s": self._meta_t0,
-                              "spans": len(spans),
-                              "recorded_total": self.mark()}}
+                "otherData": other}
 
     def write_chrome_trace(self, path: str) -> str:
         with open(path, "w", encoding="utf-8") as fh:
@@ -323,5 +336,66 @@ def disable_tracing() -> Tracer:
     return TRACER.disable()
 
 
-__all__ = ["Span", "Tracer", "TRACER", "get_tracer", "enable_tracing",
-           "disable_tracing"]
+#: The canonical span registry: every span NAME the package records,
+#: mapped to ``(category, well-known arg keys)``. Downstream consumers
+#: key on these literals — waterfall assembly (monitor/reqtrace.py)
+#: selects ``serving.*``/``fleet.attempt`` by name, steptime attribution
+#: selects the train-tier stages, report lanes color by name — so a
+#: rename is a silent data loss everywhere at once. The span-name lint
+#: (tests/test_static_lint.py) walks every ``span("...")`` /
+#: ``record_completed("...")`` / ``_dispatch(..., "...")`` literal in
+#: the package and asserts BOTH directions: every recorded name is
+#: cataloged, and every cataloged name is still recorded somewhere.
+#: Arg keys are the documented contract (e.g. ``trace_id``/``segment``
+#: land on any serving span once request tracing propagates a
+#: TraceContext; ``slots`` is the batch-level occupancy map).
+SPAN_CATALOG: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    # train tier (autodiff/samediff.py, autodiff/window.py)
+    "window": ("train", ("k", "iteration")),
+    "step": ("train", ("k",)),
+    "data_wait": ("train", ()),
+    "dispatch": ("train", ("k",)),
+    "flush": ("train", ("steps",)),
+    "h2d_stage": ("train", ("k",)),
+    "integrity.replay_probe": ("integrity", ("k",)),
+    # compile pipeline (compilecache/, samediff precompile, memstats)
+    "compile.precompile": ("compile", ("target",)),
+    "compile.plan_capture": ("compile", ("target",)),
+    "compile.backend": ("compile", ("cache_hit",)),
+    "compile.trace": ("compile", ()),
+    "compile.lower": ("compile", ()),
+    # checkpoint rail (checkpoint/, parallel/trainer.py)
+    "checkpoint.capture": ("checkpoint", ("step",)),
+    "checkpoint.commit": ("checkpoint", ("step", "asynchronous",
+                                         "queue_s")),
+    "checkpoint.serialize": ("checkpoint", ("step",)),
+    "checkpoint.reshard": ("checkpoint", ("step",)),
+    # fault rail (faults/)
+    "faults.rollback": ("faults", ("cause",)),
+    "faults.backoff": ("faults", ("attempt", "backoff_s")),
+    "data.loader_seek": ("data", ("skip",)),
+    "data.loader_retry": ("data", ("skip",)),
+    # serving lifecycle (serving/) — request-traced spans additionally
+    # carry trace_id/segment; batch-level dispatches carry slots
+    "serving.enqueue": ("serving", ("id", "trace_id", "segment")),
+    "serving.batch": ("serving", ("rows", "requests")),
+    "serving.pad": ("serving", ("rows", "bucket")),
+    "serving.exec": ("serving", ("rows", "padding")),
+    "serving.reply": ("serving", ("id", "requests", "trace_id",
+                                  "segment")),
+    "serving.warmup": ("serving", ("bucket",)),
+    "serving.reload": ("serving", ("step", "arrays")),
+    "serving.prefill": ("serving", ("bucket", "slot", "trace_id",
+                                    "segment")),
+    "serving.decode": ("serving", ("active", "slots")),
+    "serving.draft": ("serving", ("active", "step", "slots")),
+    "serving.verify": ("serving", ("active", "window", "slots")),
+    # fleet tier (serving/fleet/router.py) — one span per placement
+    # attempt, the segment boundary request waterfalls link on
+    "fleet.attempt": ("fleet", ("trace_id", "segment", "kind",
+                                "replica", "outcome")),
+}
+
+
+__all__ = ["Span", "Tracer", "TRACER", "SPAN_CATALOG", "get_tracer",
+           "enable_tracing", "disable_tracing"]

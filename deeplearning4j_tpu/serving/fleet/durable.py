@@ -120,7 +120,12 @@ class StreamCursor:
     a gap would mean the continuation machinery lost a token, which
     must surface as a loud bug, never as silent stream corruption.
     ``delivered`` doubles as the resume prefix the next attempt
-    prefills with."""
+    prefills with.
+
+    Every attempt the cursor survives shares ONE request trace: the
+    router's TraceContext (monitor/reqtrace.py) keeps its trace_id
+    across the resume, so the assembled waterfall shows the death and
+    the continuation as consecutive segments of the same request."""
 
     def __init__(self, on_token: Optional[Callable[[int], None]] = None,
                  *, metrics: Optional[DurabilityMetrics] = None,

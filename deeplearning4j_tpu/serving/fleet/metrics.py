@@ -56,6 +56,10 @@ class FleetMetrics:
         # to_record extends the fleet record with a "durability" sub-
         # dict — same record type, no new registry/report plumbing
         self.durability = None
+        # attached by a FleetRouter (its SLOTracker): same pattern, the
+        # fleet record grows an "slo" sub-dict (attainment, burn rate,
+        # percentiles, worst sampled waterfalls) — see monitor/reqtrace
+        self.slo = None
 
     def inc(self, name: str, v: int = 1) -> None:
         with self._lock:
@@ -122,6 +126,8 @@ class FleetMetrics:
         }
         if durability is not None:
             rec["durability"] = durability
+        if self.slo is not None:
+            rec["slo"] = self.slo.to_dict()
         return rec
 
 

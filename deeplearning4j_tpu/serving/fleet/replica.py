@@ -194,7 +194,10 @@ class FleetReplica:
     # -- traffic --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16, **kw):
         """Delegate to the server; a dead/stopped replica raises
-        ``ServerClosedError`` exactly like a vanished process would."""
+        ``ServerClosedError`` exactly like a vanished process would.
+        ``**kw`` flows through verbatim — in particular the router's
+        ``trace=`` TraceContext (monitor/reqtrace.py), so the server's
+        spans carry the fleet-wide trace_id/segment of this hop."""
         if not self.alive or self.server is None:
             raise ServerClosedError(
                 f"replica {self.name} is {self.state}")

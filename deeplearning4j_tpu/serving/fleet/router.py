@@ -57,6 +57,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor.reqtrace import (RequestTracer, SLOTracker,
+                                                 TraceContext, ttft_breakdown)
+from deeplearning4j_tpu.monitor.trace import TRACER as _tracer
 from deeplearning4j_tpu.serving.fleet.durable import (DurabilityMetrics,
                                                       RequestJournal,
                                                       StreamCursor)
@@ -92,6 +95,12 @@ class FleetResult:
     # instead of regenerating (0/0 on an uninterrupted request)
     resumes: int = 0
     tokens_salvaged: int = 0
+    # request-tracing rail: the fleet-wide trace id every segment of
+    # this request carried, and (when the trace was sampled) the
+    # assembled waterfall's TTFT decomposition — both None when the
+    # router runs with tracing off (observational only, never math)
+    trace_id: Optional[int] = None
+    ttft_breakdown: Optional[dict] = None
 
 
 class FleetRouter:
@@ -110,6 +119,7 @@ class FleetRouter:
                  spill_queue_depth: int = 4, spill_occupancy: float = 0.9,
                  metrics: Optional[FleetMetrics] = None,
                  journal: Optional[RequestJournal] = None,
+                 slo=None, trace_sample: float = 1.0, reqtrace=None,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic):
         self._lock = threading.RLock()
@@ -128,6 +138,22 @@ class FleetRouter:
         # (when given) times its fsyncs into the same instance
         self.durability = DurabilityMetrics()
         self.metrics.durability = self.durability
+        # the request-tracing/SLO rail: ``slo`` is a SLOTracker (None →
+        # default objectives, False → disabled), ``reqtrace`` a
+        # RequestTracer (None → head-sample ``trace_sample`` of
+        # requests, False → disabled). Attainment/burn ride the fleet
+        # record as its "slo" sub-dict; waterfalls are host-side only.
+        if slo is False:
+            self.slo: Optional[SLOTracker] = None
+        else:
+            self.slo = slo if slo is not None else SLOTracker()
+        self.metrics.slo = self.slo
+        if reqtrace is False:
+            self.reqtrace: Optional[RequestTracer] = None
+        else:
+            self.reqtrace = (reqtrace if reqtrace is not None
+                             else RequestTracer(sample=float(trace_sample),
+                                                slo=self.slo))
         self._journal = journal
         if journal is not None and journal.metrics is None:
             journal.metrics = self.durability
@@ -358,9 +384,16 @@ class FleetRouter:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         rid, kw = self._register(prompt, max_new_tokens, timeout_ms, kw)
         cursor = StreamCursor(on_token, metrics=self.durability)
+        # mint the request's TraceContext: trace_id IS the fleet rid
+        # (which is also the journal key and the pinned sampling seed —
+        # one id names the request everywhere). Every retry, failover
+        # resume, and recover() replay reuses it with a new segment.
+        ctx = (self.reqtrace.begin(rid) if self.reqtrace is not None
+               else TraceContext(rid))
+        t0 = self._clock()
         try:
             result = self._drive(rid, prompt, max_new_tokens,
-                                 timeout_ms, cursor, kw)
+                                 timeout_ms, cursor, kw, ctx=ctx, t0=t0)
         except (ValueError, PoisonedRequestError, RequestTimeoutError) as e:
             # permanent: terminal in the journal so recover() skips it.
             # A retryable give-up (FleetUnavailableError et al.) is
@@ -368,20 +401,71 @@ class FleetRouter:
             # restarted router replays it as a continuation.
             if self._journal is not None:
                 self._journal.log_failed(rid, e)
+            self._trace_outcome(ctx, cursor, t0, status=(
+                "timed_out" if isinstance(e, RequestTimeoutError)
+                else "failed"))
+            raise
+        except RetryableServingError:
+            self._trace_outcome(ctx, cursor, t0, status="shed")
             raise
         if self._journal is not None:
             self._journal.log_completed(rid, len(result.tokens))
+        wf = self._trace_outcome(ctx, cursor, t0, status="ok",
+                                 result=result)
+        if wf is not None:
+            result.ttft_breakdown = ttft_breakdown(wf)
         return result
+
+    def _trace_outcome(self, ctx: TraceContext, cursor: StreamCursor,
+                       t0: float, *, status: str, result=None):
+        """Terminal bookkeeping for one traced request: feed the SLO
+        tracker's rolling windows and close the trace (waterfall
+        assembly + head/tail keep decision). Observational only; returns
+        the kept waterfall dict or None."""
+        if self.slo is None and self.reqtrace is None:
+            return None
+        e2e = (self._clock() - t0) * 1000.0
+        outcome = {
+            "status": status,
+            "ttft_ms": (result.ttft_ms if result is not None else None),
+            "e2e_ms": e2e,
+            "tokens": (len(result.tokens) if result is not None
+                       else len(cursor.delivered)),
+            "replica": (result.replica if result is not None else None),
+            # segments minted so far count the attempts even when the
+            # request died before a FleetResult existed
+            "retries": (result.retries if result is not None
+                        else max(0, ctx.segments_minted - 1)),
+            "resumes": (result.resumes if result is not None else 0),
+            "origin": ctx.origin,
+        }
+        if self.slo is not None:
+            self.slo.record(status, ttft_ms=outcome["ttft_ms"],
+                            e2e_ms=e2e, tokens=outcome["tokens"],
+                            replica=outcome["replica"],
+                            retries=outcome["retries"],
+                            resumes=outcome["resumes"],
+                            trace_id=ctx.trace_id)
+        if self.reqtrace is not None:
+            return self.reqtrace.finish(ctx, outcome)
+        return None
 
     def _drive(self, rid: int, prompt, max_new_tokens: int,
                timeout_ms: Optional[float], cursor: StreamCursor,
-               kw: dict) -> FleetResult:
+               kw: dict, ctx: Optional[TraceContext] = None,
+               t0: Optional[float] = None) -> FleetResult:
         """The retry/failover loop behind :meth:`generate` and
         :meth:`recover`: attempts start from the cursor's delivered
         prefix (empty on a fresh request, pre-seeded on a journal
         replay) and every mid-stream death resumes instead of
-        restarting."""
-        t0 = self._clock()
+        restarting. Each placement attempt is one trace SEGMENT: a
+        ``fleet.attempt`` span tagged trace_id/segment/kind, with the
+        same context handed to the replica so the server-side spans of
+        that hop carry the identity too."""
+        if ctx is None:
+            ctx = TraceContext(rid)
+        if t0 is None:
+            t0 = self._clock()
         plen = int(np.asarray(prompt).size)
         attempts = 0
         resumes = 0
@@ -389,30 +473,41 @@ class FleetRouter:
         marks: List[float] = []
         while True:
             replica, kind = None, "least_loaded"
+            base = len(cursor.delivered)
+            seg = ctx.next_segment()
+            seg_kind = ("replay" if ctx.origin == "replay" and seg == 0
+                        else "resume" if base
+                        else "retry" if attempts else "initial")
             try:
-                remaining = self._remaining_ms(t0, timeout_ms)
-                replica, kind = self.route(prompt)
-                base = len(cursor.delivered)
-                ordinal = itertools.count(base)
+                with _tracer.span("fleet.attempt", cat="fleet",
+                                  trace_id=ctx.trace_id, segment=seg,
+                                  kind=seg_kind) as asp:
+                    remaining = self._remaining_ms(t0, timeout_ms)
+                    replica, kind = self.route(prompt)
+                    asp.set(replica=replica.name)
+                    ordinal = itertools.count(base)
 
-                def _deliver(tok, _ord=ordinal):
-                    idx = next(_ord)
-                    if cursor.deliver(idx, tok):
-                        marks.append(self._clock())
-                        if self._journal is not None:
-                            self._journal.append_token(rid, plen + idx,
-                                                       tok)
+                    def _deliver(tok, _ord=ordinal):
+                        idx = next(_ord)
+                        if cursor.deliver(idx, tok):
+                            marks.append(self._clock())
+                            if self._journal is not None:
+                                self._journal.append_token(
+                                    rid, plen + idx, tok)
 
-                if base:
-                    handle = replica.submit_continuation(
-                        prompt, list(cursor.delivered),
-                        max_new_tokens=max_new_tokens,
-                        timeout_ms=remaining, on_token=_deliver, **kw)
-                else:
-                    handle = replica.submit(
-                        prompt, max_new_tokens=max_new_tokens,
-                        timeout_ms=remaining, on_token=_deliver, **kw)
-                handle.result()
+                    if base:
+                        handle = replica.submit_continuation(
+                            prompt, list(cursor.delivered),
+                            max_new_tokens=max_new_tokens,
+                            timeout_ms=remaining, on_token=_deliver,
+                            trace=ctx, **kw)
+                    else:
+                        handle = replica.submit(
+                            prompt, max_new_tokens=max_new_tokens,
+                            timeout_ms=remaining, on_token=_deliver,
+                            trace=ctx, **kw)
+                    handle.result()
+                    asp.set(outcome="ok")
                 self.metrics.on_routed(kind, replica.name)
                 self.metrics.inc("requests_ok")
                 ttft = (marks[0] - t0) * 1000.0 if marks else None
@@ -423,7 +518,8 @@ class FleetRouter:
                                    retries=attempts, routed=kind,
                                    ttft_ms=ttft, intertoken_ms=inter,
                                    resumes=resumes,
-                                   tokens_salvaged=salvaged)
+                                   tokens_salvaged=salvaged,
+                                   trace_id=ctx.trace_id)
             except (ValueError, PoisonedRequestError):
                 self.metrics.inc("requests_failed")
                 raise
@@ -498,16 +594,31 @@ class FleetRouter:
                 self.durability.inc("tokens_salvaged", len(emitted))
             kw = {k: v for k, v in entry["sampling"].items()
                   if v is not None}
+            # a replay keeps the ORIGINAL trace_id (the rid) — the
+            # recovered segments join the same trace, tagged replay
+            ctx = (self.reqtrace.begin(rid, origin="replay")
+                   if self.reqtrace is not None
+                   else TraceContext(rid, origin="replay"))
+            t0 = self._clock()
             try:
                 res = self._drive(rid, prompt, entry["max_new_tokens"],
-                                  entry["timeout_ms"], cursor, kw)
+                                  entry["timeout_ms"], cursor, kw,
+                                  ctx=ctx, t0=t0)
             except (ValueError, PoisonedRequestError,
                     RequestTimeoutError) as e:
                 jn.log_failed(rid, e)
+                self._trace_outcome(ctx, cursor, t0, status=(
+                    "timed_out" if isinstance(e, RequestTimeoutError)
+                    else "failed"))
                 continue
             except RetryableServingError:
+                self._trace_outcome(ctx, cursor, t0, status="shed")
                 continue        # still open: the NEXT recover retries
             jn.log_completed(rid, len(res.tokens))
+            wf = self._trace_outcome(ctx, cursor, t0, status="ok",
+                                     result=res)
+            if wf is not None:
+                res.ttft_breakdown = ttft_breakdown(wf)
             results[rid] = res
         return results
 

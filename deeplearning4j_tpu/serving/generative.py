@@ -165,6 +165,15 @@ class SlotAllocator:
 _STREAM_DONE = object()
 
 
+def _trace_args(req: "GenerationRequest") -> dict:
+    """The span args tying a per-request serving span to its fleet
+    trace — empty for untraced requests, so local (non-fleet) traffic
+    records byte-identical spans to the pre-tracing tier."""
+    if req.trace_id is None:
+        return {}
+    return {"trace_id": req.trace_id, "segment": req.trace_seg}
+
+
 @dataclass
 class GenerationRequest(InferenceRequest):
     """One queued generation: prompt + budget + the per-token stream.
@@ -185,6 +194,12 @@ class GenerationRequest(InferenceRequest):
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     seed: Optional[int] = None
+    # request tracing (monitor/reqtrace.py): the fleet-wide trace id +
+    # segment this attempt serves under, snapshotted at submit; tags
+    # every serving.* span the request touches. None = untraced (the
+    # spans carry no trace args, exactly the pre-tracing shape)
+    trace_id: Optional[int] = None
+    trace_seg: int = 0
     generated: List[int] = field(default_factory=list)
     cancelled: bool = False
     first_token_t: Optional[float] = None
@@ -850,7 +865,8 @@ class GenerativeServer:
                temperature: float = 0.0,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               seed: Optional[int] = None) -> GenerationHandle:
+               seed: Optional[int] = None,
+               trace=None) -> GenerationHandle:
         """Enqueue one generation; returns a :class:`GenerationHandle`
         streaming tokens as they decode. Sheds typed at the call site:
         :class:`ServerOverloadedError` when the queue is full or the
@@ -862,7 +878,13 @@ class GenerativeServer:
         seeded by ``(seed, absolute token index)`` so the continuation
         is reproducible per request regardless of co-batching or a
         crash requeue. ``seed`` defaults to the request id (stable for
-        the request's whole lifetime, including requeues)."""
+        the request's whole lifetime, including requeues).
+
+        ``trace`` is an optional request-trace context (anything with
+        ``trace_id``/``segment`` ints — the fleet router passes a
+        ``monitor.reqtrace.TraceContext``); its identity is snapshotted
+        onto the request and tags every span it touches. Purely
+        observational: tokens are bit-identical with or without it."""
         prompt = self._validate_submit(prompt, max_new_tokens)
         temperature = float(temperature)
         if not np.isfinite(temperature) or temperature < 0.0:
@@ -890,9 +912,12 @@ class GenerativeServer:
             temperature=temperature,
             top_k=int(top_k) if top_k is not None else None,
             top_p=float(top_p) if top_p is not None else None,
-            seed=int(seed) if seed is not None else rid)
+            seed=int(seed) if seed is not None else rid,
+            trace_id=(int(trace.trace_id) if trace is not None
+                      else None),
+            trace_seg=(int(trace.segment) if trace is not None else 0))
         with _tracer.span("serving.enqueue", cat="serving", id=req.id,
-                          prompt=int(prompt.size)):
+                          prompt=int(prompt.size), **_trace_args(req)):
             try:
                 self._queue.put(req)
             except ServerOverloadedError:
@@ -909,8 +934,8 @@ class GenerativeServer:
                             temperature: float = 0.0,
                             top_k: Optional[int] = None,
                             top_p: Optional[float] = None,
-                            seed: Optional[int] = None
-                            ) -> GenerationHandle:
+                            seed: Optional[int] = None,
+                            trace=None) -> GenerationHandle:
         """Resume a generation from its already-emitted prefix — the
         fleet's failover/replay primitive. ``prompt + emitted`` becomes
         the prefill (on the paged server that span hits the prefix
@@ -960,13 +985,17 @@ class GenerativeServer:
                 id=self._next_id(), prompt=prefix,
                 max_new_tokens=max(1, remaining),
                 eos_id=eos, temperature=temperature,
-                top_k=top_k, top_p=top_p, seed=seed)
+                top_k=top_k, top_p=top_p, seed=seed,
+                trace_id=(int(trace.trace_id) if trace is not None
+                          else None),
+                trace_seg=(int(trace.segment) if trace is not None
+                           else 0))
             req.succeed()
             return GenerationHandle(req)
         return self.submit(prefix, remaining, timeout_ms=timeout_ms,
                            on_token=on_token, eos_id=eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p, seed=seed)
+                           top_p=top_p, seed=seed, trace=trace)
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  timeout_ms: Optional[float] = None) -> List[int]:
@@ -1156,7 +1185,7 @@ class GenerativeServer:
         io = {"tokens": padded, "length": np.int32(L), "slot": np.int32(s)}
         t0 = time.perf_counter()
         out = self._dispatch(self._prefill_disp, io, "serving.prefill",
-                             bucket=bucket, slot=s)
+                             bucket=bucket, slot=s, **_trace_args(req))
         tok = self._resolve_token(req, int(out[2]), out[3])
         self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
         self._positions[s] = L
@@ -1206,15 +1235,36 @@ class GenerativeServer:
         return any(r is not None and r.temperature > 0
                    for r in self._slot_reqs)
 
+    def _trace_slots(self) -> dict:
+        """The slot -> trace_id occupancy map a batch-level dispatch
+        span records: ONE decode dispatch serves every active slot at
+        once, so per-request attribution needs to know who shared it
+        (``monitor.reqtrace.assemble`` divides the span's duration by
+        the map size). Only traced requests appear; call sites attach
+        the map only while the tracer is recording."""
+        out = {}
+        for s, r in enumerate(self._slot_reqs):
+            if r is not None and r.trace_id is not None:
+                out[s] = r.trace_id
+        return out
+
+    def _batch_span_args(self, n_active: int, **extra) -> dict:
+        attrs = dict(extra, active=n_active)
+        if _tracer.enabled:
+            slots = self._trace_slots()
+            if slots:
+                attrs["slots"] = slots
+        return attrs
+
     def _decode_once(self, slot: InflightSlot) -> None:
         n_active = self._n_active()
         io = {"tokens": self._tokens.copy(),
               "positions": self._positions.copy(),
               "active": self._active.copy()}
         t0 = time.perf_counter()
-        _, _, nxt_d, logits_d = self._dispatch(self._decode_disp, io,
-                                               "serving.decode",
-                                               active=n_active)
+        _, _, nxt_d, logits_d = self._dispatch(
+            self._decode_disp, io, "serving.decode",
+            **self._batch_span_args(n_active))
         nxt = np.asarray(nxt_d)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.observe_decode_step(n_active, ms)
@@ -1297,7 +1347,7 @@ class GenerativeServer:
                    "active": active.copy()}
             _, _, dnxt, dlg = self._dispatch(
                 self._draft_decode_disp, dio, "serving.draft",
-                draft=True, step=m, active=n_active)
+                draft=True, **self._batch_span_args(n_active, step=m))
             if m >= W:
                 break
             dnxt = np.asarray(dnxt)
@@ -1323,7 +1373,7 @@ class GenerativeServer:
         vio = self._verify_io(window, positions, active)
         _, _, out_d, vlg_d = self._dispatch(
             self._verify_disp, vio, "serving.verify",
-            active=n_active, window=W)
+            **self._batch_span_args(n_active, window=W))
         out = np.asarray(out_d)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.observe_decode_step(n_active, ms)
@@ -1426,7 +1476,8 @@ class GenerativeServer:
         if req.cancelled:
             self._retire(s, cancelled=True)
             return
-        with _tracer.span("serving.reply", cat="serving", id=req.id):
+        with _tracer.span("serving.reply", cat="serving", id=req.id,
+                          **_trace_args(req)):
             req.emit(tok)
         self.metrics.inc("tokens_generated")
         if req.first_token_t is None:

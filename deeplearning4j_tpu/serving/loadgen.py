@@ -65,8 +65,9 @@ class LoadResult:
     tokens_total: int = 0
     # fleet traffic (FleetLoadGenerator): one row per request —
     # ``{"i", "outcome", "replica", "retries", "routed", "ttft_ms",
-    # "resumes", "tokens_salvaged"}`` — so a run can be sliced per
-    # replica, per retry count and per durability resume
+    # "e2e_ms", "resumes", "tokens_salvaged", "ttft_breakdown"}`` — so
+    # a run can be sliced per replica, per retry count, per durability
+    # resume, and (when the request's trace was sampled) per TTFT phase
     rows: List[dict] = field(default_factory=list)
 
     @property
@@ -118,6 +119,19 @@ class LoadResult:
 
     def intertoken_percentile(self, p: float) -> float:
         return self._pct(self.intertoken_ms, p)
+
+    def slo_attainment(self, slo_ms: float, lane: str = "ttft_ms") -> float:
+        """Fraction of issued requests that met ``slo_ms`` on ``lane``
+        (``"ttft_ms"`` or ``"e2e_ms"``) — the SAME definition the
+        router-side ``monitor.reqtrace.SLOTracker`` applies, so bench
+        rows and the fleet record's ``slo`` sub-dict can't disagree:
+        non-ok outcomes are misses, ok rows without a measurement are
+        excluded."""
+        from deeplearning4j_tpu.monitor.reqtrace import slo_attainment
+        return slo_attainment(
+            ((("ok" if r.get("outcome") == "ok"
+               else (r.get("outcome") or "failed")), r.get(lane))
+             for r in self.rows), slo_ms)
 
     def stats(self) -> str:
         s = (f"LoadResult: {self.n_ok}/{self.n_issued} ok "
@@ -504,7 +518,8 @@ class FleetLoadGenerator:
         t0 = time.monotonic()
         row = {"i": int(i), "outcome": None, "replica": None,
                "retries": 0, "routed": None, "ttft_ms": None,
-               "resumes": 0, "tokens_salvaged": 0}
+               "e2e_ms": None, "resumes": 0, "tokens_salvaged": 0,
+               "ttft_breakdown": None}
         # sampling kwargs only on sampled traces: plain front doors
         # keep the documented (prompt, max_new_tokens, timeout_ms)
         # signature working unchanged
@@ -536,9 +551,14 @@ class FleetLoadGenerator:
                    retries=int(getattr(res, "retries", 0) or 0),
                    routed=getattr(res, "routed", None),
                    ttft_ms=getattr(res, "ttft_ms", None),
+                   e2e_ms=ms,
                    resumes=int(getattr(res, "resumes", 0) or 0),
                    tokens_salvaged=int(
-                       getattr(res, "tokens_salvaged", 0) or 0))
+                       getattr(res, "tokens_salvaged", 0) or 0),
+                   # populated when the request's trace was sampled
+                   # (FleetResult.ttft_breakdown from the assembled
+                   # waterfall): queue_wait/prefill/first_decode ms
+                   ttft_breakdown=getattr(res, "ttft_breakdown", None))
         with lock:
             result.n_ok += 1
             result.latencies_ms.append(ms)
@@ -548,6 +568,35 @@ class FleetLoadGenerator:
             result.intertoken_ms.extend(
                 getattr(res, "intertoken_ms", ()) or ())
             result.rows.append(row)
+
+    def run_closed(self, n_requests: int = 64,
+                   concurrency: int = 4) -> LoadResult:
+        """Fixed-concurrency closed loop over the front door: each of
+        ``concurrency`` workers issues its next request only after the
+        previous one returned (same trace as :meth:`run_open` — request
+        ``i`` is a pure function of ``(seed, i)``)."""
+        result = LoadResult()
+        lock = threading.Lock()
+        counter = {"next": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = counter["next"]
+                    if i >= n_requests:
+                        return
+                    counter["next"] = i + 1
+                self._issue(i, result, lock)
+
+        t_start = time.monotonic()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, int(concurrency)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        result.duration_s = time.monotonic() - t_start
+        return result
 
     def run_open(self, n_requests: int = 64,
                  rate_rps: float = 50.0) -> LoadResult:

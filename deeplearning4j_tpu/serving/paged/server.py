@@ -62,7 +62,7 @@ from deeplearning4j_tpu.serving.generative import (GenerationHandle,
                                                    GenerationRequest,
                                                    GenerativeMetrics,
                                                    GenerativeServer,
-                                                   SlotAllocator)
+                                                   SlotAllocator, _trace_args)
 from deeplearning4j_tpu.serving.metrics import safe_ratio
 from deeplearning4j_tpu.serving.paged.pool import (NULL_BLOCK, BlockPool,
                                                    PoolExhaustedError,
@@ -520,7 +520,8 @@ class PagedGenerativeServer(GenerativeServer):
               "hist": np.int32(hist), "table": self._tables[s].copy()}
         t0 = time.perf_counter()
         out = self._dispatch(self._prefill_disp, io, "serving.prefill",
-                             bucket=bucket, slot=s, hist=hist)
+                             bucket=bucket, slot=s, hist=hist,
+                             **_trace_args(req))
         tok = self._resolve_token(req, int(out[2]), out[3])
         self.metrics.observe_prefill((time.perf_counter() - t0) * 1000.0)
         if self.prefix_cache_enabled:
@@ -574,7 +575,7 @@ class PagedGenerativeServer(GenerativeServer):
         t0 = time.perf_counter()
         _, _, nxt_d, logits_d = self._dispatch(self._decode_disp, io,
                                                "serving.decode",
-                                               active=n_active)
+                                               **self._batch_span_args(n_active))
         nxt = np.asarray(nxt_d)
         ms = (time.perf_counter() - t0) * 1000.0
         self.metrics.observe_decode_step(n_active, ms)

@@ -905,6 +905,69 @@ td,th{{border:1px solid #ccc;padding:3px 8px}}</style></head><body>
                 f"{dur.get('recovered_requests', 0)} journal replays; "
                 f"{dur.get('journal_records', 0)} journal records "
                 f"(fsync p99 {fs.get('p99', 0.0):.2f} ms)</p>")
+        slo = rec.get("slo")
+        if slo:
+            parts.append("<h3>SLO</h3>")
+            objectives = slo.get("objectives") or {}
+            head = []
+            for field in sorted(objectives):
+                o = objectives[field]
+                head.append(
+                    f"{_html.escape(field)} ≤ {o.get('target_ms', 0):g} "
+                    f"ms: attainment <b>{o.get('attainment', 1.0):.2%}"
+                    f"</b>, burn rate <b>{o.get('burn_rate', 0.0):.2f}×"
+                    f"</b>, p50 {o.get('p50_ms', 0.0):.1f} / p99 "
+                    f"{o.get('p99_ms', 0.0):.1f} ms")
+            outcomes = slo.get("outcomes") or {}
+            oc = "; ".join(f"{k} {v}" for k, v in sorted(outcomes.items())
+                           if v)
+            parts.append(
+                "<p>" + "; ".join(head)
+                + f" (window {slo.get('window', 0)} of "
+                f"{slo.get('total', 0)} total"
+                + (f"; outcomes: {oc}" if oc else "") + ")</p>")
+            # attainment over time: one point per published fleet record
+            for field in sorted(objectives):
+                pts = []
+                for i, frec in enumerate(fleet):
+                    o = ((frec.get("slo") or {}).get("objectives")
+                         or {}).get(field)
+                    if o is not None:
+                        pts.append((float(i), float(
+                            o.get("attainment", 1.0))))
+                if len(pts) > 1:
+                    parts.append(_svg_line(
+                        pts, color="#2ca02c",
+                        label=f"SLO attainment ({field})"))
+            worst = slo.get("worst_traces") or []
+            if worst:
+                parts.append(
+                    "<p>worst sampled traces (TTFT breakdown — "
+                    "where the time went):</p>"
+                    "<table><tr><th>trace</th><th>ttft ms</th>"
+                    "<th>queue wait</th><th>prefill</th>"
+                    "<th>first decode</th><th>e2e ms</th>"
+                    "<th>replica</th><th>retries</th><th>kept</th>"
+                    "</tr>")
+                for e in worst:
+                    bd = e.get("breakdown") or {}
+                    ttft = e.get("ttft_ms")
+                    e2e = e.get("e2e_ms")
+                    parts.append(
+                        f"<tr><td>{_html.escape(str(e.get('trace_id')))}"
+                        f"</td>"
+                        f"<td>{0.0 if ttft is None else ttft:.1f}</td>"
+                        f"<td>{bd.get('queue_wait_ms', 0.0):.1f}</td>"
+                        f"<td>{bd.get('prefill_ms', 0.0):.1f}</td>"
+                        f"<td>{bd.get('first_decode_ms', 0.0):.1f}</td>"
+                        f"<td>{0.0 if e2e is None else e2e:.1f}</td>"
+                        f"<td>{_html.escape(str(e.get('replica') or '—'))}"
+                        f"</td><td>{e.get('retries', 0)}</td>"
+                        f"<td>{_html.escape(str(e.get('kept') or '—'))}"
+                        f"</td></tr>")
+                parts.append("</table>")
+            parts.append("<p>(docs/observability.md \"Request tracing "
+                         "&amp; SLOs\")</p>")
         replicas = rec.get("replicas", {})
         if replicas:
             parts.append(

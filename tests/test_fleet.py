@@ -688,3 +688,63 @@ class TestFleetIntegration:
             assert "r0" not in late
         finally:
             stop_fleet(replicas)
+
+
+# ----------------------------------------------------------------------
+# request tracing on the real stack (ISSUE 20): the serving spans carry
+# trace_id tags, batch decodes carry the slot->trace occupancy map, and
+# the rail never changes the tokens
+
+class TestFleetTracing:
+    def test_spans_tagged_and_tracing_never_changes_tokens(self, spec):
+        from deeplearning4j_tpu.monitor.trace import (TRACER,
+                                                      disable_tracing,
+                                                      enable_tracing)
+        prompt = np.arange(5, dtype=np.int32)
+
+        def run(traced):
+            if traced:
+                enable_tracing(reset=True)
+            else:
+                disable_tracing()
+            router, replicas = make_fleet(
+                spec, n=1, router_kw=(
+                    {} if traced else {"slo": False, "reqtrace": False}))
+            try:
+                return router, [router.generate(p, max_new_tokens=3)
+                                for p in (prompt, prompt + 1)]
+            finally:
+                stop_fleet(replicas)
+
+        try:
+            _, plain = run(False)
+            router, traced = run(True)
+            # bit-identity: seeds pin to the request id, which both legs
+            # mint identically — tracing on MUST NOT move a single token
+            assert [r.tokens for r in traced] == \
+                [r.tokens for r in plain]
+            ids = {r.trace_id for r in traced}
+            assert len(ids) == 2 and None not in ids
+            spans = TRACER.spans()
+            tagged = {s.name for s in spans
+                      if s.args.get("trace_id") in ids}
+            assert {"fleet.attempt", "serving.enqueue",
+                    "serving.prefill", "serving.reply"} <= tagged
+            # batch-level decode spans record slot->trace occupancy
+            decodes = [s for s in spans if s.name == "serving.decode"
+                       and s.args.get("slots")]
+            assert decodes
+            occupants = set()
+            for d in decodes:
+                occupants |= set(d.args["slots"].values())
+            assert ids <= occupants
+            # ...which is what makes the per-request waterfall add up
+            for r in traced:
+                wf = router.reqtrace.get(r.trace_id)
+                assert wf is not None
+                assert wf["phases"]["prefill_ms"] > 0.0
+                assert wf["phases"]["decode_rounds"] >= 1
+                assert r.ttft_breakdown is not None
+                assert r.ttft_breakdown["prefill_ms"] > 0.0
+        finally:
+            disable_tracing()

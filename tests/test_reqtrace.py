@@ -1,0 +1,652 @@
+"""Request-scoped distributed tracing + fleet SLO observability
+(monitor/reqtrace.py, ISSUE 20).
+
+Covers: TraceContext propagation + deterministic head sampling; the ONE
+slo_attainment definition shared by SLOTracker and LoadResult; waterfall
+assembly with proportional batch attribution; the RequestTracer's
+head/tail keep policy and bounded LRU; concurrent Tracer drain (the
+fleet collector's path — 8 writers, 1 drainer); the chaos drill (kill a
+replica mid-stream → ONE trace_id whose waterfall shows the dead
+segment and the resume segment) asserted in-process AND over the
+/requesttrace route; /slo + registry fold + report panel; and the
+router-level bit-identity of tracing on vs off.
+
+Real-model trace tagging and bit-identity ride tests/test_fleet.py
+(shared compile set); everything here runs on stubs — router logic,
+not model math.
+"""
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.monitor.reqtrace import (RequestTracer, SLOTracker,
+                                                 TraceContext, assemble,
+                                                 head_sampled,
+                                                 slo_attainment,
+                                                 ttft_breakdown)
+from deeplearning4j_tpu.monitor.server import TelemetryServer
+from deeplearning4j_tpu.monitor.trace import (SPAN_CATALOG, TRACER, Tracer,
+                                              disable_tracing,
+                                              enable_tracing)
+from deeplearning4j_tpu.serving.fleet.replica import FleetReplica
+from deeplearning4j_tpu.serving.fleet.router import FleetRouter
+from deeplearning4j_tpu.serving.loadgen import FleetLoadGenerator, LoadResult
+from deeplearning4j_tpu.serving.queue import ServerClosedError
+from deeplearning4j_tpu.ui.report import render_report
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+@pytest.fixture(autouse=True)
+def _global_tracer_off():
+    yield
+    disable_tracing()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read().decode("utf-8"))
+
+
+# ----------------------------------------------------------------------
+# stub fleet (the test_durable idiom): streams tokens, can die once
+# mid-stream, resumes via submit_continuation — router logic only
+
+class _Handle:
+    def __init__(self, tokens, fail=None):
+        self._tokens = tokens
+        self._fail = fail
+
+    def result(self, timeout=None):
+        if self._fail is not None:
+            raise self._fail
+        return self._tokens
+
+
+class StreamingStub:
+    """Emits ``100 + i`` via ``on_token``; ``die_after=k`` fails the
+    handle (once) after k tokens TOTAL have streamed."""
+
+    def __init__(self, name="s", die_after=None, step_s=0.0):
+        self.name = name
+        self.block_size = 8
+        self.telemetry = None
+        self.die_after = die_after
+        self.step_s = step_s
+        self.traces_seen = []           # the trace= kwarg per submit
+        self._queue = SimpleNamespace(pending=lambda: 0)
+
+    def _n_active(self):
+        return 0
+
+    def _telemetry_health(self):
+        return {"ready": True, "healthy": True,
+                "load": {"queue_depth": 0, "slot_occupancy": 0.0,
+                         "p99_decode_step_ms": 1.0}}
+
+    def _run(self, start, n, on_token):
+        for i in range(n):
+            if self.die_after is not None and start + i >= self.die_after:
+                self.die_after = None
+                return _Handle(None, fail=ServerClosedError("crashed"))
+            if self.step_s:
+                time.sleep(self.step_s)
+            if on_token is not None:
+                on_token(100 + start + i)
+        return _Handle([100 + start + i for i in range(n)])
+
+    def submit(self, prompt, max_new_tokens=16, timeout_ms=None,
+               on_token=None, trace=None, **kw):
+        self.traces_seen.append(trace)
+        return self._run(0, max_new_tokens, on_token)
+
+    def submit_continuation(self, prompt, emitted, max_new_tokens=16,
+                            timeout_ms=None, on_token=None, trace=None,
+                            **kw):
+        self.traces_seen.append(trace)
+        return self._run(len(emitted), max_new_tokens - len(emitted),
+                         on_token)
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def stub_fleet(servers, **router_kw):
+    replicas = [FleetReplica(s.name, server=s) for s in servers]
+    router_kw.setdefault("poll_interval_s", 0.0)
+    router_kw.setdefault("affinity", False)
+    router_kw.setdefault("sleep", lambda s: None)
+    return FleetRouter(replicas, **router_kw), replicas
+
+
+# ----------------------------------------------------------------------
+class TestTraceContext:
+    def test_segment_counter(self):
+        ctx = TraceContext(7)
+        assert ctx.trace_id == 7 and ctx.segment == 0
+        assert ctx.segments_minted == 0
+        assert ctx.next_segment() == 0
+        assert ctx.next_segment() == 1
+        assert ctx.segment == 1
+        assert ctx.segments_minted == 2
+        assert ctx.span_args() == {"trace_id": 7, "segment": 1}
+
+    def test_head_sampling_is_deterministic_and_roughly_fair(self):
+        assert all(head_sampled(i, 1.0) for i in range(50))
+        assert not any(head_sampled(i, 0.0) for i in range(50))
+        first = [head_sampled(i, 0.25) for i in range(2000)]
+        assert first == [head_sampled(i, 0.25) for i in range(2000)]
+        rate = sum(first) / len(first)
+        assert 0.15 < rate < 0.35       # hash-fair, not exact
+
+    def test_origin_marks_replays(self):
+        assert TraceContext(1).origin == "live"
+        assert TraceContext(1, origin="replay").origin == "replay"
+
+
+class TestSloAttainmentDefinition:
+    def test_one_definition(self):
+        recs = [("ok", 100.0), ("ok", 900.0), ("ok", None),
+                ("shed", None), ("failed:Boom", 50.0)]
+        # ok-with-None excluded; non-ok always a miss
+        assert slo_attainment(recs, 500.0) == pytest.approx(1 / 4)
+        assert slo_attainment(recs, 1000.0) == pytest.approx(2 / 4)
+        assert slo_attainment([], 1.0) == 1.0
+
+    def test_loadgen_rows_and_tracker_agree(self):
+        outcomes = [("ok", 10.0), ("ok", 5000.0), ("shed", None),
+                    ("ok", 100.0)]
+        tracker = SLOTracker(objectives={"ttft_ms": 1000.0},
+                             error_budget=0.1)
+        res = LoadResult()
+        for status, ttft in outcomes:
+            tracker.record(status, ttft_ms=ttft, e2e_ms=ttft, tokens=1)
+            res.rows.append({"outcome": status if status != "ok"
+                             else "ok", "ttft_ms": ttft})
+        assert res.slo_attainment(1000.0) == \
+            tracker.attainment("ttft_ms") == pytest.approx(2 / 4)
+
+
+class TestSLOTracker:
+    def test_attainment_burn_rate_and_record_shape(self):
+        t = SLOTracker(objectives={"ttft_ms": 100.0}, window=64,
+                       error_budget=0.1)
+        for _ in range(9):
+            t.record("ok", ttft_ms=50.0, e2e_ms=80.0, tokens=4,
+                     replica="a")
+        t.record("shed", tokens=0)
+        assert t.attainment("ttft_ms") == pytest.approx(0.9)
+        # 10% missing vs a 10% budget: burning exactly as provisioned
+        assert t.burn_rate("ttft_ms") == pytest.approx(1.0)
+        d = t.to_dict()
+        assert d["window"] == 10 and d["total"] == 10
+        assert d["outcomes"]["ok"] == 9 and d["outcomes"]["shed"] == 1
+        obj = d["objectives"]["ttft_ms"]
+        assert obj["target_ms"] == 100.0
+        assert obj["attainment"] == pytest.approx(0.9)
+        assert obj["burn_rate"] == pytest.approx(1.0)
+        assert obj["p50_ms"] == 50.0
+
+    def test_breached(self):
+        t = SLOTracker(objectives={"ttft_ms": 100.0})
+        assert t.breached({"status": "shed"})
+        assert t.breached({"status": "ok", "ttft_ms": 101.0})
+        assert not t.breached({"status": "ok", "ttft_ms": 99.0})
+        assert not t.breached({"status": "ok", "ttft_ms": None})
+
+    def test_worst_waterfalls_bounded_and_sorted(self):
+        t = SLOTracker(worst_k=2)
+        for i, ttft in enumerate([5.0, 50.0, 20.0]):
+            t.note_waterfall({"trace_id": i, "ttft_ms": ttft,
+                              "phases": {"queue_wait_ms": ttft / 2}})
+        worst = t.to_dict()["worst_traces"]
+        assert [w["trace_id"] for w in worst] == [1, 2]   # worst first
+        assert worst[0]["breakdown"]["queue_wait_ms"] == 25.0
+
+
+class TestAssemble:
+    def _spans(self):
+        t = Tracer(capacity=256, enabled=True)
+        with t.span("fleet.attempt", cat="fleet", trace_id=5, segment=0,
+                    kind="initial", outcome="ok"):
+            with t.span("serving.enqueue", cat="serving", id=1,
+                        trace_id=5, segment=0):
+                time.sleep(0.002)
+            time.sleep(0.002)           # the queue wait
+            with t.span("serving.prefill", cat="serving", bucket=8,
+                        slot=0, trace_id=5, segment=0):
+                time.sleep(0.002)
+            # two decode rounds shared with another request (slot 1)
+            for _ in range(2):
+                with t.span("serving.decode", cat="serving", active=2,
+                            slots={0: 5, 1: 9}):
+                    time.sleep(0.002)
+            with t.span("serving.reply", cat="serving", id=1,
+                        trace_id=5, segment=0):
+                pass
+        return t.spans()
+
+    def test_waterfall_phases_and_proportional_attribution(self):
+        spans = self._spans()
+        wf = assemble(spans, 5, outcome={"status": "ok",
+                                         "ttft_ms": 8.0, "e2e_ms": 12.0,
+                                         "tokens": 2, "replica": "a",
+                                         "retries": 0, "resumes": 0,
+                                         "origin": "live"})
+        ph = wf["phases"]
+        assert ph["queue_wait_ms"] > 0.0
+        assert ph["prefill_ms"] > 0.0
+        assert ph["decode_rounds"] == 2
+        # shared 2-slot dispatch: this request is attributed HALF
+        raw_decode = sum(s.dur for s in spans
+                         if s.name == "serving.decode") * 1000.0
+        assert ph["decode_ms"] == pytest.approx(raw_decode / 2, rel=0.01)
+        assert wf["segments"][0]["kind"] == "initial"
+        assert wf["status"] == "ok" and wf["ttft_ms"] == 8.0
+        shares = {ln["name"]: ln["share"] for ln in wf["spans"]}
+        assert shares["serving.decode"] == 0.5
+        assert shares["serving.prefill"] == 1.0
+        # the OTHER occupant of the shared dispatch sees it too
+        other = assemble(spans, 9)
+        assert other["phases"]["decode_rounds"] == 2
+        assert other["phases"]["prefill_ms"] == 0.0
+
+    def test_every_assembled_span_name_is_cataloged(self):
+        for s in self._spans():
+            assert s.name in SPAN_CATALOG
+
+
+class TestRequestTracer:
+    def _rt(self, **kw):
+        t = Tracer(capacity=512, enabled=True)
+        kw.setdefault("tracer", t)
+        return RequestTracer(**kw), t
+
+    def _record_request(self, t, ctx, ok=True):
+        with t.span("fleet.attempt", cat="fleet", kind="initial",
+                    outcome="ok" if ok else None, **ctx.span_args()):
+            pass
+
+    def test_head_keep_and_get(self):
+        rt, t = self._rt(sample=1.0)
+        ctx = rt.begin(3)
+        assert ctx.sampled
+        self._record_request(t, ctx)
+        wf = rt.finish(ctx, {"status": "ok", "ttft_ms": 1.0,
+                             "e2e_ms": 2.0})
+        assert wf is not None and wf["kept"] == "head"
+        assert rt.get(3)["trace_id"] == 3
+        assert rt.summaries()[0]["status"] == "ok"
+
+    def test_unsampled_ok_is_dropped(self):
+        rt, t = self._rt(sample=0.0)
+        ctx = rt.begin(3)
+        self._record_request(t, ctx)
+        assert rt.finish(ctx, {"status": "ok", "ttft_ms": 1.0}) is None
+        assert rt.get(3) is None
+
+    def test_tail_keep_on_failure_retry_and_slo_breach(self):
+        slo = SLOTracker(objectives={"ttft_ms": 10.0})
+        rt, t = self._rt(sample=0.0, slo=slo)
+        for tid, outcome in ((1, {"status": "shed"}),
+                             (2, {"status": "ok", "retries": 2}),
+                             (3, {"status": "ok", "resumes": 1}),
+                             (4, {"status": "ok", "ttft_ms": 99.0})):
+            ctx = rt.begin(tid)
+            assert not ctx.sampled
+            self._record_request(t, ctx)
+            wf = rt.finish(ctx, outcome)
+            assert wf is not None and wf["kept"] == "tail", outcome
+
+    def test_lru_bound(self):
+        rt, t = self._rt(sample=1.0, capacity=2)
+        for tid in (1, 2, 3):
+            ctx = rt.begin(tid)
+            self._record_request(t, ctx)
+            rt.finish(ctx, {"status": "ok"})
+        assert rt.get(1) is None
+        assert [w["trace_id"] for w in rt.waterfalls()] == [2, 3]
+
+    def test_inert_while_tracer_disabled(self):
+        t = Tracer(capacity=16, enabled=False)
+        rt = RequestTracer(tracer=t, sample=1.0)
+        assert not rt.active
+        ctx = rt.begin(1)
+        assert rt.finish(ctx, {"status": "ok"}) is None
+        assert rt.waterfalls() == []
+
+    def test_chrome_trace_is_lane_per_request(self):
+        rt, t = self._rt(sample=1.0)
+        for tid in (11, 12):
+            ctx = rt.begin(tid)
+            self._record_request(t, ctx)
+            rt.finish(ctx, {"status": "ok"})
+        out = rt.to_chrome_trace()
+        meta = [e for e in out["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in meta} == \
+            {"request 11", "request 12"}
+        lanes = {e["tid"] for e in out["traceEvents"] if e["ph"] == "X"}
+        assert lanes == {11, 12}
+
+
+# ----------------------------------------------------------------------
+# satellite: the ring under concurrent drain() + recording threads —
+# the fleet collector polls a live tracer exactly like this
+
+class TestTracerConcurrentDrain:
+    N_WRITERS, PER_WRITER = 8, 300
+
+    def _hammer(self, capacity):
+        t = Tracer(capacity=capacity, enabled=True)
+        drained, cursors, dropped_total = [], [], [0]
+        stop = threading.Event()
+
+        def writer(w):
+            for i in range(self.PER_WRITER):
+                with t.span("step", cat="train", w=w, i=i):
+                    pass
+
+        def drainer():
+            mark = 0
+            while True:
+                spans, mark2, dropped = t.drain(mark)
+                assert mark2 >= mark, "drain cursor went backwards"
+                drained.extend(spans)
+                dropped_total[0] += dropped
+                cursors.append(mark2)
+                mark = mark2
+                if stop.is_set():
+                    spans, mark, dropped = t.drain(mark)
+                    drained.extend(spans)
+                    dropped_total[0] += dropped
+                    cursors.append(mark)
+                    return
+                time.sleep(0.0002)
+
+        dt = threading.Thread(target=drainer)
+        ws = [threading.Thread(target=writer, args=(w,))
+              for w in range(self.N_WRITERS)]
+        dt.start()
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        stop.set()
+        dt.join()
+        return t, drained, cursors, dropped_total[0]
+
+    def test_no_span_loss_below_capacity(self):
+        total = self.N_WRITERS * self.PER_WRITER
+        t, drained, cursors, dropped = self._hammer(capacity=total + 64)
+        assert dropped == 0
+        assert len(drained) == total
+        # every (writer, i) arrived exactly once
+        seen = {(s.args["w"], s.args["i"]) for s in drained}
+        assert len(seen) == total
+        # seq cursors monotonic; collected seqs strictly increasing
+        assert cursors == sorted(cursors)
+        seqs = [s.seq for s in drained]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert t.mark() == total
+
+    def test_eviction_accounting_is_exact_under_concurrency(self):
+        total = self.N_WRITERS * self.PER_WRITER
+        t, drained, cursors, dropped = self._hammer(capacity=64)
+        # conservation: every recorded span was either drained or
+        # counted evicted — never both, never neither
+        assert len(drained) + dropped == total
+        seqs = [s.seq for s in drained]
+        assert len(set(seqs)) == len(seqs)
+        assert cursors == sorted(cursors)
+
+    def test_eviction_accounting_exact_single_thread(self):
+        t = Tracer(capacity=64, enabled=True)
+        for i in range(500):
+            with t.span("step", cat="train", i=i):
+                pass
+        spans, mark, dropped = t.drain(0)
+        assert (len(spans), mark, dropped) == (64, 500, 436)
+        assert [s.args["i"] for s in spans] == list(range(436, 500))
+        spans, mark, dropped = t.drain(490)
+        assert (len(spans), mark, dropped) == (10, 500, 0)
+
+
+# ----------------------------------------------------------------------
+# the chaos drill: kill a replica mid-stream -> ONE trace_id whose
+# waterfall shows the dead segment + the resume segment (acceptance)
+
+class TestChaosDrillTrace:
+    def _drill(self):
+        enable_tracing(reset=True)
+        s1 = StreamingStub("a", die_after=3, step_s=0.001)
+        s2 = StreamingStub("b", step_s=0.001)
+        router, _ = stub_fleet([s1, s2])
+        res = router.generate([1, 2, 3], max_new_tokens=6)
+        return router, res, s1, s2
+
+    def test_one_trace_id_dead_segment_then_resume(self):
+        router, res, s1, s2 = self._drill()
+        assert res.tokens == [100, 101, 102, 103, 104, 105]
+        assert res.resumes == 1 and res.trace_id is not None
+        # every hop saw the SAME context object/trace id
+        hops = s1.traces_seen + s2.traces_seen
+        assert all(h is not None and h.trace_id == res.trace_id
+                   for h in hops)
+        wf = router.reqtrace.get(res.trace_id)
+        assert wf is not None
+        segs = wf["segments"]
+        assert len(segs) == 2
+        assert segs[0]["error"] == "ServerClosedError"
+        assert segs[0]["outcome"] is None
+        assert segs[1]["kind"] == "resume"
+        assert segs[1]["outcome"] == "ok"
+        assert segs[1]["replica"] == "b"
+        assert segs[0]["start_ms"] <= segs[1]["start_ms"]
+        # correct total TTFT/e2e: the router's measurement is merged in
+        assert wf["ttft_ms"] == pytest.approx(res.ttft_ms)
+        assert wf["e2e_ms"] >= wf["ttft_ms"] > 0.0
+        assert wf["resumes"] == 1
+        # a failover is a tail-keep trigger even at 0% head sampling
+        assert router.slo.to_dict()["outcomes"]["ok"] == 1
+
+    def test_failover_tail_kept_at_one_percent_sampling(self):
+        enable_tracing(reset=True)
+        s1 = StreamingStub("a", die_after=3)
+        s2 = StreamingStub("b")
+        router, _ = stub_fleet([s1, s2], trace_sample=0.0)
+        res = router.generate([1, 2, 3], max_new_tokens=6)
+        wf = router.reqtrace.get(res.trace_id)
+        assert wf is not None and wf["kept"] == "tail"
+
+    def test_rendered_over_requesttrace_and_slo_routes(self):
+        router, res, _, _ = self._drill()
+        srv = TelemetryServer(storage=StatsStorage(), port=0)
+        try:
+            srv.attach_reqtrace(router.reqtrace)
+            srv.attach_slo(router.slo)
+            code, idx = _get(f"{srv.url}/requesttrace")
+            assert code == 200
+            assert [t["trace_id"] for t in idx["traces"]] == \
+                [res.trace_id]
+            code, wf = _get(
+                f"{srv.url}/requesttrace?id={res.trace_id}")
+            assert code == 200
+            assert wf["segments"][0]["error"] == "ServerClosedError"
+            assert wf["segments"][1]["kind"] == "resume"
+            code, chrome = _get(
+                f"{srv.url}/requesttrace?id={res.trace_id}&chrome=1")
+            assert code == 200
+            lanes = {e["tid"] for e in chrome["traceEvents"]
+                     if e["ph"] == "X"}
+            assert lanes == {res.trace_id}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{srv.url}/requesttrace?id=999999")
+            assert ei.value.code == 404
+            code, slo = _get(f"{srv.url}/slo")
+            assert code == 200 and slo["source"] == "live"
+            assert slo["slo"]["outcomes"]["ok"] == 1
+        finally:
+            srv.close()
+
+    def test_slo_route_falls_back_to_storage(self):
+        router, _, _, _ = self._drill()
+        storage = StatsStorage()
+        router.publish(storage)
+        srv = TelemetryServer(storage=storage, port=0)
+        try:
+            code, slo = _get(f"{srv.url}/slo")
+            assert code == 200 and slo["source"] == "storage"
+            assert "ttft_ms" in slo["slo"]["objectives"]
+        finally:
+            srv.close()
+
+    def test_replay_segments_reuse_the_trace_id(self, tmp_path):
+        from deeplearning4j_tpu.serving.fleet.durable import \
+            RequestJournal
+        enable_tracing(reset=True)
+        jn = RequestJournal(tmp_path)
+        rid = jn.next_request_id()
+        jn.log_submitted(rid, [1, 2], 4, None,
+                         sampling={"temperature": 0.0})
+        jn.append_token(rid, 2, 100)
+        jn.flush(rid)
+        router, _ = stub_fleet([StreamingStub("a")], journal=jn)
+        results = router.recover()
+        assert list(results) == [rid]
+        wf = router.reqtrace.get(rid)
+        assert wf is not None
+        assert wf["origin"] == "replay"
+        assert wf["segments"][0]["kind"] == "replay"
+        jn.close()
+
+
+# ----------------------------------------------------------------------
+# the /trace?since= incremental drain satellite
+
+class TestTraceSinceRoute:
+    def test_incremental_drain_with_cursor(self):
+        enable_tracing(reset=True)
+        with TRACER.span("window", cat="train", k=1):
+            pass
+        srv = TelemetryServer(port=0)
+        try:
+            code, full = _get(f"{srv.url}/trace")
+            assert code == 200
+            cursor = full["otherData"]["next"]
+            assert cursor == 1 and "dropped" not in full["otherData"]
+            with TRACER.span("step", cat="train", k=1):
+                pass
+            code, inc = _get(f"{srv.url}/trace?since={cursor}")
+            names = [e["name"] for e in inc["traceEvents"]
+                     if e["ph"] == "X"]
+            assert names == ["step"]    # old spans NOT re-downloaded
+            assert inc["otherData"]["next"] == 2
+            assert inc["otherData"]["dropped"] == 0
+            code, empty = _get(f"{srv.url}/trace?since=2")
+            assert [e for e in empty["traceEvents"]
+                    if e["ph"] == "X"] == []
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{srv.url}/trace?since=bogus")
+            assert ei.value.code == 400
+        finally:
+            srv.close()
+
+
+# ----------------------------------------------------------------------
+# record / registry / report plumbing + the loadgen satellite
+
+class TestSloRecordAndPanels:
+    def _router_after_traffic(self):
+        enable_tracing(reset=True)
+        router, _ = stub_fleet([StreamingStub("a"), StreamingStub("b")])
+        gen = FleetLoadGenerator(router.generate, vocab_size=64, seed=3,
+                                 prompt_len=(1, 4), new_tokens=(2, 4))
+        res = gen.run_closed(n_requests=8, concurrency=2)
+        assert res.n_ok == 8
+        return router, res
+
+    def test_fleet_record_grows_slo_subdict(self):
+        router, _ = self._router_after_traffic()
+        rec = router.metrics.to_record()
+        assert rec["type"] == "fleet"           # NO new record type
+        slo = rec["slo"]
+        assert slo["window"] == 8
+        assert set(slo["objectives"]) == {"ttft_ms", "e2e_ms"}
+        for obj in slo["objectives"].values():
+            assert 0.0 <= obj["attainment"] <= 1.0
+            assert obj["p99_ms"] >= obj["p50_ms"] >= 0.0
+
+    def test_registry_folds_slo_gauges(self):
+        router, _ = self._router_after_traffic()
+        reg = MetricsRegistry()
+        reg.fold_fleet(router.metrics)
+        text = reg.to_prometheus_text()
+        assert 'dl4j_fleet_slo_attainment{objective="ttft_ms"}' in text
+        assert 'dl4j_fleet_slo_burn_rate{objective="e2e_ms"}' in text
+        assert 'dl4j_fleet_slo_requests_total{outcome="ok"} 8' in text
+        assert "dl4j_fleet_slo_p99_ms" in text
+
+    def test_report_renders_slo_panel(self):
+        router, _ = self._router_after_traffic()
+        storage = StatsStorage()
+        router.publish(storage)
+        html = render_report(storage)
+        assert "<h3>SLO</h3>" in html
+        assert "burn rate" in html
+        assert "worst sampled traces" in html
+        assert "Request tracing" in html
+
+    def test_loadgen_rows_carry_ttft_breakdown_when_sampled(self):
+        _, res = self._router_after_traffic()
+        ok = [r for r in res.rows if r["outcome"] == "ok"]
+        assert ok and all(isinstance(r["ttft_breakdown"], dict)
+                          for r in ok)
+        assert set(ok[0]["ttft_breakdown"]) == \
+            {"queue_wait_ms", "prefill_ms", "first_decode_ms"}
+        assert res.slo_attainment(60000.0) == 1.0
+        assert res.slo_attainment(60000.0, lane="e2e_ms") == 1.0
+
+    def test_loadgen_breakdown_absent_when_tracing_off(self):
+        disable_tracing()
+        router, _ = stub_fleet([StreamingStub("a")])
+        gen = FleetLoadGenerator(router.generate, vocab_size=64, seed=3,
+                                 prompt_len=(1, 4), new_tokens=(2, 4))
+        res = gen.run_closed(n_requests=4, concurrency=2)
+        assert all(r["ttft_breakdown"] is None for r in res.rows)
+        # ...but the SLO rail still records (host-side counters only)
+        assert router.metrics.to_record()["slo"]["window"] == 4
+
+
+# ----------------------------------------------------------------------
+# the standing contract: tracing must never change the math
+
+class TestBitIdentityOnOff:
+    def _tokens(self, traced):
+        if traced:
+            enable_tracing(reset=True)
+        else:
+            disable_tracing()
+        router, _ = stub_fleet([StreamingStub("a", die_after=3),
+                                StreamingStub("b")])
+        try:
+            return router.generate([1, 2, 3], max_new_tokens=6).tokens
+        finally:
+            disable_tracing()
+
+    def test_router_results_identical_tracing_on_vs_off(self):
+        assert self._tokens(False) == self._tokens(True)
+
+    def test_disabled_rail_is_fully_inert(self):
+        disable_tracing()
+        router, _ = stub_fleet([StreamingStub("a")],
+                               slo=False, reqtrace=False)
+        res = router.generate([1], max_new_tokens=2)
+        assert res.tokens == [100, 101]
+        assert res.ttft_breakdown is None
+        assert router.reqtrace is None and router.slo is None
+        assert "slo" not in router.metrics.to_record()

@@ -241,3 +241,100 @@ class TestTracedPathLint:
         calls = {c for _, c, _ in find_traced_hazards(tree)}
         assert calls == {"time.time", "np.random.normal",
                          "np.random.default_rng()"}
+
+
+# ---------------------------------------------------------------------------
+# 4. span-name lint (ISSUE 20 satellite): every span the package emits
+# must be in monitor.trace.SPAN_CATALOG — waterfall assembly
+# (monitor/reqtrace.py) and the report's lanes key on these literals,
+# so a silent rename would quietly drop a phase from every waterfall.
+
+#: files the span walk skips, with the reason
+SPAN_LINT_SKIP = {
+    "monitor/trace.py":
+        "the tracer machinery itself — SPAN_CATALOG literals and the "
+        "module docstring's span() example, not emission sites",
+}
+
+#: emission shapes: context-manager spans, pre-timed completions, and
+#: the serving tier's _dispatch(disp, io, "<span name>", ...) helper
+#: which forwards its third argument to Tracer.span. ``[^,()]+`` keeps
+#: each argument match inside one call; ``\s`` spans line breaks.
+_SPAN_SITE_PATTERNS = (
+    re.compile(r'\.span\(\s*"([a-z_][a-z_.0-9]*)"'),
+    re.compile(r'record_completed\(\s*"([a-z_][a-z_.0-9]*)"'),
+    re.compile(r'_dispatch\(\s*[^,()]+,\s*[^,()]+,'
+               r'\s*"([a-z_][a-z_.0-9]*)"'),
+)
+
+
+def find_span_names(text: str):
+    """(span_name, lineno) for every span-emission literal in source
+    text, across all three emission shapes."""
+    hits = []
+    for pat in _SPAN_SITE_PATTERNS:
+        for m in pat.finditer(text):
+            hits.append((m.group(1), text[:m.start()].count("\n") + 1))
+    return hits
+
+
+class TestSpanNameLint:
+    def test_every_emitted_span_is_cataloged(self):
+        from deeplearning4j_tpu.monitor.trace import SPAN_CATALOG
+        emitted = {}
+        n_sites = 0
+        for rel, text in _iter_sources():
+            if rel in SPAN_LINT_SKIP:
+                continue
+            for name, lineno in find_span_names(text):
+                n_sites += 1
+                emitted.setdefault(name, []).append(f"{rel}:{lineno}")
+        # the walk sees the oldest (train-tier) and the newest (fleet)
+        # emission sites, through all three shapes
+        assert n_sites > 25, f"span lint walked too few sites ({n_sites})"
+        assert "window" in emitted
+        assert "serving.decode" in emitted       # _dispatch shape
+        assert "compile.backend" in emitted      # record_completed shape
+        assert "fleet.attempt" in emitted        # this PR's span
+        rogue = {n: sites for n, sites in emitted.items()
+                 if n not in SPAN_CATALOG}
+        assert not rogue, (
+            f"span names emitted but missing from monitor.trace."
+            f"SPAN_CATALOG — waterfall assembly and report lanes key on "
+            f"the catalog, so add the name (+ category and arg keys) "
+            f"or revert the rename: {rogue}")
+
+    def test_every_cataloged_span_is_emitted(self):
+        """The other direction: a catalog entry no source emits is a
+        rename that left the catalog behind (assembly would wait for a
+        span that never comes)."""
+        from deeplearning4j_tpu.monitor.trace import SPAN_CATALOG
+        emitted = set()
+        for rel, text in _iter_sources():
+            if rel in SPAN_LINT_SKIP:
+                continue
+            emitted.update(n for n, _ in find_span_names(text))
+        stale = sorted(set(SPAN_CATALOG) - emitted)
+        assert not stale, (
+            f"SPAN_CATALOG entries no source emits (stale after a "
+            f"rename?): {stale}")
+
+    def test_skip_entries_still_exist(self):
+        for rel in SPAN_LINT_SKIP:
+            assert (PKG / rel).exists(), f"stale SPAN_LINT_SKIP: {rel}"
+
+    def test_checker_catches_seeded_violation(self):
+        text = (
+            'with _tracer.span("serving.reply", cat="serving"):\n'
+            "    pass\n"
+            "_tracer.record_completed(\n"
+            '    "compile.trace", cat="compile", dur=1.0)\n'
+            "out = self._dispatch(self._decode_disp, io,\n"
+            '                     "serving.decode", active=n)\n'
+            'with _tracer.span("bogus.name", cat="x"):\n'
+            "    pass\n")
+        names = {n for n, _ in find_span_names(text)}
+        assert names == {"serving.reply", "compile.trace",
+                         "serving.decode", "bogus.name"}
+        from deeplearning4j_tpu.monitor.trace import SPAN_CATALOG
+        assert "bogus.name" not in SPAN_CATALOG
